@@ -1,0 +1,68 @@
+"""Iterative distributed computing example.
+
+The framework's equivalent of the reference's
+examples/iterative_example.jl:1-89 (BASELINE config 1): a coordinator
+broadcasts a byte payload to a pool of workers, returns as soon as the
+single fastest worker responds (``nwait=1``), prints whatever fresh
+results arrived, and repeats for 10 epochs. Worker delays here are
+deterministic per (worker, epoch) instead of the reference's
+``sleep(rand())`` (examples/iterative_example.jl:74), so runs are
+reproducible.
+
+Run:  python examples/iterative_example.py [nworkers]
+"""
+
+import socket
+import sys
+
+import numpy as np
+
+from mpistragglers_jl_tpu import AsyncPool, LocalBackend, asyncmap, waitall
+
+COORDINATOR_TX_BYTES = 100
+WORKER_TX_BYTES = 100
+
+
+def worker_compute(i: int, payload: np.ndarray, epoch: int) -> np.ndarray:
+    """Receive -> compute -> reply, the reference worker_main loop body
+    (examples/iterative_example.jl:68-81) as a plain function."""
+    recs = payload.tobytes().rstrip(b"\x00").decode()
+    print(f"[worker {i}]\t\treceived from coordinator\t{recs}")
+    reply = f"hello from worker {i} on {socket.gethostname()}, epoch {epoch}"
+    out = np.zeros(WORKER_TX_BYTES, dtype=np.uint8)
+    b = reply.encode()[:WORKER_TX_BYTES]
+    out[: len(b)] = np.frombuffer(b, dtype=np.uint8)
+    return out
+
+
+def coordinator_main(nworkers: int) -> None:
+    # deterministic straggling: worker w stalls (w+1)*20 ms at every epoch,
+    # so worker 0 always wins the nwait=1 race
+    delay_fn = lambda i, epoch: 0.020 * (i + 1)
+    backend = LocalBackend(worker_compute, nworkers, delay_fn=delay_fn)
+    pool = AsyncPool(nworkers)
+
+    recvbuf = np.zeros(nworkers * WORKER_TX_BYTES, dtype=np.uint8)
+    sendbuf = np.zeros(COORDINATOR_TX_BYTES, dtype=np.uint8)
+    recvbufs = recvbuf.reshape(nworkers, WORKER_TX_BYTES)
+
+    for epoch in range(1, 11):
+        msg = f"hello from coordinator on {socket.gethostname()}, epoch {epoch}"
+        sendbuf[:] = 0
+        b = msg.encode()[:COORDINATOR_TX_BYTES]
+        sendbuf[: len(b)] = np.frombuffer(b, dtype=np.uint8)
+        repochs = asyncmap(pool, sendbuf, backend, recvbuf, epoch=epoch, nwait=1)
+        for i in range(nworkers):
+            if repochs[i] == epoch:
+                recs = recvbufs[i].tobytes().rstrip(b"\x00").decode()
+                print(f"[coordinator]\t\treceived from worker {i}:\t\t{recs}")
+
+    # drain stragglers, then signal all workers to close
+    # (the reference's control-channel broadcast + MPI.Barrier)
+    waitall(pool, backend, recvbuf, timeout=5.0)
+    backend.shutdown()
+    print(f"done: latency per worker = {np.round(pool.latency, 3).tolist()}")
+
+
+if __name__ == "__main__":
+    coordinator_main(int(sys.argv[1]) if len(sys.argv) > 1 else 4)

@@ -1,0 +1,25 @@
+"""TPU-native straggler-resilient async worker pools.
+
+A from-scratch re-design of the reference MPIAsyncPools.jl
+(severinson/MPIStragglers.jl) for JAX/XLA device meshes: a coordinator
+broadcasts work to a pool of n workers and returns as soon as the ``nwait``
+fastest respond (or an arbitrary predicate over per-worker receive-epochs
+holds), with stale results harvested and re-tasked across epochs — the
+primitive under erasure-coded GEMM and gradient-coded SGD that decode from
+any k-of-n shards.
+"""
+
+from .pool import AsyncPool, asyncmap, waitall, DeadWorkerError
+from .backends import Backend, LocalBackend, WorkerFailure
+
+__all__ = [
+    "AsyncPool",
+    "asyncmap",
+    "waitall",
+    "DeadWorkerError",
+    "Backend",
+    "LocalBackend",
+    "WorkerFailure",
+]
+
+__version__ = "0.1.0"

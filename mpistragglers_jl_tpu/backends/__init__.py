@@ -1,0 +1,4 @@
+from .base import Backend, SlotBackend
+from .local import LocalBackend, WorkerFailure
+
+__all__ = ["Backend", "SlotBackend", "LocalBackend", "WorkerFailure"]

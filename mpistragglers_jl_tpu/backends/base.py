@@ -1,0 +1,151 @@
+"""Backend protocol: the transport layer under :mod:`..pool`.
+
+A backend plays the role the ``comm: MPI.Comm`` argument plays in the
+reference (src/MPIAsyncPools.jl:68): it owns the in-flight request state
+(the reference's ``sreqs``/``rreqs`` vectors, src/MPIAsyncPools.jl:26-27)
+and provides the completion primitives the pool's three phases need:
+
+==============  =========================================================
+pool phase       backend primitive        reference analog
+==============  =========================================================
+phase 1 drain    ``test(i)``              ``MPI.Test!`` (:99)
+phase 2 send     ``dispatch(i, ...)``     ``MPI.Isend``/``Irecv!`` (:137-138)
+phase 3 wait     ``wait_any(indices)``    ``MPI.Waitany!`` (:161)
+waitall          ``wait(i, timeout)``     ``MPI.Waitall!`` (:212)
+shutdown         ``shutdown()``           control-channel broadcast
+                                          (test/kmap2.jl:14-18)
+==============  =========================================================
+
+:class:`SlotBackend` is a shared implementation skeleton: one *slot* per
+worker holding at most one outstanding task (the pool's ``active`` flag
+discipline guarantees single occupancy), a completion event per slot, and
+a condition variable notified on every completion so ``wait_any`` can
+sleep instead of spinning. Subclasses only implement how a task actually
+runs (thread compute, XLA device dispatch, ...).
+"""
+
+from __future__ import annotations
+
+import threading
+from abc import ABC, abstractmethod
+from typing import Sequence
+
+
+class Backend(ABC):
+    """Minimal transport interface consumed by ``asyncmap``/``waitall``."""
+
+    n_workers: int
+
+    @abstractmethod
+    def dispatch(self, i: int, sendbuf, epoch: int, *, tag: int = 0) -> None:
+        """Start asynchronous work on worker ``i`` with a *snapshot* of
+        ``sendbuf`` (the reference's ``isendbufs[i] .= sendbuf`` discipline,
+        src/MPIAsyncPools.jl:130 — here the backend owns the snapshot)."""
+
+    @abstractmethod
+    def test(self, i: int):
+        """Non-blocking completion probe. Returns the result exactly once
+        if worker ``i`` has completed, else None (``MPI.Test!``)."""
+
+    @abstractmethod
+    def wait_any(self, indices: Sequence[int]) -> tuple[int, object]:
+        """Block until any worker in ``indices`` completes; return
+        ``(i, result)`` (``MPI.Waitany!``)."""
+
+    @abstractmethod
+    def wait(self, i: int, timeout: float | None = None):
+        """Block until worker ``i`` completes; return its result, or None
+        on timeout (building block for ``MPI.Waitall!``-style drains)."""
+
+    def shutdown(self) -> None:  # pragma: no cover - default no-op
+        """Release worker resources (the reference's control-channel
+        shutdown broadcast, examples/iterative_example.jl:50-52)."""
+
+
+class _Slot:
+    """One in-flight task slot. At most one outstanding task per worker."""
+
+    __slots__ = ("seq", "done", "result", "outstanding")
+
+    def __init__(self):
+        self.seq = 0  # dispatch sequence number, guards late completions
+        self.done = False
+        self.result = None
+        self.outstanding = False
+
+
+class SlotBackend(Backend):
+    """Completion-event machinery shared by concrete backends."""
+
+    def __init__(self, n_workers: int):
+        self.n_workers = int(n_workers)
+        self._slots = [_Slot() for _ in range(self.n_workers)]
+        self._cond = threading.Condition()
+
+    # -- subclass surface -------------------------------------------------
+    @abstractmethod
+    def _start(self, i: int, sendbuf, epoch: int, seq: int, tag: int) -> None:
+        """Begin asynchronous execution; must eventually call
+        ``self._complete(i, seq, result)`` from any thread."""
+
+    # -- completion plumbing ---------------------------------------------
+    def _complete(self, i: int, seq: int, result) -> None:
+        with self._cond:
+            slot = self._slots[i]
+            if slot.seq != seq or not slot.outstanding:
+                return  # stale completion from a superseded dispatch
+            slot.result = result
+            slot.done = True
+            self._cond.notify_all()
+
+    def _take(self, slot: _Slot):
+        result = slot.result
+        slot.result = None
+        slot.done = False
+        slot.outstanding = False
+        return result
+
+    # -- Backend interface ------------------------------------------------
+    def dispatch(self, i: int, sendbuf, epoch: int, *, tag: int = 0) -> None:
+        with self._cond:
+            slot = self._slots[i]
+            if slot.outstanding:
+                raise RuntimeError(
+                    f"worker {i} already has an outstanding task; the pool "
+                    "must only dispatch to inactive workers"
+                )
+            slot.seq += 1
+            slot.done = False
+            slot.result = None
+            slot.outstanding = True
+            seq = slot.seq
+        self._start(i, sendbuf, epoch, seq, tag)
+
+    def test(self, i: int):
+        with self._cond:
+            slot = self._slots[i]
+            if slot.outstanding and slot.done:
+                return self._take(slot)
+            return None
+
+    def wait_any(self, indices: Sequence[int]) -> tuple[int, object]:
+        idx = [int(i) for i in indices]
+        if not idx:
+            raise ValueError("wait_any over an empty index set would hang")
+        with self._cond:
+            while True:
+                for i in idx:
+                    slot = self._slots[i]
+                    if slot.outstanding and slot.done:
+                        return i, self._take(slot)
+                self._cond.wait()
+
+    def wait(self, i: int, timeout: float | None = None):
+        with self._cond:
+            slot = self._slots[i]
+            if not slot.outstanding:
+                raise RuntimeError(f"worker {i} has no outstanding task")
+            ok = self._cond.wait_for(lambda: slot.done, timeout=timeout)
+            if not ok:
+                return None
+            return self._take(slot)

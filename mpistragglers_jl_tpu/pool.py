@@ -1,0 +1,323 @@
+"""Core pool state machine and async scatter/gather with fastest-k return.
+
+This module is the TPU-native re-design of the reference library's entire L2
+layer (reference: src/MPIAsyncPools.jl:24-224). The behavioral contract is
+identical — per-worker epoch bookkeeping, fastest-k or predicate-driven
+return, stale-result harvesting with immediate re-tasking, and a quiescence
+barrier — but the transport is abstracted behind a :class:`Backend` (the
+analog of the ``comm: MPI.Comm`` argument, reference src/MPIAsyncPools.jl:68)
+so the same pool drives thread workers, single-host XLA devices, or a
+multi-chip TPU mesh.
+
+Key design departures from the reference, all TPU-motivated:
+
+* **No caller-managed ``isendbuf``/``irecvbuf``.** The reference needs a
+  private per-worker snapshot of ``sendbuf`` so in-flight MPI sends survive
+  caller mutation (src/MPIAsyncPools.jl:63-66, :130). JAX arrays are
+  immutable and ``jax.device_put`` snapshots by construction, so the
+  snapshot discipline is owned by the backend, not the caller.
+* **Results may stay on device.** ``recvbuf`` is optional; when omitted,
+  per-worker results are retained as (possibly device-resident) arrays in
+  ``pool.results`` so a decode/combine step can consume them without a
+  host round-trip. When provided, ``recvbuf`` is partitioned into
+  ``n_workers`` equal chunks exactly like ``MPI.Gather!``
+  (src/MPIAsyncPools.jl:58-61) and arrivals are copied into their chunk.
+* **The hot wait loop** (reference ``MPI.Waitany!``, src/MPIAsyncPools.jl:161)
+  becomes host-side polling of per-dispatch completion events / JAX array
+  readiness — see backends.
+
+State-machine invariants preserved exactly (reference §2.1 semantics):
+
+* each ``asyncmap`` call *is* an epoch; default ``epoch = pool.epoch + 1``
+  but any value may be passed (src/MPIAsyncPools.jl:68, :87);
+* ``repochs[i] == epoch0`` means "never heard from worker i"
+  (src/MPIAsyncPools.jl:39; test/kmap2.jl:42-44);
+* with integer ``nwait``, only phase-3 arrivals stamped with the *current*
+  epoch count toward completion (src/MPIAsyncPools.jl:173-176); stale
+  arrivals are written to ``recvbuf``, stamped in ``repochs``, and the
+  worker is immediately re-tasked with the current payload and stays
+  active (src/MPIAsyncPools.jl:177-184);
+* a functional ``nwait`` is evaluated as ``nwait(epoch, repochs)`` before
+  the first wait and after every arrival, over the live ``repochs``
+  (src/MPIAsyncPools.jl:148-158);
+* after ``waitall``, no worker is active (src/MPIAsyncPools.jl:193).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Sequence, Union
+
+import numpy as np
+
+from .backends.base import Backend
+
+NwaitArg = Union[int, Callable[[int, np.ndarray], bool]]
+
+__all__ = ["AsyncPool", "asyncmap", "waitall"]
+
+
+class AsyncPool:
+    """Bookkeeping for a pool of potentially-straggling workers.
+
+    Mirrors the reference ``MPIAsyncPool`` struct field-for-field
+    (src/MPIAsyncPools.jl:24-46) minus the MPI request handles, which live
+    in the backend:
+
+    ``ranks``        worker ids managed by the pool; pool index ``i`` maps
+                     to ``ranks[i]`` and ``recvbuf`` chunk order is *pool*
+                     order, not rank order.
+    ``sepochs[i]``   epoch at which the in-flight dispatch to worker ``i``
+                     was initiated.
+    ``repochs[i]``   epoch of the most recently received result — the
+                     freshness oracle returned to callers.
+    ``active[i]``    True iff worker ``i`` has an outstanding task.
+    ``stimestamps``  perf-counter ns at dispatch.
+    ``latency[i]``   last measured round-trip seconds per worker.
+    ``nwait``        default wait-count (ctor kwarg, default ``n``).
+    ``epoch``        current epoch, starts at ``epoch0``.
+
+    Construction performs no communication; the pool is lazy like the
+    reference (first backend activity happens inside the first
+    ``asyncmap`` — reference §3.4).
+    """
+
+    def __init__(
+        self,
+        ranks: Union[int, Sequence[int]],
+        *,
+        epoch0: int = 0,
+        nwait: int | None = None,
+    ):
+        if isinstance(ranks, (int, np.integer)):
+            # convenience form, reference src/MPIAsyncPools.jl:46 (ranks 1:n
+            # there; 0-based 0:n here, idiomatic for device indices)
+            ranks = list(range(int(ranks)))
+        self.ranks: list[int] = [int(r) for r in ranks]
+        if len(set(self.ranks)) != len(self.ranks):
+            raise ValueError(f"ranks must be unique, got {self.ranks}")
+        n = len(self.ranks)
+        if nwait is None:
+            nwait = n
+        if not (0 <= int(nwait) <= n):
+            raise ValueError(f"default nwait must be in [0, {n}], got {nwait}")
+        self.sepochs = np.full(n, epoch0, dtype=np.int64)
+        self.repochs = np.full(n, epoch0, dtype=np.int64)
+        self.active = np.zeros(n, dtype=bool)
+        self.stimestamps = np.zeros(n, dtype=np.int64)
+        self.latency = np.zeros(n, dtype=np.float64)
+        self.nwait = int(nwait)
+        self.epoch = int(epoch0)
+        self.epoch0 = int(epoch0)
+        # most recently received result object per worker (device array or
+        # ndarray); None = never received. TPU-native addition: lets decode
+        # steps consume device-resident shards without a recvbuf copy.
+        self.results: list = [None] * n
+
+    @property
+    def n_workers(self) -> int:
+        return len(self.ranks)
+
+    def __repr__(self) -> str:
+        return (
+            f"AsyncPool(n={self.n_workers}, epoch={self.epoch}, "
+            f"nwait={self.nwait}, active={int(self.active.sum())})"
+        )
+
+
+def _recv_chunks(recvbuf: np.ndarray | None, n: int) -> list[np.ndarray] | None:
+    """Partition ``recvbuf`` into n equal chunks, ``MPI.Gather!`` layout.
+
+    Reference: byte-view partitioning at src/MPIAsyncPools.jl:80-84. We
+    slice the flat element view rather than a byte reinterpretation; the
+    chunk-j <- worker-j correspondence is the same.
+    """
+    if recvbuf is None:
+        return None
+    if not isinstance(recvbuf, np.ndarray):
+        raise TypeError("recvbuf must be a numpy ndarray (host gather arena)")
+    if recvbuf.dtype == object:
+        raise TypeError("recvbuf eltype must be a fixed-size dtype")
+    if recvbuf.size % n != 0:
+        # reference src/MPIAsyncPools.jl:77
+        raise ValueError(
+            f"recvbuf length {recvbuf.size} must be a multiple of the "
+            f"number of workers {n}"
+        )
+    flat = recvbuf.reshape(-1)
+    rl = recvbuf.size // n
+    return [flat[i * rl : (i + 1) * rl] for i in range(n)]
+
+
+def _store(
+    pool: AsyncPool, i: int, result, recvbufs: list[np.ndarray] | None
+) -> None:
+    """Harvest one arrival: latency, result store, epoch stamp.
+
+    Reference: the arrival block repeated at src/MPIAsyncPools.jl:104-110,
+    :163-168 and :215-218.
+    """
+    pool.latency[i] = (time.perf_counter_ns() - pool.stimestamps[i]) / 1e9
+    pool.results[i] = result
+    if recvbufs is not None:
+        chunk = recvbufs[i]
+        arr = np.asarray(result).reshape(-1)
+        if arr.size != chunk.size:
+            raise ValueError(
+                f"worker {i} returned {arr.size} elements but recvbuf "
+                f"chunks hold {chunk.size}"
+            )
+        chunk[:] = arr.astype(chunk.dtype, copy=False)
+    pool.repochs[i] = pool.sepochs[i]
+
+
+def _dispatch(pool: AsyncPool, backend: Backend, i: int, sendbuf, tag: int) -> None:
+    """Dispatch current payload to worker i and mark it active.
+
+    Reference: dispatch block at src/MPIAsyncPools.jl:126-138 (and the
+    re-task copy at :178-183). The payload snapshot the reference does via
+    ``isendbufs[i] .= sendbuf`` (:130) is the backend's responsibility here.
+    """
+    pool.active[i] = True
+    pool.sepochs[i] = pool.epoch
+    pool.stimestamps[i] = time.perf_counter_ns()
+    backend.dispatch(i, sendbuf, pool.epoch, tag=tag)
+
+
+def asyncmap(
+    pool: AsyncPool,
+    sendbuf,
+    backend: Backend,
+    recvbuf: np.ndarray | None = None,
+    *,
+    nwait: NwaitArg | None = None,
+    epoch: int | None = None,
+    tag: int = 0,
+) -> np.ndarray:
+    """Broadcast ``sendbuf`` to all idle workers; wait for the fastest few.
+
+    Dispatches the payload asynchronously to every idle worker, then blocks
+    until either ``nwait`` workers have responded with results *from this
+    epoch* (integer ``nwait``) or ``nwait(epoch, repochs)`` evaluates True
+    (callable ``nwait``). Late results from earlier epochs are harvested
+    opportunistically; workers that return stale results are immediately
+    re-tasked with the current payload. Returns ``pool.repochs`` — the
+    per-worker freshness mask (``repochs[i] == epoch`` iff chunk ``i`` is
+    from this epoch), which is exactly the arrival mask an erasure decoder
+    needs to select any-k-of-n shards.
+
+    Reference semantics: ``Base.asyncmap!`` at src/MPIAsyncPools.jl:68-188;
+    docstring contract :48-67; the returned array aliases ``pool.repochs``
+    like the reference (:187) — callers must copy if they retain it across
+    epochs (test/kmap2.jl relies on reading it before the next call).
+    """
+    n = pool.n_workers
+    if nwait is None:
+        nwait = pool.nwait
+    if epoch is None:
+        epoch = pool.epoch + 1
+    if isinstance(nwait, (int, np.integer)):
+        if not (0 <= nwait <= n):
+            # reference src/MPIAsyncPools.jl:71
+            raise ValueError(f"nwait must be in [0, {n}], got {nwait}")
+    elif not callable(nwait):
+        # reference src/MPIAsyncPools.jl:157
+        raise TypeError(f"nwait must be an int or callable, got {type(nwait)}")
+    recvbufs = _recv_chunks(recvbuf, n)
+
+    # each call to asyncmap is the start of a new epoch
+    # (reference src/MPIAsyncPools.jl:87)
+    pool.epoch = int(epoch)
+
+    # PHASE 1 — opportunistic, non-blocking drain of results that arrived
+    # since the last call, to keep iterations independent
+    # (reference src/MPIAsyncPools.jl:91-114).
+    for i in range(n):
+        if not pool.active[i]:
+            continue
+        result = backend.test(i)
+        if result is None:
+            continue
+        _store(pool, i, result, recvbufs)
+        pool.active[i] = False
+
+    # PHASE 2 — dispatch to every idle worker; all workers are active after
+    # this loop (reference src/MPIAsyncPools.jl:118-139).
+    for i in range(n):
+        if pool.active[i]:
+            continue
+        _dispatch(pool, backend, i, sendbuf, tag)
+
+    # PHASE 3 — collect until satisfied: the hot loop
+    # (reference src/MPIAsyncPools.jl:145-185). Only arrivals stamped with
+    # the current epoch count toward integer-nwait completion; stale
+    # arrivals trigger an immediate re-task and the worker stays active.
+    nrecv = 0
+    while True:
+        if callable(nwait):
+            if bool(nwait(pool.epoch, pool.repochs)):
+                break
+        else:
+            if nrecv >= nwait:
+                break
+        # block until any active worker responds
+        # (reference MPI.Waitany! at src/MPIAsyncPools.jl:161)
+        i, result = backend.wait_any(np.flatnonzero(pool.active))
+        _store(pool, i, result, recvbufs)
+        if pool.repochs[i] == pool.epoch:
+            nrecv += 1
+            pool.active[i] = False
+        else:
+            _dispatch(pool, backend, i, sendbuf, tag)
+
+    return pool.repochs
+
+
+def waitall(
+    pool: AsyncPool,
+    backend: Backend,
+    recvbuf: np.ndarray | None = None,
+    *,
+    timeout: float | None = None,
+) -> np.ndarray:
+    """Drain the pool: block until every active worker has responded.
+
+    All workers are inactive on return (reference ``waitall!`` at
+    src/MPIAsyncPools.jl:190-224; quiescence asserted in test/kmap2.jl:60).
+
+    ``timeout`` (seconds) is a new capability the reference lacks (its
+    ``MPI.Waitall!`` would hang forever on a dead worker — SURVEY §5):
+    if the pool does not quiesce in time, a :class:`DeadWorkerError` is
+    raised naming the workers that never responded.
+    """
+    n = pool.n_workers
+    recvbufs = _recv_chunks(recvbuf, n)
+    if not pool.active.any():
+        return pool.repochs
+    deadline = None if timeout is None else time.perf_counter() + timeout
+    for i in list(np.flatnonzero(pool.active)):
+        remaining = None if deadline is None else deadline - time.perf_counter()
+        result = backend.wait(i, timeout=remaining)
+        if result is None:
+            dead = [int(j) for j in np.flatnonzero(pool.active)]
+            raise DeadWorkerError(dead, timeout)
+        _store(pool, i, result, recvbufs)
+        pool.active[i] = False
+    return pool.repochs
+
+
+class DeadWorkerError(TimeoutError):
+    """Raised by :func:`waitall` when workers fail to respond in time.
+
+    The reference has no failure detection: a dead worker is
+    indistinguishable from an infinite straggler and ``waitall!`` hangs
+    (SURVEY §5 'Failure detection'). ``dead`` lists the pool indices that
+    were still active at the deadline.
+    """
+
+    def __init__(self, dead: list[int], timeout: float | None):
+        self.dead = dead
+        self.timeout = timeout
+        super().__init__(
+            f"workers {dead} did not respond within {timeout} s"
+        )

@@ -1,0 +1,281 @@
+"""Behavioral checklist for the core pool on the thread backend.
+
+Mirrors the reference's distributed test scenarios (SURVEY §4) as fast
+in-process unit tests — the fake backend the reference never had — plus
+the edges the reference leaves untested (epoch0 != 0, non-contiguous
+ranks, validation errors, multiple dtypes, deterministic stragglers).
+
+Reference scenarios reproduced:
+* full gather with nwait=n, each worker's payload in its own chunk
+  (test/kmap1.jl:20-22)
+* fastest-k over 100 epochs with nwait=2 of 3: >= 2 fresh responses per
+  epoch and epoch-echo integrity (test/kmap2.jl:32-54)
+* waitall quiescence (test/kmap2.jl:57-61)
+* functional nwait predicate waiting on a specific worker + latency
+  accuracy vs wall-clock (test/kmap2.jl:63-72)
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import (
+    AsyncPool,
+    LocalBackend,
+    WorkerFailure,
+    asyncmap,
+    waitall,
+)
+from mpistragglers_jl_tpu.pool import DeadWorkerError
+
+
+def echo_worker(i, payload, epoch):
+    """Workers echo [rank, payload[0], epoch] — the reference's result
+    message layout [rank, t, epoch] (test/kmap2.jl:92-94)."""
+    return np.array([float(i + 1), float(payload[0]), float(epoch)])
+
+
+def make(n=3, *, delay_fn=None, work_fn=echo_worker, **pool_kw):
+    backend = LocalBackend(work_fn, n, delay_fn=delay_fn)
+    pool = AsyncPool(n, **pool_kw)
+    return pool, backend
+
+
+def test_full_gather_nwait_n():
+    # kmap1 scenario: one round, nwait = n, every chunk lands in pool order
+    n = 3
+    pool, backend = make(n, work_fn=lambda i, p, e: np.array([i + 1.0]))
+    sendbuf = np.array([3.14])
+    recvbuf = np.zeros(n)
+    repochs = asyncmap(pool, sendbuf, backend, recvbuf, nwait=n)
+    assert np.allclose(recvbuf, np.arange(1, n + 1))
+    assert list(repochs) == [1] * n
+    backend.shutdown()
+
+
+def test_fastest_k_and_epoch_echo():
+    # kmap2 scenario 1: 100 epochs, nwait=2 of 3, deterministic stragglers
+    n = 3
+    # worker 2 is a persistent straggler: 30 ms vs 1 ms for the others
+    delay_fn = lambda i, e: 0.030 if i == 2 else 0.001
+    pool, backend = make(n, delay_fn=delay_fn)
+    sendbuf = np.zeros(1)
+    recvbuf = np.zeros(3 * n)
+    for epoch in range(1, 101):
+        sendbuf[0] = epoch
+        repochs = asyncmap(pool, sendbuf, backend, recvbuf, nwait=2)
+        chunks = recvbuf.reshape(n, 3)
+        fresh = 0
+        for i in range(n):
+            if repochs[i] == 0:
+                continue  # never heard from worker i
+            if repochs[i] == epoch:
+                fresh += 1
+            # echo integrity: the epoch a worker echoes equals repochs[i]
+            assert chunks[i][2] == repochs[i]
+        assert fresh >= 2
+    waitall(pool, backend, recvbuf)
+    backend.shutdown()
+
+
+def test_stale_results_are_harvested_and_retasked():
+    # drive the stale path deterministically: worker 2 always misses the
+    # epoch deadline, so each later epoch first harvests its stale result
+    # (written to recvbuf, stamped in repochs) and re-tasks it
+    n = 3
+    delay_fn = lambda i, e: 0.040 if i == 2 else 0.005
+    pool, backend = make(n, delay_fn=delay_fn)
+    sendbuf = np.zeros(1)
+    recvbuf = np.zeros(3 * n)
+    saw_stale = False
+    for epoch in range(1, 21):
+        sendbuf[0] = epoch
+        repochs = asyncmap(pool, sendbuf, backend, recvbuf, nwait=2)
+        if 0 < repochs[2] < epoch:
+            saw_stale = True
+            # stale payload still written into recvbuf chunk 2, and the
+            # chunk's embedded epoch matches repochs (freshness mask is
+            # authoritative, recvbuf may mix epochs)
+            assert recvbuf.reshape(n, 3)[2][2] == repochs[2]
+        assert pool.active[2]  # straggler was re-tasked, stays active
+    assert saw_stale
+    waitall(pool, backend, recvbuf)
+    backend.shutdown()
+
+
+def test_waitall_quiescence():
+    # kmap2 scenario 2: after waitall, no worker is active — 100 rounds
+    n = 3
+    delay_fn = lambda i, e: 0.001 * (i + 1)
+    pool, backend = make(n, delay_fn=delay_fn)
+    sendbuf = np.zeros(1)
+    recvbuf = np.zeros(3 * n)
+    for epoch in range(1, 101):
+        sendbuf[0] = epoch
+        asyncmap(pool, sendbuf, backend, recvbuf, nwait=1)
+        repochs = waitall(pool, backend, recvbuf)
+        assert not pool.active.any()
+        assert list(repochs) == [epoch] * n  # everyone answered this epoch
+    backend.shutdown()
+
+
+def test_functional_nwait_and_latency_accuracy():
+    # kmap2 scenario 3: predicate waits for worker 0 specifically; measured
+    # latency of that worker ~= wall-clock of the call (atol 1e-3 in the
+    # reference; we allow 5 ms for thread scheduling jitter)
+    n = 3
+    delay_fn = lambda i, e: 0.010 if i == 0 else 0.001
+    pool, backend = make(n, delay_fn=delay_fn)
+    sendbuf = np.zeros(1)
+    recvbuf = np.zeros(3 * n)
+    pred = lambda epoch, repochs: repochs[0] == epoch
+    for epoch in range(101, 201):
+        sendbuf[0] = epoch
+        t0 = time.perf_counter()
+        repochs = asyncmap(pool, sendbuf, backend, recvbuf, nwait=pred)
+        delay = time.perf_counter() - t0
+        assert repochs[0] == pool.epoch
+        assert abs(delay - pool.latency[0]) < 5e-3
+    waitall(pool, backend, recvbuf)
+    backend.shutdown()
+
+
+def test_nwait_zero_returns_immediately():
+    n = 3
+    pool, backend = make(n, delay_fn=lambda i, e: 0.05)
+    recvbuf = np.zeros(3 * n)
+    t0 = time.perf_counter()
+    repochs = asyncmap(pool, np.zeros(1), backend, recvbuf, nwait=0)
+    assert time.perf_counter() - t0 < 0.04
+    assert list(repochs) == [0] * n  # nobody has ever answered
+    assert pool.active.all()
+    waitall(pool, backend, recvbuf)
+    backend.shutdown()
+
+
+def test_epoch0_nonzero_and_custom_epoch():
+    # reference edge never tested: epoch0 != 0 and caller-supplied epochs
+    n = 2
+    pool, backend = make(n, epoch0=7)
+    assert pool.epoch == 7
+    assert list(pool.repochs) == [7, 7]  # "never heard" sentinel is epoch0
+    recvbuf = np.zeros(3 * n)
+    repochs = asyncmap(pool, np.zeros(1), backend, recvbuf, epoch=42, nwait=n)
+    assert pool.epoch == 42
+    assert list(repochs) == [42] * n
+    backend.shutdown()
+
+
+def test_noncontiguous_ranks():
+    # MPIAsyncPool([1, 4, 5]) appears only in reference docs
+    # (src/MPIAsyncPools.jl:21); recvbuf chunk order is pool order
+    pool = AsyncPool([1, 4, 5])
+    assert pool.ranks == [1, 4, 5]
+    assert pool.n_workers == 3
+    backend = LocalBackend(lambda i, p, e: np.array([10.0 + i]), 3)
+    recvbuf = np.zeros(3)
+    asyncmap(pool, np.zeros(1), backend, recvbuf, nwait=3)
+    assert np.allclose(recvbuf, [10.0, 11.0, 12.0])
+    backend.shutdown()
+
+
+def test_validation_errors():
+    pool, backend = make(3)
+    recvbuf = np.zeros(9)
+    with pytest.raises(ValueError):  # nwait out of range (ref :71)
+        asyncmap(pool, np.zeros(1), backend, recvbuf, nwait=4)
+    with pytest.raises(ValueError):
+        asyncmap(pool, np.zeros(1), backend, recvbuf, nwait=-1)
+    with pytest.raises(TypeError):  # nwait wrong type (ref :157)
+        asyncmap(pool, np.zeros(1), backend, recvbuf, nwait="3")
+    with pytest.raises(ValueError):  # recvbuf not divisible by n (ref :77)
+        asyncmap(pool, np.zeros(1), backend, np.zeros(10), nwait=3)
+    with pytest.raises(TypeError):  # object dtype rejected (ref isbits :73)
+        asyncmap(pool, np.zeros(1), backend,
+                 np.empty(3, dtype=object), nwait=3)
+    with pytest.raises(ValueError):  # default nwait out of range
+        AsyncPool(3, nwait=5)
+    with pytest.raises(ValueError):  # duplicate ranks
+        AsyncPool([1, 1, 2])
+    backend.shutdown()
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32, np.uint8])
+def test_multiple_dtypes(dtype):
+    # reference tests only exercise Float64 (+ UInt8 in the example)
+    n = 4
+    backend = LocalBackend(
+        lambda i, p, e: (p + i).astype(dtype), n)
+    pool = AsyncPool(n)
+    sendbuf = np.arange(5, dtype=dtype)
+    recvbuf = np.zeros(5 * n, dtype=dtype)
+    asyncmap(pool, sendbuf, backend, recvbuf, nwait=n)
+    for i in range(n):
+        assert np.array_equal(
+            recvbuf.reshape(n, 5)[i], (sendbuf + i).astype(dtype))
+    backend.shutdown()
+
+
+def test_sendbuf_snapshot_discipline():
+    # in-flight dispatch must survive caller mutation of sendbuf
+    # (the reference's isendbuf copy, src/MPIAsyncPools.jl:63-66,:130)
+    n = 2
+    pool, backend = make(n, delay_fn=lambda i, e: 0.02,
+                         work_fn=lambda i, p, e: p.copy())
+    sendbuf = np.array([1.0])
+    recvbuf = np.zeros(n)
+    # dispatch, then immediately clobber sendbuf before workers compute
+    import threading
+
+    def clobber():
+        time.sleep(0.005)
+        sendbuf[0] = -999.0
+
+    t = threading.Thread(target=clobber)
+    t.start()
+    asyncmap(pool, sendbuf, backend, recvbuf, nwait=n)
+    t.join()
+    assert np.allclose(recvbuf, [1.0, 1.0])
+    backend.shutdown()
+
+
+def test_worker_exception_surfaces_on_harvest():
+    n = 2
+
+    def flaky(i, p, e):
+        if i == 1:
+            raise RuntimeError("boom")
+        return np.zeros(1)
+
+    backend = LocalBackend(flaky, n)
+    pool = AsyncPool(n)
+    recvbuf = np.zeros(n)
+    with pytest.raises(WorkerFailure):
+        asyncmap(pool, np.zeros(1), backend, recvbuf, nwait=n)
+    backend.shutdown()
+
+
+def test_waitall_timeout_detects_dead_worker():
+    # new capability: the reference's waitall! hangs forever on a dead
+    # worker (SURVEY §5 failure detection)
+    n = 2
+    delay_fn = lambda i, e: 10.0 if i == 1 else 0.0
+    pool, backend = make(n, delay_fn=delay_fn)
+    recvbuf = np.zeros(3 * n)
+    asyncmap(pool, np.zeros(1), backend, recvbuf, nwait=1)
+    with pytest.raises(DeadWorkerError) as ei:
+        waitall(pool, backend, recvbuf, timeout=0.05)
+    assert 1 in ei.value.dead
+    backend.shutdown()
+
+
+def test_results_stay_available_without_recvbuf():
+    # TPU-native path: no recvbuf arena, results kept per-worker
+    n = 3
+    pool, backend = make(n)
+    repochs = asyncmap(pool, np.array([5.0]), backend, nwait=n)
+    assert list(repochs) == [1] * n
+    for i in range(n):
+        assert pool.results[i][1] == 5.0
+    backend.shutdown()
