@@ -19,7 +19,18 @@ __all__ = [
     "DeadWorkerError",
     "Backend",
     "LocalBackend",
+    "XLADeviceBackend",
     "WorkerFailure",
 ]
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # lazy: keep `import mpistragglers_jl_tpu` jax-free for
+    # LocalBackend-only (pure numpy) use
+    if name == "XLADeviceBackend":
+        from .backends.xla import XLADeviceBackend
+
+        return XLADeviceBackend
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,4 +1,21 @@
-from .base import Backend, SlotBackend
-from .local import LocalBackend, WorkerFailure
+from .base import Backend, SlotBackend, WorkerError, WorkerFailure
+from .local import LocalBackend
 
-__all__ = ["Backend", "SlotBackend", "LocalBackend", "WorkerFailure"]
+__all__ = [
+    "Backend",
+    "SlotBackend",
+    "WorkerError",
+    "WorkerFailure",
+    "LocalBackend",
+    "XLADeviceBackend",
+]
+
+
+def __getattr__(name):
+    # lazy: importing the XLA backend pulls in jax (and TPU plugin
+    # registration); LocalBackend-only use stays numpy-only
+    if name == "XLADeviceBackend":
+        from .xla import XLADeviceBackend
+
+        return XLADeviceBackend
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

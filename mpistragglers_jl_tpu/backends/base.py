@@ -31,6 +31,32 @@ from abc import ABC, abstractmethod
 from typing import Sequence
 
 
+class WorkerFailure(RuntimeError):
+    """A worker raised during compute; re-raised coordinator-side at
+    harvest (the reference loses worker errors entirely — assertions die
+    inside mpiexec subprocesses, SURVEY §4)."""
+
+    def __init__(self, worker: int, epoch: int, error: BaseException):
+        self.worker = worker
+        self.epoch = epoch
+        self.error = error
+        super().__init__(f"worker {worker} failed at epoch {epoch}: {error!r}")
+
+
+class WorkerError:
+    """Marker carrying a captured worker exception to the coordinator."""
+
+    __slots__ = ("worker", "epoch", "error")
+
+    def __init__(self, worker: int, epoch: int, error: BaseException):
+        self.worker = worker
+        self.epoch = epoch
+        self.error = error
+
+    def raise_(self) -> None:
+        raise WorkerFailure(self.worker, self.epoch, self.error)
+
+
 class Backend(ABC):
     """Minimal transport interface consumed by ``asyncmap``/``waitall``."""
 
@@ -60,6 +86,11 @@ class Backend(ABC):
     def shutdown(self) -> None:  # pragma: no cover - default no-op
         """Release worker resources (the reference's control-channel
         shutdown broadcast, examples/iterative_example.jl:50-52)."""
+
+    def begin_epoch(self, epoch: int) -> None:  # pragma: no cover - no-op
+        """Called by ``asyncmap`` once per call, before any dispatch.
+        Backends may use it to reset per-epoch state (e.g. the XLA
+        backend's shared-payload snapshot cache)."""
 
 
 class _Slot:

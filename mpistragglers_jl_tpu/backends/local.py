@@ -26,7 +26,7 @@ from typing import Callable
 
 import numpy as np
 
-from .base import SlotBackend
+from .base import SlotBackend, WorkerError
 
 WorkFn = Callable[[int, np.ndarray, int], object]
 DelayFn = Callable[[int, int], float]
@@ -98,7 +98,7 @@ class LocalBackend(SlotBackend):
             try:
                 result = self.work_fn(i, payload, epoch)
             except BaseException as e:  # surfaced on harvest, not lost
-                result = _WorkerError(i, epoch, e)
+                result = WorkerError(i, epoch, e)
             self._complete(i, seq, result)
 
     def _start(self, i: int, sendbuf, epoch: int, seq: int, tag: int) -> None:
@@ -119,25 +119,3 @@ class LocalBackend(SlotBackend):
                 pass  # worker busy with a task it will never deliver; daemon
         for t in self._threads:
             t.join(timeout=1.0)
-
-
-class _WorkerError:
-    """A worker exception captured for delivery to the coordinator."""
-
-    def __init__(self, worker: int, epoch: int, error: BaseException):
-        self.worker = worker
-        self.epoch = epoch
-        self.error = error
-
-    def __array__(self, dtype=None, copy=None):  # np.asarray(result) raises
-        raise WorkerFailure(self.worker, self.epoch, self.error)
-
-
-class WorkerFailure(RuntimeError):
-    def __init__(self, worker: int, epoch: int, error: BaseException):
-        self.worker = worker
-        self.epoch = epoch
-        self.error = error
-        super().__init__(
-            f"worker {worker} failed at epoch {epoch}: {error!r}"
-        )

@@ -1,0 +1,167 @@
+"""XLA device backend: pool workers are accelerator devices.
+
+This is the TPU-native replacement for the reference's transport layer
+(MPI.jl point-to-point over OS processes — SURVEY §2 component C8). The
+mapping, per SURVEY §7 "the hard parts":
+
+=====================  ==================================================
+reference (MPI)         here (JAX/XLA)
+=====================  ==================================================
+worker process          an accelerator device (TPU chip / virtual CPU
+                        device); several pool workers may time-slice one
+                        device when the pool is larger than the slice
+``MPI.Isend``           ``jax.device_put`` of the payload onto the
+                        worker's device — an asynchronous H2D DMA whose
+                        result is an *immutable* snapshot, so the
+                        reference's ``isendbuf`` copy discipline
+                        (src/MPIAsyncPools.jl:63-66,:130) is free
+compute on worker       a jitted per-shard program dispatched on the
+                        worker's device; XLA's async dispatch returns a
+                        future-like ``jax.Array`` immediately
+``MPI.Waitany!``        per-worker dispatcher threads block on
+                        ``Array.block_until_ready`` and signal the shared
+                        completion condition (backends/base.py), so the
+                        coordinator's hot loop sleeps instead of spinning
+=====================  ==================================================
+
+Crucially there is **no collective in the straggle-exposed path**: each
+worker's program is independent, so a slow or dead device delays nobody
+else — a single ``pjit`` with a ``psum`` would re-introduce the very
+bulk-synchronous straggler penalty this design exists to kill (SURVEY §7).
+Collectives belong in the decode/combine step over the k winners (see
+parallel/collectives.py).
+
+Results are left device-resident; the decode/combine step can consume
+them without a host round-trip (``pool.results[i]``), and only a caller-
+provided ``recvbuf`` forces a D2H gather.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Sequence
+
+import jax
+import numpy as np
+
+from .base import SlotBackend, WorkerError
+
+# work_fn(worker_index, device_payload, epoch) -> jax.Array (device-resident)
+XLAWorkFn = Callable[[int, jax.Array, int], jax.Array]
+DelayFn = Callable[[int, int], float]
+
+_SHUTDOWN = object()
+
+
+class XLADeviceBackend(SlotBackend):
+    """n pool workers executing jitted programs on accelerator devices.
+
+    Parameters
+    ----------
+    work_fn:
+        ``work_fn(worker_index, payload, epoch) -> jax.Array``. Called in
+        the worker's dispatcher thread with the payload already resident
+        on the worker's device. It should be (or call) a jitted function;
+        it may close over per-worker device-resident operands (e.g. a
+        matrix shard placed at setup time). ``epoch`` is a Python int;
+        pass it into jitted code as an array to avoid retracing.
+    n_workers:
+        Pool size. May exceed the device count (workers then time-slice
+        devices round-robin — the single-real-chip case).
+    devices:
+        Devices to map workers onto; defaults to ``jax.devices()``.
+    delay_fn:
+        Deterministic straggler injection, seconds of host-side stall
+        before dispatch as a function of ``(worker, epoch)``. On a real
+        TPU slice stragglers are rare (SURVEY §7), so injection is the
+        test mechanism of record.
+    """
+
+    def __init__(
+        self,
+        work_fn: XLAWorkFn,
+        n_workers: int,
+        *,
+        devices: Sequence[jax.Device] | None = None,
+        delay_fn: DelayFn | None = None,
+    ):
+        super().__init__(n_workers)
+        if devices is None:
+            devices = jax.devices()
+        self.devices = [devices[i % len(devices)] for i in range(n_workers)]
+        self.work_fn = work_fn
+        self.delay_fn = delay_fn
+        self._closed = False
+        # per-epoch snapshot cache: device -> device-resident payload.
+        # asyncmap broadcasts ONE sendbuf to all idle workers per epoch
+        # (reference src/MPIAsyncPools.jl:118-139), so workers sharing a
+        # device can share one H2D transfer; cleared in begin_epoch.
+        self._payload_cache: dict = {}
+        self._mailboxes: list[queue.Queue] = [
+            queue.Queue(maxsize=1) for _ in range(n_workers)
+        ]
+        self._threads = [
+            threading.Thread(
+                target=self._dispatcher_loop, args=(i,), daemon=True,
+                name=f"xla-worker-{i}",
+            )
+            for i in range(n_workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _dispatcher_loop(self, i: int) -> None:
+        """Worker-side loop (reference §3.2) as a device dispatcher.
+
+        Blocking mailbox get is the worker's ``Waitany!([control, data])``
+        select; the shutdown sentinel is the control channel.
+        """
+        mbox = self._mailboxes[i]
+        while True:
+            msg = mbox.get()
+            if msg is _SHUTDOWN:
+                return
+            seq, payload, epoch = msg
+            if self.delay_fn is not None:
+                d = float(self.delay_fn(i, epoch))
+                if d > 0:
+                    time.sleep(d)
+            try:
+                result = self.work_fn(i, payload, epoch)
+                # wait for the device computation to actually finish —
+                # this thread *is* the arrival detector; block_until_ready
+                # releases the GIL so n workers wait concurrently
+                result = jax.block_until_ready(result)
+            except BaseException as e:
+                result = WorkerError(i, epoch, e)
+            self._complete(i, seq, result)
+
+    def _start(self, i: int, sendbuf, epoch: int, seq: int, tag: int) -> None:
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
+        # Asynchronous H2D (or D2D) transfer onto the worker's device.
+        # jax arrays are immutable, so this IS the payload snapshot: the
+        # caller may mutate a numpy sendbuf immediately after dispatch.
+        # Within one epoch the coordinator broadcasts a single stable
+        # sendbuf, so the transfer is shared across workers on a device.
+        dev = self.devices[i]
+        payload = self._payload_cache.get(dev)
+        if payload is None:
+            payload = jax.device_put(sendbuf, dev)
+            self._payload_cache[dev] = payload
+        self._mailboxes[i].put((seq, payload, epoch))
+
+    def begin_epoch(self, epoch: int) -> None:
+        self._payload_cache.clear()
+
+    def shutdown(self) -> None:
+        self._closed = True
+        for mbox in self._mailboxes:
+            try:
+                mbox.put_nowait(_SHUTDOWN)
+            except queue.Full:
+                pass
+        for t in self._threads:
+            t.join(timeout=5.0)

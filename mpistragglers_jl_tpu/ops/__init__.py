@@ -1,0 +1,10 @@
+__all__ = ["DistributedGemm", "gather_rows"]
+
+
+def __getattr__(name):
+    # lazy: ops pull in jax; keep the core package importable without it
+    if name in __all__:
+        from . import gemm
+
+        return getattr(gemm, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,124 @@
+"""Distributed matrix multiplication over an async device pool.
+
+Uncoded row-block GEMM (BASELINE config 2): ``C = A @ B`` with ``A`` row-
+partitioned over n workers. Worker ``w`` holds its block ``A_w`` resident
+on its device (placed once at setup — the reference's analog is each MPI
+worker holding its data slice process-locally) and each epoch receives
+``B`` as the broadcast payload, computing ``C_w = A_w @ B`` on the MXU.
+
+The reference library is payload-agnostic and has no model/workload code
+at all (SURVEY §5 "Long-context" row: the library is bytes-over-MPI,
+src/MPIAsyncPools.jl:82-84); distributed GEMM is the north-star workload
+BASELINE.json prescribes on top of the pool primitive. Design notes:
+
+* blocks are placed device-resident once; only ``B`` moves per epoch —
+  the HBM-friendly layout (A never re-crosses PCIe/ICI);
+* the per-worker program is a single large matmul in the worker's native
+  dtype (bf16/f32 on TPU MXU, f64 available on the CPU backend);
+* ``nwait < n`` returns a row-partial product with ``repochs`` as the
+  per-block freshness mask — the uncoded base case of the coded layer
+  (ops/coding.py), which makes missing blocks recoverable.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.xla import XLADeviceBackend, DelayFn
+from ..pool import AsyncPool, asyncmap
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def _block_matmul(a_block: jax.Array, b: jax.Array, precision=None) -> jax.Array:
+    return jnp.matmul(a_block, b, precision=precision)
+
+
+def gather_rows(pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
+    """Assemble the row-stacked result from per-worker results.
+
+    Rows from workers whose ``repochs[i] != epoch`` are zero-filled; the
+    per-row-block freshness mask is ``pool.repochs == epoch`` (i.e. the
+    value ``asyncmap`` returned) — callers needing staleness policy read
+    that, this function only stacks. Raises ``ValueError`` if no worker
+    has any result at all for the requested epoch.
+    """
+    if epoch is None:
+        epoch = pool.epoch
+    blocks = []
+    proto = None
+    for i in range(pool.n_workers):
+        r = pool.results[i]
+        if r is not None:
+            r = np.asarray(r)
+            proto = r  # any result (fresh or stale) fixes the block shape
+        if r is None or pool.repochs[i] != epoch:
+            blocks.append(None)
+        else:
+            blocks.append(r)
+    if proto is None:
+        raise ValueError("no worker has returned any result yet")
+    if all(b is None for b in blocks):
+        raise ValueError(f"no worker has a result for epoch {epoch}")
+    out = [b if b is not None else np.zeros_like(proto) for b in blocks]
+    return np.concatenate(out, axis=0)
+
+
+class DistributedGemm:
+    """``C = A @ B`` row-partitioned over an async pool of devices.
+
+    >>> g = DistributedGemm(A, n_workers=8)
+    >>> pool = AsyncPool(8)
+    >>> repochs = asyncmap(pool, B, g.backend)   # broadcast B, fastest-k
+    >>> C = g.result(pool)                       # stack fresh row blocks
+    """
+
+    def __init__(
+        self,
+        A: np.ndarray,
+        n_workers: int,
+        *,
+        devices: Sequence[jax.Device] | None = None,
+        delay_fn: DelayFn | None = None,
+        dtype=None,
+        precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
+    ):
+        # HIGHEST by default: the TPU MXU's native matmul accumulates in
+        # bf16-ish precision (observed max err ~0.25 on a 512-deep f32
+        # contraction vs 5e-5 at HIGHEST); coded decode paths need the
+        # accuracy. Benchmarks may pass precision=None for peak MXU rate.
+        self.precision = precision
+        m = A.shape[0]
+        if m % n_workers != 0:
+            raise ValueError(
+                f"rows {m} must divide evenly over {n_workers} workers"
+            )
+        if devices is None:
+            devices = jax.devices()
+        if dtype is not None:
+            A = np.asarray(A, dtype=dtype)
+        self.n_workers = n_workers
+        self.block_rows = m // n_workers
+        # place each row block on its worker's device once, up front
+        self.blocks = [
+            jax.device_put(
+                A[i * self.block_rows : (i + 1) * self.block_rows],
+                devices[i % len(devices)],
+            )
+            for i in range(n_workers)
+        ]
+        self.backend = XLADeviceBackend(
+            self._work, n_workers, devices=devices, delay_fn=delay_fn
+        )
+
+    def _work(self, i: int, payload: jax.Array, epoch: int) -> jax.Array:
+        return _block_matmul(self.blocks[i], payload, precision=self.precision)
+
+    def result(self, pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
+        return gather_rows(pool, epoch)

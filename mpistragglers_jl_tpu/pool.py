@@ -50,7 +50,7 @@ from typing import Callable, Sequence, Union
 
 import numpy as np
 
-from .backends.base import Backend
+from .backends.base import Backend, WorkerError
 
 NwaitArg = Union[int, Callable[[int, np.ndarray], bool]]
 
@@ -158,6 +158,12 @@ def _store(
     :163-168 and :215-218.
     """
     pool.latency[i] = (time.perf_counter_ns() - pool.stimestamps[i]) / 1e9
+    if isinstance(result, WorkerError):
+        # keep the pool recoverable: the backend slot is already consumed,
+        # so mark the worker idle (re-dispatchable next epoch) and leave
+        # repochs unstamped (nothing useful arrived) before raising
+        pool.active[i] = False
+        result.raise_()
     pool.results[i] = result
     if recvbufs is not None:
         chunk = recvbufs[i]
@@ -228,6 +234,7 @@ def asyncmap(
     # each call to asyncmap is the start of a new epoch
     # (reference src/MPIAsyncPools.jl:87)
     pool.epoch = int(epoch)
+    backend.begin_epoch(pool.epoch)
 
     # PHASE 1 — opportunistic, non-blocking drain of results that arrived
     # since the last call, to keep iterations independent

@@ -1,0 +1,165 @@
+"""XLA device backend tests on the 8-device virtual CPU mesh.
+
+Re-runs the reference behavioral checklist (SURVEY §4) with workers as
+accelerator devices instead of threads/processes, plus the uncoded
+distributed GEMM workload (BASELINE config 2).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import (
+    AsyncPool,
+    XLADeviceBackend,
+    WorkerFailure,
+    asyncmap,
+    waitall,
+)
+from mpistragglers_jl_tpu.ops import DistributedGemm, gather_rows
+
+
+def test_devices_available():
+    assert len(jax.devices()) == 8
+    assert jax.devices()[0].platform == "cpu"
+
+
+@jax.jit
+def _echo(payload, epoch):
+    return jnp.concatenate([payload, epoch[None]])
+
+
+def echo_work(i, payload, epoch):
+    return _echo(payload, jnp.asarray(float(epoch)))
+
+
+def test_full_gather_on_devices():
+    n = 8
+    backend = XLADeviceBackend(
+        lambda i, p, e: jax.jit(lambda x: x * (i + 1))(p), n)
+    pool = AsyncPool(n)
+    recvbuf = np.zeros(2 * n)
+    asyncmap(pool, np.array([1.0, 2.0]), backend, recvbuf, nwait=n)
+    for i in range(n):
+        assert np.allclose(recvbuf.reshape(n, 2)[i], [i + 1, 2 * (i + 1)])
+    # results are device-resident, one per device
+    devs = {list(pool.results[i].devices())[0].id for i in range(n)}
+    assert devs == set(range(8))
+    backend.shutdown()
+
+
+def test_fastest_k_epoch_echo_on_devices():
+    n = 4
+    delay_fn = lambda i, e: 0.030 if i == 3 else 0.001
+    backend = XLADeviceBackend(echo_work, n, delay_fn=delay_fn)
+    pool = AsyncPool(n)
+    sendbuf = np.zeros(1)
+    recvbuf = np.zeros(2 * n)
+    for epoch in range(1, 31):
+        sendbuf[0] = epoch
+        repochs = asyncmap(pool, sendbuf, backend, recvbuf, nwait=3)
+        fresh = 0
+        for i in range(n):
+            if repochs[i] == 0:
+                continue
+            if repochs[i] == epoch:
+                fresh += 1
+            # device workers echo the epoch they were dispatched at
+            assert recvbuf.reshape(n, 2)[i][1] == repochs[i]
+        assert fresh >= 3
+    waitall(pool, backend, recvbuf)
+    assert not pool.active.any()
+    backend.shutdown()
+
+
+def test_functional_nwait_on_devices():
+    n = 3
+    delay_fn = lambda i, e: 0.015 if i == 0 else 0.001
+    backend = XLADeviceBackend(echo_work, n, delay_fn=delay_fn)
+    pool = AsyncPool(n)
+    recvbuf = np.zeros(2 * n)
+    pred = lambda epoch, repochs: repochs[0] == epoch
+    sendbuf = np.zeros(1)
+    for epoch in range(1, 11):
+        sendbuf[0] = epoch
+        t0 = time.perf_counter()
+        repochs = asyncmap(pool, sendbuf, backend, recvbuf, nwait=pred)
+        delay = time.perf_counter() - t0
+        assert repochs[0] == pool.epoch
+        assert abs(delay - pool.latency[0]) < 10e-3
+    waitall(pool, backend, recvbuf)
+    backend.shutdown()
+
+
+def test_worker_failure_on_device():
+    n = 2
+
+    def bad(i, p, e):
+        if i == 1:
+            raise ValueError("device boom")
+        return p
+
+    backend = XLADeviceBackend(bad, n)
+    pool = AsyncPool(n)
+    with pytest.raises(WorkerFailure):
+        asyncmap(pool, np.zeros(1), backend, nwait=n)
+    backend.shutdown()
+
+
+def test_more_workers_than_devices():
+    # 16 pool workers time-slice 8 devices (the single-real-chip case)
+    n = 16
+    backend = XLADeviceBackend(
+        lambda i, p, e: jax.jit(lambda x: x + i)(p), n)
+    pool = AsyncPool(n)
+    recvbuf = np.zeros(n)
+    asyncmap(pool, np.zeros(1), backend, recvbuf, nwait=n)
+    assert np.allclose(recvbuf, np.arange(n))
+    backend.shutdown()
+
+
+def test_uncoded_gemm_full():
+    # BASELINE config 2 shape, scaled down for CI: row-block GEMM, nwait=n
+    rng = np.random.default_rng(0)
+    n = 8
+    A = rng.standard_normal((256, 64)).astype(np.float32)
+    B = rng.standard_normal((64, 32)).astype(np.float32)
+    g = DistributedGemm(A, n)
+    pool = AsyncPool(n)
+    repochs = asyncmap(pool, B, g.backend, nwait=n)
+    assert list(repochs) == [1] * n
+    C = g.result(pool)
+    assert np.allclose(C, A @ B, atol=1e-4)
+    g.backend.shutdown()
+
+
+def test_uncoded_gemm_fastest_k_masks_straggler_rows():
+    rng = np.random.default_rng(1)
+    n = 4
+    A = rng.standard_normal((64, 32)).astype(np.float32)
+    B = rng.standard_normal((32, 16)).astype(np.float32)
+    delay_fn = lambda i, e: 0.050 if i == 2 else 0.0
+    g = DistributedGemm(A, n, delay_fn=delay_fn)
+    pool = AsyncPool(n)
+    repochs = asyncmap(pool, B, g.backend, nwait=3)
+    C = g.result(pool)
+    ref = A @ B
+    rows = A.shape[0] // n
+    for i in range(n):
+        if repochs[i] == 1:
+            assert np.allclose(
+                C[i * rows : (i + 1) * rows], ref[i * rows : (i + 1) * rows],
+                atol=1e-4)
+    # straggler block is zero-filled, mask says stale
+    assert repochs[2] == 0
+    assert np.allclose(C[2 * rows : 3 * rows], 0)
+    waitall(pool, g.backend)
+    g.backend.shutdown()
+
+
+def test_gemm_wrong_shape_errors():
+    with pytest.raises(ValueError):
+        DistributedGemm(np.zeros((10, 4)), 3)  # 10 rows not divisible by 3

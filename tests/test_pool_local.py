@@ -256,6 +256,52 @@ def test_worker_exception_surfaces_on_harvest():
     backend.shutdown()
 
 
+def test_pool_recovers_after_worker_failure():
+    # a transient failure must not wedge the pool: the failed worker is
+    # marked idle and the next epoch re-dispatches to it
+    n = 2
+    calls = {"count": 0}
+
+    def flaky_once(i, p, e):
+        if i == 1 and e == 1:
+            raise RuntimeError("transient")
+        return np.array([float(i)])
+
+    backend = LocalBackend(flaky_once, n)
+    pool = AsyncPool(n)
+    recvbuf = np.zeros(n)
+    with pytest.raises(WorkerFailure):
+        asyncmap(pool, np.zeros(1), backend, recvbuf, nwait=n)
+    assert not pool.active[1]  # failed worker is idle, not wedged
+    repochs = asyncmap(pool, np.zeros(1), backend, recvbuf, nwait=n)
+    assert list(repochs) == [2, 2]
+    assert np.allclose(recvbuf, [0.0, 1.0])
+    repochs = waitall(pool, backend, recvbuf, timeout=1.0)
+    assert not pool.active.any()
+    backend.shutdown()
+
+
+def test_import_is_jax_free():
+    # LocalBackend-only use must not pay jax import/plugin registration
+    import subprocess, sys
+    import os
+    code = (
+        "import sys; import mpistragglers_jl_tpu; "
+        "from mpistragglers_jl_tpu import AsyncPool, LocalBackend; "
+        "assert not any(m == 'jax' or m.startswith('jax.') "
+        "for m in sys.modules), 'jax imported eagerly'"
+    )
+    root = str(__import__('pathlib').Path(__file__).parent.parent)
+    env = dict(os.environ)
+    # drop the axon sitecustomize (it preloads jax in every interpreter)
+    env["PYTHONPATH"] = root
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, cwd=root, env=env,
+    )
+    assert r.returncode == 0, r.stderr
+
+
 def test_waitall_timeout_detects_dead_worker():
     # new capability: the reference's waitall! hangs forever on a dead
     # worker (SURVEY §5 failure detection)
