@@ -1,0 +1,58 @@
+"""Benchmark entry point: prints ONE JSON line.
+
+Headline metric (BASELINE.json): coded-GEMM GFLOPS/chip + wall-clock vs
+the CPU baseline. Until the coded layer lands this benches the uncoded
+distributed GEMM (BASELINE config 2) through the async pool on the real
+chip, with vs_baseline measured against single-host numpy (the closest
+stand-in for the reference's CPU/MPI execution on this machine).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_uncoded_gemm(m=4096, k=4096, n=4096, n_workers=4, epochs=3):
+    import jax
+
+    from mpistragglers_jl_tpu import AsyncPool, asyncmap
+    from mpistragglers_jl_tpu.ops import DistributedGemm
+
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((m, k)).astype(np.float32)
+    B = rng.standard_normal((k, n)).astype(np.float32)
+
+    # CPU baseline: same product, single host numpy (BLAS)
+    t0 = time.perf_counter()
+    C_ref = A @ B
+    cpu_s = time.perf_counter() - t0
+
+    g = DistributedGemm(A, n_workers, precision=None)
+    pool = AsyncPool(n_workers)
+    # warmup epoch (compile + first H2D)
+    asyncmap(pool, B, g.backend, nwait=n_workers)
+    times = []
+    for _ in range(epochs):
+        t0 = time.perf_counter()
+        asyncmap(pool, B, g.backend, nwait=n_workers)
+        times.append(time.perf_counter() - t0)
+    tpu_s = min(times)
+    g.backend.shutdown()
+
+    flops = 2.0 * m * k * n
+    gflops_chip = flops / tpu_s / 1e9  # single chip runs all workers
+    return {
+        "metric": "uncoded-gemm-4096-wallclock",
+        "value": round(tpu_s, 4),
+        "unit": "s",
+        "vs_baseline": round(cpu_s / tpu_s, 2),
+        "gflops_per_chip": round(gflops_chip, 1),
+        "cpu_baseline_s": round(cpu_s, 3),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_uncoded_gemm()))

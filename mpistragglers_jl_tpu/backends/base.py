@@ -26,9 +26,15 @@ runs (thread compute, XLA device dispatch, ...).
 
 from __future__ import annotations
 
+import queue
 import threading
+import time
 from abc import ABC, abstractmethod
-from typing import Sequence
+from typing import Callable, Sequence
+
+DelayFn = Callable[[int, int], float]
+
+_SHUTDOWN = object()
 
 
 class WorkerFailure(RuntimeError):
@@ -180,3 +186,89 @@ class SlotBackend(Backend):
             if not ok:
                 return None
             return self._take(slot)
+
+
+class MailboxBackend(SlotBackend):
+    """Worker-loop skeleton: one dispatcher thread + depth-1 mailbox each.
+
+    This is the reference's worker-side convention (receive -> optional
+    injected stall -> compute -> deliver, with a control channel for
+    shutdown; examples/iterative_example.jl:55-82, SURVEY §3.2) made a
+    first-class, reusable library component. The depth-1 mailbox models
+    an ``MPI.Isend`` whose matching ``Irecv!`` the worker only posts
+    after finishing its previous compute; the shutdown sentinel is the
+    control-tag broadcast (test/kmap2.jl:14-18).
+
+    Subclasses implement:
+
+    * ``_snapshot(i, sendbuf, epoch)`` — produce the private payload
+      snapshot enqueued to the worker (the reference's ``isendbuf``
+      discipline, src/MPIAsyncPools.jl:63-66,:130);
+    * ``_compute(i, payload, epoch)`` — the worker computation.
+    """
+
+    def __init__(
+        self,
+        n_workers: int,
+        *,
+        delay_fn: DelayFn | None = None,
+        join_timeout: float = 2.0,
+        thread_name: str = "pool-worker",
+    ):
+        super().__init__(n_workers)
+        self.delay_fn = delay_fn
+        self._closed = False
+        self._join_timeout = join_timeout
+        self._mailboxes: list[queue.Queue] = [
+            queue.Queue(maxsize=1) for _ in range(n_workers)
+        ]
+        self._threads = [
+            threading.Thread(
+                target=self._worker_loop, args=(i,), daemon=True,
+                name=f"{thread_name}-{i}",
+            )
+            for i in range(n_workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    @abstractmethod
+    def _snapshot(self, i: int, sendbuf, epoch: int):
+        ...
+
+    @abstractmethod
+    def _compute(self, i: int, payload, epoch: int):
+        ...
+
+    def _worker_loop(self, i: int) -> None:
+        mbox = self._mailboxes[i]
+        while True:
+            msg = mbox.get()
+            if msg is _SHUTDOWN:
+                return
+            seq, payload, epoch = msg
+            if self.delay_fn is not None:
+                d = float(self.delay_fn(i, epoch))
+                if d > 0:
+                    time.sleep(d)
+            try:
+                result = self._compute(i, payload, epoch)
+            except BaseException as e:  # surfaced on harvest, not lost
+                result = WorkerError(i, epoch, e)
+            self._complete(i, seq, result)
+
+    def _start(self, i: int, sendbuf, epoch: int, seq: int, tag: int) -> None:
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
+        payload = self._snapshot(i, sendbuf, epoch)
+        self._mailboxes[i].put((seq, payload, epoch))
+
+    def shutdown(self) -> None:
+        self._closed = True
+        for mbox in self._mailboxes:
+            try:
+                mbox.put_nowait(_SHUTDOWN)
+            except queue.Full:
+                pass  # worker busy with a task it will never deliver; daemon
+        for t in self._threads:
+            t.join(timeout=self._join_timeout)

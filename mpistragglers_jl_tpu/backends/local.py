@@ -3,38 +3,25 @@
 The reference has no in-process backend at all — its only execution mode is
 ``mpiexec`` spawning real OS processes, and its test harness shells out to
 ``mpiexec -n N julia`` per scenario (test/runtests.jl:17), which SURVEY §4
-calls out as the weakness to fix. :class:`LocalBackend` is that fix: the
-worker loop of examples/iterative_example.jl:55-82 (receive -> compute ->
-send, with a control channel for shutdown) becomes a first-class library
-API, with *deterministic* straggler injection replacing the reference's
-``sleep(rand())`` (examples/iterative_example.jl:74, test/kmap2.jl:95).
-
-Each worker is a daemon thread with a depth-1 mailbox (a dispatched payload
-waits there while the worker is busy, exactly like an ``MPI.Isend`` whose
-matching ``Irecv!`` the worker only posts after finishing its previous
-compute — reference §3.2 call stack). ``shutdown()`` posts a sentinel on
-the mailbox, the analog of the reference's control-tag broadcast
-(test/kmap2.jl:14-18).
+calls out as the weakness to fix. :class:`LocalBackend` is that fix: pure
+numpy worker threads with *deterministic* straggler injection replacing the
+reference's ``sleep(rand())`` (examples/iterative_example.jl:74,
+test/kmap2.jl:95). The worker loop itself lives in
+:class:`~.base.MailboxBackend`.
 """
 
 from __future__ import annotations
 
-import queue
-import threading
-import time
 from typing import Callable
 
 import numpy as np
 
-from .base import SlotBackend, WorkerError
+from .base import MailboxBackend, DelayFn
 
 WorkFn = Callable[[int, np.ndarray, int], object]
-DelayFn = Callable[[int, int], float]
-
-_SHUTDOWN = object()
 
 
-class LocalBackend(SlotBackend):
+class LocalBackend(MailboxBackend):
     """n worker threads computing ``work_fn(worker_index, payload, epoch)``.
 
     Parameters
@@ -47,10 +34,8 @@ class LocalBackend(SlotBackend):
     n_workers:
         Pool size.
     delay_fn:
-        Optional deterministic latency injection: seconds to stall before
-        computing, as a function of ``(worker_index, epoch)``. First-class
-        replacement for the reference's random sleeps (SURVEY §7 "the hard
-        parts": injection must be deterministic and first-class).
+        Deterministic latency injection: seconds to stall before
+        computing, as a function of ``(worker_index, epoch)``.
     """
 
     def __init__(
@@ -60,62 +45,17 @@ class LocalBackend(SlotBackend):
         *,
         delay_fn: DelayFn | None = None,
     ):
-        super().__init__(n_workers)
         self.work_fn = work_fn
-        self.delay_fn = delay_fn
-        self._closed = False
-        self._mailboxes: list[queue.Queue] = [
-            queue.Queue(maxsize=1) for _ in range(n_workers)
-        ]
-        self._threads = [
-            threading.Thread(
-                target=self._worker_loop, args=(i,), daemon=True,
-                name=f"pool-worker-{i}",
-            )
-            for i in range(n_workers)
-        ]
-        for t in self._threads:
-            t.start()
+        super().__init__(
+            n_workers, delay_fn=delay_fn, join_timeout=1.0,
+            thread_name="local-worker",
+        )
 
-    def _worker_loop(self, i: int) -> None:
-        """The reference's worker_main convention, as library code.
+    def _snapshot(self, i: int, sendbuf, epoch: int) -> np.ndarray:
+        # host copy: the reference's per-worker isendbuf discipline
+        # (src/MPIAsyncPools.jl:130) — in-flight sends survive caller
+        # mutation of sendbuf
+        return np.array(sendbuf, copy=True)
 
-        Loop: take next payload (blocks like the worker-side
-        ``MPI.Waitany!([control, data])`` select, reference §3.2),
-        optionally stall (injected straggling), compute, deliver. A
-        shutdown sentinel breaks the loop — the control channel.
-        """
-        mbox = self._mailboxes[i]
-        while True:
-            msg = mbox.get()
-            if msg is _SHUTDOWN:
-                return
-            seq, payload, epoch = msg
-            if self.delay_fn is not None:
-                d = float(self.delay_fn(i, epoch))
-                if d > 0:
-                    time.sleep(d)
-            try:
-                result = self.work_fn(i, payload, epoch)
-            except BaseException as e:  # surfaced on harvest, not lost
-                result = WorkerError(i, epoch, e)
-            self._complete(i, seq, result)
-
-    def _start(self, i: int, sendbuf, epoch: int, seq: int, tag: int) -> None:
-        if self._closed:
-            raise RuntimeError("backend has been shut down")
-        # Snapshot at dispatch time: the reference's per-worker isendbuf
-        # copy (src/MPIAsyncPools.jl:130) — in-flight sends must survive
-        # caller mutation of sendbuf.
-        payload = np.array(sendbuf, copy=True)
-        self._mailboxes[i].put((seq, payload, epoch))
-
-    def shutdown(self) -> None:
-        self._closed = True
-        for mbox in self._mailboxes:
-            try:
-                mbox.put_nowait(_SHUTDOWN)
-            except queue.Full:
-                pass  # worker busy with a task it will never deliver; daemon
-        for t in self._threads:
-            t.join(timeout=1.0)
+    def _compute(self, i: int, payload: np.ndarray, epoch: int):
+        return self.work_fn(i, payload, epoch)

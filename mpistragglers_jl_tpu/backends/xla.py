@@ -38,24 +38,17 @@ provided ``recvbuf`` forces a D2H gather.
 
 from __future__ import annotations
 
-import queue
-import threading
-import time
 from typing import Callable, Sequence
 
 import jax
-import numpy as np
 
-from .base import SlotBackend, WorkerError
+from .base import MailboxBackend, DelayFn
 
 # work_fn(worker_index, device_payload, epoch) -> jax.Array (device-resident)
 XLAWorkFn = Callable[[int, jax.Array, int], jax.Array]
-DelayFn = Callable[[int, int], float]
-
-_SHUTDOWN = object()
 
 
-class XLADeviceBackend(SlotBackend):
+class XLADeviceBackend(MailboxBackend):
     """n pool workers executing jitted programs on accelerator devices.
 
     Parameters
@@ -87,81 +80,43 @@ class XLADeviceBackend(SlotBackend):
         devices: Sequence[jax.Device] | None = None,
         delay_fn: DelayFn | None = None,
     ):
-        super().__init__(n_workers)
         if devices is None:
             devices = jax.devices()
         self.devices = [devices[i % len(devices)] for i in range(n_workers)]
         self.work_fn = work_fn
-        self.delay_fn = delay_fn
-        self._closed = False
-        # per-epoch snapshot cache: device -> device-resident payload.
-        # asyncmap broadcasts ONE sendbuf to all idle workers per epoch
-        # (reference src/MPIAsyncPools.jl:118-139), so workers sharing a
-        # device can share one H2D transfer; cleared in begin_epoch.
+        # (device, epoch) -> device-resident payload. asyncmap broadcasts
+        # ONE stable sendbuf to all idle workers per epoch (reference
+        # src/MPIAsyncPools.jl:118-139), so workers sharing a device share
+        # one H2D transfer; keyed by epoch so direct Backend-API users
+        # dispatching fresh payloads at new epochs never see stale data.
         self._payload_cache: dict = {}
-        self._mailboxes: list[queue.Queue] = [
-            queue.Queue(maxsize=1) for _ in range(n_workers)
-        ]
-        self._threads = [
-            threading.Thread(
-                target=self._dispatcher_loop, args=(i,), daemon=True,
-                name=f"xla-worker-{i}",
-            )
-            for i in range(n_workers)
-        ]
-        for t in self._threads:
-            t.start()
+        super().__init__(
+            n_workers, delay_fn=delay_fn, join_timeout=5.0,
+            thread_name="xla-worker",
+        )
 
-    def _dispatcher_loop(self, i: int) -> None:
-        """Worker-side loop (reference §3.2) as a device dispatcher.
-
-        Blocking mailbox get is the worker's ``Waitany!([control, data])``
-        select; the shutdown sentinel is the control channel.
-        """
-        mbox = self._mailboxes[i]
-        while True:
-            msg = mbox.get()
-            if msg is _SHUTDOWN:
-                return
-            seq, payload, epoch = msg
-            if self.delay_fn is not None:
-                d = float(self.delay_fn(i, epoch))
-                if d > 0:
-                    time.sleep(d)
-            try:
-                result = self.work_fn(i, payload, epoch)
-                # wait for the device computation to actually finish —
-                # this thread *is* the arrival detector; block_until_ready
-                # releases the GIL so n workers wait concurrently
-                result = jax.block_until_ready(result)
-            except BaseException as e:
-                result = WorkerError(i, epoch, e)
-            self._complete(i, seq, result)
-
-    def _start(self, i: int, sendbuf, epoch: int, seq: int, tag: int) -> None:
-        if self._closed:
-            raise RuntimeError("backend has been shut down")
+    def _snapshot(self, i: int, sendbuf, epoch: int) -> jax.Array:
         # Asynchronous H2D (or D2D) transfer onto the worker's device.
         # jax arrays are immutable, so this IS the payload snapshot: the
         # caller may mutate a numpy sendbuf immediately after dispatch.
-        # Within one epoch the coordinator broadcasts a single stable
-        # sendbuf, so the transfer is shared across workers on a device.
         dev = self.devices[i]
-        payload = self._payload_cache.get(dev)
+        key = (dev, epoch)
+        payload = self._payload_cache.get(key)
         if payload is None:
             payload = jax.device_put(sendbuf, dev)
-            self._payload_cache[dev] = payload
-        self._mailboxes[i].put((seq, payload, epoch))
+            self._payload_cache[key] = payload
+        return payload
+
+    def _compute(self, i: int, payload: jax.Array, epoch: int) -> jax.Array:
+        result = self.work_fn(i, payload, epoch)
+        # wait for the device computation to actually finish — this
+        # thread *is* the arrival detector; block_until_ready releases
+        # the GIL so n workers wait concurrently
+        return jax.block_until_ready(result)
 
     def begin_epoch(self, epoch: int) -> None:
-        self._payload_cache.clear()
-
-    def shutdown(self) -> None:
-        self._closed = True
-        for mbox in self._mailboxes:
-            try:
-                mbox.put_nowait(_SHUTDOWN)
-            except queue.Full:
-                pass
-        for t in self._threads:
-            t.join(timeout=5.0)
+        # drop snapshots from previous epochs (memory hygiene; the
+        # epoch-keyed entries would otherwise accumulate)
+        self._payload_cache = {
+            k: v for k, v in self._payload_cache.items() if k[1] == epoch
+        }

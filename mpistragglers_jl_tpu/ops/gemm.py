@@ -28,7 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..backends.xla import XLADeviceBackend, DelayFn
+from ..backends.base import DelayFn
+from ..backends.xla import XLADeviceBackend
 from ..pool import AsyncPool, asyncmap
 
 
@@ -51,20 +52,18 @@ def gather_rows(pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
     """
     if epoch is None:
         epoch = pool.epoch
-    blocks = []
-    proto = None
-    for i in range(pool.n_workers):
-        r = pool.results[i]
-        if r is not None:
-            r = np.asarray(r)
-            proto = r  # any result (fresh or stale) fixes the block shape
-        if r is None or pool.repochs[i] != epoch:
-            blocks.append(None)
-        else:
-            blocks.append(r)
+    # convert only fresh blocks — stale device-resident results must not
+    # pay a D2H transfer just to be replaced by zeros
+    blocks = [
+        np.asarray(pool.results[i])
+        if pool.results[i] is not None and pool.repochs[i] == epoch
+        else None
+        for i in range(pool.n_workers)
+    ]
+    proto = next((b for b in blocks if b is not None), None)
     if proto is None:
-        raise ValueError("no worker has returned any result yet")
-    if all(b is None for b in blocks):
+        if all(r is None for r in pool.results):
+            raise ValueError("no worker has returned any result yet")
         raise ValueError(f"no worker has a result for epoch {epoch}")
     out = [b if b is not None else np.zeros_like(proto) for b in blocks]
     return np.concatenate(out, axis=0)
