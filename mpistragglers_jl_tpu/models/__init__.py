@@ -1,0 +1,10 @@
+__all__ = ["LogisticRegression", "CodedSGD"]
+
+
+def __getattr__(name):
+    # lazy: models pull in jax; keep the core package importable without it
+    if name in ("LogisticRegression", "CodedSGD"):
+        from . import logreg
+
+        return getattr(logreg, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,10 +1,24 @@
-__all__ = ["DistributedGemm", "gather_rows"]
+_HOME = {
+    "DistributedGemm": "gemm",
+    "gather_rows": "gemm",
+    "MDSCode": "coding",
+    "nwait_decodable": "coding",
+    "CodedGemm": "coded_gemm",
+    "LTCodedGemm": "coded_gemm",
+    "LTCode": "lt",
+    "nwait_lt_decodable": "lt",
+    "GradientCode": "gradcode",
+}
+
+__all__ = list(_HOME)
 
 
 def __getattr__(name):
-    # lazy: ops pull in jax; keep the core package importable without it
-    if name in __all__:
-        from . import gemm
+    # lazy: most ops pull in jax; keep the core package importable
+    # without it
+    if name in _HOME:
+        import importlib
 
-        return getattr(gemm, name)
+        mod = importlib.import_module(f".{_HOME[name]}", __name__)
+        return getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

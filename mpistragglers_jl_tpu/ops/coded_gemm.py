@@ -1,0 +1,195 @@
+"""MDS-coded distributed GEMM: decode ``C = A @ B`` from any k of n chips.
+
+BASELINE config 3: (n=8, k=6) systematic Reed–Solomon-style row blocks,
+``nwait=6``. The pipeline:
+
+1. setup: row-partition ``A`` into k source blocks, MDS-encode into n
+   coded blocks (one MXU einsum, ops/coding.py), place coded block i on
+   worker i's device;
+2. per epoch: broadcast ``B`` via ``asyncmap``; worker i computes
+   ``Ã_i @ B`` — because encoding is linear, the coded results are the
+   same code applied to the true row blocks of ``C``;
+3. return when ``nwait >= k`` workers are fresh (integer nwait or the
+   :func:`~.coding.nwait_decodable` predicate);
+4. decode: pick the first k fresh shards by the ``repochs`` mask, solve
+   the k×k system, restack — the *full* product, stragglers ignored.
+
+The reference can express step 3's wait (its fastest-k return) but has no
+coded layer (SURVEY §2: no model/workload code of any kind); this module
+is the north-star capability BASELINE.json prescribes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.base import DelayFn
+from ..backends.xla import XLADeviceBackend
+from ..pool import AsyncPool
+from .coding import MDSCode, nwait_decodable
+from .gemm import _block_matmul
+from .lt import LTCode, nwait_lt_decodable
+
+
+class CodedGemm:
+    """``C = A @ B`` recoverable from any k of n workers.
+
+    >>> cg = CodedGemm(A, n=8, k=6)
+    >>> pool = AsyncPool(8, nwait=6)
+    >>> repochs = asyncmap(pool, B, cg.backend)      # waits for 6 of 8
+    >>> C = cg.result(pool)                          # exact full product
+    """
+
+    def __init__(
+        self,
+        A: np.ndarray,
+        n: int,
+        k: int,
+        *,
+        devices: Sequence[jax.Device] | None = None,
+        delay_fn: DelayFn | None = None,
+        parity: str = "cauchy",
+        dtype=None,
+        precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
+    ):
+        if dtype is not None:
+            A = np.asarray(A, dtype=dtype)
+        m = A.shape[0]
+        if m % k != 0:
+            raise ValueError(f"rows {m} must divide evenly into k={k} blocks")
+        if devices is None:
+            devices = jax.devices()
+        self.devices = list(devices)
+        self.code = MDSCode(n, k, parity=parity, dtype=A.dtype,
+                            precision=precision)
+        self.n, self.k = n, k
+        self.block_rows = m // k
+        self.precision = precision
+        # encode once (on the default device), then distribute coded
+        # blocks to their workers' devices
+        coded = self.code.encode_array(A)
+        self.blocks = [
+            jax.device_put(coded[i], devices[i % len(devices)])
+            for i in range(n)
+        ]
+        self.backend = XLADeviceBackend(
+            self._work, n, devices=devices, delay_fn=delay_fn
+        )
+
+    def _work(self, i: int, payload: jax.Array, epoch: int) -> jax.Array:
+        return _block_matmul(self.blocks[i], payload, precision=self.precision)
+
+    @property
+    def nwait(self):
+        """Decodability predicate for ``asyncmap(nwait=...)``."""
+        return nwait_decodable(self.k)
+
+    def result(self, pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
+        """Decode the full product from the first k fresh shards."""
+        if epoch is None:
+            epoch = pool.epoch
+        fresh = np.flatnonzero(pool.repochs == epoch)
+        if fresh.size < self.k:
+            raise ValueError(
+                f"only {fresh.size} fresh shards at epoch {epoch}, "
+                f"need k={self.k}"
+            )
+        idx = fresh[: self.k]
+        # decode on the pool's first device, not the global default — the
+        # caller may have deliberately excluded other devices
+        shards = jnp.stack([
+            jax.device_put(jnp.asarray(pool.results[i]), self.devices[0])
+            for i in idx
+        ])
+        return np.asarray(self.code.decode_array(shards, idx))
+
+
+class LTCodedGemm:
+    """LT/rateless-coded GEMM (BASELINE config 4).
+
+    Each of the n workers takes one rateless shard id; worker i holds the
+    real-field sum of its shard's source blocks of ``A`` (device-
+    resident). ``nwait`` is the *decodability* predicate: ``asyncmap``
+    returns at the first arrival set whose shards peel, not at a fixed
+    count. Decode is host-side peeling (ops/lt.py) — cheap 0/1
+    subtractions, no solve.
+    """
+
+    def __init__(
+        self,
+        A: np.ndarray,
+        n_workers: int,
+        k: int,
+        *,
+        devices: Sequence[jax.Device] | None = None,
+        delay_fn: DelayFn | None = None,
+        seed: int = 0,
+        dtype=None,
+        precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
+        shard_ids: Sequence[int] | None = None,
+    ):
+        if dtype is not None:
+            A = np.asarray(A, dtype=dtype)
+        m = A.shape[0]
+        if m % k != 0:
+            raise ValueError(f"rows {m} must divide evenly into k={k} blocks")
+        if devices is None:
+            devices = jax.devices()
+        self.code = LTCode(k, seed=seed)
+        self.k = k
+        self.n = n_workers
+        self.block_rows = m // k
+        self.precision = precision
+        if shard_ids is None:
+            # rateless: any distinct ids work; slide a window over the
+            # unbounded shard stream until the full set peels (so
+            # nwait=n is always satisfiable)
+            shard_ids = list(range(n_workers))
+            for _ in range(1000):
+                if self.code.peelable(shard_ids):
+                    break
+                shard_ids = [s + 1 for s in shard_ids]
+            else:
+                raise ValueError(
+                    f"no decodable window of {n_workers} shards found for "
+                    f"k={k}; increase n_workers/k ratio"
+                )
+        elif not self.code.peelable(shard_ids):
+            # otherwise the nwait predicate can never fire and the pool
+            # would die deep inside wait_any with an opaque error
+            raise ValueError(
+                f"shard_ids {list(shard_ids)} are not decodable even with "
+                f"all workers fresh (peeling stalls); choose a different set"
+            )
+        self.shard_ids = list(shard_ids)
+        G = self.code.generator_rows(self.shard_ids)  # (n, k) 0/1
+        blocks = jnp.asarray(A).reshape(k, m // k, *A.shape[1:])
+        coded = jnp.einsum("nk,krc->nrc", jnp.asarray(G), blocks,
+                           precision=precision)
+        self.blocks = [
+            jax.device_put(coded[i], devices[i % len(devices)])
+            for i in range(n_workers)
+        ]
+        self.backend = XLADeviceBackend(
+            self._work, n_workers, devices=devices, delay_fn=delay_fn
+        )
+
+    def _work(self, i: int, payload: jax.Array, epoch: int) -> jax.Array:
+        return _block_matmul(self.blocks[i], payload, precision=self.precision)
+
+    @property
+    def nwait(self):
+        """Variable decodability predicate for ``asyncmap(nwait=...)``."""
+        return nwait_lt_decodable(self.code, self.shard_ids)
+
+    def result(self, pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
+        if epoch is None:
+            epoch = pool.epoch
+        fresh = np.flatnonzero(pool.repochs == epoch)
+        shards = np.stack([np.asarray(pool.results[i]) for i in fresh])
+        ids = [self.shard_ids[i] for i in fresh]
+        return self.code.decode_array(shards, ids)

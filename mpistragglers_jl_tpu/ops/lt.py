@@ -1,0 +1,143 @@
+"""LT (Luby transform) rateless codes over the reals, with peeling decode.
+
+BASELINE config 4: LT-coded GEMM on 16 workers with a *variable*
+``nwait(epoch, repochs)`` predicate — return not after a fixed count but
+as soon as the arrived shard set is actually decodable. This exercises
+the reference's functional-``nwait`` mechanism
+(src/MPIAsyncPools.jl:152-154) with a real decoder in the loop, which is
+exactly what it exists for: the predicate sees the live ``repochs``
+vector after every arrival.
+
+Rateless-ness: shard ids are unbounded — shard ``s`` is a deterministic
+pseudo-random sum of a few source blocks (degree drawn from the robust
+soliton distribution, then that many blocks chosen uniformly), so any
+number of workers can each take a distinct shard id and more shards only
+help. Over the reals the XOR of classical LT becomes a sum, and peeling
+subtracts instead of XORs; releases are numerically benign (coefficients
+are 0/1, no amplification beyond degree-many subtractions).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["LTCode", "nwait_lt_decodable"]
+
+
+def robust_soliton(k: int, c: float = 0.1, delta: float = 0.5) -> np.ndarray:
+    """Robust soliton degree distribution over degrees 1..k."""
+    d = np.arange(1, k + 1)
+    rho = np.zeros(k)
+    rho[0] = 1.0 / k
+    rho[1:] = 1.0 / (d[1:] * (d[1:] - 1.0))
+    R = c * np.log(k / delta) * np.sqrt(k)
+    tau = np.zeros(k)
+    kR = int(np.floor(k / R)) if R > 0 else k
+    kR = max(1, min(kR, k))
+    for i in range(1, kR):
+        tau[i - 1] = R / (i * k)
+    tau[kR - 1] = R * np.log(R / delta) / k if R > delta else 0.0
+    mu = rho + tau
+    return mu / mu.sum()
+
+
+class LTCode:
+    """Rateless LT code over k source blocks.
+
+    ``shard_indices(s)`` is the deterministic support of shard ``s``;
+    workers compute real-field sums of those source blocks.
+    """
+
+    def __init__(self, k: int, *, seed: int = 0, c: float = 0.1,
+                 delta: float = 0.5):
+        self.k = int(k)
+        self.seed = int(seed)
+        self._mu = robust_soliton(self.k, c, delta)
+
+    def shard_indices(self, s: int) -> np.ndarray:
+        """Deterministic support (sorted source-block ids) of shard s."""
+        rng = np.random.default_rng((self.seed, int(s)))
+        d = 1 + rng.choice(self.k, p=self._mu)
+        return np.sort(rng.choice(self.k, size=d, replace=False))
+
+    def generator_rows(self, shard_ids) -> np.ndarray:
+        """0/1 generator rows (len(shard_ids) × k) for the given shards."""
+        G = np.zeros((len(shard_ids), self.k), dtype=np.float32)
+        for r, s in enumerate(shard_ids):
+            G[r, self.shard_indices(s)] = 1.0
+        return G
+
+    # -- decodability (pure graph logic, no data) ------------------------
+    def peelable(self, shard_ids) -> bool:
+        """True iff peeling decodes all k source blocks from these shards."""
+        supports = [set(self.shard_indices(s).tolist()) for s in shard_ids]
+        resolved: set[int] = set()
+        progress = True
+        while progress and len(resolved) < self.k:
+            progress = False
+            for sup in supports:
+                live = sup - resolved
+                if len(live) == 1:
+                    resolved.add(next(iter(live)))
+                    progress = True
+        return len(resolved) == self.k
+
+    # -- decode ----------------------------------------------------------
+    def decode(self, shards, shard_ids) -> np.ndarray:
+        """Peel: recover the k source blocks from arrived shards.
+
+        ``shards``: (m, rows, cols) arrived coded sums, ``shard_ids``:
+        their shard ids. Raises ``ValueError`` if peeling stalls (use
+        :meth:`peelable` / the nwait predicate to avoid).
+        """
+        shards = [np.array(s, copy=True) for s in np.asarray(shards)]
+        supports = [set(self.shard_indices(s).tolist()) for s in shard_ids]
+        out = [None] * self.k
+        nresolved = 0
+        progress = True
+        while progress and nresolved < self.k:
+            progress = False
+            for sh, sup in zip(shards, supports):
+                if len(sup) != 1:
+                    continue
+                j = next(iter(sup))
+                if out[j] is None:
+                    out[j] = sh.copy()
+                    nresolved += 1
+                sup.clear()
+                progress = True
+                # release: subtract the resolved block everywhere
+                for sh2, sup2 in zip(shards, supports):
+                    if j in sup2:
+                        sh2 -= out[j]
+                        sup2.discard(j)
+        if nresolved < self.k:
+            raise ValueError(
+                f"peeling stalled at {nresolved}/{self.k} blocks; "
+                "shard set not decodable"
+            )
+        return np.stack(out)
+
+    def decode_array(self, shards, shard_ids) -> np.ndarray:
+        blocks = self.decode(shards, shard_ids)
+        return blocks.reshape(-1, *blocks.shape[2:])
+
+
+def nwait_lt_decodable(code: LTCode, shard_of_worker):
+    """Predicate factory: True once the fresh workers' shards peel.
+
+    ``shard_of_worker[i]`` maps pool worker i to its shard id. The
+    predicate runs after every arrival (reference
+    src/MPIAsyncPools.jl:152-154), so the pool returns at the *first*
+    decodable arrival set — the variable-nwait behavior of BASELINE
+    config 4.
+    """
+    shard_of_worker = np.asarray(shard_of_worker)
+
+    def pred(epoch: int, repochs: np.ndarray) -> bool:
+        fresh = np.flatnonzero(repochs == epoch)
+        if fresh.size == 0:
+            return False
+        return code.peelable(shard_of_worker[fresh].tolist())
+
+    return pred

@@ -20,3 +20,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# float64 must stay float64 in the coding-layer tests (the reference's
+# tests are Float64 throughout, SURVEY §7 "the hard parts"); TPU-path
+# tests pin float32 explicitly so this only affects CPU-mesh runs
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,181 @@
+"""Unit tests for the erasure-coding layer (no pool involved).
+
+MDS encode/decode-from-any-k, LT peeling, gradient-code decode weights.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu.ops import MDSCode, LTCode, GradientCode
+from mpistragglers_jl_tpu.ops.lt import robust_soliton
+
+
+class TestMDS:
+    def test_systematic_prefix(self):
+        code = MDSCode(8, 6)
+        assert np.allclose(code.G[:6], np.eye(6))
+
+    def test_encode_decode_every_k_subset(self):
+        # exactness from EVERY k-of-n subset — the MDS property itself
+        rng = np.random.default_rng(0)
+        n, k = 6, 4
+        code = MDSCode(n, k, dtype=np.float64)
+        blocks = rng.standard_normal((k, 8, 5))
+        coded = np.asarray(code.encode(blocks))
+        for idx in itertools.combinations(range(n), k):
+            idx = list(idx)
+            out = np.asarray(code.decode(coded[idx], idx))
+            assert np.allclose(out, blocks, atol=1e-8), f"subset {idx}"
+
+    def test_encode_decode_f32_accuracy(self):
+        rng = np.random.default_rng(1)
+        n, k = 8, 6
+        code = MDSCode(n, k, dtype=np.float32)
+        blocks = rng.standard_normal((k, 16, 8)).astype(np.float32)
+        coded = np.asarray(code.encode(blocks))
+        # worst case: all-parity decode
+        idx = [0, 3, 4, 5, 6, 7]
+        out = np.asarray(code.decode(coded[idx], idx))
+        assert np.allclose(out, blocks, atol=1e-3)
+
+    def test_gaussian_parity(self):
+        rng = np.random.default_rng(2)
+        code = MDSCode(10, 7, parity="gaussian", dtype=np.float64)
+        blocks = rng.standard_normal((7, 4, 3))
+        coded = np.asarray(code.encode(blocks))
+        idx = [2, 3, 5, 6, 7, 8, 9]
+        assert np.allclose(
+            np.asarray(code.decode(coded[idx], idx)), blocks, atol=1e-8)
+
+    def test_encode_array_roundtrip(self):
+        rng = np.random.default_rng(3)
+        code = MDSCode(5, 3, dtype=np.float64)
+        A = rng.standard_normal((12, 7))
+        coded = np.asarray(code.encode_array(A))
+        assert coded.shape == (5, 4, 7)
+        out = np.asarray(code.decode_array(coded[[1, 3, 4]], [1, 3, 4]))
+        assert np.allclose(out, A, atol=1e-8)
+
+    def test_n_equals_k_is_identity(self):
+        code = MDSCode(4, 4)
+        assert np.allclose(code.G, np.eye(4))
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            MDSCode(4, 5)
+        with pytest.raises(ValueError):
+            MDSCode(4, 0)
+        with pytest.raises(ValueError):
+            MDSCode(8, 6, parity="bogus")
+        code = MDSCode(6, 4, dtype=np.float64)
+        blocks = np.zeros((4, 2, 2))
+        coded = np.asarray(code.encode(blocks))
+        with pytest.raises(ValueError):  # duplicate indices
+            code.decode(coded[[0, 0, 1, 2]], [0, 0, 1, 2])
+        with pytest.raises(ValueError):  # wrong count
+            code.decode(coded[[0, 1, 2]], [0, 1, 2])
+        with pytest.raises(ValueError):  # wrong block count to encode
+            code.encode(np.zeros((3, 2, 2)))
+
+
+class TestLT:
+    def test_robust_soliton_is_distribution(self):
+        for k in (4, 16, 64):
+            mu = robust_soliton(k)
+            assert mu.shape == (k,)
+            assert abs(mu.sum() - 1.0) < 1e-12
+            assert (mu >= 0).all()
+
+    def test_shard_indices_deterministic(self):
+        code = LTCode(16, seed=5)
+        for s in range(20):
+            a = code.shard_indices(s)
+            b = code.shard_indices(s)
+            assert np.array_equal(a, b)
+            assert len(set(a.tolist())) == len(a)
+            assert 1 <= len(a) <= 16
+
+    def test_peel_decode_roundtrip(self):
+        rng = np.random.default_rng(4)
+        k = 8
+        code = LTCode(k, seed=0)
+        blocks = rng.standard_normal((k, 6, 4))
+        # collect shards until peelable, then decode
+        ids = []
+        s = 0
+        while not code.peelable(ids):
+            ids.append(s)
+            s += 1
+        G = code.generator_rows(ids)
+        shards = np.einsum("nk,krc->nrc", G, blocks)
+        out = code.decode(shards, ids)
+        assert np.allclose(out, blocks, atol=1e-10)
+
+    def test_peelable_matches_decode(self):
+        # whenever peelable says False, decode must raise; when True, it
+        # must succeed — over many random arrival subsets
+        rng = np.random.default_rng(5)
+        k = 6
+        code = LTCode(k, seed=1)
+        blocks = rng.standard_normal((k, 3, 2))
+        all_ids = list(range(18))
+        G = code.generator_rows(all_ids)
+        shards = np.einsum("nk,krc->nrc", G, blocks)
+        for _ in range(30):
+            m = rng.integers(1, len(all_ids))
+            sub = sorted(rng.choice(len(all_ids), size=m, replace=False).tolist())
+            ids = [all_ids[i] for i in sub]
+            if code.peelable(ids):
+                out = code.decode(shards[sub], ids)
+                assert np.allclose(out, blocks, atol=1e-10)
+            else:
+                with pytest.raises(ValueError):
+                    code.decode(shards[sub], ids)
+
+
+class TestGradientCode:
+    def test_exact_recovery_all_subsets(self):
+        n, s = 6, 2
+        gc = GradientCode(n, s, seed=0)
+        rng = np.random.default_rng(6)
+        grads = rng.standard_normal((n, 5))  # per-chunk gradients
+        coded = gc.B @ grads  # what each worker computes
+        total = grads.sum(axis=0)
+        # every (n-s)-subset must reproduce the exact total gradient
+        for idx in itertools.combinations(range(n), n - s):
+            idx = list(idx)
+            a = gc.decode_weights(idx)
+            assert np.allclose(a @ coded[idx], total, atol=1e-8), idx
+
+    def test_support_is_cyclic_window(self):
+        gc = GradientCode(5, 2)
+        assert gc.support(0) == [0, 1, 2]
+        assert gc.support(3) == [3, 4, 0]
+        assert gc.support(4) == [4, 0, 1]
+
+    def test_more_than_minimum_workers_ok(self):
+        gc = GradientCode(6, 2, seed=1)
+        rng = np.random.default_rng(7)
+        grads = rng.standard_normal((6, 4))
+        coded = gc.B @ grads
+        a = gc.decode_weights([0, 1, 2, 3, 4])  # 5 > n-s = 4
+        assert np.allclose(a @ coded[[0, 1, 2, 3, 4]], grads.sum(0), atol=1e-8)
+
+    def test_too_few_workers_raises(self):
+        gc = GradientCode(6, 2)
+        with pytest.raises(ValueError):
+            gc.decode_weights([0, 1, 2])
+
+    def test_s_zero_is_uncoded(self):
+        gc = GradientCode(4, 0)
+        assert np.count_nonzero(gc.B - np.diag(np.diag(gc.B))) == 0
+        a = gc.decode_weights([0, 1, 2, 3])
+        assert np.allclose(a * np.diag(gc.B), 1.0)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            GradientCode(4, 4)
+        with pytest.raises(ValueError):
+            GradientCode(4, -1)
