@@ -1,0 +1,20 @@
+_HOME = {
+    "make_mesh": "mesh",
+    "MeshCodedGemm": "mesh_gemm",
+    "distributed_mds_decode": "collectives",
+    "masked_psum_scatter_combine": "collectives",
+    "ring_allgather": "collectives",
+}
+
+__all__ = list(_HOME)
+
+
+def __getattr__(name):
+    # lazy: parallel pulls in jax; keep the core package importable
+    # without it
+    if name in _HOME:
+        import importlib
+
+        mod = importlib.import_module(f".{_HOME[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

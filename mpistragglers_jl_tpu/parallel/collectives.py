@@ -1,0 +1,132 @@
+"""Sharded decode/combine collectives: the ICI-fast path.
+
+SURVEY §7's core design split: *computation* stays per-device-independent
+(the async pool's map step — a straggling chip delays nobody), while
+*aggregation* over the winners is where collectives belong. This module
+implements that aggregation as ``shard_map`` programs whose cross-device
+traffic is a single ``psum_scatter``/``all_gather`` riding ICI — the
+TPU-native replacement for the reference's coordinator-side harvest
+copies (src/MPIAsyncPools.jl:108,:167: per-worker memcpy into recvbuf).
+
+The masked combine is data-independent of stragglers: stale shards enter
+with weight zero, so the result never depends on straggler *data*. (On a
+real mesh every chip must still *participate* in the collective — that is
+the XLA bulk-synchronous contract; a truly dead chip means reforming the
+mesh. The fully-asynchronous host-side decode in ops/coding.py remains
+the straggler-proof fallback, and the single-controller pool uses it.)
+
+Why ``psum_scatter``: the MDS decode ``X = W @ shards`` (W the k×k
+inverse padded to n×n with zero rows/cols for stale workers) is, per
+output block j, a weighted sum over workers — each device computes its
+weighted contribution to every output block, and one reduce-scatter both
+sums the contributions and leaves output block j on device j. One
+collective, no gather-to-host, traffic n·blocksize per device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "masked_psum_scatter_combine",
+    "distributed_mds_decode",
+    "ring_allgather",
+]
+
+
+def masked_psum_scatter_combine(mesh: Mesh, axis: str = "w"):
+    """Build the jitted masked weighted-combine over a pool mesh.
+
+    Returns ``combine(shards, weights)`` where ``shards`` is sharded
+    (n, rows, cols) with one block per device along ``axis`` and
+    ``weights`` is a replicated (n, n) matrix (row j = coefficients of
+    output block j over workers; zero column for every stale worker).
+    Output: (n, rows, cols), block j resident on device j — i.e. the
+    combined result, still sharded, ready for the next sharded consumer.
+    """
+
+    def _combine(shard, weights):
+        # shard: (1, rows, cols) this device's block; weights: (n, n)
+        w = jax.lax.axis_index(axis)
+        contrib = weights[:, w][:, None, None] * shard[0][None]  # (n, r, c)
+        # reduce-scatter: sums contributions AND places block j on dev j
+        out = jax.lax.psum_scatter(
+            contrib, axis, scatter_dimension=0, tiled=False
+        )
+        return out[None] if out.ndim == 2 else out
+
+    f = jax.shard_map(
+        _combine,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(axis),
+    )
+    return jax.jit(f)
+
+
+def distributed_mds_decode(mesh: Mesh, code, axis: str = "w"):
+    """Sharded decode for an (n, k) MDS code (ops/coding.MDSCode).
+
+    Returns ``decode(shards, repochs, epoch)``: given the pool's sharded
+    coded results (n, rows, cols) and the arrival mask, computes the
+    decode weights host-side (tiny k×k solve on fresh rows of G) and runs
+    the masked psum_scatter combine — source block j lands on device j,
+    devices j >= k receive zeros.
+    """
+    combine = masked_psum_scatter_combine(mesh, axis)
+    n, k = code.n, code.k
+
+    def decode(shards, repochs, epoch):
+        fresh = np.flatnonzero(np.asarray(repochs) == epoch)
+        if fresh.size < k:
+            raise ValueError(
+                f"only {fresh.size} fresh shards, need k={k}"
+            )
+        idx = fresh[:k]
+        Winv = np.linalg.inv(code.G[idx])  # (k, k)
+        weights = np.zeros((n, n), dtype=code.G.dtype)
+        weights[:k, idx] = Winv
+        return combine(shards, jnp.asarray(weights))
+
+    return decode
+
+
+def ring_allgather(mesh: Mesh, axis: str = "w"):
+    """Ring all-gather via ``ppermute`` — the building block pattern for
+    ring attention (parallel/ring_attention.py) exposed standalone.
+
+    Returns ``gather(x)`` mapping per-device (rows, cols) blocks to the
+    full (n*rows, cols) array on every device, moving one block per step
+    around the ring (n-1 steps, each over a single ICI hop).
+    """
+    n = mesh.shape[axis]
+
+    def _gather(x):
+        # x: (1, rows, cols) local block
+        block = x[0]
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        me = jax.lax.axis_index(axis)
+
+        def step(carry, _):
+            recv, out, src = carry
+            nxt = jax.lax.ppermute(recv, axis, perm)
+            src = (src - 1) % n
+            out = jax.lax.dynamic_update_index_in_dim(out, nxt, src, 0)
+            return (nxt, out, src), None
+
+        out0 = jnp.zeros((n,) + block.shape, block.dtype)
+        out0 = jax.lax.dynamic_update_index_in_dim(out0, block, me, 0)
+        (_, out, _), _ = jax.lax.scan(
+            step, (block, out0, me), None, length=n - 1
+        )
+        return out.reshape((1, n * block.shape[0]) + block.shape[1:])
+
+    f = jax.shard_map(
+        _gather, mesh=mesh, in_specs=(P(axis),), out_specs=P(axis)
+    )
+    return jax.jit(f)
