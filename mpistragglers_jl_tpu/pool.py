@@ -46,11 +46,14 @@ State-machine invariants preserved exactly (reference §2.1 semantics):
 from __future__ import annotations
 
 import time
-from typing import Callable, Sequence, Union
+from typing import TYPE_CHECKING, Callable, Sequence, Union
 
 import numpy as np
 
 from .backends.base import Backend, WorkerError
+
+if TYPE_CHECKING:  # runtime import would be circular (utils -> pool)
+    from .utils.trace import EpochTracer
 
 NwaitArg = Union[int, Callable[[int, np.ndarray], bool]]
 
@@ -199,6 +202,7 @@ def asyncmap(
     nwait: NwaitArg | None = None,
     epoch: int | None = None,
     tag: int = 0,
+    tracer: "EpochTracer | None" = None,
 ) -> np.ndarray:
     """Broadcast ``sendbuf`` to all idle workers; wait for the fastest few.
 
@@ -235,48 +239,69 @@ def asyncmap(
     # (reference src/MPIAsyncPools.jl:87)
     pool.epoch = int(epoch)
     backend.begin_epoch(pool.epoch)
+    if tracer is not None:
+        tracer.begin("asyncmap", pool.epoch, nwait)
 
-    # PHASE 1 — opportunistic, non-blocking drain of results that arrived
-    # since the last call, to keep iterations independent
-    # (reference src/MPIAsyncPools.jl:91-114).
-    for i in range(n):
-        if not pool.active[i]:
-            continue
-        result = backend.test(i)
-        if result is None:
-            continue
-        _store(pool, i, result, recvbufs)
-        pool.active[i] = False
-
-    # PHASE 2 — dispatch to every idle worker; all workers are active after
-    # this loop (reference src/MPIAsyncPools.jl:118-139).
-    for i in range(n):
-        if pool.active[i]:
-            continue
-        _dispatch(pool, backend, i, sendbuf, tag)
-
-    # PHASE 3 — collect until satisfied: the hot loop
-    # (reference src/MPIAsyncPools.jl:145-185). Only arrivals stamped with
-    # the current epoch count toward integer-nwait completion; stale
-    # arrivals trigger an immediate re-task and the worker stays active.
-    nrecv = 0
-    while True:
-        if callable(nwait):
-            if bool(nwait(pool.epoch, pool.repochs)):
-                break
-        else:
-            if nrecv >= nwait:
-                break
-        # block until any active worker responds
-        # (reference MPI.Waitany! at src/MPIAsyncPools.jl:161)
-        i, result = backend.wait_any(np.flatnonzero(pool.active))
-        _store(pool, i, result, recvbufs)
-        if pool.repochs[i] == pool.epoch:
-            nrecv += 1
+    # the finally clause flushes the open trace record even when a
+    # WorkerFailure or buffer-size error aborts the call — failure traces
+    # are the ones worth keeping
+    try:
+        # PHASE 1 — opportunistic, non-blocking drain of results that
+        # arrived since the last call, to keep iterations independent
+        # (reference src/MPIAsyncPools.jl:91-114).
+        for i in range(n):
+            if not pool.active[i]:
+                continue
+            result = backend.test(i)
+            if result is None:
+                continue
+            _store(pool, i, result, recvbufs)
             pool.active[i] = False
-        else:
-            _dispatch(pool, backend, i, sendbuf, tag)
+            if tracer is not None:
+                tracer.arrival(
+                    i, pool.repochs[i],
+                    fresh=pool.repochs[i] == pool.epoch, drain=True,
+                )
 
+        # PHASE 2 — dispatch to every idle worker; all workers are active
+        # after this loop (reference src/MPIAsyncPools.jl:118-139).
+        for i in range(n):
+            if pool.active[i]:
+                continue
+            _dispatch(pool, backend, i, sendbuf, tag)
+            if tracer is not None:
+                tracer.dispatch(i, pool.epoch)
+
+        # PHASE 3 — collect until satisfied: the hot loop
+        # (reference src/MPIAsyncPools.jl:145-185). Only arrivals stamped
+        # with the current epoch count toward integer-nwait completion;
+        # stale arrivals trigger an immediate re-task and the worker
+        # stays active.
+        nrecv = 0
+        while True:
+            if callable(nwait):
+                if bool(nwait(pool.epoch, pool.repochs)):
+                    break
+            else:
+                if nrecv >= nwait:
+                    break
+            # block until any active worker responds
+            # (reference MPI.Waitany! at src/MPIAsyncPools.jl:161)
+            i, result = backend.wait_any(np.flatnonzero(pool.active))
+            _store(pool, i, result, recvbufs)
+            fresh = pool.repochs[i] == pool.epoch
+            if tracer is not None:
+                tracer.arrival(i, pool.repochs[i], fresh=fresh)
+            if fresh:
+                nrecv += 1
+                pool.active[i] = False
+            else:
+                _dispatch(pool, backend, i, sendbuf, tag)
+                if tracer is not None:
+                    tracer.dispatch(i, pool.epoch, retask=True)
+    finally:
+        if tracer is not None:
+            tracer.end(pool)
     return pool.repochs
 
 
@@ -286,6 +311,7 @@ def waitall(
     recvbuf: np.ndarray | None = None,
     *,
     timeout: float | None = None,
+    tracer: "EpochTracer | None" = None,
 ) -> np.ndarray:
     """Drain the pool: block until every active worker has responded.
 
@@ -301,15 +327,28 @@ def waitall(
     recvbufs = _recv_chunks(recvbuf, n)
     if not pool.active.any():
         return pool.repochs
-    deadline = None if timeout is None else time.perf_counter() + timeout
-    for i in list(np.flatnonzero(pool.active)):
-        remaining = None if deadline is None else deadline - time.perf_counter()
-        result = backend.wait(i, timeout=remaining)
-        if result is None:
-            dead = [int(j) for j in np.flatnonzero(pool.active)]
-            raise DeadWorkerError(dead, timeout)
-        _store(pool, i, result, recvbufs)
-        pool.active[i] = False
+    if tracer is not None:
+        # nwait field = number of workers actually being drained
+        tracer.begin("waitall", pool.epoch, int(pool.active.sum()))
+    try:
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        for i in list(np.flatnonzero(pool.active)):
+            remaining = (
+                None if deadline is None else deadline - time.perf_counter()
+            )
+            result = backend.wait(i, timeout=remaining)
+            if result is None:
+                dead = [int(j) for j in np.flatnonzero(pool.active)]
+                raise DeadWorkerError(dead, timeout)
+            _store(pool, i, result, recvbufs)
+            pool.active[i] = False
+            if tracer is not None:
+                tracer.arrival(
+                    i, pool.repochs[i], fresh=pool.repochs[i] == pool.epoch
+                )
+    finally:
+        if tracer is not None:
+            tracer.end(pool)
     return pool.repochs
 
 

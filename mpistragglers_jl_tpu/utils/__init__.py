@@ -1,0 +1,21 @@
+"""Auxiliary subsystems: tracing, fault injection, checkpoint, codecs.
+
+All of these are new capability relative to the reference, which has no
+observability beyond ``pool.latency``, no deterministic fault injection
+(random ``sleep`` only), and no checkpointing (SURVEY §5).
+"""
+
+from . import faults
+from .trace import EpochTracer, EpochRecord, Event
+from .checkpoint import state_dict, load_state_dict, save, restore
+
+__all__ = [
+    "faults",
+    "EpochTracer",
+    "EpochRecord",
+    "Event",
+    "state_dict",
+    "load_state_dict",
+    "save",
+    "restore",
+]

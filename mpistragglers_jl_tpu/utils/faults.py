@@ -1,0 +1,239 @@
+"""Deterministic fault and latency injection.
+
+The reference induces stragglers with bare randomness — workers
+``sleep(rand())`` (reference examples/iterative_example.jl:74) or
+``sleep(max(rand()/10, 0.005))`` (reference test/kmap2.jl:95) — which
+SURVEY §4/§5 flags as the gap to close: on a real TPU slice stragglers
+are rare and ICI is lockstep-fast, so *injection* must be a first-class,
+reproducible test subsystem rather than an un-seeded sleep.
+
+Every factory here returns a ``DelayFn`` — ``(worker, epoch) -> seconds``
+— consumable by any backend's ``delay_fn`` kwarg (backends/base.py
+``MailboxBackend``). All schedules are pure functions of ``(worker,
+epoch)`` (seeded hashing, no global RNG state), so a failing test
+reproduces bit-for-bit and schedules compose freely.
+
+Failure (as opposed to latency) injection is expressed by wrapping the
+workload: :func:`failing` raises inside the worker at chosen epochs,
+exercising the coordinator-side :class:`~..backends.base.WorkerFailure`
+surfacing path the reference entirely lacks (its worker assertions die
+silently inside mpiexec subprocesses — reference test/runtests.jl:47).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Callable, Mapping, Sequence
+
+import numpy as np
+
+from ..backends.base import DelayFn
+
+__all__ = [
+    "no_delay",
+    "fixed",
+    "per_worker",
+    "seeded_uniform",
+    "seeded_lognormal",
+    "straggler",
+    "intermittent",
+    "dead_from",
+    "compose",
+    "failing",
+    "FaultSchedule",
+]
+
+
+def _unit(seed: int, worker: int, epoch: int) -> float:
+    """Deterministic uniform [0, 1) from (seed, worker, epoch).
+
+    Uses blake2b so nearby (worker, epoch) pairs decorrelate — the
+    reproducible stand-in for the reference's ``rand()``.
+    """
+    h = hashlib.blake2b(
+        struct.pack("<qqq", seed, worker, epoch), digest_size=8
+    ).digest()
+    return struct.unpack("<Q", h)[0] / 2.0**64
+
+
+def no_delay(worker: int, epoch: int) -> float:
+    """The null schedule (every worker instant)."""
+    return 0.0
+
+
+def fixed(seconds: float) -> DelayFn:
+    """Every worker stalls ``seconds`` every epoch."""
+    return lambda worker, epoch: float(seconds)
+
+
+def per_worker(delays: Sequence[float] | Mapping[int, float]) -> DelayFn:
+    """Constant per-worker delay; workers absent from a mapping get 0."""
+    if isinstance(delays, Mapping):
+        table = dict(delays)
+        return lambda worker, epoch: float(table.get(worker, 0.0))
+    arr = [float(d) for d in delays]
+    return lambda worker, epoch: arr[worker]
+
+
+def seeded_uniform(lo: float, hi: float, *, seed: int = 0) -> DelayFn:
+    """Deterministic analog of the reference's ``sleep(rand())``: uniform
+    in [lo, hi), reproducible per (worker, epoch)."""
+    span = float(hi) - float(lo)
+    return lambda worker, epoch: lo + span * _unit(seed, worker, epoch)
+
+
+def seeded_lognormal(
+    median: float, sigma: float = 1.0, *, seed: int = 0
+) -> DelayFn:
+    """Heavy-tailed straggler model: lognormal with given median.
+
+    Lognormal tails are the standard empirical model for straggler
+    latencies (occasional order-of-magnitude outliers), which uniform
+    sleeps cannot produce.
+    """
+
+    def fn(worker: int, epoch: int) -> float:
+        u1 = _unit(seed, worker, epoch)
+        u2 = _unit(seed + 0x9E3779B9, worker, epoch)
+        # Box-Muller; clamp u1 away from 0
+        z = np.sqrt(-2.0 * np.log(max(u1, 1e-12))) * np.cos(2 * np.pi * u2)
+        return float(median * np.exp(sigma * z))
+
+    return fn
+
+
+def straggler(
+    workers: int | Sequence[int], delay: float, *, every: int = 1, offset: int = 0
+) -> DelayFn:
+    """Designated worker(s) stall ``delay`` seconds on epochs where
+    ``epoch % every == offset``; everyone else is instant.
+
+    The workhorse for fastest-k tests: make worker j *the* straggler and
+    assert the pool returns without it.
+    """
+    ws = {workers} if isinstance(workers, (int, np.integer)) else set(workers)
+    return (
+        lambda worker, epoch: float(delay)
+        if worker in ws and epoch % every == offset % every
+        else 0.0
+    )
+
+
+def intermittent(p: float, delay: float, *, seed: int = 0) -> DelayFn:
+    """Each (worker, epoch) independently stalls ``delay`` with
+    probability ``p`` — deterministic given the seed."""
+    return (
+        lambda worker, epoch: float(delay)
+        if _unit(seed, worker, epoch) < p
+        else 0.0
+    )
+
+
+def dead_from(workers: int | Sequence[int], epoch: int, *, delay: float = 3600.0) -> DelayFn:
+    """Worker(s) become unresponsive from ``epoch`` onward.
+
+    A dead worker is modelled as an arbitrarily long stall (default 1 h)
+    — exactly how the reference's design treats death ("a dead worker is
+    indistinguishable from an infinite straggler", SURVEY §5). Pair with
+    ``waitall(timeout=...)`` to exercise :class:`~..pool.DeadWorkerError`.
+    """
+    ws = {workers} if isinstance(workers, (int, np.integer)) else set(workers)
+    return (
+        lambda worker, e: float(delay) if worker in ws and e >= epoch else 0.0
+    )
+
+
+def compose(*fns: DelayFn) -> DelayFn:
+    """Sum of schedules (e.g. baseline jitter + one designated straggler)."""
+    return lambda worker, epoch: sum(f(worker, epoch) for f in fns)
+
+
+def failing(
+    work_fn: Callable,
+    *,
+    workers: int | Sequence[int],
+    epochs: int | Sequence[int] | None = None,
+    error: Callable[[], BaseException] = lambda: RuntimeError("injected fault"),
+):
+    """Wrap a workload so designated workers *raise* at designated epochs.
+
+    Returns a drop-in ``work_fn(worker, payload, epoch)``. ``epochs=None``
+    means every epoch. The raise happens inside the worker; the backend
+    captures it and the coordinator sees a ``WorkerFailure`` at harvest.
+    """
+    ws = {workers} if isinstance(workers, (int, np.integer)) else set(workers)
+    es = (
+        None
+        if epochs is None
+        else ({epochs} if isinstance(epochs, (int, np.integer)) else set(epochs))
+    )
+
+    def wrapped(worker, payload, epoch):
+        if worker in ws and (es is None or epoch in es):
+            raise error()
+        return work_fn(worker, payload, epoch)
+
+    return wrapped
+
+
+class FaultSchedule:
+    """Declarative scenario builder collecting delay + failure injections.
+
+    >>> sched = (FaultSchedule(seed=7)
+    ...          .jitter(0.001, 0.005)
+    ...          .straggler(2, 0.2, every=3)
+    ...          .dead_from(5, epoch=10))
+    >>> backend = LocalBackend(work, n, delay_fn=sched.delay_fn)
+
+    Keeps whole scenarios reproducible from one seed and printable for
+    failure reports (``repr`` lists the stacked schedules).
+    """
+
+    def __init__(self, *, seed: int = 0):
+        self.seed = seed
+        self._fns: list[DelayFn] = []
+        self._desc: list[str] = []
+
+    def _add(self, fn: DelayFn, desc: str) -> "FaultSchedule":
+        self._fns.append(fn)
+        self._desc.append(desc)
+        return self
+
+    def jitter(self, lo: float, hi: float) -> "FaultSchedule":
+        return self._add(
+            seeded_uniform(lo, hi, seed=self.seed), f"jitter[{lo},{hi})"
+        )
+
+    def lognormal(self, median: float, sigma: float = 1.0) -> "FaultSchedule":
+        return self._add(
+            seeded_lognormal(median, sigma, seed=self.seed),
+            f"lognormal(median={median},sigma={sigma})",
+        )
+
+    def straggler(
+        self, workers, delay: float, *, every: int = 1, offset: int = 0
+    ) -> "FaultSchedule":
+        return self._add(
+            straggler(workers, delay, every=every, offset=offset),
+            f"straggler({workers},{delay}s,every={every})",
+        )
+
+    def intermittent(self, p: float, delay: float) -> "FaultSchedule":
+        return self._add(
+            intermittent(p, delay, seed=self.seed),
+            f"intermittent(p={p},{delay}s)",
+        )
+
+    def dead_from(self, workers, epoch: int) -> "FaultSchedule":
+        return self._add(
+            dead_from(workers, epoch), f"dead_from({workers},epoch={epoch})"
+        )
+
+    @property
+    def delay_fn(self) -> DelayFn:
+        fns = list(self._fns)
+        return lambda worker, epoch: sum(f(worker, epoch) for f in fns)
+
+    def __repr__(self) -> str:
+        return f"FaultSchedule(seed={self.seed}, [{', '.join(self._desc)}])"
