@@ -1,0 +1,63 @@
+"""Native (C++) runtime components, compiled on demand.
+
+The reference's only native code is the external ``libmpi`` it reaches
+through MPI.jl (SURVEY §2, component C8); the TPU data path's native
+runtime is XLA itself. What lives here is the host-side native layer
+this framework adds: currently the GF(256) Reed-Solomon codec
+(rs_gf256.cpp) used for byte-exact erasure coding of host payloads.
+
+Libraries are compiled with ``g++ -O3 -shared -fPIC`` on first use and
+cached next to the source (gitignored). Consumers fall back to a pure
+NumPy implementation when no compiler is available, so the package never
+hard-fails on import.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def lib_path(name: str) -> str:
+    return os.path.join(_DIR, f"_lib{name}.so")
+
+
+def build(name: str, *, force: bool = False) -> str:
+    """Compile ``<name>.cpp`` into ``_lib<name>.so`` if stale; return the
+    library path. Thread-safe; cheap when the library is current."""
+    src = os.path.join(_DIR, f"{name}.cpp")
+    out = lib_path(name)
+    with _LOCK:
+        if (
+            not force
+            and os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)
+        ):
+            return out
+        # pid-suffixed tmp keeps concurrent builds from separate
+        # processes from clobbering each other; os.replace is atomic
+        tmp = f"{out}.{os.getpid()}.tmp"
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            "-o", tmp, src,
+        ]
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=120
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise NativeBuildError(f"g++ unavailable or hung: {e}") from e
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"g++ failed for {src}:\n{proc.stderr}"
+            )
+        os.replace(tmp, out)
+    return out

@@ -8,6 +8,7 @@ observability beyond ``pool.latency``, no deterministic fault injection
 from . import faults
 from .trace import EpochTracer, EpochRecord, Event
 from .checkpoint import state_dict, load_state_dict, save, restore
+from .rs_gf256 import RSGF256
 
 __all__ = [
     "faults",
@@ -18,4 +19,5 @@ __all__ = [
     "load_state_dict",
     "save",
     "restore",
+    "RSGF256",
 ]
