@@ -1,10 +1,23 @@
-__all__ = ["LogisticRegression", "CodedSGD"]
+_HOME = {
+    "LogisticRegression": "logreg",
+    "CodedSGD": "logreg",
+    "TransformerConfig": "transformer",
+    "init_params": "transformer",
+    "param_specs": "transformer",
+    "forward_dense": "transformer",
+    "make_forward": "transformer",
+    "make_train_step": "transformer",
+    "shard_params": "transformer",
+}
+
+__all__ = list(_HOME)
 
 
 def __getattr__(name):
     # lazy: models pull in jax; keep the core package importable without it
-    if name in ("LogisticRegression", "CodedSGD"):
-        from . import logreg
+    if name in _HOME:
+        import importlib
 
-        return getattr(logreg, name)
+        mod = importlib.import_module(f".{_HOME[name]}", __name__)
+        return getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,276 @@
+"""Flagship model: decoder-only transformer, SPMD over a dp x sp x tp mesh.
+
+The reference has no model code of any kind (SURVEY §2: "the library has
+no model code at all") — its workloads are conventions written by users.
+This framework ships model families as first-class components; the
+transformer is the flagship long-context workload, exercising every
+parallel mechanism the framework provides in one train step:
+
+* **dp** — batch data parallelism: batch sharded over ``dp``; gradient
+  averaging is the ``psum`` XLA inserts when the loss mean crosses the
+  axis.
+* **sp** — sequence/context parallelism: activations sharded over the
+  sequence axis; attention is exact ring attention
+  (parallel/ring_attention.py) whose K/V blocks ride ICI via
+  ``ppermute``, or Ulysses all-to-all. This is the long-context story:
+  per-device activation memory is O(L / sp).
+* **tp** — Megatron-style tensor parallelism: attention heads and the
+  MLP hidden dimension sharded over ``tp``; one ``psum`` after the
+  attention out-projection and one after the MLP down-projection.
+
+The whole train step is a single ``shard_map`` program under ``jit`` —
+collectives are explicit where they are structural (ring ppermute, tp
+psum) and compiler-inserted where they are incidental (loss mean). RoPE
+positions are computed from the global offset ``sp_index * L_local``, so
+sequence sharding is invisible to the math.
+
+Weight layout (TPU-first): projections keep (d_model, heads, head_dim)
+so the contracted dim is leading and heads*head_dim tile the MXU lanes;
+everything defaults to float32 with a ``dtype`` knob for bfloat16
+compute on real chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.ring_attention import (
+    reference_attention,
+    ring_self_attention,
+    ulysses_attention,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "param_specs",
+    "forward_dense",
+    "make_forward",
+    "make_train_step",
+    "shard_params",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    attn: str = "ring"  # "ring" | "ulysses" | used inside shard_map
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads != 0:
+            raise ValueError(
+                f"d_model {self.d_model} not divisible by n_heads "
+                f"{self.n_heads}"
+            )
+        if (self.d_model // self.n_heads) % 2 != 0:
+            raise ValueError(
+                f"RoPE requires even head_dim, got "
+                f"{self.d_model // self.n_heads}"
+            )
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(cfg: TransformerConfig, seed: int = 0) -> dict:
+    """Plain pytree-of-arrays parameters (replicable / shardable)."""
+    rng = np.random.default_rng(seed)
+    D, H, Dh, F = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    sd = lambda *s: jnp.asarray(
+        rng.standard_normal(s) / np.sqrt(s[0]), cfg.dtype
+    )
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append(
+            {
+                "ln1_s": jnp.ones((D,), cfg.dtype),
+                "ln1_b": jnp.zeros((D,), cfg.dtype),
+                "wq": sd(D, H, Dh),
+                "wk": sd(D, H, Dh),
+                "wv": sd(D, H, Dh),
+                "wo": sd(H, Dh, D) / np.sqrt(cfg.n_layers),
+                "ln2_s": jnp.ones((D,), cfg.dtype),
+                "ln2_b": jnp.zeros((D,), cfg.dtype),
+                "w1": sd(D, F),
+                "b1": jnp.zeros((F,), cfg.dtype),
+                "w2": sd(F, D) / np.sqrt(cfg.n_layers),
+                "b2": jnp.zeros((D,), cfg.dtype),
+            }
+        )
+    return {
+        "emb": jnp.asarray(
+            rng.standard_normal((cfg.vocab, D)) * 0.02, cfg.dtype
+        ),
+        "layers": layers,
+        "lnf_s": jnp.ones((D,), cfg.dtype),
+        "lnf_b": jnp.zeros((D,), cfg.dtype),
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpecs matching :func:`init_params`: heads and d_ff over
+    ``tp`` (Megatron split), everything else replicated."""
+    layer = {
+        "ln1_s": P(), "ln1_b": P(),
+        "wq": P(None, "tp", None),
+        "wk": P(None, "tp", None),
+        "wv": P(None, "tp", None),
+        "wo": P("tp", None, None),
+        "ln2_s": P(), "ln2_b": P(),
+        "w1": P(None, "tp"),
+        "b1": P("tp"),
+        "w2": P("tp", None),
+        "b2": P(),
+    }
+    return {
+        "emb": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "lnf_s": P(),
+        "lnf_b": P(),
+    }
+
+
+def _ln(x, s, b, eps=1e-5):
+    x = x.astype(jnp.float32)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return ((x - mu) * jax.lax.rsqrt(var + eps)).astype(s.dtype) * s + b
+
+
+def _rope(x, pos):
+    """Rotary embedding; pos carries GLOBAL token positions (L,)."""
+    B, L, H, Dh = x.shape
+    half = Dh // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]  # (L, half)
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+
+
+def _attn_block(x, lp, pos, attn_fn):
+    """Attention half-block on (B, L?, D) activations; the head dim may
+    be the tp-local shard — the caller supplies matching weights and the
+    tp psum when sharded (``attn_fn`` closes over sp specifics)."""
+    h = _ln(x, lp["ln1_s"], lp["ln1_b"])
+    q = jnp.einsum("bld,dhk->blhk", h, lp["wq"])
+    k = jnp.einsum("bld,dhk->blhk", h, lp["wk"])
+    v = jnp.einsum("bld,dhk->blhk", h, lp["wv"])
+    q, k = _rope(q, pos), _rope(k, pos)
+    o = attn_fn(q, k, v)
+    return jnp.einsum("blhk,hkd->bld", o, lp["wo"])
+
+
+def _mlp(x, lp):
+    a = jax.nn.gelu(jnp.einsum("bld,df->blf", x, lp["w1"]) + lp["b1"])
+    return jnp.einsum("blf,fd->bld", a, lp["w2"])
+
+
+def forward_dense(params: dict, tokens: jax.Array, cfg: TransformerConfig):
+    """Unsharded oracle forward: full attention, no collectives. The
+    sharded program must agree with this bit-for-float."""
+    pos = jnp.arange(tokens.shape[1])
+    x = params["emb"][tokens]
+    for lp in params["layers"]:
+        attn_out = _attn_block(
+            x, lp, pos,
+            lambda q, k, v: reference_attention(q, k, v, causal=True),
+        )
+        x = x + attn_out
+        h = _ln(x, lp["ln2_s"], lp["ln2_b"])
+        x = x + _mlp(h, lp) + lp["b2"]
+    x = _ln(x, params["lnf_s"], params["lnf_b"])
+    return jnp.einsum("bld,vd->blv", x, params["emb"])  # tied head
+
+
+def _forward_local(params, tokens, cfg: TransformerConfig):
+    """Per-shard forward: tokens are the (dp, sp)-local chunk, params the
+    tp-local shards. Returns local logits (B', L', V)."""
+    Lc = tokens.shape[1]
+    pos = jax.lax.axis_index("sp") * Lc + jnp.arange(Lc)
+    if cfg.attn == "ring":
+        attn = partial(ring_self_attention, axis="sp", causal=True)
+    elif cfg.attn == "ulysses":
+        attn = partial(ulysses_attention, axis="sp", causal=True)
+    else:
+        raise ValueError(f"unknown sharded attention kind {cfg.attn!r}")
+    x = params["emb"][tokens]
+    for lp in params["layers"]:
+        attn_out = _attn_block(x, lp, pos, attn)
+        # tp combine: heads were a shard, the out-projection partial-sums
+        attn_out = jax.lax.psum(attn_out, "tp")
+        x = x + attn_out
+        h = _ln(x, lp["ln2_s"], lp["ln2_b"])
+        y = jax.lax.psum(_mlp(h, lp), "tp")  # d_ff shard partial-sum
+        x = x + y + lp["b2"]  # b2 outside the psum (it is replicated)
+    x = _ln(x, params["lnf_s"], params["lnf_b"])
+    return jnp.einsum("bld,vd->blv", x, params["emb"])
+
+
+def _loss_local(params, tokens, targets, cfg: TransformerConfig):
+    logits = _forward_local(params, tokens, cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    total = jax.lax.psum(nll.sum(), ("dp", "sp"))
+    count = jax.lax.psum(jnp.asarray(nll.size, jnp.float32), ("dp", "sp"))
+    return total / count
+
+
+def make_forward(cfg: TransformerConfig, mesh: Mesh):
+    """Jitted sharded forward over global (B, L) token arrays."""
+    f = jax.shard_map(
+        partial(_forward_local, cfg=cfg),
+        mesh=mesh,
+        in_specs=(param_specs(cfg), P("dp", "sp")),
+        out_specs=P("dp", "sp"),
+    )
+    return jax.jit(f)
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Mesh, *, lr: float = 1e-2):
+    """Jitted (params, tokens, targets) -> (params, loss) SGD step.
+
+    The loss/grad runs as one shard_map program (explicit ring/tp
+    collectives inside); the parameter update stays in plain jit where
+    XLA propagates the NamedShardings.
+    """
+    loss_fn = jax.shard_map(
+        partial(_loss_local, cfg=cfg),
+        mesh=mesh,
+        in_specs=(param_specs(cfg), P("dp", "sp"), P("dp", "sp")),
+        out_specs=P(),
+    )
+
+    @jax.jit
+    def step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
+                              params, grads)
+        return params, loss
+
+    return step
+
+
+def shard_params(params: dict, cfg: TransformerConfig, mesh: Mesh) -> dict:
+    """Place a replicated param pytree onto the mesh per param_specs."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params,
+        param_specs(cfg),
+    )

@@ -4,6 +4,11 @@ _HOME = {
     "distributed_mds_decode": "collectives",
     "masked_psum_scatter_combine": "collectives",
     "ring_allgather": "collectives",
+    "ring_self_attention": "ring_attention",
+    "ulysses_attention": "ring_attention",
+    "make_ring_attention": "ring_attention",
+    "make_ulysses_attention": "ring_attention",
+    "reference_attention": "ring_attention",
 }
 
 __all__ = list(_HOME)

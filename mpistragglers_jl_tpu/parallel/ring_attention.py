@@ -1,0 +1,218 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Long-context support is first-class in this framework even though the
+reference has none of it ("no ring attention, no context/sequence
+parallel, no attention or model code of any kind" — SURVEY §5
+'Long-context'): a framework at this scale must handle sequences longer
+than one chip's HBM, and the mechanisms below are the TPU-native way.
+
+Two complementary strategies over an ``"sp"`` mesh axis of size n:
+
+* **Ring attention** (:func:`ring_self_attention`): Q stays put; K/V
+  blocks rotate around the ring via ``jax.lax.ppermute`` (one ICI hop
+  per step), with numerically-stable *online softmax* accumulation so no
+  device ever materializes the full (L, L) score matrix or the full K/V.
+  Memory per device is O(L/n), traffic is n-1 block transfers fully
+  overlappable with the block matmuls. Causal masking is applied from
+  global positions, so whole future blocks contribute zeros (XLA still
+  executes them — static shapes — but no extra communication happens).
+* **Ulysses all-to-all** (:func:`ulysses_attention`): one
+  ``jax.lax.all_to_all`` re-shards sequence-sharded Q/K/V into
+  head-sharded full-sequence tensors, attention runs *unsharded per
+  head group* on each device, and a second all-to-all restores sequence
+  sharding. Two collectives total, best when n divides the head count.
+
+Both are written as *per-shard* functions to be called inside a
+``shard_map`` (composable into larger SPMD programs — see
+models/transformer.py, which runs them inside its dp x sp x tp train
+step); ``make_ring_attention`` / ``make_ulysses_attention`` wrap them
+into standalone jitted callables over global arrays.
+
+Layout convention: activations are (batch, seq, heads, head_dim), the
+TPU-friendly layout where the trailing two dims (heads*head_dim) tile
+onto the MXU/VPU lanes and the sequence axis is shardable.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = [
+    "ring_self_attention",
+    "ulysses_attention",
+    "make_ring_attention",
+    "make_ulysses_attention",
+    "reference_attention",
+]
+
+_NEG = -1e30  # large-negative mask value; -inf breaks the m-update exp
+
+
+def _block_update(q, kc, vc, o, m, l, qpos, kpos, scale, causal):
+    """One online-softmax accumulation step against K/V block (kc, vc).
+
+    q: (B, Lq, H, D); kc/vc: (B, Lk, H, D); o: (B, Lq, H, D) f32;
+    m, l: (B, H, Lq) f32 running max / normalizer.
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, kc, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = kpos[None, :] <= qpos[:, None]  # (Lq, Lk)
+        s = jnp.where(mask[None, None], s, _NEG)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # rows with nothing visible yet keep m=_NEG; their p underflows to 0
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    corr = jnp.exp(m - m_new)  # (B, H, Lq)
+    l = l * corr + p.sum(axis=-1)
+    o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, vc.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return o, m_new, l
+
+
+def ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str = "sp",
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Exact attention over ring-sharded sequence; call inside shard_map.
+
+    Arguments are the *local* sequence chunks: (B, L/n, H, D) each. The
+    K/V pair makes n-1 hops around the ring (``ppermute`` under a
+    ``lax.scan``, so the loop is compiled once); the online-softmax
+    carry (o, m, l) makes the result exact, not approximate. Returns the
+    local (B, L/n, H, D) output chunk, in q's dtype.
+    """
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    Lc = q.shape[1]
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    qpos = me * Lc + jnp.arange(Lc)
+
+    # derive the accumulators from q so they inherit its full set of
+    # varying mesh axes (not just the ring axis — the enclosing
+    # shard_map may span dp/tp too) and the scan carry types match
+    o0 = q.astype(jnp.float32) * 0.0
+    zeros = o0.sum(-1).transpose(0, 2, 1)  # (B, H, Lq)
+    m0 = zeros + _NEG
+    l0 = zeros
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    # step 0: the resident block, no communication
+    o, m, l = _block_update(
+        q, k, v, o0, m0, l0, qpos, me * Lc + jnp.arange(Lc), scale, causal
+    )
+
+    def step(carry, i):
+        o, m, l, kc, vc = carry
+        # rotate K/V one hop first, then accumulate — n-1 hops total, no
+        # discarded final transfer
+        kc = jax.lax.ppermute(kc, axis, perm)
+        vc = jax.lax.ppermute(vc, axis, perm)
+        src = (me - i) % n  # who originally owned the block we now hold
+        kpos = src * Lc + jnp.arange(Lc)
+        o, m, l = _block_update(
+            q, kc, vc, o, m, l, qpos, kpos, scale, causal
+        )
+        return (o, m, l, kc, vc), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o, m, l, k, v), jnp.arange(1, n)
+    )
+    l = jnp.maximum(l, 1e-20)  # fully-masked rows (non-causal never hits)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str = "sp",
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """All-to-all sequence parallelism; call inside shard_map.
+
+    Local chunks (B, L/n, H, D) are re-sharded by one ``all_to_all``
+    into (B, L, H/n, D) — full sequence, head subset — attention runs
+    locally, and the inverse all_to_all restores (B, L/n, H, D).
+    Requires H % n == 0.
+    """
+    n = jax.lax.axis_size(axis)
+    if q.shape[2] % n != 0:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by the "
+            f"sequence-parallel degree ({n})"
+        )
+    # (B, L/n, H, D) -> (B, L, H/n, D): split heads, concat sequence
+    a2a = partial(
+        jax.lax.all_to_all, axis_name=axis, split_axis=2, concat_axis=1,
+        tiled=True,
+    )
+    qf, kf, vf = a2a(q), a2a(k), a2a(v)
+    of = reference_attention(qf, kf, vf, causal=causal, scale=scale)
+    # inverse: split sequence back out, concat heads
+    return jax.lax.all_to_all(
+        of, axis_name=axis, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def reference_attention(q, k, v, *, causal=False, scale=None):
+    """Plain full-materialization attention (the correctness oracle and
+    the per-device kernel inside Ulysses). (B, L, H, D) layout."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        L, Lk = q.shape[1], k.shape[1]
+        mask = jnp.arange(Lk)[None, :] <= jnp.arange(L)[:, None]
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(p.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+def _make_wrapped(inner, mesh: Mesh, axis: str, causal: bool):
+    spec = P(None, axis, None, None)
+
+    def per_shard(q, k, v):
+        return inner(q, k, v, axis=axis, causal=causal)
+
+    f = jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    return jax.jit(f)
+
+
+def make_ring_attention(mesh: Mesh, *, axis: str = "sp", causal: bool = False):
+    """Jitted ring attention over global (B, L, H, D) arrays sequence-
+    sharded along ``axis`` of ``mesh``."""
+    return _make_wrapped(ring_self_attention, mesh, axis, causal)
+
+
+def make_ulysses_attention(
+    mesh: Mesh, *, axis: str = "sp", causal: bool = False
+):
+    """Jitted Ulysses attention over global (B, L, H, D) arrays."""
+    return _make_wrapped(ulysses_attention, mesh, axis, causal)

@@ -1,0 +1,145 @@
+"""Flagship transformer: sharded dp x sp x tp program vs the dense oracle.
+
+8 virtual CPU devices (conftest.py) arranged as (dp, sp, tp) meshes; the
+sharded shard_map program must match the unsharded forward exactly
+(same float ops, different partitioning), and the train step must reduce
+the loss.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpistragglers_jl_tpu.models.transformer import (
+    TransformerConfig,
+    forward_dense,
+    init_params,
+    make_forward,
+    make_train_step,
+    shard_params,
+)
+from mpistragglers_jl_tpu.parallel import make_mesh
+
+CFG = TransformerConfig(
+    vocab=61, d_model=32, n_heads=4, n_layers=2, d_ff=64
+)
+
+
+def _tokens(cfg, B=4, L=16, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = jnp.asarray(
+        rng.integers(0, cfg.vocab, (B, L)), dtype=jnp.int32
+    )
+    return toks
+
+
+def _place(mesh, toks):
+    return jax.device_put(toks, NamedSharding(mesh, P("dp", "sp")))
+
+
+@pytest.mark.parametrize(
+    "shape,attn",
+    [
+        ((2, 2, 2), "ring"),
+        ((1, 4, 2), "ring"),
+        ((2, 4, 1), "ring"),
+        ((1, 2, 2), "ulysses"),
+        ((2, 2, 2), "ulysses"),
+    ],
+)
+def test_sharded_forward_matches_dense(shape, attn):
+    cfg = TransformerConfig(**{**CFG.__dict__, "attn": attn})
+    mesh = make_mesh(shape, ("dp", "sp", "tp"))
+    params = init_params(cfg, seed=1)
+    toks = _tokens(cfg)
+    want = forward_dense(params, toks, cfg)
+    fwd = make_forward(cfg, mesh)
+    got = fwd(shard_params(params, cfg, mesh), _place(mesh, toks))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_train_step_reduces_loss_and_stays_sharded():
+    mesh = make_mesh((2, 2, 2), ("dp", "sp", "tp"))
+    params = shard_params(init_params(CFG, seed=2), CFG, mesh)
+    step = make_train_step(CFG, mesh, lr=0.1)
+    rng = np.random.default_rng(3)
+    data = jnp.asarray(
+        rng.integers(0, CFG.vocab, (4, 17)), dtype=jnp.int32
+    )
+    toks, tgts = data[:, :-1], data[:, 1:]
+    toks, tgts = _place(mesh, toks), _place(mesh, tgts)
+    losses = []
+    for _ in range(10):
+        params, loss = step(params, toks, tgts)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.1, losses
+    # tp-sharded weights stay tp-sharded through the update
+    wq_spec = params["layers"][0]["wq"].sharding.spec
+    assert "tp" in tuple(wq_spec)
+
+
+def test_sharded_grads_match_dense_grads():
+    mesh = make_mesh((2, 2, 2), ("dp", "sp", "tp"))
+    params = init_params(CFG, seed=4)
+    rng = np.random.default_rng(5)
+    data = jnp.asarray(
+        rng.integers(0, CFG.vocab, (4, 17)), dtype=jnp.int32
+    )
+    toks, tgts = data[:, :-1], data[:, 1:]
+
+    def dense_loss(params):
+        logits = forward_dense(params, toks, CFG).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tgts[..., None], axis=-1)
+        return nll.mean()
+
+    g_want = jax.grad(dense_loss)(params)
+
+    from functools import partial
+
+    from mpistragglers_jl_tpu.models.transformer import (
+        _loss_local,
+        param_specs,
+    )
+
+    loss_fn = jax.jit(
+        jax.shard_map(
+            partial(_loss_local, cfg=CFG),
+            mesh=mesh,
+            in_specs=(param_specs(CFG), P("dp", "sp"), P("dp", "sp")),
+            out_specs=P(),
+        )
+    )
+    g_got = jax.grad(loss_fn)(
+        shard_params(params, CFG, mesh), _place(mesh, toks),
+        _place(mesh, tgts),
+    )
+    flat_w, _ = jax.tree.flatten(g_want)
+    flat_g, _ = jax.tree.flatten(g_got)
+    for a, b in zip(flat_g, flat_w):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-3
+        )
+
+
+def test_long_context_memory_scaling_shape():
+    # sp=8: per-device sequence chunk is L/8; just assert the program
+    # compiles and runs at a length where the full (L, L) score matrix
+    # per device would be 64x bigger than the ring block
+    cfg = TransformerConfig(
+        vocab=31, d_model=16, n_heads=2, n_layers=1, d_ff=32
+    )
+    mesh = make_mesh((1, 8, 1), ("dp", "sp", "tp"))
+    params = shard_params(init_params(cfg), cfg, mesh)
+    toks = _tokens(cfg, B=1, L=256, seed=6)
+    fwd = make_forward(cfg, mesh)
+    out = fwd(params, _place(mesh, toks))
+    assert out.shape == (1, 256, cfg.vocab)
+    want = forward_dense(init_params(cfg), toks, cfg)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(want), atol=2e-4, rtol=2e-4
+    )
