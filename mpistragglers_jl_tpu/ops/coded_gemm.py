@@ -88,8 +88,13 @@ class CodedGemm:
         """Decodability predicate for ``asyncmap(nwait=...)``."""
         return nwait_decodable(self.k)
 
-    def result(self, pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
-        """Decode the full product from the first k fresh shards."""
+    def result_device(
+        self, pool: AsyncPool, epoch: int | None = None
+    ) -> jax.Array:
+        """Decode the full product from the first k fresh shards, leaving
+        it device-resident — the TPU-native output form, ready to feed the
+        next device computation without a host round-trip (host transfer
+        is the expensive edge of the system, not HBM)."""
         if epoch is None:
             epoch = pool.epoch
         fresh = np.flatnonzero(pool.repochs == epoch)
@@ -105,7 +110,11 @@ class CodedGemm:
             jax.device_put(jnp.asarray(pool.results[i]), self.devices[0])
             for i in idx
         ])
-        return np.asarray(self.code.decode_array(shards, idx))
+        return self.code.decode_array(shards, idx)
+
+    def result(self, pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
+        """Decode the full product from the first k fresh shards (host copy)."""
+        return np.asarray(self.result_device(pool, epoch))
 
 
 class LTCodedGemm:
