@@ -42,7 +42,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.ring_attention import (
-    reference_attention,
+    resolve_attention_impl,
     ring_self_attention,
     ulysses_attention,
 )
@@ -66,9 +66,23 @@ class TransformerConfig:
     n_layers: int = 2
     d_ff: int = 256
     attn: str = "ring"  # "ring" | "ulysses" | used inside shard_map
+    # per-device attention kernel: "reference" (materializing oracle) or
+    # "flash" (fused Pallas kernel, ops/flash_attention.py) — applies to
+    # the dense forward and to the local attention inside Ulysses
+    attn_impl: str = "reference"
     dtype: Any = jnp.float32
 
     def __post_init__(self):
+        if self.attn == "ring" and self.attn_impl == "flash":
+            # ring attention accumulates block-wise itself; flash only
+            # applies to the per-device full-sequence attention (dense
+            # forward / inside Ulysses). Accepting the combination would
+            # silently run ring without flash while the dense oracle
+            # diverged to a different kernel.
+            raise ValueError(
+                'attn_impl="flash" requires attn="ulysses" (ring '
+                "attention has no per-device full-sequence kernel)"
+            )
         if self.d_model % self.n_heads != 0:
             raise ValueError(
                 f"d_model {self.d_model} not divisible by n_heads "
@@ -182,16 +196,19 @@ def _mlp(x, lp):
     return jnp.einsum("blf,fd->bld", a, lp["w2"])
 
 
+def _local_attention(cfg: TransformerConfig):
+    """The per-device (unsharded) attention kernel selected by config."""
+    return partial(resolve_attention_impl(cfg.attn_impl), causal=True)
+
+
 def forward_dense(params: dict, tokens: jax.Array, cfg: TransformerConfig):
     """Unsharded oracle forward: full attention, no collectives. The
     sharded program must agree with this bit-for-float."""
     pos = jnp.arange(tokens.shape[1])
     x = params["emb"][tokens]
+    attn_fn = _local_attention(cfg)
     for lp in params["layers"]:
-        attn_out = _attn_block(
-            x, lp, pos,
-            lambda q, k, v: reference_attention(q, k, v, causal=True),
-        )
+        attn_out = _attn_block(x, lp, pos, attn_fn)
         x = x + attn_out
         h = _ln(x, lp["ln2_s"], lp["ln2_b"])
         x = x + _mlp(h, lp) + lp["b2"]
@@ -207,7 +224,9 @@ def _forward_local(params, tokens, cfg: TransformerConfig):
     if cfg.attn == "ring":
         attn = partial(ring_self_attention, axis="sp", causal=True)
     elif cfg.attn == "ulysses":
-        attn = partial(ulysses_attention, axis="sp", causal=True)
+        attn = partial(
+            ulysses_attention, axis="sp", causal=True, impl=cfg.attn_impl
+        )
     else:
         raise ValueError(f"unknown sharded attention kind {cfg.attn!r}")
     x = params["emb"][tokens]
@@ -239,6 +258,9 @@ def make_forward(cfg: TransformerConfig, mesh: Mesh):
         mesh=mesh,
         in_specs=(param_specs(cfg), P("dp", "sp")),
         out_specs=P("dp", "sp"),
+        # interpret-mode Pallas (flash attn on the CPU test mesh) trips
+        # the vma checker — see parallel/ring_attention._make_wrapped
+        check_vma=cfg.attn_impl != "flash",
     )
     return jax.jit(f)
 
@@ -255,6 +277,8 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, *, lr: float = 1e-2):
         mesh=mesh,
         in_specs=(param_specs(cfg), P("dp", "sp"), P("dp", "sp")),
         out_specs=P(),
+        # see make_forward: flash attn in interpret mode needs this off
+        check_vma=cfg.attn_impl != "flash",
     )
 
     @jax.jit

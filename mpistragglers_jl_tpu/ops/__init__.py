@@ -8,6 +8,7 @@ _HOME = {
     "LTCode": "lt",
     "nwait_lt_decodable": "lt",
     "GradientCode": "gradcode",
+    "flash_attention": "flash_attention",
 }
 
 __all__ = list(_HOME)

@@ -1,0 +1,362 @@
+"""Pallas TPU flash attention: fused online-softmax attention kernels.
+
+The reference has no attention code at all (SURVEY §5 'Long-context');
+this op is part of the framework's long-context story. The per-device
+attention inside Ulysses sequence parallelism and the dense transformer
+forward materialize an (L, L) score matrix per head
+(parallel/ring_attention.py ``reference_attention``) — O(L^2) HBM
+traffic and memory. This module replaces that hot op with a Pallas
+kernel that streams K/V blocks through VMEM and keeps the softmax
+normalizer in on-chip scratch, the standard flash-attention scheme
+mapped to the TPU memory hierarchy (HBM -> VMEM -> MXU):
+
+* forward: grid (batch*heads, q-blocks, k-blocks), k innermost; online
+  softmax accumulators (o_acc, m, l) live in VMEM scratch across the
+  k sweep; causal blocks entirely above the diagonal are skipped via
+  predication; saves per-row logsumexp for the backward;
+* backward: two kernels (dq over the k sweep; dk/dv over the q sweep)
+  recompute probabilities from the saved logsumexp, the
+  recomputation-based flash backward — no (L, L) residual is ever
+  stored;
+* wrapped in ``jax.custom_vjp`` so it differentiates inside the model
+  train steps.
+
+On non-TPU backends (the CI mesh is 8 virtual CPU devices) the kernels
+run in Pallas interpret mode automatically, so the same code path is
+testable everywhere.
+
+Layout matches the rest of the framework: (batch, seq, heads, head_dim).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30  # matches parallel/ring_attention.py: large-negative mask
+_LANE = 128  # TPU lane width; m/l scratch is broadcast across lanes
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() not in ("tpu",)
+
+
+def _pick_block(L: int, block: int) -> int:
+    b = min(block, L)
+    while L % b:  # L is typically a power of two; degrade gracefully
+        b -= 1
+    return b
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying ``like``'s varying-mesh-axes, so the
+    kernels are callable inside ``shard_map`` (e.g. as the per-device
+    attention of Ulysses) where outputs must declare their vma."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# --------------------------------------------------------------------------
+# forward kernel
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
+                *, scale, causal, bq, bk, nk):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    # causal: skip blocks entirely above the diagonal (first key position
+    # of this block beyond the last query position of the q block)
+    run = (j * bk <= i * bq + bq - 1) if causal else True
+
+    @pl.when(run)
+    def _update():
+        q = q_ref[0]  # (bq, D)
+        kb = k_ref[0]  # (bk, D)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (bq, bk)
+        if causal:
+            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = kpos <= qpos
+            s = jnp.where(mask, s, _NEG)
+        m_prev = m_sc[:, :1]  # (bq, 1)
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)  # (bq, 1)
+        l_sc[:] = jnp.broadcast_to(
+            l_sc[:, :1] * corr + p.sum(axis=-1, keepdims=True),
+            l_sc.shape,
+        )
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_sc[:, :1], 1e-20)
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_sc[:, :1] + jnp.log(l)).astype(jnp.float32)
+
+
+def _fwd(q3, k3, v3, scale, causal, bq, bk, interpret):
+    """q3/k3/v3: (BH, L, D) -> (o (BH, L, D), lse (BH, L))."""
+    BH, Lq, D = q3.shape
+    Lk = k3.shape[1]
+    nq, nk = Lq // bq, Lk // bk
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+    )
+    return pl.pallas_call(
+        kern,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            # lse is (BH, L, 1): a trailing singleton keeps the TPU block
+            # tiling legal ((1, bq, 1): bq sublane-divisible, 1 == whole
+            # trailing dim) and broadcasts cleanly in the backward
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            _sds((BH, Lq, D), q3.dtype, q3),
+            _sds((BH, Lq, 1), jnp.float32, q3),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, _LANE), jnp.float32),
+            pltpu.VMEM((bq, _LANE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+# --------------------------------------------------------------------------
+# backward kernels (recompute from lse)
+# --------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc, *, scale, causal, bq, bk, nk):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    run = (j * bk <= i * bq + bq - 1) if causal else True
+
+    @pl.when(run)
+    def _update():
+        q = q_ref[0]
+        kb = k_ref[0]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG)
+        p = jnp.exp(s - lse_ref[0])  # (bq, bk); masked rows -> 0
+        dp = jax.lax.dot_general(
+            do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0])
+        acc[:] = acc[:] + jax.lax.dot_general(
+            ds, kb.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = (acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, bq, bk, nq):
+    j, i = pl.program_id(1), pl.program_id(2)  # k block major, q innermost
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (j * bk <= i * bq + bq - 1) if causal else True
+
+    @pl.when(run)
+    def _update():
+        q = q_ref[0]
+        kb = k_ref[0]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG)
+        p = jnp.exp(s - lse_ref[0])  # (bq, bk)
+        do = do_ref[0].astype(jnp.float32)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bk, D)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0])  # (bq, bk)
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, interpret):
+    BH, Lq, D = q3.shape
+    Lk = k3.shape[1]
+    nq, nk = Lq // bq, Lk // bk
+    delta = jnp.sum(
+        do3.astype(jnp.float32) * o3.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )  # (BH, Lq, 1), same trailing-singleton layout as lse
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+        ),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=_sds((BH, Lq, D), q3.dtype, q3),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq
+        ),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            _sds((BH, Lk, D), k3.dtype, k3),
+            _sds((BH, Lk, D), v3.dtype, v3),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# custom-vjp wrapper over (BH, L, D) tensors
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash3(q3, k3, v3, scale, causal, bq, bk, interpret):
+    o, _ = _fwd(q3, k3, v3, scale, causal, bq, bk, interpret)
+    return o
+
+
+def _flash3_fwd(q3, k3, v3, scale, causal, bq, bk, interpret):
+    o, lse = _fwd(q3, k3, v3, scale, causal, bq, bk, interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash3_bwd(scale, causal, bq, bk, interpret, res, do3):
+    q3, k3, v3, o3, lse = res
+    return _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, interpret)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused flash attention on (B, L, H, D) tensors; differentiable.
+
+    Drop-in for :func:`~..parallel.ring_attention.reference_attention`
+    (same layout, same causal semantics) without materializing (L, L)
+    scores. Block sizes shrink automatically to divide the sequence
+    lengths; ``interpret`` defaults to compiled on TPU and interpret
+    mode elsewhere.
+    """
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    bq = _pick_block(Lq, block_q)
+    bk = _pick_block(Lk, block_k)
+
+    def to3(x, L):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+
+    o3 = _flash3(
+        to3(q, Lq), to3(k, Lk), to3(v, Lk),
+        float(scale), bool(causal), bq, bk, bool(interpret),
+    )
+    return o3.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)

@@ -47,6 +47,7 @@ __all__ = [
     "make_ring_attention",
     "make_ulysses_attention",
     "reference_attention",
+    "resolve_attention_impl",
 ]
 
 _NEG = -1e30  # large-negative mask value; -inf breaks the m-update exp
@@ -145,13 +146,17 @@ def ulysses_attention(
     axis: str = "sp",
     causal: bool = False,
     scale: float | None = None,
+    impl: str = "reference",
 ) -> jax.Array:
     """All-to-all sequence parallelism; call inside shard_map.
 
     Local chunks (B, L/n, H, D) are re-sharded by one ``all_to_all``
     into (B, L, H/n, D) — full sequence, head subset — attention runs
     locally, and the inverse all_to_all restores (B, L/n, H, D).
-    Requires H % n == 0.
+    Requires H % n == 0. ``impl="flash"`` runs the per-device attention
+    as the fused Pallas kernel (ops/flash_attention.py) instead of the
+    materializing reference — the memory-sane choice at long L, since
+    the device holds the *full* sequence here.
     """
     n = jax.lax.axis_size(axis)
     if q.shape[2] % n != 0:
@@ -165,11 +170,25 @@ def ulysses_attention(
         tiled=True,
     )
     qf, kf, vf = a2a(q), a2a(k), a2a(v)
-    of = reference_attention(qf, kf, vf, causal=causal, scale=scale)
+    of = resolve_attention_impl(impl)(qf, kf, vf, causal=causal, scale=scale)
     # inverse: split sequence back out, concat heads
     return jax.lax.all_to_all(
         of, axis_name=axis, split_axis=1, concat_axis=2, tiled=True
     )
+
+
+def resolve_attention_impl(impl: str):
+    """Resolve a per-device (unsharded) attention kernel by name: the
+    materializing ``"reference"`` oracle or the fused Pallas ``"flash"``
+    kernel. Shared by Ulysses and the model configs so the accepted
+    names cannot drift."""
+    if impl == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention
+    if impl == "reference":
+        return reference_attention
+    raise ValueError(f"unknown attention impl {impl!r}")
 
 
 def reference_attention(q, k, v, *, causal=False, scale=None):
@@ -192,15 +211,21 @@ def reference_attention(q, k, v, *, causal=False, scale=None):
     return out.astype(q.dtype)
 
 
-def _make_wrapped(inner, mesh: Mesh, axis: str, causal: bool):
+def _make_wrapped(inner, mesh: Mesh, axis: str, causal: bool, **kw):
     spec = P(None, axis, None, None)
 
     def per_shard(q, k, v):
-        return inner(q, k, v, axis=axis, causal=causal)
+        return inner(q, k, v, axis=axis, causal=causal, **kw)
 
+    # check_vma must stay on except for Pallas-in-interpret-mode: the
+    # Pallas HLO interpreter (CPU-mesh test path) evaluates block
+    # dynamic_slices whose index operands carry no vma, which trips
+    # shard_map's vma checker; JAX's own error message prescribes this
+    # workaround. On TPU the kernel is compiled and the check passes.
     f = jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=kw.get("impl") != "flash",
     )
     return jax.jit(f)
 
@@ -212,7 +237,8 @@ def make_ring_attention(mesh: Mesh, *, axis: str = "sp", causal: bool = False):
 
 
 def make_ulysses_attention(
-    mesh: Mesh, *, axis: str = "sp", causal: bool = False
+    mesh: Mesh, *, axis: str = "sp", causal: bool = False,
+    impl: str = "reference",
 ):
     """Jitted Ulysses attention over global (B, L, H, D) arrays."""
-    return _make_wrapped(ulysses_attention, mesh, axis, causal)
+    return _make_wrapped(ulysses_attention, mesh, axis, causal, impl=impl)
