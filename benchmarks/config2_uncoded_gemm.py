@@ -1,0 +1,15 @@
+"""BASELINE config 2: uncoded distributed GEMM 4096^2, nwait=n.
+
+Thin wrapper over the repo-root bench module's secondary metric.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import bench_uncoded_gemm
+
+if __name__ == "__main__":
+    print(json.dumps(bench_uncoded_gemm()))

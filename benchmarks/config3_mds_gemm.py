@@ -1,0 +1,15 @@
+"""BASELINE config 3: (n=8, k=6) MDS-coded GEMM 8192^2, nwait=6.
+
+This is the headline metric; thin wrapper over the repo-root bench.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import bench_coded_gemm
+
+if __name__ == "__main__":
+    print(json.dumps(bench_coded_gemm()))

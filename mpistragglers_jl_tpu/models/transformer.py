@@ -42,6 +42,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.ring_attention import (
+    _flash_interpreted,
     resolve_attention_impl,
     ring_self_attention,
     ulysses_attention,
@@ -259,8 +260,9 @@ def make_forward(cfg: TransformerConfig, mesh: Mesh):
         in_specs=(param_specs(cfg), P("dp", "sp")),
         out_specs=P("dp", "sp"),
         # interpret-mode Pallas (flash attn on the CPU test mesh) trips
-        # the vma checker — see parallel/ring_attention._make_wrapped
-        check_vma=cfg.attn_impl != "flash",
+        # the vma checker — see parallel/ring_attention._make_wrapped;
+        # compiled-on-TPU flash keeps the check on
+        check_vma=not _flash_interpreted(cfg.attn_impl),
     )
     return jax.jit(f)
 
@@ -278,7 +280,7 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, *, lr: float = 1e-2):
         in_specs=(param_specs(cfg), P("dp", "sp"), P("dp", "sp")),
         out_specs=P(),
         # see make_forward: flash attn in interpret mode needs this off
-        check_vma=cfg.attn_impl != "flash",
+        check_vma=not _flash_interpreted(cfg.attn_impl),
     )
 
     @jax.jit

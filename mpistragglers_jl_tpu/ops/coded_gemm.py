@@ -151,6 +151,7 @@ class LTCodedGemm:
         self.code = LTCode(k, seed=seed)
         self.k = k
         self.n = n_workers
+        self.devices = list(devices)
         self.block_rows = m // k
         self.precision = precision
         if shard_ids is None:
@@ -202,3 +203,40 @@ class LTCodedGemm:
         shards = np.stack([np.asarray(pool.results[i]) for i in fresh])
         ids = [self.shard_ids[i] for i in fresh]
         return self.code.decode_array(shards, ids)
+
+    def result_device(
+        self, pool: AsyncPool, epoch: int | None = None
+    ) -> jax.Array:
+        """Decode on device, leaving the product in HBM.
+
+        Host peeling (:meth:`result`) is the exact LT algorithm but
+        forces a D2H gather of every shard — the slow edge. Peelability
+        of the arrived set implies the 0/1 generator has full rank, so
+        the same system solves as one MXU-friendly k x k linear solve
+        over a full-rank row subset, identical math to the MDS decode.
+        """
+        if epoch is None:
+            epoch = pool.epoch
+        fresh = np.flatnonzero(pool.repochs == epoch)
+        ids = [self.shard_ids[i] for i in fresh]
+        if not self.code.peelable(ids):
+            raise ValueError(
+                f"fresh shards {ids} at epoch {epoch} are not decodable"
+            )
+        G = self.code.generator_rows(ids)  # (m, k) 0/1, full column rank
+        sel: list[int] = []
+        for r in range(len(ids)):  # greedy full-rank row subset (tiny G)
+            if np.linalg.matrix_rank(G[sel + [r]]) == len(sel) + 1:
+                sel.append(r)
+                if len(sel) == self.k:
+                    break
+        G_S = jnp.asarray(G[sel])
+        shards = jnp.stack([
+            jax.device_put(jnp.asarray(pool.results[fresh[r]]),
+                           self.devices[0])
+            for r in sel
+        ])
+        from .coding import _decode
+
+        blocks = _decode(G_S, shards, self.precision)
+        return blocks.reshape(-1, *blocks.shape[2:])

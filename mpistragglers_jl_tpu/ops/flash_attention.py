@@ -46,10 +46,15 @@ def _use_interpret() -> bool:
 
 
 def _pick_block(L: int, block: int) -> int:
+    """Largest TPU-legal block <= ``block`` dividing L: sublane-aligned
+    (multiple of 8) or spanning the whole dimension (both are legal
+    Mosaic tilings; anything else compiles only in interpret mode)."""
     b = min(block, L)
-    while L % b:  # L is typically a power of two; degrade gracefully
+    while b > 0:
+        if L % b == 0 and (b % 8 == 0 or b == L):
+            return b
         b -= 1
-    return b
+    return L
 
 
 def _sds(shape, dtype, like):

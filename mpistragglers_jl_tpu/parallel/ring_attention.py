@@ -217,17 +217,27 @@ def _make_wrapped(inner, mesh: Mesh, axis: str, causal: bool, **kw):
     def per_shard(q, k, v):
         return inner(q, k, v, axis=axis, causal=causal, **kw)
 
-    # check_vma must stay on except for Pallas-in-interpret-mode: the
-    # Pallas HLO interpreter (CPU-mesh test path) evaluates block
-    # dynamic_slices whose index operands carry no vma, which trips
-    # shard_map's vma checker; JAX's own error message prescribes this
-    # workaround. On TPU the kernel is compiled and the check passes.
+    # check_vma must stay on except for Pallas-in-interpret-mode (i.e.
+    # flash on a non-TPU backend): the Pallas HLO interpreter (CPU-mesh
+    # test path) evaluates block dynamic_slices whose index operands
+    # carry no vma, which trips shard_map's vma checker; JAX's own error
+    # message prescribes this workaround. On TPU the kernel is compiled,
+    # declares its vma (flash_attention._sds), and the check stays on.
     f = jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=kw.get("impl") != "flash",
+        check_vma=not _flash_interpreted(kw.get("impl")),
     )
     return jax.jit(f)
+
+
+def _flash_interpreted(impl) -> bool:
+    """True iff the flash kernel would run via the Pallas interpreter."""
+    if impl != "flash":
+        return False
+    from ..ops.flash_attention import _use_interpret
+
+    return _use_interpret()
 
 
 def make_ring_attention(mesh: Mesh, *, axis: str = "sp", causal: bool = False):

@@ -101,6 +101,23 @@ class TestLTCodedGemm:
         assert np.allclose(lg.result(pool), A @ B, atol=1e-3)
         lg.backend.shutdown()
 
+    def test_result_device_matches_host_peeling(self):
+        # the on-device linear-solve decode == the host peeling decode
+        rng = np.random.default_rng(8)
+        n, k = 14, 7
+        A = rng.standard_normal((28, 12)).astype(np.float32)
+        B = rng.standard_normal((12, 6)).astype(np.float32)
+        lg = LTCodedGemm(A, n, k,
+                         delay_fn=lambda i, e: 0.2 if i in (0, 7) else 0.0)
+        pool = AsyncPool(n)
+        asyncmap(pool, B, lg.backend, nwait=lg.nwait)
+        C_host = lg.result(pool)
+        C_dev = np.asarray(lg.result_device(pool))
+        assert np.allclose(C_dev, C_host, atol=1e-4)
+        assert np.allclose(C_dev, A @ B, atol=1e-3)
+        waitall(pool, lg.backend)
+        lg.backend.shutdown()
+
 
 class TestCodedSGD:
     def test_converges_with_stragglers(self):
@@ -115,6 +132,21 @@ class TestCodedSGD:
         w, hist = sgd.fit(epochs=30, lr=1.0, X_eval=X, y_eval=y)
         assert hist[-1] < 0.35
         assert hist[-1] < hist[0] * 0.6  # actually descended
+        sgd.backend.shutdown()
+
+    def test_synthetic_device_generated_converges(self):
+        # device-generated data (no host dataset), stragglers injected
+        sgd = CodedSGD.synthetic(
+            512, 16, 4, 1, delay_fn=lambda i, e: 0.1 if i == 3 else 0.0,
+            seed=1,
+        )
+        X_eval, y_eval = sgd._chunks[0][0][0], sgd._chunks[0][1][0]
+        w, hist = sgd.fit(
+            epochs=25, lr=1.0,
+            X_eval=np.asarray(X_eval), y_eval=np.asarray(y_eval),
+        )
+        assert isinstance(w, np.ndarray)
+        assert hist[-1] < hist[0]  # learning the hidden w*
         sgd.backend.shutdown()
 
     def test_coded_gradient_equals_uncoded(self):
