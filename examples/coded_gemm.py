@@ -1,0 +1,61 @@
+"""Coded matrix multiplication walkthrough: decode A @ B from 6 of 8 workers.
+
+The reference's headline use case is straggler-resilient iterative
+algorithms; erasure-coded GEMM is the canonical one (SURVEY §2: the
+fastest-k + epoch-stamped partial results mechanism is exactly what
+enables it). This example MDS-encodes A's row blocks, injects two
+deterministic stragglers, and shows the full product recovered exactly
+without hearing from them.
+
+Run:  python examples/coded_gemm.py [n] [k]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from mpistragglers_jl_tpu import AsyncPool, asyncmap, waitall
+from mpistragglers_jl_tpu.ops import CodedGemm
+
+
+def main(n: int = 8, k: int = 6) -> None:
+    rng = np.random.default_rng(0)
+    m = 64 * k
+    A = rng.standard_normal((m, 128)).astype(np.float32)
+    B = rng.standard_normal((128, 96)).astype(np.float32)
+
+    stragglers = (1, 4) if n > 4 else (n - 1,)
+    delay_fn = lambda i, e: 0.5 if i in stragglers else 0.0
+    print(f"(n={n}, k={k}) MDS-coded GEMM; workers {stragglers} are "
+          f"0.5 s stragglers, nwait={k}")
+
+    cg = CodedGemm(A, n, k, delay_fn=delay_fn)
+    pool = AsyncPool(n)
+    C_ref = A @ B
+    scale = float(np.max(np.abs(C_ref)))
+
+    for epoch in range(1, 4):
+        t0 = time.perf_counter()
+        repochs = asyncmap(pool, B, cg.backend, nwait=k)
+        C = cg.result(pool)
+        dt = time.perf_counter() - t0
+        fresh = np.flatnonzero(repochs == pool.epoch)
+        rel = float(np.max(np.abs(C - C_ref))) / scale
+        print(f"epoch {epoch}: {dt * 1e3:7.1f} ms  "
+              f"fresh={fresh.tolist()}  rel err = {rel:.2e}")
+        assert rel < 1e-3, f"decode mismatch (rel={rel})"
+
+    # the stragglers never made any epoch, yet every product was exact
+    for i in stragglers:
+        assert pool.repochs[i] != pool.epoch
+    waitall(pool, cg.backend)
+    cg.backend.shutdown()
+    print("done: every epoch decoded exactly without the stragglers")
+
+
+if __name__ == "__main__":
+    args = [int(a) for a in sys.argv[1:3]]
+    main(*args)

@@ -1,0 +1,53 @@
+"""Gradient-coded SGD walkthrough: exact training despite stragglers.
+
+Each epoch is one ``asyncmap`` with ``nwait = n - s``; the cyclic
+gradient code (Tandon et al.) recovers the exact full-batch gradient
+from whichever n-s workers arrive. Two injected stragglers slow nothing
+down and cost no gradient information.
+
+Run:  python examples/gradient_coded_sgd.py
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from mpistragglers_jl_tpu import AsyncPool, waitall
+from mpistragglers_jl_tpu.models import CodedSGD
+
+
+def main() -> None:
+    n, s = 8, 2
+    stragglers = (2, 5)
+    delay_fn = lambda i, e: 0.3 if i in stragglers else 0.0
+    print(f"gradient-coded SGD: n={n} workers, s={s} stragglers tolerated, "
+          f"workers {stragglers} injected with 0.3 s delays")
+
+    # data generated on device — nothing crosses the host<->device edge
+    sgd = CodedSGD.synthetic(4096, 32, n, s, delay_fn=delay_fn, seed=0)
+    import jax
+    import jax.numpy as jnp
+
+    X_eval, y_eval = sgd._chunks[0][0][0], sgd._chunks[0][1][0]
+    eval_loss = jax.jit(sgd.model.loss)
+
+    pool = AsyncPool(n)
+    w = jnp.zeros(32, dtype=jnp.float32)
+    for epoch in range(1, 16):
+        t0 = time.perf_counter()
+        w = sgd.step(pool, w, lr=1.0)
+        dt = time.perf_counter() - t0
+        fresh = int((pool.repochs == pool.epoch).sum())
+        if epoch % 3 == 0 or epoch == 1:
+            loss = float(eval_loss(w, X_eval, y_eval))
+            print(f"epoch {epoch:2d}: {dt * 1e3:7.1f} ms  "
+                  f"fresh={fresh}/{n}  loss={loss:.4f}")
+    waitall(pool, sgd.backend)
+    sgd.backend.shutdown()
+    print("done: converged on the fastest n-s workers every epoch")
+
+
+if __name__ == "__main__":
+    main()

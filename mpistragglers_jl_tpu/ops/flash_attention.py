@@ -48,7 +48,11 @@ def _use_interpret() -> bool:
 def _pick_block(L: int, block: int) -> int:
     """Largest TPU-legal block <= ``block`` dividing L: sublane-aligned
     (multiple of 8) or spanning the whole dimension (both are legal
-    Mosaic tilings; anything else compiles only in interpret mode)."""
+    Mosaic tilings; anything else compiles only in interpret mode).
+    When L has no 8-aligned divisor <= ``block`` (odd/prime lengths),
+    the fallback is the whole dimension in one block — legal but VMEM-
+    bounded, so very large such L may exceed VMEM; pad the sequence to
+    a multiple of 8 upstream for those shapes."""
     b = min(block, L)
     while b > 0:
         if L % b == 0 and (b % 8 == 0 or b == L):
