@@ -42,7 +42,7 @@ def main():
         N, DIM, N_WORKERS, S, delay_fn=delay_fn, seed=0
     )
     # eval set = worker 0's own first chunk (device-resident)
-    X_eval, y_eval = sgd._chunks[0][0][0], sgd._chunks[0][1][0]
+    X_eval, y_eval = sgd.eval_data()
     eval_loss = jax.jit(sgd.model.loss)
 
     fence = jax.jit(jnp.sum)

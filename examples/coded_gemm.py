@@ -12,8 +12,11 @@ Run:  python examples/coded_gemm.py [n] [k]
 
 from __future__ import annotations
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -21,13 +24,19 @@ from mpistragglers_jl_tpu import AsyncPool, asyncmap, waitall
 from mpistragglers_jl_tpu.ops import CodedGemm
 
 
-def main(n: int = 8, k: int = 6) -> None:
+def main(n: int = 8, k: int | None = None) -> None:
+    if k is None:
+        k = max(1, n - 2)
+    if not 0 < k <= n:
+        raise SystemExit(f"need 0 < k <= n, got n={n} k={k}")
     rng = np.random.default_rng(0)
     m = 64 * k
     A = rng.standard_normal((m, 128)).astype(np.float32)
     B = rng.standard_normal((128, 96)).astype(np.float32)
 
-    stragglers = (1, 4) if n > 4 else (n - 1,)
+    # at most n - k stragglers, or nwait=k would have to wait for them
+    candidates = (1, 4) if n > 4 else (n - 1,)
+    stragglers = candidates[: n - k]
     delay_fn = lambda i, e: 0.5 if i in stragglers else 0.0
     print(f"(n={n}, k={k}) MDS-coded GEMM; workers {stragglers} are "
           f"0.5 s stragglers, nwait={k}")

@@ -10,7 +10,11 @@ Run:  python examples/gradient_coded_sgd.py
 
 from __future__ import annotations
 
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -30,7 +34,7 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    X_eval, y_eval = sgd._chunks[0][0][0], sgd._chunks[0][1][0]
+    X_eval, y_eval = sgd.eval_data()
     eval_loss = jax.jit(sgd.model.loss)
 
     pool = AsyncPool(n)

@@ -12,8 +12,11 @@ reproducible.
 Run:  python examples/iterative_example.py [nworkers]
 """
 
+import os
 import socket
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

@@ -184,6 +184,13 @@ class CodedSGD:
             self._work, n_workers, devices=devices, delay_fn=delay_fn
         )
 
+    def eval_data(self, worker: int = 0) -> tuple[jax.Array, jax.Array]:
+        """The first data chunk held by ``worker``, as a device-resident
+        ``(X, y)`` pair — for loss evaluation in examples/benchmarks
+        without reaching into the internal chunk layout."""
+        Xc, yc, _ = self._chunks[worker]
+        return Xc[0], yc[0]
+
     def _work(self, i: int, payload: jax.Array, epoch: int) -> jax.Array:
         Xc, yc, coeffs = self._chunks[i]
         return _coded_grad(payload, Xc, yc, coeffs)

@@ -140,7 +140,7 @@ class TestCodedSGD:
             512, 16, 4, 1, delay_fn=lambda i, e: 0.1 if i == 3 else 0.0,
             seed=1,
         )
-        X_eval, y_eval = sgd._chunks[0][0][0], sgd._chunks[0][1][0]
+        X_eval, y_eval = sgd.eval_data()
         w, hist = sgd.fit(
             epochs=25, lr=1.0,
             X_eval=np.asarray(X_eval), y_eval=np.asarray(y_eval),
