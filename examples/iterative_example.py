@@ -9,7 +9,7 @@ deterministic per (worker, epoch) instead of the reference's
 ``sleep(rand())`` (examples/iterative_example.jl:74), so runs are
 reproducible.
 
-Run:  python examples/iterative_example.py [nworkers]
+Run:  python examples/iterative_example.py [nworkers] [threads|process]
 """
 
 import os
@@ -38,11 +38,31 @@ def worker_compute(i: int, payload: np.ndarray, epoch: int) -> np.ndarray:
     return out
 
 
-def coordinator_main(nworkers: int) -> None:
-    # deterministic straggling: worker w stalls (w+1)*20 ms at every epoch,
-    # so worker 0 always wins the nwait=1 race
-    delay_fn = lambda i, epoch: 0.020 * (i + 1)
-    backend = LocalBackend(worker_compute, nworkers, delay_fn=delay_fn)
+def staircase_delay(i: int, epoch: int) -> float:
+    """Deterministic straggling: worker w stalls (w+1)*20 ms every epoch,
+    so worker 0 always wins the nwait=1 race. Module-level so it is
+    picklable for the process backend."""
+    return 0.020 * (i + 1)
+
+
+def coordinator_main(nworkers: int, backend_kind: str = "threads") -> None:
+    if backend_kind == "process":
+        # the reference's real execution model: one OS process per worker
+        # (test/runtests.jl:17), payloads crossing a process boundary
+        from mpistragglers_jl_tpu import ProcessBackend
+
+        backend = ProcessBackend(
+            worker_compute, nworkers, delay_fn=staircase_delay
+        )
+    elif backend_kind == "threads":
+        backend = LocalBackend(
+            worker_compute, nworkers, delay_fn=staircase_delay
+        )
+    else:
+        raise SystemExit(
+            f"unknown backend {backend_kind!r}: use 'threads' or 'process'"
+        )
+    print(f"[coordinator]\t\tbackend = {type(backend).__name__}")
     pool = AsyncPool(nworkers)
 
     recvbuf = np.zeros(nworkers * WORKER_TX_BYTES, dtype=np.uint8)
@@ -68,4 +88,7 @@ def coordinator_main(nworkers: int) -> None:
 
 
 if __name__ == "__main__":
-    coordinator_main(int(sys.argv[1]) if len(sys.argv) > 1 else 4)
+    # usage: iterative_example.py [nworkers] [threads|process]
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    kind = sys.argv[2] if len(sys.argv) > 2 else "threads"
+    coordinator_main(n, kind)

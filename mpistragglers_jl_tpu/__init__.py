@@ -10,7 +10,7 @@ any k-of-n shards.
 """
 
 from .pool import AsyncPool, asyncmap, waitall, DeadWorkerError
-from .backends import Backend, LocalBackend, WorkerFailure
+from .backends import Backend, LocalBackend, ProcessBackend, WorkerFailure
 
 __all__ = [
     "AsyncPool",
@@ -19,6 +19,7 @@ __all__ = [
     "DeadWorkerError",
     "Backend",
     "LocalBackend",
+    "ProcessBackend",
     "XLADeviceBackend",
     "WorkerFailure",
 ]

@@ -1,5 +1,6 @@
 from .base import Backend, SlotBackend, WorkerError, WorkerFailure
 from .local import LocalBackend
+from .process import ProcessBackend, RemoteWorkerError, WorkerProcessDied
 
 __all__ = [
     "Backend",
@@ -7,6 +8,9 @@ __all__ = [
     "WorkerError",
     "WorkerFailure",
     "LocalBackend",
+    "ProcessBackend",
+    "RemoteWorkerError",
+    "WorkerProcessDied",
     "XLADeviceBackend",
 ]
 

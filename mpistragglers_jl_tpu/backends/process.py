@@ -1,0 +1,223 @@
+"""OS-process worker backend: real process isolation, serialized transport.
+
+The reference's only execution mode is ``mpiexec`` spawning one OS process
+per rank, with every payload crossing a real process boundary
+(test/runtests.jl:17; workers speak raw ``MPI.Irecv!``/``Isend`` —
+examples/iterative_example.jl:55-82). :class:`LocalBackend` deliberately
+replaces that with threads for fast unit tests; :class:`ProcessBackend` is
+the faithful counterpart: n spawned worker *processes*, payloads pickled
+over OS pipes (serialization is the in-host analog of the network hop),
+a per-worker shutdown sentinel standing in for the reference's
+control-tag broadcast (test/kmap2.jl:14-18), and — beyond the reference —
+dead-worker detection: a worker process dying mid-task surfaces as a
+:class:`~.base.WorkerFailure` at harvest instead of hanging the pool the
+way a dead rank hangs ``MPI.Waitall!`` (SURVEY §5 'Failure detection').
+
+Because workers are spawned processes, ``work_fn`` and ``delay_fn`` must
+be picklable: module-level functions, ``functools.partial`` of them, or
+instances of module-level classes defining ``__call__`` (the fault
+schedules in :mod:`..utils.faults` qualify).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import threading
+import time
+import traceback
+from typing import Callable
+
+import numpy as np
+
+from .base import DelayFn, SlotBackend, WorkerError
+
+WorkFn = Callable[[int, object, int], object]
+
+__all__ = ["ProcessBackend", "RemoteWorkerError", "WorkerProcessDied"]
+
+
+class RemoteWorkerError(RuntimeError):
+    """A worker process raised during compute; carries the remote traceback
+    (the reference loses these entirely — assertions die inside mpiexec
+    subprocesses and only garble stdout, SURVEY §4)."""
+
+    def __init__(self, exc_type: str, message: str, remote_traceback: str):
+        self.exc_type = exc_type
+        self.remote_traceback = remote_traceback
+        super().__init__(f"{exc_type}: {message}\n{remote_traceback}")
+
+
+class WorkerProcessDied(RuntimeError):
+    """The worker OS process exited without delivering its result."""
+
+    def __init__(self, worker: int):
+        self.worker = worker
+        super().__init__(f"worker process {worker} died")
+
+
+def _worker_main(i: int, conn, work_fn: WorkFn, delay_fn: DelayFn | None) -> None:
+    """Worker process entry: the reference's receive -> stall -> compute ->
+    send loop (§3.2) over a pipe instead of MPI point-to-point."""
+    try:
+        while True:
+            msg = conn.recv()
+            if msg is None:  # shutdown sentinel (control channel)
+                break
+            seq, payload, epoch = msg
+            if delay_fn is not None:
+                d = float(delay_fn(i, epoch))
+                if d > 0:
+                    time.sleep(d)
+            try:
+                out = (seq, epoch, "ok", work_fn(i, payload, epoch))
+            except BaseException as e:
+                out = (
+                    seq, epoch, "error",
+                    (type(e).__name__, str(e), traceback.format_exc()),
+                )
+            try:
+                conn.send(out)
+            except Exception as e:  # result not picklable
+                conn.send((
+                    seq, epoch, "error",
+                    (type(e).__name__,
+                     f"worker result could not be serialized: {e}", ""),
+                ))
+    except (EOFError, OSError, KeyboardInterrupt):
+        pass
+    finally:
+        conn.close()
+
+
+class ProcessBackend(SlotBackend):
+    """n spawned worker processes computing ``work_fn(i, payload, epoch)``.
+
+    The payload snapshot the reference takes via ``isendbufs[i] .= sendbuf``
+    (src/MPIAsyncPools.jl:130) happens here by construction: pickling at
+    dispatch time copies the payload, so in-flight sends survive caller
+    mutation. numpy arrays cross the pipe zero-conversion; jax arrays are
+    converted to numpy at dispatch (device buffers are not picklable).
+
+    Parameters
+    ----------
+    work_fn:
+        Picklable worker computation ``(worker_index, payload, epoch) ->
+        result``.
+    n_workers:
+        Pool size (= number of spawned processes).
+    delay_fn:
+        Picklable deterministic latency injection, seconds as a function
+        of ``(worker_index, epoch)``, applied *inside* the worker process.
+    mp_context:
+        ``multiprocessing`` start method; ``"spawn"`` (default) is safe
+        with JAX/threads in the coordinator, ``"fork"`` is faster to boot
+        for pure-numpy workers.
+    """
+
+    def __init__(
+        self,
+        work_fn: WorkFn,
+        n_workers: int,
+        *,
+        delay_fn: DelayFn | None = None,
+        mp_context: str = "spawn",
+        join_timeout: float = 5.0,
+    ):
+        super().__init__(n_workers)
+        self.work_fn = work_fn
+        self.delay_fn = delay_fn
+        self._join_timeout = join_timeout
+        self._closed = False
+        self._dead = [False] * self.n_workers
+        self._send_lock = threading.Lock()
+        ctx = mp.get_context(mp_context)
+        self._conns = []
+        self._procs = []
+        for i in range(self.n_workers):
+            parent, child = ctx.Pipe(duplex=True)
+            proc = ctx.Process(
+                target=_worker_main,
+                args=(i, child, work_fn, delay_fn),
+                daemon=True,
+                name=f"pool-proc-worker-{i}",
+            )
+            proc.start()
+            child.close()  # parent keeps only its end; EOF works
+            self._conns.append(parent)
+            self._procs.append(proc)
+        self._readers = [
+            threading.Thread(
+                target=self._reader_loop, args=(i,), daemon=True,
+                name=f"pool-proc-reader-{i}",
+            )
+            for i in range(self.n_workers)
+        ]
+        for t in self._readers:
+            t.start()
+
+    # -- coordinator-side completion pump ---------------------------------
+    def _reader_loop(self, i: int) -> None:
+        conn = self._conns[i]
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                self._on_worker_death(i)
+                return
+            if msg is None:
+                return
+            seq, epoch, kind, payload = msg
+            if kind == "error":
+                exc_type, message, tb = payload
+                payload = WorkerError(
+                    i, epoch, RemoteWorkerError(exc_type, message, tb)
+                )
+            self._complete(i, seq, payload)
+
+    def _on_worker_death(self, i: int) -> None:
+        """Fail the outstanding task (if any) so waits don't hang — the
+        capability the reference lacks (dead rank hangs ``Waitall!``)."""
+        self._dead[i] = True
+        with self._cond:
+            slot = self._slots[i]
+            pending = slot.outstanding and not slot.done
+            seq = slot.seq
+        if pending and not self._closed:
+            self._complete(
+                i, seq, WorkerError(i, -1, WorkerProcessDied(i))
+            )
+
+    # -- SlotBackend surface ----------------------------------------------
+    def _start(self, i: int, sendbuf, epoch: int, seq: int, tag: int) -> None:
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
+        if self._dead[i]:  # fail fast instead of writing to a broken pipe
+            self._complete(i, seq, WorkerError(i, epoch, WorkerProcessDied(i)))
+            return
+        payload = sendbuf
+        if hasattr(payload, "__array__") and not isinstance(payload, np.ndarray):
+            payload = np.asarray(payload)  # device arrays are not picklable
+        try:
+            with self._send_lock:
+                self._conns[i].send((seq, payload, epoch))
+        except (BrokenPipeError, OSError):
+            self._dead[i] = True
+            self._complete(i, seq, WorkerError(i, epoch, WorkerProcessDied(i)))
+
+    def shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for i, conn in enumerate(self._conns):
+            try:
+                with self._send_lock:
+                    conn.send(None)
+            except (BrokenPipeError, OSError):
+                pass
+        for proc in self._procs:
+            proc.join(timeout=self._join_timeout)
+        for proc in self._procs:
+            if proc.is_alive():  # pragma: no cover - stuck worker
+                proc.terminate()
+        for conn in self._conns:
+            conn.close()

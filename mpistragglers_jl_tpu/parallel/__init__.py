@@ -9,6 +9,9 @@ _HOME = {
     "make_ring_attention": "ring_attention",
     "make_ulysses_attention": "ring_attention",
     "reference_attention": "ring_attention",
+    "initialize_multihost": "multihost",
+    "make_multihost_mesh": "multihost",
+    "local_worker_indices": "multihost",
 }
 
 __all__ = list(_HOME)

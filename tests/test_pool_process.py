@@ -1,0 +1,133 @@
+"""Core pool on the OS-process backend — the reference's execution model.
+
+The reference only ever runs as real OS processes under mpiexec
+(test/runtests.jl:17); ProcessBackend reproduces that process isolation
+(spawned workers, pickled payloads over pipes) while keeping assertions
+coordinator-side instead of losing them inside subprocesses (SURVEY §4).
+Everything here must be module-level picklable for spawn.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import (
+    AsyncPool,
+    ProcessBackend,
+    WorkerFailure,
+    asyncmap,
+    waitall,
+)
+from mpistragglers_jl_tpu.backends.process import (
+    RemoteWorkerError,
+    WorkerProcessDied,
+)
+
+
+def _echo(i, payload, epoch):
+    # the reference's result message layout [rank, t, epoch]
+    # (test/kmap2.jl:92-94)
+    return np.array([float(i + 1), float(payload[0]), float(epoch)])
+
+
+def _fail_worker1_epoch2(i, payload, epoch):
+    if i == 1 and epoch == 2:
+        raise ValueError("boom from worker process")
+    return np.array([float(i + 1), float(payload[0]), float(epoch)])
+
+
+def _exit_worker2(i, payload, epoch):
+    if i == 2:
+        os._exit(3)  # simulate a crashed rank, not a Python exception
+    return np.array([float(i + 1), float(payload[0]), float(epoch)])
+
+
+class StragglerDelay:
+    """Picklable deterministic delay: one slow worker, the rest fast."""
+
+    def __init__(self, straggler: int, slow: float = 0.25, fast: float = 0.001):
+        self.straggler = straggler
+        self.slow = slow
+        self.fast = fast
+
+    def __call__(self, i: int, epoch: int) -> float:
+        return self.slow if i == self.straggler else self.fast
+
+
+def test_full_gather_and_epoch_echo():
+    n = 3
+    backend = ProcessBackend(_echo, n)
+    try:
+        pool = AsyncPool(n)
+        sendbuf = np.array([3.14])
+        recvbuf = np.zeros(3 * n)
+        for epoch in range(1, 4):
+            sendbuf[0] = epoch
+            repochs = asyncmap(pool, sendbuf, backend, recvbuf, nwait=n)
+            chunks = recvbuf.reshape(n, 3)
+            assert list(repochs) == [epoch] * n
+            for i in range(n):
+                assert chunks[i][0] == i + 1  # chunk j <- worker j
+                assert chunks[i][1] == float(epoch)  # payload crossed intact
+                assert chunks[i][2] == epoch  # epoch echo
+    finally:
+        backend.shutdown()
+    assert not any(p.is_alive() for p in backend._procs)
+
+
+def test_fastest_k_skips_straggler_process():
+    n = 3
+    backend = ProcessBackend(_echo, n, delay_fn=StragglerDelay(2))
+    try:
+        pool = AsyncPool(n)
+        sendbuf = np.zeros(1)
+        for epoch in range(1, 5):
+            sendbuf[0] = epoch
+            repochs = asyncmap(pool, sendbuf, backend, nwait=2)
+            fresh = int((repochs == epoch).sum())
+            assert fresh >= 2
+            assert repochs[0] == epoch and repochs[1] == epoch
+        # straggler never made an epoch deadline but stays tasked
+        assert pool.active[2]
+        waitall(pool, backend)
+        assert not pool.active.any()
+    finally:
+        backend.shutdown()
+
+
+def test_remote_exception_carries_traceback():
+    n = 3
+    backend = ProcessBackend(_fail_worker1_epoch2, n)
+    try:
+        pool = AsyncPool(n)
+        payload = np.array([1.0])
+        asyncmap(pool, payload, backend, nwait=n)  # epoch 1 fine
+        with pytest.raises(WorkerFailure) as excinfo:
+            asyncmap(pool, payload, backend, nwait=n)
+            waitall(pool, backend)
+        err = excinfo.value.error
+        assert isinstance(err, RemoteWorkerError)
+        assert err.exc_type == "ValueError"
+        assert "boom from worker process" in str(err)
+        assert "Traceback" in err.remote_traceback
+        # pool stays recoverable: failed worker marked idle, others drain
+        waitall(pool, backend)
+    finally:
+        backend.shutdown()
+
+
+def test_dead_worker_process_fails_fast_not_hangs():
+    # a crashed rank hangs the reference's Waitall! forever (SURVEY §5);
+    # here the EOF on its pipe surfaces as WorkerFailure at harvest
+    n = 3
+    backend = ProcessBackend(_exit_worker2, n)
+    try:
+        pool = AsyncPool(n)
+        with pytest.raises(WorkerFailure) as excinfo:
+            asyncmap(pool, np.array([1.0]), backend, nwait=n)
+            waitall(pool, backend)
+        assert isinstance(excinfo.value.error, WorkerProcessDied)
+        assert excinfo.value.error.worker == 2
+    finally:
+        backend.shutdown()
