@@ -212,8 +212,8 @@ class CodedSGD:
             nwait = self.n - self.s
         dev = self.backend.devices[0]  # decode device (D2D on a slice)
         w = jax.device_put(jnp.asarray(w, dtype=jnp.float32), dev)
-        repochs = asyncmap(pool, w, self.backend, nwait=nwait, epoch=epoch)
-        fresh = np.flatnonzero(repochs == pool.epoch)
+        asyncmap(pool, w, self.backend, nwait=nwait, epoch=epoch)
+        fresh = pool.fresh_indices()
         a = jnp.asarray(self.code.decode_weights(fresh), jnp.float32)
         G = jnp.stack([
             jax.device_put(jnp.asarray(pool.results[i]), dev) for i in fresh

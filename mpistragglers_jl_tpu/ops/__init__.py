@@ -8,6 +8,8 @@ _HOME = {
     "LTCode": "lt",
     "nwait_lt_decodable": "lt",
     "GradientCode": "gradcode",
+    "PolynomialCode": "polynomial",
+    "PolyCodedGemm": "polynomial",
     "flash_attention": "flash_attention",
 }
 

@@ -95,13 +95,11 @@ class CodedGemm:
         it device-resident — the TPU-native output form, ready to feed the
         next device computation without a host round-trip (host transfer
         is the expensive edge of the system, not HBM)."""
-        if epoch is None:
-            epoch = pool.epoch
-        fresh = np.flatnonzero(pool.repochs == epoch)
+        fresh = pool.fresh_indices(epoch)
         if fresh.size < self.k:
             raise ValueError(
-                f"only {fresh.size} fresh shards at epoch {epoch}, "
-                f"need k={self.k}"
+                f"only {fresh.size} fresh shards at epoch "
+                f"{pool.epoch if epoch is None else epoch}, need k={self.k}"
             )
         idx = fresh[: self.k]
         # decode on the pool's first device, not the global default — the
@@ -197,9 +195,9 @@ class LTCodedGemm:
         return nwait_lt_decodable(self.code, self.shard_ids)
 
     def result(self, pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
-        if epoch is None:
-            epoch = pool.epoch
-        fresh = np.flatnonzero(pool.repochs == epoch)
+        fresh = pool.fresh_indices(epoch)
+        if fresh.size == 0:
+            raise ValueError(f"no fresh shards at epoch {pool.epoch}")
         shards = np.stack([np.asarray(pool.results[i]) for i in fresh])
         ids = [self.shard_ids[i] for i in fresh]
         return self.code.decode_array(shards, ids)
@@ -215,13 +213,12 @@ class LTCodedGemm:
         the same system solves as one MXU-friendly k x k linear solve
         over a full-rank row subset, identical math to the MDS decode.
         """
-        if epoch is None:
-            epoch = pool.epoch
-        fresh = np.flatnonzero(pool.repochs == epoch)
+        fresh = pool.fresh_indices(epoch)
         ids = [self.shard_ids[i] for i in fresh]
         if not self.code.peelable(ids):
             raise ValueError(
-                f"fresh shards {ids} at epoch {epoch} are not decodable"
+                f"fresh shards {ids} at epoch "
+                f"{pool.epoch if epoch is None else epoch} are not decodable"
             )
         G = self.code.generator_rows(ids)  # (m, k) 0/1, full column rank
         sel: list[int] = []

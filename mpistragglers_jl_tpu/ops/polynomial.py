@@ -1,0 +1,247 @@
+"""Polynomial-coded GEMM: partition BOTH factors, decode from any pq of n.
+
+MDS row-coding (ops/coding.py) replicates the whole payload ``B`` to
+every worker — fine when ``A`` dominates, wasteful otherwise. Polynomial
+codes (Yu, Maddah-Ali, Avestimehr, 2017 — public technique) partition
+``A`` into p row blocks AND ``B`` into q column blocks; worker i
+computes the single product ``Ã_i @ B̃_i`` of the polynomial evaluations
+
+    Ã_i = Σ_j A_j x_i^j           (j < p)
+    B̃_i = Σ_l B_l x_i^(l·p)      (l < q)
+
+so ``C̃_i = Ã_i @ B̃_i = Σ_{j,l} (A_j @ B_l) x_i^(j + l·p)`` is the
+evaluation at ``x_i`` of a matrix polynomial whose pq coefficients are
+exactly the blocks of ``C = A @ B``. Any pq distinct evaluations
+determine the coefficients — the recovery threshold is pq with every
+worker doing only 1/(pq) of the multiply work (vs 1/k of the full-B
+product under MDS row coding).
+
+TPU-first choices:
+
+* **Workers encode their own B̃_i** from the *broadcast* raw ``B`` — a
+  cheap weighted sum over q column blocks fused in front of the worker
+  matmul. This preserves the pool's snapshot-broadcast semantics
+  (reference src/MPIAsyncPools.jl:51-61): the coordinator dispatches one
+  payload, nothing per-worker crosses the slow edge, and on a slice the
+  broadcast rides ICI once instead of shipping n distinct B̃_i.
+* **Chebyshev evaluation points** ``x_i = cos((2i+1)π/2n)``: the
+  resulting Vandermonde systems are far better conditioned than
+  equispaced points, which is what makes real-field (MXU-matmul) decode
+  viable — SURVEY §7's "Float64 / conditioning" hard part.
+* **Decode is one pq×pq solve** plus block reassembly, device-resident.
+
+The ``repochs`` arrival mask selects which evaluations decode — the same
+fastest-k mechanism as every other coded workload here (SURVEY §2.1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.base import DelayFn
+from ..backends.xla import XLADeviceBackend
+from ..pool import AsyncPool
+from .coding import nwait_decodable
+
+__all__ = ["PolynomialCode", "PolyCodedGemm"]
+
+
+@partial(jax.jit, static_argnames=("q", "precision"))
+def _poly_worker(A_i, w_i, B, q, precision):
+    # B: (kd, nc) -> (kd, q, nc/q) column blocks; B̃_i = Σ_l w_i[l] B_l
+    kd, nc = B.shape
+    Bq = B.reshape(kd, q, nc // q)
+    B_enc = jnp.einsum("l,klw->kw", w_i, Bq, precision=precision)
+    return jnp.matmul(A_i, B_enc, precision=precision)
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def _poly_decode(V_S, shards, precision):
+    # shards: (pq, r, w) evaluations; solve V_S @ coeffs = shards
+    pq = V_S.shape[0]
+    flat = shards.reshape(pq, -1)
+    coeffs = jax.scipy.linalg.solve(V_S, flat)
+    return coeffs.reshape(shards.shape)
+
+
+class PolynomialCode:
+    """(p, q) polynomial code over n workers, recovery threshold pq.
+
+    >>> code = PolynomialCode(p=2, q=2, n=6)
+    >>> A_enc = code.encode_A(A_blocks)   # (p,r,c) -> (6,r,c)
+    >>> # worker i: A_enc[i] @ (sum_l B_weights[i,l] * B_l)
+    >>> C_blocks = code.decode(shards, indices)   # any 4 of 6
+    """
+
+    def __init__(
+        self,
+        p: int,
+        q: int,
+        n: int,
+        *,
+        dtype=np.float32,
+        precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
+    ):
+        if p < 1 or q < 1:
+            raise ValueError(f"need p, q >= 1, got p={p}, q={q}")
+        if n < p * q:
+            raise ValueError(
+                f"need n >= p*q workers for decodability, got n={n} < "
+                f"{p}*{q}={p * q}"
+            )
+        self.p, self.q, self.n = int(p), int(q), int(n)
+        self.k = self.p * self.q  # recovery threshold
+        self.precision = precision
+        # Chebyshev nodes: well-conditioned real Vandermonde systems
+        i = np.arange(self.n)
+        self.points = np.cos((2 * i + 1) * np.pi / (2 * self.n)).astype(
+            np.float64
+        )
+        # A-encode weights x_i^j, B-encode weights x_i^(l*p), decode
+        # Vandermonde x_i^t for t < pq
+        self.VA = (self.points[:, None] ** np.arange(self.p)).astype(dtype)
+        self.VB = (
+            self.points[:, None] ** (self.p * np.arange(self.q))
+        ).astype(dtype)
+        self.VC = (self.points[:, None] ** np.arange(self.k)).astype(dtype)
+
+    def encode_A(self, blocks) -> jax.Array:
+        """(p, rows, cols) row blocks of A -> (n, rows, cols) evaluations."""
+        blocks = jnp.asarray(blocks)
+        if blocks.shape[0] != self.p:
+            raise ValueError(
+                f"expected {self.p} A-blocks, got {blocks.shape[0]}"
+            )
+        return jnp.einsum(
+            "nj,jrc->nrc", jnp.asarray(self.VA), blocks,
+            precision=self.precision,
+        )
+
+    def decode(self, shards, indices) -> jax.Array:
+        """Recover the pq coefficient blocks from any pq evaluations.
+
+        ``shards``: (pq, rows, w) stacked worker products; ``indices``:
+        which worker (= evaluation point) each came from. Returns
+        ``(pq, rows, w)`` where entry ``t`` is ``A_{t % p} @ B_{t // p}``.
+        """
+        idx = np.asarray(indices)
+        if idx.shape[0] != self.k or len(set(idx.tolist())) != self.k:
+            raise ValueError(
+                f"need exactly pq={self.k} distinct shard indices, got {idx}"
+            )
+        shards = jnp.asarray(shards)
+        if shards.shape[0] != self.k:
+            raise ValueError(f"expected {self.k} shards, got {shards.shape[0]}")
+        return _poly_decode(
+            jnp.asarray(self.VC[idx]), shards, self.precision
+        )
+
+    def assemble(self, coeffs) -> jax.Array:
+        """(pq, r, w) coefficient blocks -> full (p*r, q*w) product."""
+        pq, r, w = coeffs.shape
+        # t = j + l*p  ->  grid[l, j] = C block at rows j, cols l
+        grid = coeffs.reshape(self.q, self.p, r, w)
+        return jnp.block([
+            [grid[l, j] for l in range(self.q)] for j in range(self.p)
+        ])
+
+
+class PolyCodedGemm:
+    """``C = A @ B`` from any pq of n workers, both factors partitioned.
+
+    Worker i holds the static evaluation ``Ã_i`` (m/p × kd) and encodes
+    its own ``B̃_i`` from the broadcast payload, so per-worker compute
+    and memory are 1/(pq) of the full product (vs 1/k compute with full
+    B under :class:`~.coded_gemm.CodedGemm`).
+
+    >>> pg = PolyCodedGemm(A, p=2, q=2, n=6)
+    >>> pool = AsyncPool(6)
+    >>> repochs = asyncmap(pool, B, pg.backend, nwait=4)
+    >>> C = pg.result_device(pool)        # exact A @ B from 4 of 6
+    """
+
+    def __init__(
+        self,
+        A: np.ndarray,
+        p: int,
+        q: int,
+        n: int,
+        *,
+        devices: Sequence[jax.Device] | None = None,
+        delay_fn: DelayFn | None = None,
+        dtype=None,
+        precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
+    ):
+        if dtype is not None:
+            A = np.asarray(A, dtype=dtype)
+        m = A.shape[0]
+        if m % p != 0:
+            raise ValueError(f"rows {m} must divide evenly into p={p} blocks")
+        if devices is None:
+            devices = jax.devices()
+        self.devices = list(devices)
+        self.code = PolynomialCode(p, q, n, dtype=A.dtype, precision=precision)
+        self.p, self.q, self.n = p, q, n
+        self.k = p * q
+        self.block_rows = m // p
+        self.precision = precision
+        coded = self.code.encode_A(
+            jnp.asarray(A).reshape(p, m // p, A.shape[1])
+        )
+        self.A_shards = [
+            jax.device_put(coded[i], self.devices[i % len(self.devices)])
+            for i in range(n)
+        ]
+        self.B_weights = [
+            jax.device_put(
+                jnp.asarray(self.code.VB[i]),
+                self.devices[i % len(self.devices)],
+            )
+            for i in range(n)
+        ]
+        self.backend = XLADeviceBackend(
+            self._work, n, devices=devices, delay_fn=delay_fn
+        )
+
+    def _work(self, i: int, payload: jax.Array, epoch: int) -> jax.Array:
+        if payload.shape[1] % self.q != 0:
+            raise ValueError(
+                f"B cols {payload.shape[1]} must divide evenly into "
+                f"q={self.q} blocks"
+            )
+        return _poly_worker(
+            self.A_shards[i], self.B_weights[i], payload, self.q,
+            self.precision,
+        )
+
+    @property
+    def nwait(self):
+        """Decodability predicate: pq fresh evaluations suffice."""
+        return nwait_decodable(self.k)
+
+    def result_device(
+        self, pool: AsyncPool, epoch: int | None = None
+    ) -> jax.Array:
+        """Decode the full product from the first pq fresh evaluations,
+        device-resident (host transfer is the slow edge, not HBM)."""
+        fresh = pool.fresh_indices(epoch)
+        if fresh.size < self.k:
+            raise ValueError(
+                f"only {fresh.size} fresh shards at epoch "
+                f"{pool.epoch if epoch is None else epoch}, need pq={self.k}"
+            )
+        idx = fresh[: self.k]
+        shards = jnp.stack([
+            jax.device_put(jnp.asarray(pool.results[i]), self.devices[0])
+            for i in idx
+        ])
+        return self.code.assemble(self.code.decode(shards, idx))
+
+    def result(self, pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
+        """Host-copy variant of :meth:`result_device`."""
+        return np.asarray(self.result_device(pool, epoch))

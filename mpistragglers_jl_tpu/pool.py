@@ -121,6 +121,24 @@ class AsyncPool:
     def n_workers(self) -> int:
         return len(self.ranks)
 
+    def fresh_indices(self, epoch: int | None = None) -> np.ndarray:
+        """Workers whose latest *stored* result is from ``epoch``
+        (default: the current one) — the decode-selection mask.
+
+        ``repochs[i] == epoch`` alone is not sufficient: at
+        ``epoch == epoch0`` it also matches workers never heard from
+        (``repochs`` initializes to ``epoch0``, reference
+        src/MPIAsyncPools.jl:39), whose ``results[i]`` is still None.
+        Every coded workload selects shards through this method so that
+        invariant lives in one place.
+        """
+        if epoch is None:
+            epoch = self.epoch
+        heard = np.array(
+            [r is not None for r in self.results], dtype=bool
+        )
+        return np.flatnonzero((self.repochs == epoch) & heard)
+
     def __repr__(self) -> str:
         return (
             f"AsyncPool(n={self.n_workers}, epoch={self.epoch}, "

@@ -59,6 +59,18 @@ class TestCodedGemm:
             assert np.allclose(cg.result(pool), A @ B, atol=1e-3)
         cg.backend.shutdown()
 
+    def test_result_before_any_epoch_raises(self):
+        # at construction pool.epoch == epoch0 == repochs[i]: "never heard"
+        # must not count as fresh (reference src/MPIAsyncPools.jl:39)
+        rng = np.random.default_rng(3)
+        cg = CodedGemm(rng.standard_normal((12, 6)).astype(np.float32), 4, 3)
+        pool = AsyncPool(4)
+        try:
+            with pytest.raises(ValueError, match="fresh"):
+                cg.result(pool)
+        finally:
+            cg.backend.shutdown()
+
     def test_result_raises_below_k(self):
         rng = np.random.default_rng(3)
         cg = CodedGemm(rng.standard_normal((12, 6)).astype(np.float32), 4, 3)

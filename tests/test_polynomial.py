@@ -1,0 +1,135 @@
+"""Polynomial-coded GEMM: both-factor partitioning, decode from any pq.
+
+New capability beyond the reference (which has no coded layer at all,
+SURVEY §2) and beyond the BASELINE MDS/LT configs: per-worker compute is
+1/(pq) of the product, with recovery threshold pq out of n workers.
+"""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import AsyncPool, asyncmap, waitall
+from mpistragglers_jl_tpu.ops import PolyCodedGemm, PolynomialCode
+
+
+class TestPolynomialCode:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="n >= p\\*q"):
+            PolynomialCode(2, 3, 5)
+        with pytest.raises(ValueError, match="p, q >= 1"):
+            PolynomialCode(0, 2, 4)
+        code = PolynomialCode(2, 2, 6)
+        with pytest.raises(ValueError, match="distinct shard indices"):
+            code.decode(np.zeros((4, 2, 2)), [0, 1, 2, 2])
+        with pytest.raises(ValueError, match="expected 2 A-blocks"):
+            code.encode_A(np.zeros((3, 2, 2)))
+
+    def test_points_are_distinct_chebyshev(self):
+        code = PolynomialCode(2, 3, 9)
+        assert len(set(np.round(code.points, 12))) == 9
+        assert np.all(np.abs(code.points) < 1.0)
+
+    @pytest.mark.parametrize("p,q,n", [(2, 2, 6), (1, 3, 4), (3, 1, 5), (2, 3, 8)])
+    def test_decode_every_pq_subset(self, p, q, n):
+        rng = np.random.default_rng(0)
+        m, kd, nc = 4 * p, 8, 6 * q
+        A = rng.standard_normal((m, kd)).astype(np.float64)
+        B = rng.standard_normal((kd, nc)).astype(np.float64)
+        code = PolynomialCode(p, q, n, dtype=np.float64)
+        A_enc = code.encode_A(A.reshape(p, m // p, kd))
+        w = nc // q
+        Bq = B.reshape(kd, q, w)
+        C_true = A @ B
+        # every worker's evaluation
+        evals = []
+        for i in range(n):
+            B_enc = np.einsum("l,klw->kw", code.VB[i], Bq)
+            evals.append(np.asarray(A_enc[i]) @ B_enc)
+        # any pq of them decode to the exact product
+        for idx in itertools.combinations(range(n), p * q):
+            shards = np.stack([evals[i] for i in idx])
+            C = np.asarray(code.assemble(code.decode(shards, list(idx))))
+            np.testing.assert_allclose(C, C_true, rtol=1e-8, atol=1e-8)
+
+    def test_f32_conditioning_acceptable(self):
+        # Chebyshev points keep the worst-case pq=6 subset solvable in f32
+        rng = np.random.default_rng(1)
+        p, q, n = 2, 3, 8
+        m, kd, nc = 8, 16, 12
+        A = rng.standard_normal((m, kd)).astype(np.float32)
+        B = rng.standard_normal((kd, nc)).astype(np.float32)
+        code = PolynomialCode(p, q, n)
+        A_enc = code.encode_A(A.reshape(p, m // p, kd))
+        Bq = B.reshape(kd, q, nc // q)
+        evals = [
+            np.asarray(A_enc[i]) @ np.einsum("l,klw->kw", code.VB[i], Bq)
+            for i in range(n)
+        ]
+        scale = float(np.max(np.abs(A @ B)))
+        for idx in itertools.combinations(range(n), p * q):
+            C = np.asarray(code.assemble(code.decode(
+                np.stack([evals[i] for i in idx]), list(idx)
+            )))
+            rel = float(np.max(np.abs(C - A @ B))) / scale
+            assert rel < 1e-3, (idx, rel)
+
+
+class TestPolyCodedGemm:
+    def test_decodes_exactly_with_stragglers(self):
+        rng = np.random.default_rng(0)
+        p, q, n = 2, 2, 6
+        A = rng.standard_normal((32, 24)).astype(np.float32)
+        B = rng.standard_normal((24, 16)).astype(np.float32)
+        stragglers = (1, 4)
+        delay_fn = lambda i, e: 0.25 if i in stragglers else 0.0
+        pg = PolyCodedGemm(A, p, q, n, delay_fn=delay_fn)
+        pool = AsyncPool(n)
+        try:
+            C_true = A @ B
+            scale = float(np.max(np.abs(C_true)))
+            for epoch in range(1, 4):
+                repochs = asyncmap(pool, B, pg.backend, nwait=pg.nwait)
+                C = pg.result(pool)
+                rel = float(np.max(np.abs(C - C_true))) / scale
+                assert rel < 1e-4, rel
+            for i in stragglers:
+                assert pool.repochs[i] != pool.epoch
+            waitall(pool, pg.backend)
+        finally:
+            pg.backend.shutdown()
+
+    def test_result_requires_pq_fresh(self):
+        rng = np.random.default_rng(0)
+        pg = PolyCodedGemm(
+            rng.standard_normal((8, 8)).astype(np.float32), 2, 2, 4
+        )
+        pool = AsyncPool(4)
+        try:
+            with pytest.raises(ValueError, match="need pq=4"):
+                pg.result(pool)  # nothing dispatched yet
+        finally:
+            pg.backend.shutdown()
+
+    def test_worker_validates_b_shape(self):
+        rng = np.random.default_rng(0)
+        pg = PolyCodedGemm(
+            rng.standard_normal((8, 8)).astype(np.float32), 2, 2, 4
+        )
+        pool = AsyncPool(4)
+        try:
+            B_bad = rng.standard_normal((8, 7)).astype(np.float32)
+            from mpistragglers_jl_tpu import WorkerFailure
+
+            with pytest.raises(WorkerFailure, match="divide evenly"):
+                asyncmap(pool, B_bad, pg.backend, nwait=4)
+                waitall(pool, pg.backend)
+        finally:
+            pg.backend.shutdown()
+
+    def test_validation(self):
+        A = np.zeros((9, 4), dtype=np.float32)
+        with pytest.raises(ValueError, match="divide evenly"):
+            PolyCodedGemm(A, 2, 2, 6)
