@@ -8,6 +8,13 @@ _HOME = {
     "make_forward": "transformer",
     "make_train_step": "transformer",
     "shard_params": "transformer",
+    "batch_axes": "transformer",
+    "data_spec": "transformer",
+    "init_moe_layer": "moe",
+    "moe_layer_specs": "moe",
+    "switch_route": "moe",
+    "moe_ffn_dense": "moe",
+    "moe_ffn_sharded": "moe",
 }
 
 __all__ = list(_HOME)

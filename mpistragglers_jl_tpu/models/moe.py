@@ -1,0 +1,193 @@
+"""Mixture-of-experts FFN with expert parallelism over an ``"ep"`` axis.
+
+The reference has no model code and exactly one parallelism strategy
+(coordinator/worker data-parallel map — SURVEY §2 "Parallelism
+strategies"); expert parallelism is a north-star capability this
+framework adds so the flagship transformer exercises every axis of a
+modern TPU mesh (dp, sp, tp, ep) in one program.
+
+Design (TPU-first, GShard/Switch lineage):
+
+* **Top-1 routing with static capacity.** Every shape is static: each
+  token picks its argmax expert, takes a slot among that expert's
+  ``capacity`` slots (computed by a cumsum over the one-hot dispatch —
+  no sort, no dynamic shapes), and tokens beyond capacity are dropped
+  (they ride the residual connection, the standard Switch behavior).
+  The router gradient flows through the gate probability that scales
+  the combined expert output.
+* **Dispatch/combine as einsums.** The (tokens, experts, capacity)
+  one-hot dispatch tensor turns routing into two MXU-friendly einsums
+  (gather-free), exactly the Mesh-TensorFlow formulation.
+* **Expert parallelism = all_to_all over ``"ep"``.** Experts are
+  sharded over the ``ep`` mesh axis and the *batch* is sharded over
+  ``(dp, ep)`` — every ep member holds distinct tokens, so the tiled
+  ``all_to_all`` exchanges "my tokens for your experts" in one ICI
+  collective each way, the expert FFN runs on local experts only, and
+  a second all_to_all restores token ownership.
+* **tp composes.** Each expert's hidden dim is additionally sharded
+  over ``tp`` (Megatron split); the caller psums the down-projection
+  over ``tp`` exactly like the dense MLP path.
+
+The dense path (:func:`moe_ffn_dense`) runs identical routing math with
+all experts resident — it is the correctness oracle for the sharded
+path and the single-chip execution mode.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "init_moe_layer",
+    "moe_layer_specs",
+    "switch_route",
+    "moe_ffn_dense",
+    "moe_ffn_sharded",
+]
+
+
+def init_moe_layer(rng: np.random.Generator, d_model: int, d_ff: int,
+                   n_experts: int, n_layers: int, dtype) -> dict:
+    """Per-layer MoE params: router + stacked expert FFN weights.
+
+    Expert weights carry a leading (n_experts,) axis — the axis the
+    ``ep`` PartitionSpec shards.
+    """
+    E, D, F = n_experts, d_model, d_ff
+    sd = lambda *s: jnp.asarray(
+        rng.standard_normal(s) / np.sqrt(s[-2]), dtype
+    )
+    return {
+        "wg": jnp.asarray(rng.standard_normal((D, E)) * 0.02, dtype),
+        "we1": sd(E, D, F),
+        "be1": jnp.zeros((E, F), dtype),
+        # float(): np.float64 scalars promote f32 params under x64
+        "we2": sd(E, F, D) / float(np.sqrt(n_layers)),
+        "be2": jnp.zeros((E, D), dtype),
+    }
+
+
+def moe_layer_specs():
+    """PartitionSpecs for :func:`init_moe_layer`: experts over ``ep``,
+    the expert hidden dim over ``tp``, router replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "wg": P(),
+        "we1": P("ep", None, "tp"),
+        "be1": P("ep", "tp"),
+        "we2": P("ep", "tp", None),
+        "be2": P("ep", None),
+    }
+
+
+def switch_route(x2d: jax.Array, wg: jax.Array, capacity: int):
+    """Top-1 routing of (T, D) tokens over E = wg.shape[1] experts.
+
+    Returns ``(dispatch, combine, aux)``:
+
+    * ``dispatch`` — (T, E, C) 0/1 float: token t occupies slot c of
+      expert e. At most ``capacity`` tokens per expert (cumsum slot
+      assignment in arrival order); overflow rows are all-zero.
+    * ``combine`` — ``dispatch`` scaled by the token's gate probability;
+      contracting expert outputs against it yields the MoE output (and
+      routes the gradient into the router).
+    * ``aux`` — Switch load-balance loss ``E * sum_e f_e * p_e`` where
+      ``f_e`` is the dispatched-token fraction and ``p_e`` the mean
+      router probability of expert e; 1.0 at perfect balance.
+    """
+    E = wg.shape[1]
+    logits = x2d.astype(jnp.float32) @ wg.astype(jnp.float32)  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (T, E)
+    # slot within the chosen expert, in token order; >= capacity drops
+    slot = (jnp.cumsum(onehot, axis=0) - onehot) * onehot  # (T, E)
+    slot = slot.sum(axis=1).astype(jnp.int32)  # (T,)
+    dispatch = onehot[:, :, None] * jax.nn.one_hot(
+        slot, capacity, dtype=jnp.float32
+    )[:, None, :]  # (T, E, C); one_hot(slot >= C) is all-zero = dropped
+    combine = dispatch * gate[:, None, None].astype(jnp.float32)
+    frac = onehot.mean(axis=0)
+    aux = E * jnp.sum(frac * probs.mean(axis=0))
+    return dispatch, combine, aux
+
+
+def _expert_ffn(xe, mp):
+    """Per-expert FFN on dispatched tokens xe (E_local, C', D); weights
+    carry matching local leading axis."""
+    a = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", xe, mp["we1"]) + mp["be1"][:, None, :]
+    )
+    return jnp.einsum("ecf,efd->ecd", a, mp["we2"])
+
+
+def moe_ffn_dense(x: jax.Array, mp: dict, capacity_factor: float):
+    """Oracle/single-chip MoE FFN on (B, L, D); all experts resident.
+
+    Returns ``(y, aux)``; dropped tokens contribute zeros to y (the
+    caller's residual connection carries them through). ``be2`` is added
+    via the combine weights so dropped tokens see no bias — the sharded
+    path reproduces this exactly.
+    """
+    B, L, D = x.shape
+    E = mp["wg"].shape[1]
+    C = _capacity(B * L, E, capacity_factor)
+    x2d = x.reshape(B * L, D)
+    dispatch, combine, aux = switch_route(x2d, mp["wg"], C)
+    xe = jnp.einsum("td,tec->ecd", x2d, dispatch.astype(x.dtype))
+    ye = _expert_ffn(xe, mp) + mp["be2"][:, None, :]
+    y = jnp.einsum("ecd,tec->td", ye, combine.astype(x.dtype))
+    return y.reshape(B, L, D), aux
+
+
+def moe_ffn_sharded(x: jax.Array, mp: dict, capacity_factor: float,
+                    *, ep_axis: str = "ep", tp_axis: str = "tp"):
+    """Expert-parallel MoE FFN; call inside shard_map.
+
+    ``x`` is the (B_local, L_local, D) activation chunk (batch sharded
+    over (dp, ep), sequence over sp); ``mp`` holds the ep x tp-local
+    expert shards per :func:`moe_layer_specs`. Routing and capacity are
+    computed over *local* tokens (GShard convention). One tiled
+    all_to_all ships dispatched tokens to their expert's owner, the
+    expert FFN runs on (E/ep) local experts, and the inverse all_to_all
+    ships results home. The caller must ``psum`` the returned y over
+    ``tp`` (matching the dense-MLP Megatron pattern); the tp-replicated
+    ``be2`` is folded in *after* that psum via the returned ``ybias``.
+
+    Returns ``(y_partial, ybias, aux)`` with
+    ``y = psum(y_partial, tp) + ybias``.
+    """
+    ep = jax.lax.axis_size(ep_axis)
+    B, L, D = x.shape
+    E_local = mp["we1"].shape[0]
+    E = E_local * ep
+    C = _capacity(B * L, E, capacity_factor)
+    x2d = x.reshape(B * L, D)
+    # router: wg is replicated; logits over ALL E experts
+    dispatch, combine, aux = switch_route(x2d, mp["wg"], C)
+    xe = jnp.einsum("td,tec->ecd", x2d, dispatch.astype(x.dtype))
+    # (E, C, D) -> ship expert-group j to ep member j; receive my
+    # E_local experts' slots from every member: (E_local, ep*C, D)
+    xe = jax.lax.all_to_all(
+        xe, ep_axis, split_axis=0, concat_axis=1, tiled=True
+    )
+    ye = _expert_ffn(xe, mp)  # tp-partial over the d_ff shard
+    # inverse: split the capacity axis back per source, return home
+    ye = jax.lax.all_to_all(
+        ye, ep_axis, split_axis=1, concat_axis=0, tiled=True
+    )  # (E, C, D), tp-partial
+    y = jnp.einsum("ecd,tec->td", ye, combine.astype(x.dtype))
+    # be2 is replicated over tp, so it must bypass the caller's tp psum;
+    # gather the full (E, D) table (E is small) and weight it per token
+    # by the gate mass of its non-dropped slot, matching the dense path
+    be2 = jax.lax.all_gather(mp["be2"], ep_axis, axis=0, tiled=True)
+    ybias = jnp.einsum("ed,tec->td", be2, combine.astype(x.dtype))
+    return y.reshape(B, L, D), ybias.reshape(B, L, D), aux
+
+
+def _capacity(tokens: int, n_experts: int, capacity_factor: float) -> int:
+    return max(1, int(np.ceil(tokens / n_experts * capacity_factor)))

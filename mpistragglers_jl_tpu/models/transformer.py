@@ -17,6 +17,14 @@ parallel mechanism the framework provides in one train step:
 * **tp** — Megatron-style tensor parallelism: attention heads and the
   MLP hidden dimension sharded over ``tp``; one ``psum`` after the
   attention out-projection and one after the MLP down-projection.
+* **ep** — expert parallelism (``n_experts > 0``): the FFN becomes a
+  top-1-routed mixture of experts (models/moe.py), experts sharded
+  over ``ep``, the batch sharded over ``(dp, ep)``, token routing via
+  one tiled ``all_to_all`` each way. Expert hidden dims additionally
+  shard over ``tp``.
+
+Pipeline parallelism over a ``pp`` axis is a separate program shape —
+see parallel/pipeline.py and :func:`make_pipeline_train_step` there.
 
 The whole train step is a single ``shard_map`` program under ``jit`` —
 collectives are explicit where they are structural (ring ppermute, tp
@@ -47,6 +55,12 @@ from ..parallel.ring_attention import (
     ring_self_attention,
     ulysses_attention,
 )
+from .moe import (
+    init_moe_layer,
+    moe_ffn_dense,
+    moe_ffn_sharded,
+    moe_layer_specs,
+)
 
 __all__ = [
     "TransformerConfig",
@@ -56,6 +70,8 @@ __all__ = [
     "make_forward",
     "make_train_step",
     "shard_params",
+    "batch_axes",
+    "data_spec",
 ]
 
 
@@ -71,6 +87,14 @@ class TransformerConfig:
     # "flash" (fused Pallas kernel, ops/flash_attention.py) — applies to
     # the dense forward and to the local attention inside Ulysses
     attn_impl: str = "reference"
+    # n_experts > 0 replaces every layer's dense MLP with a top-1-routed
+    # MoE (models/moe.py) whose experts shard over an "ep" mesh axis
+    n_experts: int = 0
+    capacity_factor: float = 2.0
+    # Switch load-balance aux-loss weight; 0 keeps the sharded loss
+    # bit-identical to the dense oracle (local vs global token means
+    # differ), nonzero is what real training wants
+    moe_aux_coef: float = 0.0
     dtype: Any = jnp.float32
 
     def __post_init__(self):
@@ -109,22 +133,34 @@ def init_params(cfg: TransformerConfig, seed: int = 0) -> dict:
     )
     layers = []
     for _ in range(cfg.n_layers):
-        layers.append(
-            {
-                "ln1_s": jnp.ones((D,), cfg.dtype),
-                "ln1_b": jnp.zeros((D,), cfg.dtype),
-                "wq": sd(D, H, Dh),
-                "wk": sd(D, H, Dh),
-                "wv": sd(D, H, Dh),
-                "wo": sd(H, Dh, D) / np.sqrt(cfg.n_layers),
-                "ln2_s": jnp.ones((D,), cfg.dtype),
-                "ln2_b": jnp.zeros((D,), cfg.dtype),
-                "w1": sd(D, F),
-                "b1": jnp.zeros((F,), cfg.dtype),
-                "w2": sd(F, D) / np.sqrt(cfg.n_layers),
-                "b2": jnp.zeros((D,), cfg.dtype),
-            }
-        )
+        layer = {
+            "ln1_s": jnp.ones((D,), cfg.dtype),
+            "ln1_b": jnp.zeros((D,), cfg.dtype),
+            "wq": sd(D, H, Dh),
+            "wk": sd(D, H, Dh),
+            "wv": sd(D, H, Dh),
+            # NB float(): an np.float64 scalar would silently promote
+            # the param to f64 under jax_enable_x64
+            "wo": sd(H, Dh, D) / float(np.sqrt(cfg.n_layers)),
+            "ln2_s": jnp.ones((D,), cfg.dtype),
+            "ln2_b": jnp.zeros((D,), cfg.dtype),
+        }
+        if cfg.n_experts:
+            layer.update(
+                init_moe_layer(
+                    rng, D, F, cfg.n_experts, cfg.n_layers, cfg.dtype
+                )
+            )
+        else:
+            layer.update(
+                {
+                    "w1": sd(D, F),
+                    "b1": jnp.zeros((F,), cfg.dtype),
+                    "w2": sd(F, D) / float(np.sqrt(cfg.n_layers)),
+                    "b2": jnp.zeros((D,), cfg.dtype),
+                }
+            )
+        layers.append(layer)
     return {
         "emb": jnp.asarray(
             rng.standard_normal((cfg.vocab, D)) * 0.02, cfg.dtype
@@ -145,11 +181,18 @@ def param_specs(cfg: TransformerConfig) -> dict:
         "wv": P(None, "tp", None),
         "wo": P("tp", None, None),
         "ln2_s": P(), "ln2_b": P(),
-        "w1": P(None, "tp"),
-        "b1": P("tp"),
-        "w2": P("tp", None),
-        "b2": P(),
     }
+    if cfg.n_experts:
+        layer.update(moe_layer_specs())
+    else:
+        layer.update(
+            {
+                "w1": P(None, "tp"),
+                "b1": P("tp"),
+                "w2": P("tp", None),
+                "b2": P(),
+            }
+        )
     return {
         "emb": P(),
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
@@ -205,21 +248,32 @@ def _local_attention(cfg: TransformerConfig):
 def forward_dense(params: dict, tokens: jax.Array, cfg: TransformerConfig):
     """Unsharded oracle forward: full attention, no collectives. The
     sharded program must agree with this bit-for-float."""
+    return _forward_dense_aux(params, tokens, cfg)[0]
+
+
+def _forward_dense_aux(params, tokens, cfg: TransformerConfig):
+    """Dense forward returning (logits, summed MoE aux loss)."""
     pos = jnp.arange(tokens.shape[1])
     x = params["emb"][tokens]
     attn_fn = _local_attention(cfg)
+    aux = jnp.float32(0.0)
     for lp in params["layers"]:
         attn_out = _attn_block(x, lp, pos, attn_fn)
         x = x + attn_out
         h = _ln(x, lp["ln2_s"], lp["ln2_b"])
-        x = x + _mlp(h, lp) + lp["b2"]
+        if cfg.n_experts:
+            y, a = moe_ffn_dense(h, lp, cfg.capacity_factor)
+            x, aux = x + y, aux + a
+        else:
+            x = x + _mlp(h, lp) + lp["b2"]
     x = _ln(x, params["lnf_s"], params["lnf_b"])
-    return jnp.einsum("bld,vd->blv", x, params["emb"])  # tied head
+    return jnp.einsum("bld,vd->blv", x, params["emb"]), aux  # tied head
 
 
 def _forward_local(params, tokens, cfg: TransformerConfig):
-    """Per-shard forward: tokens are the (dp, sp)-local chunk, params the
-    tp-local shards. Returns local logits (B', L', V)."""
+    """Per-shard forward: tokens are the batch/sequence-local chunk,
+    params the tp/ep-local shards. Returns (local logits (B', L', V),
+    summed MoE aux loss)."""
     Lc = tokens.shape[1]
     pos = jax.lax.axis_index("sp") * Lc + jnp.arange(Lc)
     if cfg.attn == "ring":
@@ -231,34 +285,86 @@ def _forward_local(params, tokens, cfg: TransformerConfig):
     else:
         raise ValueError(f"unknown sharded attention kind {cfg.attn!r}")
     x = params["emb"][tokens]
+    aux = jnp.float32(0.0)
     for lp in params["layers"]:
         attn_out = _attn_block(x, lp, pos, attn)
         # tp combine: heads were a shard, the out-projection partial-sums
         attn_out = jax.lax.psum(attn_out, "tp")
         x = x + attn_out
         h = _ln(x, lp["ln2_s"], lp["ln2_b"])
-        y = jax.lax.psum(_mlp(h, lp), "tp")  # d_ff shard partial-sum
-        x = x + y + lp["b2"]  # b2 outside the psum (it is replicated)
+        if cfg.n_experts:
+            y, ybias, a = moe_ffn_sharded(h, lp, cfg.capacity_factor)
+            # expert hidden dims are tp shards; bias rides outside the
+            # psum (it is tp-replicated, see moe_ffn_sharded)
+            x = x + jax.lax.psum(y, "tp") + ybias
+            aux = aux + a
+        else:
+            y = jax.lax.psum(_mlp(h, lp), "tp")  # d_ff shard partial-sum
+            x = x + y + lp["b2"]  # b2 outside the psum (replicated)
     x = _ln(x, params["lnf_s"], params["lnf_b"])
-    return jnp.einsum("bld,vd->blv", x, params["emb"])
+    return jnp.einsum("bld,vd->blv", x, params["emb"]), aux
+
+
+def batch_axes(cfg: TransformerConfig) -> tuple[str, ...]:
+    """Mesh axes the batch/sequence is sharded over: MoE adds ``ep`` as
+    an extra batch-sharding axis so every ep member routes distinct
+    tokens (GShard layout)."""
+    return ("dp", "ep", "sp") if cfg.n_experts else ("dp", "sp")
+
+
+def data_spec(cfg: TransformerConfig) -> P:
+    """PartitionSpec of global (B, L) token arrays."""
+    return P(("dp", "ep"), "sp") if cfg.n_experts else P("dp", "sp")
+
+
+def nll_loss(logits, targets, axes):
+    """Mean token NLL over all devices of the batch-sharding ``axes``;
+    call inside shard_map (shared by the flat and pipeline programs)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    total = jax.lax.psum(nll.sum(), axes)
+    count = jax.lax.psum(jnp.asarray(nll.size, jnp.float32), axes)
+    return total / count
+
+
+def sgd_step(loss_fn, *, lr: float):
+    """Jitted (params, tokens, targets) -> (params, loss) SGD step over
+    any shard_map loss; XLA propagates the NamedShardings through the
+    update (shared by the flat and pipeline train steps)."""
+
+    @jax.jit
+    def step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        params = jax.tree.map(
+            lambda p, g: p - lr * g.astype(p.dtype), params, grads
+        )
+        return params, loss
+
+    return step
 
 
 def _loss_local(params, tokens, targets, cfg: TransformerConfig):
-    logits = _forward_local(params, tokens, cfg).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    total = jax.lax.psum(nll.sum(), ("dp", "sp"))
-    count = jax.lax.psum(jnp.asarray(nll.size, jnp.float32), ("dp", "sp"))
-    return total / count
+    logits, aux = _forward_local(params, tokens, cfg)
+    axes = batch_axes(cfg)
+    loss = nll_loss(logits, targets, axes)
+    if cfg.n_experts and cfg.moe_aux_coef:
+        # mean of the per-member aux losses (each over local tokens)
+        members = jax.lax.psum(jnp.float32(1.0), axes)
+        loss = loss + cfg.moe_aux_coef * jax.lax.psum(aux, axes) / members
+    return loss
 
 
 def make_forward(cfg: TransformerConfig, mesh: Mesh):
     """Jitted sharded forward over global (B, L) token arrays."""
+
+    def fwd_local(params, tokens):
+        return _forward_local(params, tokens, cfg)[0]
+
     f = jax.shard_map(
-        partial(_forward_local, cfg=cfg),
+        fwd_local,
         mesh=mesh,
-        in_specs=(param_specs(cfg), P("dp", "sp")),
-        out_specs=P("dp", "sp"),
+        in_specs=(param_specs(cfg), data_spec(cfg)),
+        out_specs=data_spec(cfg),
         # interpret-mode Pallas (flash attn on the CPU test mesh) trips
         # the vma checker — see parallel/ring_attention._make_wrapped;
         # compiled-on-TPU flash keeps the check on
@@ -277,20 +383,12 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, *, lr: float = 1e-2):
     loss_fn = jax.shard_map(
         partial(_loss_local, cfg=cfg),
         mesh=mesh,
-        in_specs=(param_specs(cfg), P("dp", "sp"), P("dp", "sp")),
+        in_specs=(param_specs(cfg), data_spec(cfg), data_spec(cfg)),
         out_specs=P(),
         # see make_forward: flash attn in interpret mode needs this off
         check_vma=not _flash_interpreted(cfg.attn_impl),
     )
-
-    @jax.jit
-    def step(params, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
-        params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
-                              params, grads)
-        return params, loss
-
-    return step
+    return sgd_step(loss_fn, lr=lr)
 
 
 def shard_params(params: dict, cfg: TransformerConfig, mesh: Mesh) -> dict:
