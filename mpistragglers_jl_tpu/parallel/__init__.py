@@ -12,6 +12,10 @@ _HOME = {
     "initialize_multihost": "multihost",
     "make_multihost_mesh": "multihost",
     "local_worker_indices": "multihost",
+    "pipeline_spmd": "pipeline",
+    "stack_layers": "pipeline",
+    "make_pipeline_train_step": "pipeline",
+    "shard_params_pipeline": "pipeline",
 }
 
 __all__ = list(_HOME)

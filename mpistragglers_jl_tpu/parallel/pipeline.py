@@ -1,0 +1,208 @@
+"""Pipeline parallelism: SPMD microbatch pipeline over a ``"pp"`` axis.
+
+The reference has no pipeline parallelism ("no tensor parallelism,
+pipeline parallelism, ... anywhere in the repo" — SURVEY §2); this is a
+north-star mechanism so the framework covers every axis of a modern TPU
+mesh. The design is the TPU-native formulation (collective-permute
+pipelining, as in praxis/scaling-book) rather than the GPU
+point-to-point one:
+
+* The L layers are **stacked** along a leading axis and sharded over
+  ``pp`` — each device holds L/pp contiguous layers (one *stage*).
+* The batch is split into M **microbatches**. A single ``lax.scan``
+  runs M + pp - 1 ticks; each tick every stage applies its layers to
+  its current microbatch and hands the activation to the next stage
+  with one ``jax.lax.ppermute`` hop (stage handoffs ride ICI
+  neighbor links — the mesh's last axis is physically adjacent chips).
+* Stage 0 injects microbatch t at tick t; the last stage emits
+  microbatch t at tick t + pp - 1 into a preallocated output buffer
+  (``dynamic_update_slice`` guarded by a validity mask — everything is
+  static shapes, XLA unrolls nothing).
+* The whole schedule is **differentiable**: ``jax.grad`` through the
+  scan reverses the ticks and transposes each ``ppermute`` into the
+  reverse hop, which *is* the backward pipeline (GPipe schedule) — no
+  hand-written 1F1B machinery, the bubble fraction is the standard
+  (pp-1)/(M+pp-1) each way.
+
+``pipeline_spmd`` is the generic per-shard engine (call inside
+``shard_map``; composes with a ``dp`` batch axis outside and ``tp``
+psums inside ``stage_fn``). ``make_pipeline_train_step`` wires it into
+the flagship transformer over a (dp, pp) mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "pipeline_spmd",
+    "stack_layers",
+    "make_pipeline_train_step",
+    "pipeline_param_specs",
+    "shard_params_pipeline",
+]
+
+
+def pipeline_spmd(stage_fn, stage_params, x, *, axis: str = "pp",
+                  n_microbatch: int):
+    """Run ``x`` through pp stages of ``stage_fn``; call inside shard_map.
+
+    ``stage_fn(stage_params, micro) -> micro`` applies this device's
+    layer stack to one microbatch; ``stage_params`` is the pp-local
+    shard (leading axis = layers-per-stage). ``x`` is the full local
+    batch (identical on every stage of a pp group — shard it over dp,
+    not pp); the batch axis must divide into ``n_microbatch``.
+
+    Returns the full-batch output, replicated across the ``pp`` axis
+    (one psum at the end — the output buffer is only populated on the
+    last stage).
+    """
+    p = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    B = x.shape[0]
+    if B % n_microbatch != 0:
+        raise ValueError(
+            f"batch {B} not divisible by n_microbatch {n_microbatch}"
+        )
+    micro = x.reshape(n_microbatch, B // n_microbatch, *x.shape[1:])
+    perm = [(j, (j + 1) % p) for j in range(p)]
+    # the carry becomes pp-varying inside the loop (stage-dependent
+    # injection/emission), so its initial value must be typed varying
+    out0 = jax.lax.pcast(jnp.zeros_like(micro), (axis,), to="varying")
+    buf0 = jax.lax.pcast(jnp.zeros_like(micro[0]), (axis,), to="varying")
+
+    def tick(carry, t):
+        buf, out = carry
+        # stage 0 ingests microbatch t (clamped: injections past M-1
+        # would surface only after the last tick, so they are inert)
+        inject = micro[jnp.minimum(t, n_microbatch - 1)]
+        buf = jnp.where(idx == 0, inject, buf)
+        y = stage_fn(stage_params, buf)
+        # last stage emits microbatch ot = t - (p - 1), once it exists
+        ot = t - (p - 1)
+        valid = jnp.logical_and(idx == p - 1, ot >= 0)
+        oc = jnp.clip(ot, 0, n_microbatch - 1)
+        cur = jax.lax.dynamic_slice_in_dim(out, oc, 1, axis=0)
+        upd = jnp.where(valid, y[None].astype(out.dtype), cur)
+        out = jax.lax.dynamic_update_slice_in_dim(out, upd, oc, axis=0)
+        # hand the activation to the next stage (wrap hop p-1 -> 0 is
+        # overwritten by the next injection)
+        buf = jax.lax.ppermute(y, axis, perm)
+        return (buf, out), None
+
+    (_, out), _ = jax.lax.scan(
+        tick, (buf0, out0), jnp.arange(n_microbatch + p - 1)
+    )
+    # out is nonzero only on the last stage; replicate it everywhere
+    out = jax.lax.psum(out, axis)
+    return out.reshape(B, *x.shape[1:])
+
+
+def stack_layers(layers: list[dict]) -> dict:
+    """list-of-pytrees -> pytree-of-stacked-arrays (leading = layer)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+# ---------------------------------------------------------------- model
+
+
+def _stage_apply(stacked_local, x, pos, cfg):
+    """Apply this stage's layers-per-stage stack to one microbatch."""
+    from ..models.transformer import _attn_block, _ln, _local_attention, _mlp
+
+    attn_fn = _local_attention(cfg)
+
+    def one_layer(h, lp):
+        h = h + _attn_block(h, lp, pos, attn_fn)
+        h2 = _ln(h, lp["ln2_s"], lp["ln2_b"])
+        return h + _mlp(h2, lp) + lp["b2"], None
+
+    x, _ = jax.lax.scan(one_layer, x, stacked_local)
+    return x
+
+
+def pipeline_param_specs(cfg) -> dict:
+    """Specs for pipeline params: stacked layers sharded over ``pp`` on
+    the leading (layer) axis, embedding/final-LN replicated. Stages run
+    their layers dense (no tp psums inside ``_stage_apply``), so only
+    the layer axis is sharded."""
+    _check_dense(cfg)
+    layer_keys = (
+        "ln1_s", "ln1_b", "wq", "wk", "wv", "wo",
+        "ln2_s", "ln2_b", "w1", "b1", "w2", "b2",
+    )
+    return {
+        "emb": P(),
+        "layers": {k: P("pp") for k in layer_keys},
+        "lnf_s": P(),
+        "lnf_b": P(),
+    }
+
+
+def _check_dense(cfg):
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "pipeline stages currently use the dense MLP; MoE composes "
+            "with dp/sp/tp in models/transformer.py"
+        )
+
+
+def _pipeline_loss_local(params, tokens, targets, cfg, n_microbatch):
+    from ..models.transformer import _ln, nll_loss
+
+    pos = jnp.arange(tokens.shape[1])
+    x = params["emb"][tokens]
+    x = pipeline_spmd(
+        partial(_stage_apply, pos=pos, cfg=cfg),
+        params["layers"],
+        x,
+        axis="pp",
+        n_microbatch=n_microbatch,
+    )
+    x = _ln(x, params["lnf_s"], params["lnf_b"])
+    logits = jnp.einsum("bld,vd->blv", x, params["emb"])
+    return nll_loss(logits, targets, ("dp",))
+
+
+def make_pipeline_train_step(cfg, mesh: Mesh, *, n_microbatch: int,
+                             lr: float = 1e-2):
+    """Jitted (params, tokens, targets) -> (params, loss) SGD step over a
+    (dp, pp) mesh: batch over ``dp``, the layer stack over ``pp``.
+
+    ``cfg.n_layers`` must divide by the pp size; params come from
+    :func:`shard_params_pipeline`. Attention runs per-device full
+    sequence inside each stage (compose with tp/sp via the flat
+    shard_map program in models/transformer.py when sequence sharding is
+    needed; pipeline targets the deep-model regime).
+    """
+    from ..models.transformer import sgd_step
+
+    _check_dense(cfg)
+    pp = mesh.shape["pp"]
+    if cfg.n_layers % pp != 0:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by pp size {pp}"
+        )
+    loss_fn = jax.shard_map(
+        partial(_pipeline_loss_local, cfg=cfg, n_microbatch=n_microbatch),
+        mesh=mesh,
+        in_specs=(pipeline_param_specs(cfg), P("dp"), P("dp")),
+        out_specs=P(),
+    )
+    return sgd_step(loss_fn, lr=lr)
+
+
+def shard_params_pipeline(params: dict, cfg, mesh: Mesh) -> dict:
+    """Stack the per-layer params and place them per
+    :func:`pipeline_param_specs` (layer axis over ``pp``)."""
+    stacked = dict(params)
+    stacked["layers"] = stack_layers(params["layers"])
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        stacked,
+        pipeline_param_specs(cfg),
+    )
