@@ -19,9 +19,35 @@ are 0/1, no amplification beyond degree-many subtractions).
 
 from __future__ import annotations
 
+import ctypes
+import functools
+import warnings
+
 import numpy as np
 
 __all__ = ["LTCode", "nwait_lt_decodable"]
+
+
+@functools.lru_cache(maxsize=None)
+def _load_native():
+    """The C++ peeling decoder (native/lt_peel.cpp), compiled on first
+    use; raises if no toolchain — callers fall back to NumPy."""
+    from .. import native
+
+    lib = ctypes.CDLL(native.build("lt_peel"))
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    for name, fltp in (
+        ("lt_peel_f32", ctypes.POINTER(ctypes.c_float)),
+        ("lt_peel_f64", ctypes.POINTER(ctypes.c_double)),
+    ):
+        fn = getattr(lib, name)
+        fn.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_long,
+            i32p, i32p, fltp, fltp, u8p,
+        ]
+        fn.restype = ctypes.c_long
+    return lib
 
 
 def robust_soliton(k: int, c: float = 0.1, delta: float = 0.5) -> np.ndarray:
@@ -83,13 +109,31 @@ class LTCode:
         return len(resolved) == self.k
 
     # -- decode ----------------------------------------------------------
-    def decode(self, shards, shard_ids) -> np.ndarray:
+    def decode(self, shards, shard_ids, *, prefer_native: bool = True
+               ) -> np.ndarray:
         """Peel: recover the k source blocks from arrived shards.
 
         ``shards``: (m, rows, cols) arrived coded sums, ``shard_ids``:
         their shard ids. Raises ``ValueError`` if peeling stalls (use
-        :meth:`peelable` / the nwait predicate to avoid).
+        :meth:`peelable` / the nwait predicate to avoid). The peel runs
+        in the native C++ decoder (native/lt_peel.cpp) when a toolchain
+        is available — one in-place pass per release, no per-release
+        Python/alloc overhead — falling back to the NumPy loop
+        otherwise. Release order may differ between the two (worklist
+        vs rescan), so results agree to float rounding, not bitwise.
         """
+        if prefer_native:
+            try:
+                lib = _load_native()
+            except Exception as e:  # no compiler / bad toolchain
+                warnings.warn(
+                    f"native lt_peel unavailable ({e}); using numpy "
+                    "fallback",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+            else:
+                return self._decode_native(lib, shards, shard_ids)
         shards = [np.array(s, copy=True) for s in np.asarray(shards)]
         supports = [set(self.shard_indices(s).tolist()) for s in shard_ids]
         out = [None] * self.k
@@ -117,6 +161,43 @@ class LTCode:
                 "shard set not decodable"
             )
         return np.stack(out)
+
+    def _decode_native(self, lib, shards, shard_ids) -> np.ndarray:
+        shards = np.array(shards, copy=True)  # peeled in place
+        m = shards.shape[0]
+        block_shape = shards.shape[1:]
+        dtype = shards.dtype
+        if dtype == np.float32:
+            fn, cty = lib.lt_peel_f32, ctypes.c_float
+        elif dtype == np.float64:
+            fn, cty = lib.lt_peel_f64, ctypes.c_double
+        else:  # ints etc.: exactness in f64 up to 2^53, then cast back
+            return self._decode_native(
+                lib, shards.astype(np.float64), shard_ids
+            ).astype(dtype)
+        shards = np.ascontiguousarray(shards.reshape(m, -1))
+        supports = [self.shard_indices(s) for s in shard_ids]
+        off = np.zeros(m + 1, dtype=np.int32)
+        off[1:] = np.cumsum([len(s) for s in supports])
+        sup = np.concatenate(supports).astype(np.int32)
+        out = np.zeros((self.k, shards.shape[1]), dtype=dtype)
+        resolved = np.zeros(self.k, dtype=np.uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        fltp = ctypes.POINTER(cty)
+        n = fn(
+            m, self.k, shards.shape[1],
+            sup.ctypes.data_as(i32p), off.ctypes.data_as(i32p),
+            shards.ctypes.data_as(fltp), out.ctypes.data_as(fltp),
+            resolved.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint8)
+            ),
+        )
+        if n < self.k:
+            raise ValueError(
+                f"peeling stalled at {n}/{self.k} blocks; "
+                "shard set not decodable"
+            )
+        return out.reshape(self.k, *block_shape)
 
     def decode_array(self, shards, shard_ids) -> np.ndarray:
         blocks = self.decode(shards, shard_ids)
