@@ -12,14 +12,20 @@ __all__ = [
     "RemoteWorkerError",
     "WorkerProcessDied",
     "XLADeviceBackend",
+    "NativeProcessBackend",
 ]
 
 
 def __getattr__(name):
     # lazy: importing the XLA backend pulls in jax (and TPU plugin
-    # registration); LocalBackend-only use stays numpy-only
+    # registration), and the native backend compiles C++ on first use;
+    # LocalBackend-only use stays numpy-only
     if name == "XLADeviceBackend":
         from .xla import XLADeviceBackend
 
         return XLADeviceBackend
+    if name == "NativeProcessBackend":
+        from .native import NativeProcessBackend
+
+        return NativeProcessBackend
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

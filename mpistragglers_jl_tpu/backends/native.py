@@ -1,0 +1,218 @@
+"""Process workers over the native C++ transport (native/transport.cpp).
+
+Functionally the twin of :class:`~.process.ProcessBackend` — n spawned
+OS worker processes, real serialization boundary, dead-worker detection —
+but the coordinator side is the native runtime instead of Python pipes
+and reader threads: framed messages over Unix-domain sockets, an epoll
+progress thread doing all partial I/O (the libmpi progress-engine role,
+SURVEY component C8), and ``wait_any`` blocking in native
+``msgt_coord_waitany`` rather than a Python condition variable. The pool
+above is unchanged; this backend exists so the hot host-side wait loop
+(reference ``MPI.Waitany!``, src/MPIAsyncPools.jl:161) runs in native
+code with zero Python threads on the coordinator.
+
+Construction falls back with :class:`~..native.NativeBuildError` if no
+compiler is available; callers wanting automatic degradation should
+catch it and build a :class:`~.process.ProcessBackend` instead.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import pickle
+import tempfile
+import time
+import traceback
+import uuid
+from pathlib import Path
+from typing import Sequence
+
+import numpy as np
+
+from ..native import transport as T
+from .base import Backend, DelayFn, WorkerError
+from .process import RemoteWorkerError, WorkerProcessDied, WorkFn
+
+__all__ = ["NativeProcessBackend"]
+
+
+def _native_worker_main(
+    rank: int, path: str, work_fn: WorkFn, delay_fn: DelayFn | None
+) -> None:
+    """Worker process entry: the reference worker loop (SURVEY §3.2 —
+    receive -> stall -> compute -> send, control channel for shutdown,
+    examples/iterative_example.jl:55-82) over the native transport."""
+    try:
+        w = T.Worker(path, rank)
+    except Exception:
+        return
+    try:
+        while True:
+            msg = w.recv()
+            if msg is None or msg.kind == T.KIND_CONTROL:
+                break  # coordinator gone, or shutdown broadcast
+            payload = pickle.loads(msg.payload)
+            if delay_fn is not None:
+                d = float(delay_fn(rank, msg.epoch))
+                if d > 0:
+                    time.sleep(d)
+            try:
+                out = pickle.dumps(
+                    work_fn(rank, payload, msg.epoch), protocol=5
+                )
+                kind = T.KIND_DATA
+            except BaseException as e:
+                out = pickle.dumps(
+                    (type(e).__name__, str(e), traceback.format_exc()),
+                    protocol=5,
+                )
+                kind = T.KIND_ERROR
+            if not w.send(out, seq=msg.seq, epoch=msg.epoch, kind=kind):
+                break
+    except (KeyboardInterrupt, Exception):
+        pass
+    finally:
+        w.close()
+
+
+class NativeProcessBackend(Backend):
+    """n worker processes; all coordinator-side I/O in the C++ runtime.
+
+    Same contract as :class:`~.process.ProcessBackend` (picklable
+    ``work_fn(i, payload, epoch)`` / ``delay_fn``); the payload snapshot
+    happens twice over — pickled at dispatch, then copied into the native
+    send queue — so in-flight sends survive caller mutation (the
+    reference's ``isendbuf`` discipline, src/MPIAsyncPools.jl:130).
+    """
+
+    def __init__(
+        self,
+        work_fn: WorkFn,
+        n_workers: int,
+        *,
+        delay_fn: DelayFn | None = None,
+        mp_context: str = "spawn",
+        connect_timeout: float = 60.0,
+        join_timeout: float = 5.0,
+    ):
+        self.n_workers = int(n_workers)
+        self.work_fn = work_fn
+        self.delay_fn = delay_fn
+        self._join_timeout = join_timeout
+        self._closed = False
+        self._seqs = [0] * self.n_workers
+        self._epochs = [0] * self.n_workers  # epoch of in-flight dispatch
+        # dispatch that failed instantly (dead worker): surfaced at the
+        # next test/wait instead of raising inside the pool's send phase
+        self._synthetic: list[WorkerError | None] = [None] * self.n_workers
+        sock = Path(tempfile.gettempdir()) / f"msgt-{uuid.uuid4().hex[:12]}.sock"
+        self._coord = T.Coordinator(str(sock), self.n_workers)
+        ctx = mp.get_context(mp_context)
+        self._procs = [
+            ctx.Process(
+                target=_native_worker_main,
+                args=(i, str(sock), work_fn, delay_fn),
+                daemon=True,
+                name=f"pool-native-worker-{i}",
+            )
+            for i in range(self.n_workers)
+        ]
+        for p in self._procs:
+            p.start()
+        try:
+            self._coord.accept(timeout=connect_timeout)
+        except T.TransportError:
+            self.shutdown()
+            raise
+
+    # -- Backend interface -------------------------------------------------
+    def dispatch(self, i: int, sendbuf, epoch: int, *, tag: int = 0) -> None:
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
+        payload = sendbuf
+        if hasattr(payload, "__array__") and not isinstance(payload, np.ndarray):
+            payload = np.asarray(payload)  # device arrays are not picklable
+        self._seqs[i] += 1
+        self._epochs[i] = int(epoch)
+        ok = self._coord.isend(
+            i, pickle.dumps(payload, protocol=5),
+            seq=self._seqs[i], epoch=int(epoch), tag=int(tag),
+        )
+        if not ok:  # rank already dead: fail the task, don't hang the pool
+            self._synthetic[i] = WorkerError(i, epoch, WorkerProcessDied(i))
+
+    def _decode(self, i: int, msg: T.Message):
+        if msg.kind == T.KIND_DEATH:
+            return WorkerError(
+                i, self._epochs[i], WorkerProcessDied(i)
+            )
+        if msg.kind == T.KIND_ERROR:
+            exc_type, text, tb = pickle.loads(msg.payload)
+            return WorkerError(
+                i, msg.epoch, RemoteWorkerError(exc_type, text, tb)
+            )
+        return pickle.loads(msg.payload)
+
+    def _next(self, i: int, *, block: bool, timeout: float | None = None):
+        """Fetch the completion for worker ``i``'s current dispatch,
+        skipping frames from superseded dispatches (stale seq)."""
+        if self._synthetic[i] is not None:
+            out = self._synthetic[i]
+            self._synthetic[i] = None
+            return out
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        while True:
+            if block:
+                left = (
+                    None if deadline is None
+                    else max(deadline - time.perf_counter(), 0.0)
+                )
+                got = self._coord.waitany([i], timeout=left)
+                if got is None:
+                    return None  # timeout
+                _, msg = got
+            else:
+                msg = self._coord.poll(i)
+                if msg is None:
+                    return None
+            if msg.kind == T.KIND_DATA or msg.kind == T.KIND_ERROR:
+                if msg.seq != self._seqs[i]:
+                    continue  # superseded dispatch; drop and keep looking
+            return self._decode(i, msg)
+
+    def test(self, i: int):
+        return self._next(i, block=False)
+
+    def wait_any(self, indices: Sequence[int]) -> tuple[int, object]:
+        idx = [int(j) for j in indices]
+        if not idx:
+            raise ValueError("wait_any over an empty index set would hang")
+        for j in idx:  # synthetic failures first — they're already complete
+            if self._synthetic[j] is not None:
+                out = self._synthetic[j]
+                self._synthetic[j] = None
+                return j, out
+        while True:
+            got = self._coord.waitany(idx, timeout=None)
+            assert got is not None  # no timeout passed
+            j, msg = got
+            if msg.kind in (T.KIND_DATA, T.KIND_ERROR) and msg.seq != self._seqs[j]:
+                continue
+            return j, self._decode(j, msg)
+
+    def wait(self, i: int, timeout: float | None = None):
+        return self._next(i, block=True, timeout=timeout)
+
+    def shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for i in range(self.n_workers):
+            # control-channel broadcast (reference test/kmap2.jl:14-18)
+            self._coord.isend(i, b"", kind=T.KIND_CONTROL)
+        for p in self._procs:
+            p.join(timeout=self._join_timeout)
+        for p in self._procs:
+            if p.is_alive():  # pragma: no cover - stuck worker
+                p.terminate()
+        self._coord.close()

@@ -26,6 +26,37 @@ class NativeBuildError(RuntimeError):
     pass
 
 
+_loaded: dict[str, object] = {}
+_load_lock = threading.Lock()
+
+
+def load(name: str, configure=None):
+    """Build + ``ctypes.CDLL``-load ``_lib<name>.so`` once per process.
+
+    Success *and* failure are memoized: a broken toolchain is probed
+    exactly once, not re-probed with a fresh (120 s-timeout) g++
+    subprocess on every call from a hot path. ``configure(lib)``, if
+    given, sets up argtypes/restypes on first load.
+    """
+    import ctypes
+
+    with _load_lock:
+        cached = _loaded.get(name)
+        if cached is not None:
+            if isinstance(cached, Exception):
+                raise cached
+            return cached
+        try:
+            lib = ctypes.CDLL(build(name))
+            if configure is not None:
+                configure(lib)
+        except Exception as e:
+            _loaded[name] = e
+            raise
+        _loaded[name] = lib
+        return lib
+
+
 def lib_path(name: str) -> str:
     return os.path.join(_DIR, f"_lib{name}.so")
 

@@ -1,0 +1,557 @@
+// Native host-side message transport: framed non-blocking point-to-point
+// messaging between a coordinator and n worker processes over Unix-domain
+// sockets, driven by an epoll progress thread.
+//
+// This is the framework's analog of the reference's one native component:
+// libmpi reached through MPI.jl (SURVEY component C8 — the reference's
+// entire transport is MPI_Isend/Irecv/Test/Waitany/Waitall, see
+// src/MPIAsyncPools.jl:99,113,137-138,161,171,182-183,212). The mapping:
+//
+//   MPI primitive        here
+//   -----------------    ------------------------------------------------
+//   MPI_Isend            coord_isend: copy payload into a per-peer send
+//                        queue (the snapshot discipline of reference
+//                        src/MPIAsyncPools.jl:130 lives in the transport),
+//                        kick the progress thread via eventfd, return
+//                        immediately.
+//   progress engine      one epoll thread handling partial reads/writes on
+//                        every peer socket (libmpi's progress engine).
+//   MPI_Test             coord_poll/coord_take: non-blocking completion
+//                        probe + payload harvest.
+//   MPI_Waitany          coord_waitany: condvar sleep until any peer in a
+//                        caller-supplied set has a completed inbound frame
+//                        (or died), with optional timeout.
+//   dead rank            peer HUP/EOF marks the rank dead (sticky); polls
+//                        on a dead rank surface a death marker instead of
+//                        hanging the way a dead rank hangs MPI_Waitall
+//                        (SURVEY §5 'Failure detection').
+//
+// Wire format, both directions: a 40-byte header of five little-endian
+// int64s {payload_len, seq, epoch, tag, kind} followed by payload_len raw
+// bytes. kind: 0=data, 1=control/shutdown, 2=hello (worker->coordinator,
+// seq carries the rank), 3=death marker (synthesized locally, never on
+// the wire), 4=worker-error (payload is a serialized exception).
+//
+// Exposed as a flat C ABI for ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace {
+
+struct Header {
+  int64_t len;
+  int64_t seq;
+  int64_t epoch;
+  int64_t tag;
+  int64_t kind;
+};
+static_assert(sizeof(Header) == 40, "header must be 5 packed int64s");
+
+constexpr int64_t KIND_DATA = 0;
+constexpr int64_t KIND_CONTROL = 1;
+constexpr int64_t KIND_HELLO = 2;
+constexpr int64_t KIND_DEATH = 3;
+constexpr int64_t KIND_ERROR = 4;
+
+struct Frame {
+  Header hdr;
+  std::vector<uint8_t> payload;
+};
+
+// Blocking full read/write on a (blocking-mode) fd. Used worker-side and
+// during the coordinator's hello handshake.
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r == 0) return false;  // EOF
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void set_nonblocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+// Per-peer connection state owned by the progress thread.
+struct Peer {
+  int fd = -1;
+  bool dead = false;
+
+  // inbound reassembly state machine
+  Header rhdr{};
+  size_t rgot = 0;       // bytes of header received so far
+  bool rin_payload = false;
+  std::vector<uint8_t> rbuf;
+  size_t rpayload_got = 0;
+
+  // outbound queue: frames waiting to be written, partial-write cursor
+  std::deque<Frame> sendq;
+  size_t sent = 0;  // bytes of sendq.front() already written (hdr+payload)
+};
+
+struct Coordinator {
+  int n = 0;
+  int listen_fd = -1;
+  int epfd = -1;
+  int wake_fd = -1;  // eventfd: kicks the progress thread for sends/stop
+  std::string path;
+  std::thread progress;
+  std::atomic<bool> stopping{false};
+
+  std::mutex mu;                   // guards peers' queues + completed
+  std::condition_variable cv;      // notified on arrival / death
+  std::vector<Peer> peers;
+  std::vector<std::deque<Frame>> completed;  // inbound frames per rank
+  std::string error;  // first fatal progress-engine error, for diagnostics
+
+  ~Coordinator() {
+    if (listen_fd >= 0) ::close(listen_fd);
+    if (epfd >= 0) ::close(epfd);
+    if (wake_fd >= 0) ::close(wake_fd);
+    for (auto& p : peers)
+      if (p.fd >= 0) ::close(p.fd);
+    if (!path.empty()) ::unlink(path.c_str());
+  }
+};
+
+// Serialize one frame into a flat byte vector (header + payload) so the
+// partial-write cursor is a single offset.
+size_t frame_bytes(const Frame& f) {
+  return sizeof(Header) + f.payload.size();
+}
+
+void mark_dead(Coordinator* c, int rank) {
+  // caller holds c->mu
+  Peer& p = c->peers[rank];
+  if (p.dead) return;
+  p.dead = true;
+  if (p.fd >= 0) {
+    epoll_ctl(c->epfd, EPOLL_CTL_DEL, p.fd, nullptr);
+    ::close(p.fd);
+    p.fd = -1;
+  }
+  p.sendq.clear();
+  c->cv.notify_all();
+}
+
+// Drain as many inbound bytes as available on peer `rank`; push completed
+// frames. Returns false if the peer died.
+bool pump_read(Coordinator* c, int rank) {
+  Peer& p = c->peers[rank];
+  while (true) {
+    if (!p.rin_payload) {
+      auto* dst = reinterpret_cast<uint8_t*>(&p.rhdr) + p.rgot;
+      ssize_t r = ::read(p.fd, dst, sizeof(Header) - p.rgot);
+      if (r == 0) return false;
+      if (r < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+        if (errno == EINTR) continue;
+        return false;
+      }
+      p.rgot += static_cast<size_t>(r);
+      if (p.rgot < sizeof(Header)) continue;
+      if (p.rhdr.len < 0) return false;  // corrupt frame
+      p.rin_payload = true;
+      p.rbuf.resize(static_cast<size_t>(p.rhdr.len));
+      p.rpayload_got = 0;
+    }
+    while (p.rpayload_got < p.rbuf.size()) {
+      ssize_t r = ::read(p.fd, p.rbuf.data() + p.rpayload_got,
+                         p.rbuf.size() - p.rpayload_got);
+      if (r == 0) return false;
+      if (r < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+        if (errno == EINTR) continue;
+        return false;
+      }
+      p.rpayload_got += static_cast<size_t>(r);
+    }
+    {
+      std::lock_guard<std::mutex> lk(c->mu);
+      c->completed[rank].push_back(
+          Frame{p.rhdr, std::move(p.rbuf)});
+      c->cv.notify_all();
+    }
+    p.rbuf = {};
+    p.rgot = 0;
+    p.rin_payload = false;
+  }
+}
+
+// Write as much of the send queue as the socket accepts. Returns false on
+// a fatal write error (peer treated as dead).
+bool pump_write(Coordinator* c, int rank) {
+  Peer& p = c->peers[rank];
+  while (true) {
+    Frame* f;
+    {
+      std::lock_guard<std::mutex> lk(c->mu);
+      if (p.sendq.empty()) break;
+      f = &p.sendq.front();
+    }
+    size_t total = frame_bytes(*f);
+    while (p.sent < total) {
+      const uint8_t* src;
+      size_t avail;
+      if (p.sent < sizeof(Header)) {
+        src = reinterpret_cast<const uint8_t*>(&f->hdr) + p.sent;
+        avail = sizeof(Header) - p.sent;
+      } else {
+        size_t off = p.sent - sizeof(Header);
+        src = f->payload.data() + off;
+        avail = f->payload.size() - off;
+      }
+      ssize_t r = ::write(p.fd, src, avail);
+      if (r < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+        if (errno == EINTR) continue;
+        return false;
+      }
+      p.sent += static_cast<size_t>(r);
+    }
+    {
+      std::lock_guard<std::mutex> lk(c->mu);
+      p.sendq.pop_front();
+    }
+    p.sent = 0;
+  }
+  // nothing left to write: stop watching EPOLLOUT
+  epoll_event ev{};
+  ev.events = EPOLLIN | EPOLLRDHUP;
+  ev.data.u32 = static_cast<uint32_t>(rank);
+  epoll_ctl(c->epfd, EPOLL_CTL_MOD, p.fd, &ev);
+  return true;
+}
+
+void progress_main(Coordinator* c) {
+  constexpr uint32_t WAKE_TOKEN = 0xffffffffu;
+  epoll_event events[64];
+  while (!c->stopping.load(std::memory_order_acquire)) {
+    int nev = epoll_wait(c->epfd, events, 64, 200);
+    if (nev < 0) {
+      if (errno == EINTR) continue;
+      std::lock_guard<std::mutex> lk(c->mu);
+      c->error = std::string("epoll_wait: ") + strerror(errno);
+      return;
+    }
+    // sends may have been enqueued since the last pass: arm EPOLLOUT for
+    // any peer with a non-empty queue (cheap: n is small)
+    bool kicked = false;
+    for (int i = 0; i < nev; i++) {
+      if (events[i].data.u32 == WAKE_TOKEN) {
+        uint64_t tok;
+        (void)!::read(c->wake_fd, &tok, sizeof(tok));
+        kicked = true;
+      }
+    }
+    if (kicked) {
+      std::lock_guard<std::mutex> lk(c->mu);
+      for (int r = 0; r < c->n; r++) {
+        Peer& p = c->peers[r];
+        if (!p.dead && p.fd >= 0 && !p.sendq.empty()) {
+          epoll_event ev{};
+          ev.events = EPOLLIN | EPOLLOUT | EPOLLRDHUP;
+          ev.data.u32 = static_cast<uint32_t>(r);
+          epoll_ctl(c->epfd, EPOLL_CTL_MOD, p.fd, &ev);
+        }
+      }
+    }
+    for (int i = 0; i < nev; i++) {
+      uint32_t id = events[i].data.u32;
+      if (id == WAKE_TOKEN) continue;
+      int rank = static_cast<int>(id);
+      Peer& p = c->peers[rank];
+      if (p.dead || p.fd < 0) continue;
+      bool ok = true;
+      if (events[i].events & (EPOLLIN | EPOLLRDHUP | EPOLLHUP | EPOLLERR))
+        ok = pump_read(c, rank);
+      if (ok && (events[i].events & EPOLLOUT)) ok = pump_write(c, rank);
+      if (!ok) {
+        std::lock_guard<std::mutex> lk(c->mu);
+        mark_dead(c, rank);
+      }
+    }
+  }
+}
+
+struct WorkerCtx {
+  int fd = -1;
+  ~WorkerCtx() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- coordinator
+
+// Create the coordinator: bind + listen on a Unix socket at `path`.
+// Returns an opaque handle, or nullptr on failure.
+void* msgt_coord_create(const char* path, int n_workers) {
+  auto* c = new Coordinator();
+  c->n = n_workers;
+  c->path = path;
+  c->peers.resize(n_workers);
+  c->completed.resize(n_workers);
+  ::unlink(path);
+  c->listen_fd = ::socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (c->listen_fd < 0) {
+    delete c;
+    return nullptr;
+  }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  if (c->path.size() >= sizeof(addr.sun_path)) {
+    delete c;
+    return nullptr;
+  }
+  std::strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
+  if (::bind(c->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(c->listen_fd, n_workers) != 0) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+// Accept all n workers (each opens with a hello frame carrying its rank in
+// hdr.seq), then start the progress thread. Returns 0 on success, -1 on
+// timeout/handshake failure.
+int msgt_coord_accept(void* h, int64_t timeout_ms) {
+  auto* c = static_cast<Coordinator*>(h);
+  int accepted = 0;
+  while (accepted < c->n) {
+    pollfd pfd{c->listen_fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, static_cast<int>(timeout_ms));
+    if (pr <= 0) return -1;
+    int fd = ::accept(c->listen_fd, nullptr, nullptr);
+    if (fd < 0) continue;
+    Header hello{};
+    if (!read_full(fd, &hello, sizeof(hello)) || hello.kind != KIND_HELLO ||
+        hello.seq < 0 || hello.seq >= c->n ||
+        c->peers[hello.seq].fd >= 0) {
+      ::close(fd);
+      return -1;
+    }
+    set_nonblocking(fd);
+    c->peers[hello.seq].fd = fd;
+    accepted++;
+  }
+  c->epfd = epoll_create1(EPOLL_CLOEXEC);
+  c->wake_fd = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+  if (c->epfd < 0 || c->wake_fd < 0) return -1;
+  epoll_event wev{};
+  wev.events = EPOLLIN;
+  wev.data.u32 = 0xffffffffu;
+  epoll_ctl(c->epfd, EPOLL_CTL_ADD, c->wake_fd, &wev);
+  for (int r = 0; r < c->n; r++) {
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLRDHUP;
+    ev.data.u32 = static_cast<uint32_t>(r);
+    epoll_ctl(c->epfd, EPOLL_CTL_ADD, c->peers[r].fd, &ev);
+  }
+  c->progress = std::thread(progress_main, c);
+  return 0;
+}
+
+// Non-blocking send: snapshot `data` into rank's send queue and kick the
+// progress thread (MPI_Isend). Returns 0, or -1 if the rank is dead.
+int msgt_coord_isend(void* h, int rank, int64_t seq, int64_t epoch,
+                     int64_t tag, int64_t kind, const uint8_t* data,
+                     int64_t len) {
+  auto* c = static_cast<Coordinator*>(h);
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    Peer& p = c->peers[rank];
+    if (p.dead) return -1;
+    Frame f;
+    f.hdr = Header{len, seq, epoch, tag, kind};
+    f.payload.assign(data, data + len);
+    p.sendq.push_back(std::move(f));
+  }
+  uint64_t one = 1;
+  (void)!::write(c->wake_fd, &one, sizeof(one));
+  return 0;
+}
+
+// Non-blocking completion probe (MPI_Test). If rank has a completed
+// inbound frame, fills `hdr_out` (without consuming the payload) and
+// returns 1. If the rank is dead and its queue empty, fills a death
+// marker and returns 1 (sticky — a dead rank always polls ready, so no
+// wait can hang on it). Otherwise returns 0.
+int msgt_coord_poll(void* h, int rank, Header* hdr_out) {
+  auto* c = static_cast<Coordinator*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto& q = c->completed[rank];
+  if (!q.empty()) {
+    *hdr_out = q.front().hdr;
+    return 1;
+  }
+  if (c->peers[rank].dead) {
+    *hdr_out = Header{0, -1, -1, 0, KIND_DEATH};
+    return 1;
+  }
+  return 0;
+}
+
+// Consume the frame previously reported by msgt_coord_poll: copy its
+// payload into `buf` (caller sized it from hdr.len) and pop it. Returns
+// the payload length, or -1 if nothing was available.
+int64_t msgt_coord_take(void* h, int rank, uint8_t* buf, int64_t bufcap) {
+  auto* c = static_cast<Coordinator*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto& q = c->completed[rank];
+  if (q.empty()) {
+    // death markers are synthesized, not queued; nothing to pop
+    return c->peers[rank].dead ? 0 : -1;
+  }
+  Frame& f = q.front();
+  int64_t n = static_cast<int64_t>(f.payload.size());
+  if (n > bufcap) return -1;
+  std::memcpy(buf, f.payload.data(), static_cast<size_t>(n));
+  q.pop_front();
+  return n;
+}
+
+// Block until any rank in `ranks` has a completed frame or is dead
+// (MPI_Waitany). Returns the ready rank, or -1 on timeout (-1 timeout_ms
+// blocks forever).
+int msgt_coord_waitany(void* h, const int32_t* ranks, int nranks,
+                       int64_t timeout_ms) {
+  auto* c = static_cast<Coordinator*>(h);
+  std::unique_lock<std::mutex> lk(c->mu);
+  auto ready = [&]() -> int {
+    for (int i = 0; i < nranks; i++) {
+      int r = ranks[i];
+      if (!c->completed[r].empty() || c->peers[r].dead) return r;
+    }
+    return -1;
+  };
+  if (timeout_ms < 0) {
+    int r;
+    c->cv.wait(lk, [&] { return (r = ready()) >= 0; });
+    return r;
+  }
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  int r = -1;
+  c->cv.wait_until(lk, deadline, [&] { return (r = ready()) >= 0; });
+  return r;
+}
+
+// 1 if the rank has been marked dead (EOF/HUP/write error), else 0.
+int msgt_coord_is_dead(void* h, int rank) {
+  auto* c = static_cast<Coordinator*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  return c->peers[rank].dead ? 1 : 0;
+}
+
+// Stop the progress thread, close every socket, remove the socket file.
+void msgt_coord_destroy(void* h) {
+  auto* c = static_cast<Coordinator*>(h);
+  c->stopping.store(true, std::memory_order_release);
+  uint64_t one = 1;
+  if (c->wake_fd >= 0) (void)!::write(c->wake_fd, &one, sizeof(one));
+  if (c->progress.joinable()) c->progress.join();
+  delete c;
+}
+
+// ------------------------------------------------------------------- worker
+
+// Connect to the coordinator's socket and send the hello frame carrying
+// this worker's rank. Returns an opaque handle or nullptr.
+void* msgt_worker_connect(const char* path, int rank) {
+  auto* w = new WorkerCtx();
+  w->fd = ::socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (w->fd < 0) {
+    delete w;
+    return nullptr;
+  }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
+  if (::connect(w->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+      0) {
+    delete w;
+    return nullptr;
+  }
+  Header hello{0, rank, 0, 0, KIND_HELLO};
+  if (!write_full(w->fd, &hello, sizeof(hello))) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+// Blocking read of the next frame header. Returns 0 on success, -1 on
+// EOF/error (coordinator gone).
+int msgt_worker_recv_hdr(void* h, Header* hdr_out) {
+  auto* w = static_cast<WorkerCtx*>(h);
+  return read_full(w->fd, hdr_out, sizeof(Header)) ? 0 : -1;
+}
+
+// Blocking read of `len` payload bytes following a header.
+int msgt_worker_recv_payload(void* h, uint8_t* buf, int64_t len) {
+  auto* w = static_cast<WorkerCtx*>(h);
+  return read_full(w->fd, buf, static_cast<size_t>(len)) ? 0 : -1;
+}
+
+// Blocking send of one frame (header + payload).
+int msgt_worker_send(void* h, int64_t seq, int64_t epoch, int64_t tag,
+                     int64_t kind, const uint8_t* data, int64_t len) {
+  auto* w = static_cast<WorkerCtx*>(h);
+  Header hdr{len, seq, epoch, tag, kind};
+  if (!write_full(w->fd, &hdr, sizeof(hdr))) return -1;
+  if (len > 0 && !write_full(w->fd, data, static_cast<size_t>(len)))
+    return -1;
+  return 0;
+}
+
+void msgt_worker_close(void* h) {
+  delete static_cast<WorkerCtx*>(h);
+}
+
+}  // extern "C"

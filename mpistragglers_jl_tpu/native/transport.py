@@ -1,0 +1,248 @@
+"""ctypes bindings for the native message transport (transport.cpp).
+
+The C++ library supplies the MPI-shaped primitives (isend / test / waitany
+/ dead-rank detection over Unix-domain sockets with an epoll progress
+thread — the reference's libmpi role, SURVEY component C8); this module
+wraps them in two small classes:
+
+* :class:`Coordinator` — rank-indexed non-blocking sends, completion
+  polls, waitany, payload harvest.
+* :class:`Worker` — blocking receive/send loop primitives for worker
+  processes.
+
+Payloads are opaque bytes at this layer; the backend above
+(:mod:`..backends.native`) owns serialization. No fallback exists here on
+purpose — consumers (the backend) catch :class:`NativeBuildError` and use
+the pure-Python :class:`~..backends.process.ProcessBackend` instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from dataclasses import dataclass
+
+from . import build
+
+KIND_DATA = 0
+KIND_CONTROL = 1
+KIND_HELLO = 2
+KIND_DEATH = 3
+KIND_ERROR = 4
+
+
+class _Header(ctypes.Structure):
+    _fields_ = [
+        ("len", ctypes.c_int64),
+        ("seq", ctypes.c_int64),
+        ("epoch", ctypes.c_int64),
+        ("tag", ctypes.c_int64),
+        ("kind", ctypes.c_int64),
+    ]
+
+
+@dataclass(frozen=True)
+class Message:
+    """One received frame: bookkeeping header + raw payload bytes."""
+
+    seq: int
+    epoch: int
+    tag: int
+    kind: int
+    payload: bytes
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load_lib():
+    """Compile (if stale) and load the transport library, caching the
+    handle process-wide."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(build("transport"))
+        lib.msgt_coord_create.restype = ctypes.c_void_p
+        lib.msgt_coord_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.msgt_coord_accept.restype = ctypes.c_int
+        lib.msgt_coord_accept.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.msgt_coord_isend.restype = ctypes.c_int
+        lib.msgt_coord_isend.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.msgt_coord_poll.restype = ctypes.c_int
+        lib.msgt_coord_poll.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(_Header)
+        ]
+        lib.msgt_coord_take.restype = ctypes.c_int64
+        lib.msgt_coord_take.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ]
+        lib.msgt_coord_waitany.restype = ctypes.c_int
+        lib.msgt_coord_waitany.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.c_int64,
+        ]
+        lib.msgt_coord_is_dead.restype = ctypes.c_int
+        lib.msgt_coord_is_dead.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.msgt_coord_destroy.restype = None
+        lib.msgt_coord_destroy.argtypes = [ctypes.c_void_p]
+        lib.msgt_worker_connect.restype = ctypes.c_void_p
+        lib.msgt_worker_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.msgt_worker_recv_hdr.restype = ctypes.c_int
+        lib.msgt_worker_recv_hdr.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(_Header)
+        ]
+        lib.msgt_worker_recv_payload.restype = ctypes.c_int
+        lib.msgt_worker_recv_payload.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64
+        ]
+        lib.msgt_worker_send.restype = ctypes.c_int
+        lib.msgt_worker_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.msgt_worker_close.restype = None
+        lib.msgt_worker_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class TransportError(RuntimeError):
+    pass
+
+
+class Coordinator:
+    """Coordinator endpoint: owns the listening socket and the native
+    progress thread; one connection per worker rank."""
+
+    def __init__(self, path: str, n_workers: int):
+        self._lib = load_lib()
+        self.n_workers = int(n_workers)
+        self.path = path
+        self._h = self._lib.msgt_coord_create(
+            path.encode(), self.n_workers
+        )
+        if not self._h:
+            raise TransportError(f"could not bind coordinator socket {path}")
+
+    def accept(self, timeout: float = 30.0) -> None:
+        """Wait for all workers to connect and complete the hello
+        handshake, then start the progress engine."""
+        rc = self._lib.msgt_coord_accept(self._h, int(timeout * 1000))
+        if rc != 0:
+            raise TransportError(
+                f"workers failed to connect within {timeout}s"
+            )
+
+    def isend(
+        self, rank: int, payload: bytes, *,
+        seq: int = 0, epoch: int = 0, tag: int = 0, kind: int = KIND_DATA,
+    ) -> bool:
+        """Non-blocking send; payload is snapshotted into the native send
+        queue. Returns False if the rank is dead."""
+        rc = self._lib.msgt_coord_isend(
+            self._h, int(rank), seq, epoch, tag, kind, payload, len(payload)
+        )
+        return rc == 0
+
+    def poll(self, rank: int) -> Message | None:
+        """Non-blocking probe-and-take (``MPI.Test!``): returns the next
+        completed message for ``rank`` (a ``KIND_DEATH`` message if the
+        rank died), or None."""
+        hdr = _Header()
+        if not self._lib.msgt_coord_poll(self._h, int(rank), ctypes.byref(hdr)):
+            return None
+        return self._take(rank, hdr)
+
+    def _take(self, rank: int, hdr: _Header) -> Message:
+        n = int(hdr.len)
+        buf = (ctypes.c_uint8 * max(n, 1))()
+        got = self._lib.msgt_coord_take(self._h, int(rank), buf, n)
+        if got < 0:
+            raise TransportError(f"take({rank}) raced: nothing available")
+        return Message(
+            seq=int(hdr.seq), epoch=int(hdr.epoch), tag=int(hdr.tag),
+            kind=int(hdr.kind), payload=bytes(bytearray(buf[:got])),
+        )
+
+    def waitany(
+        self, ranks, timeout: float | None = None
+    ) -> tuple[int, Message] | None:
+        """Block until any rank in ``ranks`` has a message (or died);
+        returns ``(rank, message)``, or None on timeout
+        (``MPI.Waitany!``)."""
+        arr = (ctypes.c_int32 * len(ranks))(*[int(r) for r in ranks])
+        t = -1 if timeout is None else max(int(timeout * 1000), 0)
+        rank = self._lib.msgt_coord_waitany(self._h, arr, len(ranks), t)
+        if rank < 0:
+            return None
+        msg = self.poll(rank)
+        if msg is None:  # pragma: no cover - single-consumer coordinator
+            raise TransportError(f"waitany({rank}) raced with another take")
+        return rank, msg
+
+    def is_dead(self, rank: int) -> bool:
+        return bool(self._lib.msgt_coord_is_dead(self._h, int(rank)))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.msgt_coord_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - GC ordering dependent
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class Worker:
+    """Worker endpoint: blocking framed recv/send on one socket."""
+
+    def __init__(self, path: str, rank: int):
+        self._lib = load_lib()
+        self.rank = int(rank)
+        self._h = self._lib.msgt_worker_connect(path.encode(), self.rank)
+        if not self._h:
+            raise TransportError(
+                f"worker {rank} could not connect to {path}"
+            )
+
+    def recv(self) -> Message | None:
+        """Block for the next frame; None means the coordinator is gone."""
+        hdr = _Header()
+        if self._lib.msgt_worker_recv_hdr(self._h, ctypes.byref(hdr)) != 0:
+            return None
+        n = int(hdr.len)
+        buf = (ctypes.c_uint8 * max(n, 1))()
+        if n > 0 and self._lib.msgt_worker_recv_payload(self._h, buf, n) != 0:
+            return None
+        return Message(
+            seq=int(hdr.seq), epoch=int(hdr.epoch), tag=int(hdr.tag),
+            kind=int(hdr.kind), payload=bytes(bytearray(buf[:n])),
+        )
+
+    def send(
+        self, payload: bytes, *,
+        seq: int = 0, epoch: int = 0, tag: int = 0, kind: int = KIND_DATA,
+    ) -> bool:
+        rc = self._lib.msgt_worker_send(
+            self._h, seq, epoch, tag, kind, payload, len(payload)
+        )
+        return rc == 0
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.msgt_worker_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - GC ordering dependent
+        try:
+            self.close()
+        except Exception:
+            pass

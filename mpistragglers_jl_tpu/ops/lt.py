@@ -20,7 +20,6 @@ are 0/1, no amplification beyond degree-many subtractions).
 from __future__ import annotations
 
 import ctypes
-import functools
 import warnings
 
 import numpy as np
@@ -28,13 +27,7 @@ import numpy as np
 __all__ = ["LTCode", "nwait_lt_decodable"]
 
 
-@functools.lru_cache(maxsize=None)
-def _load_native():
-    """The C++ peeling decoder (native/lt_peel.cpp), compiled on first
-    use; raises if no toolchain — callers fall back to NumPy."""
-    from .. import native
-
-    lib = ctypes.CDLL(native.build("lt_peel"))
+def _configure(lib):
     i32p = ctypes.POINTER(ctypes.c_int32)
     u8p = ctypes.POINTER(ctypes.c_uint8)
     for name, fltp in (
@@ -47,7 +40,15 @@ def _load_native():
             i32p, i32p, fltp, fltp, u8p,
         ]
         fn.restype = ctypes.c_long
-    return lib
+
+
+def _load_native():
+    """The C++ peeling decoder (native/lt_peel.cpp), compiled on first
+    use; raises if no toolchain — callers fall back to NumPy. Success
+    and failure are both memoized by :func:`..native.load`."""
+    from .. import native
+
+    return native.load("lt_peel", _configure)
 
 
 def robust_soliton(k: int, c: float = 0.1, delta: float = 0.5) -> np.ndarray:
@@ -163,19 +164,21 @@ class LTCode:
         return np.stack(out)
 
     def _decode_native(self, lib, shards, shard_ids) -> np.ndarray:
-        shards = np.array(shards, copy=True)  # peeled in place
+        shards = np.asarray(shards)
         m = shards.shape[0]
         block_shape = shards.shape[1:]
-        dtype = shards.dtype
-        if dtype == np.float32:
-            fn, cty = lib.lt_peel_f32, ctypes.c_float
-        elif dtype == np.float64:
-            fn, cty = lib.lt_peel_f64, ctypes.c_double
+        orig_dtype = shards.dtype
+        if orig_dtype == np.float32:
+            fn, cty, dtype = lib.lt_peel_f32, ctypes.c_float, np.float32
+        elif orig_dtype == np.float64:
+            fn, cty, dtype = lib.lt_peel_f64, ctypes.c_double, np.float64
         else:  # ints etc.: exactness in f64 up to 2^53, then cast back
-            return self._decode_native(
-                lib, shards.astype(np.float64), shard_ids
-            ).astype(dtype)
-        shards = np.ascontiguousarray(shards.reshape(m, -1))
+            fn, cty, dtype = lib.lt_peel_f64, ctypes.c_double, np.float64
+        # exactly one owned working copy, peeled in place (astype with
+        # copy=True covers the dtype == orig_dtype case too)
+        shards = np.ascontiguousarray(
+            shards.reshape(m, -1).astype(dtype, copy=True)
+        )
         supports = [self.shard_indices(s) for s in shard_ids]
         off = np.zeros(m + 1, dtype=np.int32)
         off[1:] = np.cumsum([len(s) for s in supports])
@@ -197,6 +200,8 @@ class LTCode:
                 f"peeling stalled at {n}/{self.k} blocks; "
                 "shard set not decodable"
             )
+        if dtype != orig_dtype:
+            out = out.astype(orig_dtype)
         return out.reshape(self.k, *block_shape)
 
     def decode_array(self, shards, shard_ids) -> np.ndarray:

@@ -17,7 +17,6 @@ construction and exposed as ``RSGF256.impl``.
 from __future__ import annotations
 
 import ctypes
-import functools
 import warnings
 from typing import Sequence
 
@@ -93,12 +92,7 @@ def _np_invert(A: np.ndarray) -> np.ndarray:
     return inv
 
 
-@functools.lru_cache(maxsize=None)
-def _load_native():
-    from .. import native
-
-    path = native.build("rs_gf256")
-    lib = ctypes.CDLL(path)
+def _configure(lib):
     u8p = ctypes.POINTER(ctypes.c_uint8)
     i32p = ctypes.POINTER(ctypes.c_int32)
     lib.rs_make_generator.argtypes = [ctypes.c_int, ctypes.c_int, u8p]
@@ -111,7 +105,13 @@ def _load_native():
         ctypes.c_int, ctypes.c_int, u8p, i32p, u8p, u8p, ctypes.c_long,
     ]
     lib.rs_decode.restype = ctypes.c_int
-    return lib
+
+
+def _load_native():
+    """Memoized (success and failure) via :func:`..native.load`."""
+    from .. import native
+
+    return native.load("rs_gf256", _configure)
 
 
 def _u8p(a: np.ndarray):

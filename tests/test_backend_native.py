@@ -1,0 +1,246 @@
+"""Core pool on the native C++ transport backend.
+
+Same behavioral checklist as the ProcessBackend suite (the reference's
+mpiexec execution model, test/runtests.jl:17), but all coordinator-side
+I/O runs in the native runtime: framed Unix-socket messaging, epoll
+progress thread, native waitany (native/transport.cpp — the libmpi role,
+SURVEY component C8). Also covers the raw transport layer directly.
+Everything must be module-level picklable for spawn.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import AsyncPool, WorkerFailure, asyncmap, waitall
+from mpistragglers_jl_tpu.backends.process import (
+    RemoteWorkerError,
+    WorkerProcessDied,
+)
+from mpistragglers_jl_tpu.native import NativeBuildError
+
+try:
+    from mpistragglers_jl_tpu.backends.native import NativeProcessBackend
+    from mpistragglers_jl_tpu.native import transport as T
+
+    T.load_lib()
+    _SKIP = None
+except NativeBuildError as e:  # pragma: no cover - no compiler in env
+    _SKIP = str(e)
+
+pytestmark = pytest.mark.skipif(
+    _SKIP is not None, reason=f"native transport unavailable: {_SKIP}"
+)
+
+
+def _echo(i, payload, epoch):
+    # the reference's result message layout [rank, t, epoch]
+    # (test/kmap2.jl:92-94)
+    return np.array([float(i + 1), float(payload[0]), float(epoch)])
+
+
+def _fail_worker1_epoch2(i, payload, epoch):
+    if i == 1 and epoch == 2:
+        raise ValueError("boom from native worker")
+    return np.array([float(i + 1), float(payload[0]), float(epoch)])
+
+
+def _exit_worker2(i, payload, epoch):
+    if i == 2:
+        os._exit(3)  # crashed rank, not a Python exception
+    return np.array([float(i + 1), float(payload[0]), float(epoch)])
+
+
+class StragglerDelay:
+    def __init__(self, straggler: int, slow: float = 0.25, fast: float = 0.001):
+        self.straggler = straggler
+        self.slow = slow
+        self.fast = fast
+
+    def __call__(self, i: int, epoch: int) -> float:
+        return self.slow if i == self.straggler else self.fast
+
+
+# ---------------------------------------------------------------- transport
+
+
+def _transport_pair(n):
+    import tempfile
+    import uuid
+
+    path = os.path.join(
+        tempfile.gettempdir(), f"msgt-test-{uuid.uuid4().hex[:8]}.sock"
+    )
+    return T.Coordinator(path, n), path
+
+
+def test_transport_roundtrip_and_waitany():
+    """Raw frames: isend -> worker recv -> worker send -> coord waitany."""
+    import threading
+
+    coord, path = _transport_pair(2)
+    results = {}
+
+    def worker(rank):
+        w = T.Worker(path, rank)
+        while True:
+            msg = w.recv()
+            if msg is None or msg.kind == T.KIND_CONTROL:
+                break
+            w.send(
+                msg.payload + bytes([rank]), seq=msg.seq, epoch=msg.epoch
+            )
+        w.close()
+
+    threads = [
+        __import__("threading").Thread(target=worker, args=(r,), daemon=True)
+        for r in range(2)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        coord.accept(timeout=10)
+        assert coord.poll(0) is None  # nothing in flight yet
+        coord.isend(0, b"abc", seq=7, epoch=3)
+        coord.isend(1, b"xy", seq=8, epoch=3)
+        for _ in range(2):
+            rank, msg = coord.waitany([0, 1], timeout=10)
+            results[rank] = msg
+        assert results[0].payload == b"abc\x00"
+        assert results[0].seq == 7 and results[0].epoch == 3
+        assert results[1].payload == b"xy\x01"
+        # waitany over an already-drained set times out rather than hangs
+        assert coord.waitany([0, 1], timeout=0.05) is None
+        for r in range(2):
+            coord.isend(r, b"", kind=T.KIND_CONTROL)
+        for t in threads:
+            t.join(timeout=5)
+    finally:
+        coord.close()
+
+
+def test_transport_large_payload():
+    """Multi-MB frames exercise the partial-read/write state machine
+    (payloads far exceed socket buffers)."""
+    import threading
+
+    coord, path = _transport_pair(1)
+
+    def worker():
+        w = T.Worker(path, 0)
+        msg = w.recv()
+        w.send(msg.payload[::-1], seq=msg.seq)
+        w.recv()  # control
+        w.close()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        coord.accept(timeout=10)
+        blob = np.random.default_rng(0).bytes(8 * 1024 * 1024)
+        coord.isend(0, blob, seq=1)
+        rank, msg = coord.waitany([0], timeout=30)
+        assert rank == 0 and msg.payload == blob[::-1]
+        coord.isend(0, b"", kind=T.KIND_CONTROL)
+        t.join(timeout=5)
+    finally:
+        coord.close()
+
+
+def test_transport_dead_peer_is_sticky():
+    """A disconnected worker polls ready with a death marker forever —
+    the anti-hang property the reference's Waitall! lacks (SURVEY §5)."""
+    coord, path = _transport_pair(1)
+    try:
+        w = T.Worker(path, 0)
+        coord.accept(timeout=10)
+        w.close()  # peer vanishes
+        rank, msg = coord.waitany([0], timeout=10)
+        assert rank == 0 and msg.kind == T.KIND_DEATH
+        assert coord.is_dead(0)
+        # sticky: polls keep reporting death, sends fail fast
+        assert coord.poll(0).kind == T.KIND_DEATH
+        assert not coord.isend(0, b"data")
+    finally:
+        coord.close()
+
+
+# ------------------------------------------------------------------- pool
+
+
+def test_full_gather_and_epoch_echo():
+    n = 3
+    backend = NativeProcessBackend(_echo, n)
+    try:
+        pool = AsyncPool(n)
+        sendbuf = np.array([3.14])
+        recvbuf = np.zeros(3 * n)
+        for epoch in range(1, 4):
+            sendbuf[0] = epoch
+            repochs = asyncmap(pool, sendbuf, backend, recvbuf, nwait=n)
+            chunks = recvbuf.reshape(n, 3)
+            assert list(repochs) == [epoch] * n
+            for i in range(n):
+                assert chunks[i][0] == i + 1  # chunk j <- worker j
+                assert chunks[i][1] == float(epoch)
+                assert chunks[i][2] == epoch  # epoch echo
+    finally:
+        backend.shutdown()
+    assert not any(p.is_alive() for p in backend._procs)
+
+
+def test_fastest_k_skips_straggler():
+    n = 3
+    backend = NativeProcessBackend(_echo, n, delay_fn=StragglerDelay(2))
+    try:
+        pool = AsyncPool(n)
+        sendbuf = np.zeros(1)
+        for epoch in range(1, 5):
+            sendbuf[0] = epoch
+            repochs = asyncmap(pool, sendbuf, backend, nwait=2)
+            assert int((repochs == epoch).sum()) >= 2
+            assert repochs[0] == epoch and repochs[1] == epoch
+        assert pool.active[2]  # straggler still tasked
+        waitall(pool, backend)
+        assert not pool.active.any()
+    finally:
+        backend.shutdown()
+
+
+def test_remote_exception_carries_traceback():
+    n = 3
+    backend = NativeProcessBackend(_fail_worker1_epoch2, n)
+    try:
+        pool = AsyncPool(n)
+        payload = np.array([1.0])
+        asyncmap(pool, payload, backend, nwait=n)  # epoch 1 fine
+        with pytest.raises(WorkerFailure) as excinfo:
+            asyncmap(pool, payload, backend, nwait=n)
+            waitall(pool, backend)
+        err = excinfo.value.error
+        assert isinstance(err, RemoteWorkerError)
+        assert err.exc_type == "ValueError"
+        assert "boom from native worker" in str(err)
+        assert "Traceback" in err.remote_traceback
+        waitall(pool, backend)  # pool stays recoverable
+    finally:
+        backend.shutdown()
+
+
+def test_dead_worker_fails_fast_not_hangs():
+    n = 3
+    backend = NativeProcessBackend(_exit_worker2, n)
+    try:
+        pool = AsyncPool(n)
+        with pytest.raises(WorkerFailure) as excinfo:
+            asyncmap(pool, np.array([1.0]), backend, nwait=n)
+            waitall(pool, backend)
+        assert isinstance(excinfo.value.error, WorkerProcessDied)
+        assert excinfo.value.error.worker == 2
+        # re-dispatch to the dead rank fails fast too (synthetic failure)
+        with pytest.raises(WorkerFailure):
+            asyncmap(pool, np.array([2.0]), backend, nwait=n)
+            waitall(pool, backend)
+    finally:
+        backend.shutdown()
