@@ -156,6 +156,8 @@ class NativeProcessBackend(Backend):
     def _next(self, i: int, *, block: bool, timeout: float | None = None):
         """Fetch the completion for worker ``i``'s current dispatch,
         skipping frames from superseded dispatches (stale seq)."""
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
         if self._synthetic[i] is not None:
             out = self._synthetic[i]
             self._synthetic[i] = None
@@ -184,6 +186,8 @@ class NativeProcessBackend(Backend):
         return self._next(i, block=False)
 
     def wait_any(self, indices: Sequence[int]) -> tuple[int, object]:
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
         idx = [int(j) for j in indices]
         if not idx:
             raise ValueError("wait_any over an empty index set would hang")

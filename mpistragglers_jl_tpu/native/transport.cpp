@@ -51,6 +51,7 @@
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <sys/un.h>
 #include <unistd.h>
 
@@ -270,8 +271,13 @@ void progress_main(Coordinator* c) {
     int nev = epoll_wait(c->epfd, events, 64, 200);
     if (nev < 0) {
       if (errno == EINTR) continue;
+      // fatal: the progress engine cannot continue. Mark every peer dead
+      // (polls surface death markers) and wake all waiters so nothing
+      // blocks forever on a condvar nobody will notify again.
       std::lock_guard<std::mutex> lk(c->mu);
       c->error = std::string("epoll_wait: ") + strerror(errno);
+      for (int r = 0; r < c->n; r++) mark_dead(c, r);
+      c->cv.notify_all();
       return;
     }
     // sends may have been enqueued since the last pass: arm EPOLLOUT for
@@ -359,20 +365,39 @@ void* msgt_coord_create(const char* path, int n_workers) {
 
 // Accept all n workers (each opens with a hello frame carrying its rank in
 // hdr.seq), then start the progress thread. Returns 0 on success, -1 on
-// timeout/handshake failure.
+// timeout/handshake failure. timeout_ms bounds the WHOLE handshake (one
+// shared deadline), including each hello read — a worker that connects
+// but never sends its hello cannot wedge the coordinator.
 int msgt_coord_accept(void* h, int64_t timeout_ms) {
   auto* c = static_cast<Coordinator*>(h);
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  auto remaining_ms = [&]() -> int64_t {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    deadline - std::chrono::steady_clock::now())
+                    .count();
+    return left;
+  };
   int accepted = 0;
   while (accepted < c->n) {
+    int64_t left = remaining_ms();
+    if (left <= 0) return -1;
     pollfd pfd{c->listen_fd, POLLIN, 0};
-    int pr = ::poll(&pfd, 1, static_cast<int>(timeout_ms));
+    int pr = ::poll(&pfd, 1, static_cast<int>(left));
     if (pr <= 0) return -1;
     int fd = ::accept(c->listen_fd, nullptr, nullptr);
     if (fd < 0) continue;
+    left = remaining_ms();
+    timeval tv{};
+    tv.tv_sec = left > 0 ? left / 1000 : 0;
+    tv.tv_usec = left > 0 ? (left % 1000) * 1000 : 1;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     Header hello{};
-    if (!read_full(fd, &hello, sizeof(hello)) || hello.kind != KIND_HELLO ||
-        hello.seq < 0 || hello.seq >= c->n ||
-        c->peers[hello.seq].fd >= 0) {
+    bool ok = read_full(fd, &hello, sizeof(hello));
+    timeval off{};  // back to no timeout; the fd goes nonblocking next
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
+    if (!ok || hello.kind != KIND_HELLO || hello.seq < 0 ||
+        hello.seq >= c->n || c->peers[hello.seq].fd >= 0) {
       ::close(fd);
       return -1;
     }
@@ -480,6 +505,18 @@ int msgt_coord_waitany(void* h, const int32_t* ranks, int nranks,
   int r = -1;
   c->cv.wait_until(lk, deadline, [&] { return (r = ready()) >= 0; });
   return r;
+}
+
+// Copy the first fatal progress-engine error (empty string if none) into
+// buf; returns its length.
+int msgt_coord_error(void* h, char* buf, int cap) {
+  auto* c = static_cast<Coordinator*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  int n = static_cast<int>(c->error.size());
+  if (n >= cap) n = cap - 1;
+  if (n > 0) std::memcpy(buf, c->error.data(), static_cast<size_t>(n));
+  if (cap > 0) buf[n] = '\0';
+  return n;
 }
 
 // 1 if the rank has been marked dead (EOF/HUP/write error), else 0.

@@ -19,10 +19,7 @@ the pure-Python :class:`~..backends.process.ProcessBackend` instead.
 from __future__ import annotations
 
 import ctypes
-import threading
 from dataclasses import dataclass
-
-from . import build
 
 KIND_DATA = 0
 KIND_CONTROL = 1
@@ -52,64 +49,63 @@ class Message:
     payload: bytes
 
 
-_lib = None
-_lib_lock = threading.Lock()
+def _configure(lib):
+    lib.msgt_coord_create.restype = ctypes.c_void_p
+    lib.msgt_coord_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.msgt_coord_accept.restype = ctypes.c_int
+    lib.msgt_coord_accept.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.msgt_coord_isend.restype = ctypes.c_int
+    lib.msgt_coord_isend.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.msgt_coord_poll.restype = ctypes.c_int
+    lib.msgt_coord_poll.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(_Header)
+    ]
+    lib.msgt_coord_take.restype = ctypes.c_int64
+    lib.msgt_coord_take.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ]
+    lib.msgt_coord_waitany.restype = ctypes.c_int
+    lib.msgt_coord_waitany.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_int64,
+    ]
+    lib.msgt_coord_is_dead.restype = ctypes.c_int
+    lib.msgt_coord_is_dead.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.msgt_coord_error.restype = ctypes.c_int
+    lib.msgt_coord_error.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
+    ]
+    lib.msgt_coord_destroy.restype = None
+    lib.msgt_coord_destroy.argtypes = [ctypes.c_void_p]
+    lib.msgt_worker_connect.restype = ctypes.c_void_p
+    lib.msgt_worker_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.msgt_worker_recv_hdr.restype = ctypes.c_int
+    lib.msgt_worker_recv_hdr.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_Header)
+    ]
+    lib.msgt_worker_recv_payload.restype = ctypes.c_int
+    lib.msgt_worker_recv_payload.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64
+    ]
+    lib.msgt_worker_send.restype = ctypes.c_int
+    lib.msgt_worker_send.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.msgt_worker_close.restype = None
+    lib.msgt_worker_close.argtypes = [ctypes.c_void_p]
 
 
 def load_lib():
-    """Compile (if stale) and load the transport library, caching the
-    handle process-wide."""
-    global _lib
-    with _lib_lock:
-        if _lib is not None:
-            return _lib
-        lib = ctypes.CDLL(build("transport"))
-        lib.msgt_coord_create.restype = ctypes.c_void_p
-        lib.msgt_coord_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
-        lib.msgt_coord_accept.restype = ctypes.c_int
-        lib.msgt_coord_accept.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-        lib.msgt_coord_isend.restype = ctypes.c_int
-        lib.msgt_coord_isend.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
-        ]
-        lib.msgt_coord_poll.restype = ctypes.c_int
-        lib.msgt_coord_poll.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(_Header)
-        ]
-        lib.msgt_coord_take.restype = ctypes.c_int64
-        lib.msgt_coord_take.argtypes = [
-            ctypes.c_void_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
-        ]
-        lib.msgt_coord_waitany.restype = ctypes.c_int
-        lib.msgt_coord_waitany.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
-            ctypes.c_int64,
-        ]
-        lib.msgt_coord_is_dead.restype = ctypes.c_int
-        lib.msgt_coord_is_dead.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.msgt_coord_destroy.restype = None
-        lib.msgt_coord_destroy.argtypes = [ctypes.c_void_p]
-        lib.msgt_worker_connect.restype = ctypes.c_void_p
-        lib.msgt_worker_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
-        lib.msgt_worker_recv_hdr.restype = ctypes.c_int
-        lib.msgt_worker_recv_hdr.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(_Header)
-        ]
-        lib.msgt_worker_recv_payload.restype = ctypes.c_int
-        lib.msgt_worker_recv_payload.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64
-        ]
-        lib.msgt_worker_send.restype = ctypes.c_int
-        lib.msgt_worker_send.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
-        ]
-        lib.msgt_worker_close.restype = None
-        lib.msgt_worker_close.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    """Compile (if stale) and load the transport library; success and
+    failure both memoized process-wide by :func:`..native.load`."""
+    from . import load
+
+    return load("transport", _configure)
 
 
 class TransportError(RuntimeError):
@@ -130,10 +126,17 @@ class Coordinator:
         if not self._h:
             raise TransportError(f"could not bind coordinator socket {path}")
 
+    def _handle(self):
+        # a NULL handle into the C ABI would segfault, not raise
+        if not self._h:
+            raise TransportError("coordinator is closed")
+        return self._h
+
     def accept(self, timeout: float = 30.0) -> None:
         """Wait for all workers to connect and complete the hello
-        handshake, then start the progress engine."""
-        rc = self._lib.msgt_coord_accept(self._h, int(timeout * 1000))
+        handshake, then start the progress engine. ``timeout`` bounds
+        the whole handshake, stalled hellos included."""
+        rc = self._lib.msgt_coord_accept(self._handle(), int(timeout * 1000))
         if rc != 0:
             raise TransportError(
                 f"workers failed to connect within {timeout}s"
@@ -146,7 +149,8 @@ class Coordinator:
         """Non-blocking send; payload is snapshotted into the native send
         queue. Returns False if the rank is dead."""
         rc = self._lib.msgt_coord_isend(
-            self._h, int(rank), seq, epoch, tag, kind, payload, len(payload)
+            self._handle(), int(rank), seq, epoch, tag, kind, payload,
+            len(payload),
         )
         return rc == 0
 
@@ -155,19 +159,21 @@ class Coordinator:
         completed message for ``rank`` (a ``KIND_DEATH`` message if the
         rank died), or None."""
         hdr = _Header()
-        if not self._lib.msgt_coord_poll(self._h, int(rank), ctypes.byref(hdr)):
+        if not self._lib.msgt_coord_poll(
+            self._handle(), int(rank), ctypes.byref(hdr)
+        ):
             return None
         return self._take(rank, hdr)
 
     def _take(self, rank: int, hdr: _Header) -> Message:
         n = int(hdr.len)
         buf = (ctypes.c_uint8 * max(n, 1))()
-        got = self._lib.msgt_coord_take(self._h, int(rank), buf, n)
+        got = self._lib.msgt_coord_take(self._handle(), int(rank), buf, n)
         if got < 0:
             raise TransportError(f"take({rank}) raced: nothing available")
         return Message(
             seq=int(hdr.seq), epoch=int(hdr.epoch), tag=int(hdr.tag),
-            kind=int(hdr.kind), payload=bytes(bytearray(buf[:got])),
+            kind=int(hdr.kind), payload=ctypes.string_at(buf, got),
         )
 
     def waitany(
@@ -178,7 +184,7 @@ class Coordinator:
         (``MPI.Waitany!``)."""
         arr = (ctypes.c_int32 * len(ranks))(*[int(r) for r in ranks])
         t = -1 if timeout is None else max(int(timeout * 1000), 0)
-        rank = self._lib.msgt_coord_waitany(self._h, arr, len(ranks), t)
+        rank = self._lib.msgt_coord_waitany(self._handle(), arr, len(ranks), t)
         if rank < 0:
             return None
         msg = self.poll(rank)
@@ -187,7 +193,14 @@ class Coordinator:
         return rank, msg
 
     def is_dead(self, rank: int) -> bool:
-        return bool(self._lib.msgt_coord_is_dead(self._h, int(rank)))
+        return bool(self._lib.msgt_coord_is_dead(self._handle(), int(rank)))
+
+    def error(self) -> str:
+        """First fatal progress-engine error, or ''. When non-empty,
+        every rank has been marked dead with this as the cause."""
+        buf = ctypes.create_string_buffer(1024)
+        n = self._lib.msgt_coord_error(self._handle(), buf, len(buf))
+        return buf.raw[:n].decode(errors='replace')
 
     def close(self) -> None:
         if self._h:
@@ -224,7 +237,7 @@ class Worker:
             return None
         return Message(
             seq=int(hdr.seq), epoch=int(hdr.epoch), tag=int(hdr.tag),
-            kind=int(hdr.kind), payload=bytes(bytearray(buf[:n])),
+            kind=int(hdr.kind), payload=ctypes.string_at(buf, n),
         )
 
     def send(

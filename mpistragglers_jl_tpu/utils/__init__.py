@@ -9,9 +9,13 @@ from . import faults
 from .trace import EpochTracer, EpochRecord, Event
 from .checkpoint import state_dict, load_state_dict, save, restore
 from .rs_gf256 import RSGF256
+from .straggle import AdaptiveNwait, PoolLatencyModel, WorkerStats
 
 __all__ = [
     "faults",
+    "AdaptiveNwait",
+    "PoolLatencyModel",
+    "WorkerStats",
     "EpochTracer",
     "EpochRecord",
     "Event",

@@ -1,0 +1,270 @@
+"""Straggler latency modeling and straggle-aware scheduling.
+
+The reference measures per-worker round-trip latency (``pool.latency``,
+reference src/MPIAsyncPools.jl:104-105,:163-164) and then leaves every
+scheduling decision to the caller: ``nwait`` is a constant the user picks
+by hand in every test and example (test/kmap2.jl:32, :57,
+examples/iterative_example.jl:40). This module closes that loop — it
+turns the latency samples the pool already produces into decisions:
+
+* :class:`PoolLatencyModel` — online per-worker shifted-exponential fits
+  (the standard model for straggling compute nodes: a deterministic
+  service floor plus an exponential tail) from ``pool.latency``.
+* :meth:`PoolLatencyModel.expected_epoch_time` — E[time until the k
+  fastest of the n heterogeneous workers respond] (k-th order statistic),
+  by Monte-Carlo over the fitted per-worker distributions.
+* :meth:`PoolLatencyModel.optimal_nwait` — the ``nwait`` minimizing
+  expected time per fresh result (or any caller utility), the knob that
+  trades straggler-avoidance against discarded work in coded workloads.
+* :meth:`PoolLatencyModel.proportional_shares` — load-balanced work
+  splits proportional to fitted worker speed, for uncoded workloads
+  where shard sizes are free parameters.
+* :class:`AdaptiveNwait` — drop-in controller: observe after each
+  ``asyncmap``, pass ``controller.nwait`` to the next one.
+
+Everything is coordinator-side numpy over data the pool already tracks;
+no backend cooperation needed, deterministic given a seeded generator.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+__all__ = ["WorkerStats", "PoolLatencyModel", "AdaptiveNwait"]
+
+
+class WorkerStats:
+    """Online latency statistics for one worker (Welford + running min).
+
+    The fitted model is a shifted exponential ``shift + Exp(rate)``:
+    ``shift`` is the service floor (estimated by the sample minimum,
+    which converges at rate 1/m, much faster than the mean), and the
+    exponential tail rate comes from the residual mean
+    ``1 / (mean - shift)``.
+    """
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.mean = 0.0
+        self._m2 = 0.0
+        self.min = np.inf
+
+    def observe(self, latency: float) -> None:
+        x = float(latency)
+        if not np.isfinite(x) or x < 0:
+            return
+        self.count += 1
+        delta = x - self.mean
+        self.mean += delta / self.count
+        self._m2 += delta * (x - self.mean)
+        if x < self.min:
+            self.min = x
+
+    @property
+    def var(self) -> float:
+        return self._m2 / (self.count - 1) if self.count > 1 else 0.0
+
+    @property
+    def shift(self) -> float:
+        return 0.0 if self.count == 0 else float(self.min)
+
+    @property
+    def rate(self) -> float:
+        """Exponential tail rate; inf for a worker with no observed tail
+        (all samples at the floor)."""
+        if self.count == 0:
+            return np.inf
+        tail = self.mean - self.shift
+        return np.inf if tail <= 0 else 1.0 / tail
+
+    def sample(self, rng: np.random.Generator, size: int) -> np.ndarray:
+        if self.count == 0:
+            return np.zeros(size)
+        rate = self.rate
+        if not np.isfinite(rate):
+            return np.full(size, self.shift)
+        return self.shift + rng.exponential(1.0 / rate, size)
+
+    def to_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "mean_s": self.mean,
+            "std_s": float(np.sqrt(self.var)),
+            "shift_s": self.shift if self.count else None,
+            "rate_hz": None if not np.isfinite(self.rate) else self.rate,
+        }
+
+
+class PoolLatencyModel:
+    """Per-worker latency models for an n-worker pool.
+
+    Feed it after every ``asyncmap``/``waitall`` with
+    :meth:`observe_pool` (it reads ``pool.latency`` for workers that
+    delivered since the last call) or directly with :meth:`observe`.
+
+    >>> model = PoolLatencyModel(pool.n_workers)
+    >>> repochs = asyncmap(pool, payload, backend, nwait=model_k)
+    >>> model.observe_pool(pool)
+    >>> model.optimal_nwait()          # nwait minimizing time/result
+    >>> model.expected_epoch_time(6)   # predicted wall for nwait=6
+    """
+
+    def __init__(self, n_workers: int, *, seed: int = 0):
+        self.n_workers = int(n_workers)
+        self.workers = [WorkerStats() for _ in range(self.n_workers)]
+        self._rng = np.random.default_rng(seed)
+        # repochs snapshot from the previous observe_pool: only workers
+        # whose repochs advanced have a *new* latency sample
+        self._last_repochs = None
+
+    # -- data intake -------------------------------------------------------
+    def observe(self, worker: int, latency: float) -> None:
+        self.workers[worker].observe(latency)
+
+    def observe_pool(self, pool) -> int:
+        """Record latency samples for workers whose ``repochs`` advanced
+        since the previous call; returns how many samples were taken."""
+        rep = np.asarray(pool.repochs)
+        if self._last_repochs is None:
+            newly = [i for i in range(self.n_workers) if pool.results[i] is not None]
+        else:
+            newly = [
+                i for i in range(self.n_workers)
+                if rep[i] != self._last_repochs[i]
+            ]
+        for i in newly:
+            self.workers[i].observe(pool.latency[i])
+        self._last_repochs = rep.copy()
+        return len(newly)
+
+    # -- prediction --------------------------------------------------------
+    def sample_latencies(self, n_draws: int) -> np.ndarray:
+        """(n_draws, n_workers) matrix of sampled per-worker latencies."""
+        return np.stack(
+            [w.sample(self._rng, n_draws) for w in self.workers], axis=1
+        )
+
+    def expected_epoch_time(
+        self, nwait: int, *, n_draws: int = 4000
+    ) -> float:
+        """E[wall-clock until the ``nwait`` fastest workers respond] —
+        the mean ``nwait``-th order statistic over the heterogeneous
+        fitted distributions (Monte Carlo; closed forms only exist for
+        the iid case)."""
+        if not (0 <= nwait <= self.n_workers):
+            raise ValueError(f"nwait must be in [0, {self.n_workers}]")
+        if nwait == 0:
+            return 0.0
+        draws = self.sample_latencies(n_draws)
+        kth = np.partition(draws, nwait - 1, axis=1)[:, nwait - 1]
+        return float(kth.mean())
+
+    def optimal_nwait(
+        self,
+        *,
+        utility: Callable[[int], float] | None = None,
+        kmin: int = 1,
+        kmax: int | None = None,
+        n_draws: int = 4000,
+    ) -> int:
+        """The ``nwait`` maximizing ``utility(k) / E[T_(k)]`` (utility per
+        second). Default utility is ``k`` — fresh results per epoch — so
+        the default objective is minimum expected time per fresh result,
+        the natural knob for (n, k)-coded workloads where waiting for
+        more shards amortizes the service floor but exposes the epoch to
+        deeper order statistics.
+        """
+        kmax = self.n_workers if kmax is None else int(kmax)
+        if not (1 <= kmin <= kmax <= self.n_workers):
+            raise ValueError(
+                f"need 1 <= kmin <= kmax <= {self.n_workers}, "
+                f"got [{kmin}, {kmax}]"
+            )
+        u = (lambda k: float(k)) if utility is None else utility
+        draws = self.sample_latencies(n_draws)
+        draws.sort(axis=1)
+        best_k, best_score = kmin, -np.inf
+        for k in range(kmin, kmax + 1):
+            t = float(draws[:, k - 1].mean())
+            score = u(k) / t if t > 0 else np.inf
+            if score > best_score:
+                best_k, best_score = k, score
+        return best_k
+
+    def proportional_shares(self, total: int) -> np.ndarray:
+        """Split ``total`` work units across workers proportional to
+        fitted speed (1/mean latency), by largest remainder — the
+        load-balancing split for uncoded workloads. Workers without
+        samples get the mean share."""
+        means = np.array(
+            [w.mean if w.count else np.nan for w in self.workers]
+        )
+        if np.isnan(means).all():
+            means = np.ones(self.n_workers)
+        else:
+            means = np.where(np.isnan(means), np.nanmean(means), means)
+        speed = 1.0 / np.maximum(means, 1e-12)
+        ideal = total * speed / speed.sum()
+        shares = np.floor(ideal).astype(np.int64)
+        rem = int(total - shares.sum())
+        if rem > 0:
+            order = np.argsort(-(ideal - shares))
+            shares[order[:rem]] += 1
+        return shares
+
+    def summary(self) -> list[dict]:
+        return [w.to_dict() for w in self.workers]
+
+
+class AdaptiveNwait:
+    """Epoch-to-epoch ``nwait`` controller.
+
+    Starts at ``nwait0`` (default n), refits every ``refit_every``
+    observed epochs once ``min_samples`` per-worker samples exist, and
+    exposes the current choice as ``.nwait``:
+
+    >>> ctl = AdaptiveNwait(pool.n_workers, kmin=code.k)
+    >>> for step in range(epochs):
+    ...     asyncmap(pool, payload, backend, nwait=ctl.nwait)
+    ...     ctl.observe(pool)
+
+    ``kmin`` is the correctness floor — for an (n, k) code, fewer than k
+    fresh shards cannot decode, so the controller never goes below it.
+    """
+
+    def __init__(
+        self,
+        n_workers: int,
+        *,
+        kmin: int = 1,
+        kmax: int | None = None,
+        nwait0: int | None = None,
+        utility: Callable[[int], float] | None = None,
+        min_samples: int = 3,
+        refit_every: int = 5,
+        seed: int = 0,
+    ):
+        self.model = PoolLatencyModel(n_workers, seed=seed)
+        self.kmin = int(kmin)
+        self.kmax = n_workers if kmax is None else int(kmax)
+        self.utility = utility
+        self.min_samples = int(min_samples)
+        self.refit_every = int(refit_every)
+        self.nwait = self.kmax if nwait0 is None else int(nwait0)
+        self._observed = 0
+
+    def observe(self, pool) -> int:
+        """Feed the model; periodically re-pick ``nwait``. Returns the
+        current choice."""
+        self.model.observe_pool(pool)
+        self._observed += 1
+        ready = (
+            min(w.count for w in self.model.workers) >= self.min_samples
+        )
+        if ready and self._observed % self.refit_every == 0:
+            self.nwait = self.model.optimal_nwait(
+                utility=self.utility, kmin=self.kmin, kmax=self.kmax
+            )
+        return self.nwait

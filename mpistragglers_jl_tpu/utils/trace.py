@@ -150,6 +150,56 @@ class EpochTracer:
             for r in self.records:
                 f.write(json.dumps(r.to_dict()) + "\n")
 
+    def dump_chrome_trace(self, path) -> int:
+        """Export the timeline in Chrome trace-event format (open in
+        ui.perfetto.dev or chrome://tracing). One track per worker with a
+        span per task (dispatch -> arrival, stale spans flagged), plus a
+        coordinator track with one span per ``asyncmap``/``waitall``
+        call. Returns the number of events written.
+
+        Spans may cross record boundaries: a payload dispatched in epoch
+        N and drained in epoch N+1 (the reference's late-arrival harvest,
+        src/MPIAsyncPools.jl:91-114) is drawn over its true lifetime.
+        """
+        us = 1e6
+        events: list[dict[str, Any]] = []
+        open_dispatch: dict[int, tuple[float, int]] = {}  # worker -> (t_abs, epoch)
+        for r in self.records:
+            events.append({
+                "name": f"{r.call}(epoch={r.epoch}, nwait={r.nwait})",
+                "ph": "X", "pid": 0, "tid": -1,
+                "ts": r.t_begin * us, "dur": r.wall * us,
+                "args": {"n_fresh": r.n_fresh, "n_stale": r.n_stale,
+                         "n_retask": r.n_retask},
+            })
+            for e in r.events:
+                t_abs = r.t_begin + e.t
+                if e.kind in ("dispatch", "retask"):
+                    open_dispatch[e.worker] = (t_abs, e.epoch)
+                else:  # arrival / drain
+                    start = open_dispatch.pop(e.worker, None)
+                    if start is None:
+                        continue
+                    t0, sepoch = start
+                    events.append({
+                        "name": f"epoch {sepoch}"
+                        + ("" if e.fresh else " (stale)"),
+                        "ph": "X", "pid": 0, "tid": e.worker,
+                        "ts": t0 * us, "dur": (t_abs - t0) * us,
+                        "args": {"fresh": bool(e.fresh), "kind": e.kind},
+                    })
+        meta = [
+            {"name": "thread_name", "ph": "M", "pid": 0, "tid": -1,
+             "args": {"name": "coordinator"}},
+        ] + [
+            {"name": "thread_name", "ph": "M", "pid": 0, "tid": w,
+             "args": {"name": f"worker {w}"}}
+            for w in sorted({e["tid"] for e in events if e["tid"] >= 0})
+        ]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": meta + events}, f)
+        return len(events)
+
     def summary(self) -> dict[str, Any]:
         """Aggregate statistics over recorded asyncmap epochs.
 

@@ -228,6 +228,20 @@ def test_remote_exception_carries_traceback():
         backend.shutdown()
 
 
+def test_use_after_shutdown_raises_not_segfaults():
+    backend = NativeProcessBackend(_echo, 2)
+    pool = AsyncPool(2)
+    asyncmap(pool, np.array([1.0]), backend, nwait=2)
+    backend.shutdown()
+    with pytest.raises(RuntimeError):
+        backend.dispatch(0, np.array([2.0]), 2)
+    with pytest.raises(RuntimeError):
+        backend.test(0)
+    with pytest.raises(RuntimeError):
+        backend.wait_any([0, 1])
+    backend.shutdown()  # idempotent
+
+
 def test_dead_worker_fails_fast_not_hangs():
     n = 3
     backend = NativeProcessBackend(_exit_worker2, n)

@@ -1,0 +1,145 @@
+"""Straggler latency modeling + adaptive nwait (utils/straggle.py).
+
+The reference leaves nwait choice entirely to the caller (constants in
+every test/example, e.g. test/kmap2.jl:32); these tests pin down the
+decision layer built on the latency samples the pool already tracks.
+"""
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import AsyncPool, LocalBackend, asyncmap, waitall
+from mpistragglers_jl_tpu.utils.straggle import (
+    AdaptiveNwait,
+    PoolLatencyModel,
+    WorkerStats,
+)
+
+
+def test_worker_stats_fit_recovers_shifted_exponential():
+    rng = np.random.default_rng(0)
+    shift, rate = 0.05, 20.0  # mean = 0.05 + 0.05 = 0.1
+    w = WorkerStats()
+    for x in shift + rng.exponential(1.0 / rate, 4000):
+        w.observe(x)
+    assert w.count == 4000
+    assert w.shift == pytest.approx(shift, abs=2e-3)  # min converges fast
+    assert w.rate == pytest.approx(rate, rel=0.1)
+    assert w.mean == pytest.approx(shift + 1.0 / rate, rel=0.05)
+
+
+def test_worker_stats_constant_latency_degenerates_cleanly():
+    w = WorkerStats()
+    for _ in range(10):
+        w.observe(0.25)
+    assert w.shift == 0.25
+    assert not np.isfinite(w.rate)  # no tail
+    s = w.sample(np.random.default_rng(0), 100)
+    assert np.all(s == 0.25)
+    # negative / non-finite samples are ignored, not absorbed
+    w.observe(-1.0)
+    w.observe(float("nan"))
+    assert w.count == 10
+
+
+def test_expected_epoch_time_matches_iid_order_statistic():
+    # iid Exp(rate): E[T_(k)] = (1/rate) * (H_n - H_{n-k}), shift adds
+    n, rate, shift = 8, 10.0, 0.02
+    rng = np.random.default_rng(1)
+    model = PoolLatencyModel(n, seed=1)
+    for i in range(n):
+        for x in shift + rng.exponential(1.0 / rate, 3000):
+            model.observe(i, x)
+    H = np.concatenate([[0.0], np.cumsum(1.0 / np.arange(1, n + 1))])
+    for k in (1, 4, 8):
+        analytic = shift + (H[n] - H[n - k]) / rate
+        assert model.expected_epoch_time(k, n_draws=20000) == pytest.approx(
+            analytic, rel=0.08
+        )
+    assert model.expected_epoch_time(0) == 0.0
+    with pytest.raises(ValueError):
+        model.expected_epoch_time(n + 1)
+
+
+def test_optimal_nwait_amortizes_floor_and_dodges_straggler():
+    n = 8
+    # big service floor, thin tail -> waiting for everyone amortizes the
+    # floor: optimal k = n
+    floor = PoolLatencyModel(n, seed=2)
+    rng = np.random.default_rng(2)
+    for i in range(n):
+        for x in 1.0 + rng.exponential(0.01, 200):
+            floor.observe(i, x)
+    assert floor.optimal_nwait() == n
+    # one catastrophic straggler -> last order statistic is poison:
+    # optimal k < n
+    strag = PoolLatencyModel(n, seed=3)
+    for i in range(n):
+        mean = 10.0 if i == n - 1 else 0.05
+        for x in rng.exponential(mean, 200):
+            strag.observe(i, x)
+    assert strag.optimal_nwait() < n
+    # bounds respected
+    assert strag.optimal_nwait(kmin=6, kmax=7) in (6, 7)
+    with pytest.raises(ValueError):
+        strag.optimal_nwait(kmin=0)
+
+
+def test_proportional_shares_follow_speed_and_sum():
+    n = 4
+    model = PoolLatencyModel(n)
+    for i, mean in enumerate([0.1, 0.1, 0.2, 0.4]):  # speeds 10,10,5,2.5
+        for _ in range(5):
+            model.observe(i, mean)
+    shares = model.proportional_shares(110)
+    assert shares.sum() == 110
+    assert shares[0] == shares[1] > shares[2] > shares[3]
+    # no data at all: equal split
+    empty = PoolLatencyModel(3)
+    assert empty.proportional_shares(9).tolist() == [3, 3, 3]
+
+
+class _Delays:
+    """Deterministic: worker 3 is a 10x straggler."""
+
+    def __call__(self, i, epoch):
+        return 0.1 if i == 3 else 0.01
+
+
+def test_adaptive_nwait_on_live_pool():
+    n = 4
+    backend = LocalBackend(
+        lambda i, payload, epoch: payload + i, n, delay_fn=_Delays()
+    )
+    try:
+        pool = AsyncPool(n)
+        ctl = AdaptiveNwait(n, kmin=2, min_samples=2, refit_every=2, seed=0)
+        assert ctl.nwait == n  # starts conservative (full gather)
+        for _ in range(8):
+            asyncmap(pool, np.zeros(2), backend, nwait=ctl.nwait)
+            waitall(pool, backend)  # drain so every worker yields samples
+            ctl.observe(pool)
+        # the model learned worker 3 straggles: it is ranked slowest and
+        # the controller settled strictly below full gather
+        means = [w.mean for w in ctl.model.workers]
+        assert np.argmax(means) == 3
+        assert 2 <= ctl.nwait <= 3
+    finally:
+        backend.shutdown()
+
+
+def test_observe_pool_only_counts_advanced_workers():
+    n = 3
+    backend = LocalBackend(lambda i, p, e: p, n)
+    try:
+        pool = AsyncPool(n)
+        model = PoolLatencyModel(n)
+        asyncmap(pool, np.zeros(1), backend, nwait=n)
+        assert model.observe_pool(pool) == n
+        # no new epoch -> no new samples
+        assert model.observe_pool(pool) == 0
+        asyncmap(pool, np.zeros(1), backend, nwait=n)
+        assert model.observe_pool(pool) == n
+        assert all(w.count == 2 for w in model.workers)
+    finally:
+        backend.shutdown()

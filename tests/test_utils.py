@@ -150,6 +150,40 @@ class TestTracer:
         assert len(lines) == 5
         assert all(len(rec["events"]) == 6 for rec in lines)
 
+    def test_chrome_trace_export(self, tmp_path):
+        backend = LocalBackend(
+            echo_work, 3,
+            delay_fn=faults.per_worker([0.08, 0.005, 0.005]),
+        )
+        tracer = EpochTracer()
+        try:
+            pool = AsyncPool(3)
+            for _ in range(3):
+                asyncmap(pool, np.zeros(1), backend, nwait=2, tracer=tracer)
+            waitall(pool, backend, tracer=tracer)
+        finally:
+            backend.shutdown()
+        path = tmp_path / "trace.json"
+        n = tracer.dump_chrome_trace(path)
+        doc = json.loads(path.read_text())
+        evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert n == len(evs)
+        coord = [e for e in evs if e["tid"] == -1]
+        spans = [e for e in evs if e["tid"] >= 0]
+        assert len(coord) == 4  # 3 asyncmap + 1 waitall
+        # every worker task dispatched was eventually harvested: spans
+        # cover all dispatches, including the straggler's cross-epoch one
+        dispatches = sum(
+            1 for r in tracer.records for e in r.events
+            if e.kind in ("dispatch", "retask")
+        )
+        assert len(spans) == dispatches
+        assert all(e["dur"] >= 0 for e in evs)
+        stale = [e for e in spans if "(stale)" in e["name"]]
+        assert stale, "straggler must produce at least one stale span"
+        names = {e["args"]["name"] for e in doc["traceEvents"] if e["ph"] == "M"}
+        assert "coordinator" in names and "worker 0" in names
+
     def test_untraced_calls_unaffected(self):
         backend = LocalBackend(echo_work, 2)
         try:
