@@ -106,24 +106,29 @@ class NativeProcessBackend(Backend):
         # next test/wait instead of raising inside the pool's send phase
         self._synthetic: list[WorkerError | None] = [None] * self.n_workers
         sock = Path(tempfile.gettempdir()) / f"msgt-{uuid.uuid4().hex[:12]}.sock"
-        self._coord = T.Coordinator(str(sock), self.n_workers)
-        ctx = mp.get_context(mp_context)
-        self._procs = [
-            ctx.Process(
-                target=_native_worker_main,
-                args=(i, str(sock), work_fn, delay_fn),
-                daemon=True,
-                name=f"pool-native-worker-{i}",
-            )
-            for i in range(self.n_workers)
-        ]
-        for p in self._procs:
-            p.start()
+        self._sock_path = str(sock)
+        self._mp_context = mp_context
+        self._coord = T.Coordinator(self._sock_path, self.n_workers)
+        self._procs: list = [None] * self.n_workers
+        for i in range(self.n_workers):
+            self._spawn_worker(i)
         try:
             self._coord.accept(timeout=connect_timeout)
         except T.TransportError:
             self.shutdown()
             raise
+
+    def _spawn_worker(self, i: int) -> None:
+        """Start (or restart) the worker process for rank i."""
+        ctx = mp.get_context(self._mp_context)
+        proc = ctx.Process(
+            target=_native_worker_main,
+            args=(i, self._sock_path, self.work_fn, self.delay_fn),
+            daemon=True,
+            name=f"pool-native-worker-{i}",
+        )
+        proc.start()
+        self._procs[i] = proc
 
     # -- Backend interface -------------------------------------------------
     def dispatch(self, i: int, sendbuf, epoch: int, *, tag: int = 0) -> None:
@@ -206,6 +211,27 @@ class NativeProcessBackend(Backend):
 
     def wait(self, i: int, timeout: float | None = None):
         return self._next(i, block=True, timeout=timeout)
+
+    def respawn(self, i: int, *, connect_timeout: float = 60.0) -> None:
+        """Elastic recovery: replace a dead worker process with a fresh
+        one on the same rank (the reference has no such capability — a
+        dead rank is permanent and hangs ``Waitall!``, SURVEY §5). The
+        new process reconnects through the transport's reaccept path;
+        pool state is untouched — the rank simply becomes dispatchable
+        again, and any frames from the old incarnation are dropped by
+        the seq guard."""
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
+        if not self._coord.is_dead(i) and self._procs[i].is_alive():
+            raise RuntimeError(f"worker {i} is alive; nothing to respawn")
+        if self._procs[i].is_alive():  # pragma: no cover - zombie socket
+            self._procs[i].terminate()
+        self._procs[i].join(timeout=self._join_timeout)
+        self._spawn_worker(i)
+        # reaccept tolerates a not-yet-drained HUP within its timeout
+        self._coord.reaccept(i, timeout=connect_timeout)
+        # _synthetic[i], if set, stays: it records a dispatch the old
+        # incarnation never received — the pool must still see it fail
 
     def shutdown(self) -> None:
         if self._closed:

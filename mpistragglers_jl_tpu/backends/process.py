@@ -130,30 +130,54 @@ class ProcessBackend(SlotBackend):
         self._closed = False
         self._dead = [False] * self.n_workers
         self._send_lock = threading.Lock()
+        self._mp_context = mp_context
         ctx = mp.get_context(mp_context)
-        self._conns = []
-        self._procs = []
+        self._conns = [None] * self.n_workers
+        self._procs = [None] * self.n_workers
+        self._readers = [None] * self.n_workers
         for i in range(self.n_workers):
-            parent, child = ctx.Pipe(duplex=True)
-            proc = ctx.Process(
-                target=_worker_main,
-                args=(i, child, work_fn, delay_fn),
-                daemon=True,
-                name=f"pool-proc-worker-{i}",
-            )
-            proc.start()
-            child.close()  # parent keeps only its end; EOF works
-            self._conns.append(parent)
-            self._procs.append(proc)
-        self._readers = [
-            threading.Thread(
-                target=self._reader_loop, args=(i,), daemon=True,
-                name=f"pool-proc-reader-{i}",
-            )
-            for i in range(self.n_workers)
-        ]
-        for t in self._readers:
-            t.start()
+            self._spawn_worker(i)
+
+    def _spawn_worker(self, i: int) -> None:
+        """Start (or restart) worker process i and its reader thread."""
+        ctx = mp.get_context(self._mp_context)
+        parent, child = ctx.Pipe(duplex=True)
+        proc = ctx.Process(
+            target=_worker_main,
+            args=(i, child, self.work_fn, self.delay_fn),
+            daemon=True,
+            name=f"pool-proc-worker-{i}",
+        )
+        proc.start()
+        child.close()  # parent keeps only its end; EOF works
+        self._conns[i] = parent
+        self._procs[i] = proc
+        self._dead[i] = False
+        reader = threading.Thread(
+            target=self._reader_loop, args=(i,), daemon=True,
+            name=f"pool-proc-reader-{i}",
+        )
+        self._readers[i] = reader
+        reader.start()
+
+    def respawn(self, i: int) -> None:
+        """Elastic recovery: replace a dead worker process with a fresh
+        one on the same rank (the reference has no such capability — a
+        dead rank is permanent and hangs ``Waitall!``, SURVEY §5). The
+        rank becomes dispatchable again; the old reader thread has
+        already exited on its pipe's EOF."""
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
+        if not self._dead[i] and self._procs[i].is_alive():
+            raise RuntimeError(f"worker {i} is alive; nothing to respawn")
+        if self._procs[i].is_alive():  # pragma: no cover - wedged worker
+            self._procs[i].terminate()
+        self._procs[i].join(timeout=self._join_timeout)
+        old_reader = self._readers[i]
+        self._conns[i].close()  # unblock the old reader if still parked
+        if old_reader is not None:
+            old_reader.join(timeout=self._join_timeout)
+        self._spawn_worker(i)
 
     # -- coordinator-side completion pump ---------------------------------
     def _reader_loop(self, i: int) -> None:
@@ -162,7 +186,7 @@ class ProcessBackend(SlotBackend):
             try:
                 msg = conn.recv()
             except (EOFError, OSError):
-                self._on_worker_death(i)
+                self._on_worker_death(i, conn)
                 return
             if msg is None:
                 return
@@ -174,9 +198,11 @@ class ProcessBackend(SlotBackend):
                 )
             self._complete(i, seq, payload)
 
-    def _on_worker_death(self, i: int) -> None:
+    def _on_worker_death(self, i: int, conn) -> None:
         """Fail the outstanding task (if any) so waits don't hang — the
         capability the reference lacks (dead rank hangs ``Waitall!``)."""
+        if self._conns[i] is not conn:
+            return  # stale EOF from a pre-respawn incarnation
         self._dead[i] = True
         with self._cond:
             slot = self._slots[i]

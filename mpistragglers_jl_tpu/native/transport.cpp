@@ -327,6 +327,52 @@ struct WorkerCtx {
   }
 };
 
+// Accept one connection and read its hello frame before `deadline`.
+// `expected_rank` = -1 accepts any rank not yet connected; otherwise the
+// hello must carry exactly that rank (others are dropped and the wait
+// continues). On success returns the rank and stores the (still
+// blocking-mode) fd in *fd_out; on timeout/failure returns -1.
+int accept_hello(Coordinator* c,
+                 std::chrono::steady_clock::time_point deadline,
+                 int expected_rank, int* fd_out) {
+  auto remaining_ms = [&]() -> int64_t {
+    return std::chrono::duration_cast<std::chrono::milliseconds>(
+               deadline - std::chrono::steady_clock::now())
+        .count();
+  };
+  while (true) {
+    int64_t left = remaining_ms();
+    if (left <= 0) return -1;
+    pollfd pfd{c->listen_fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, static_cast<int>(left));
+    if (pr <= 0) return -1;
+    int fd = ::accept(c->listen_fd, nullptr, nullptr);
+    if (fd < 0) continue;
+    left = remaining_ms();
+    timeval tv{};
+    tv.tv_sec = left > 0 ? left / 1000 : 0;
+    tv.tv_usec = left > 0 ? (left % 1000) * 1000 : 1;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    Header hello{};
+    bool ok = read_full(fd, &hello, sizeof(hello));
+    timeval off{};  // back to no timeout before the caller takes over
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
+    bool valid = ok && hello.kind == KIND_HELLO && hello.seq >= 0 &&
+                 hello.seq < c->n;
+    if (valid && expected_rank >= 0 && hello.seq != expected_rank)
+      valid = false;  // someone else's (re)connect; not ours
+    if (valid && expected_rank < 0 && c->peers[hello.seq].fd >= 0)
+      valid = false;  // duplicate rank during initial handshake
+    if (!valid) {
+      ::close(fd);
+      if (expected_rank >= 0) continue;  // keep waiting for our rank
+      return -1;  // initial handshake is strict: bad hello is fatal
+    }
+    *fd_out = fd;
+    return static_cast<int>(hello.seq);
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -372,38 +418,12 @@ int msgt_coord_accept(void* h, int64_t timeout_ms) {
   auto* c = static_cast<Coordinator*>(h);
   auto deadline =
       std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
-  auto remaining_ms = [&]() -> int64_t {
-    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
-                    deadline - std::chrono::steady_clock::now())
-                    .count();
-    return left;
-  };
-  int accepted = 0;
-  while (accepted < c->n) {
-    int64_t left = remaining_ms();
-    if (left <= 0) return -1;
-    pollfd pfd{c->listen_fd, POLLIN, 0};
-    int pr = ::poll(&pfd, 1, static_cast<int>(left));
-    if (pr <= 0) return -1;
-    int fd = ::accept(c->listen_fd, nullptr, nullptr);
-    if (fd < 0) continue;
-    left = remaining_ms();
-    timeval tv{};
-    tv.tv_sec = left > 0 ? left / 1000 : 0;
-    tv.tv_usec = left > 0 ? (left % 1000) * 1000 : 1;
-    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-    Header hello{};
-    bool ok = read_full(fd, &hello, sizeof(hello));
-    timeval off{};  // back to no timeout; the fd goes nonblocking next
-    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
-    if (!ok || hello.kind != KIND_HELLO || hello.seq < 0 ||
-        hello.seq >= c->n || c->peers[hello.seq].fd >= 0) {
-      ::close(fd);
-      return -1;
-    }
+  for (int accepted = 0; accepted < c->n; accepted++) {
+    int fd = -1;
+    int rank = accept_hello(c, deadline, /*expected_rank=*/-1, &fd);
+    if (rank < 0) return -1;
     set_nonblocking(fd);
-    c->peers[hello.seq].fd = fd;
-    accepted++;
+    c->peers[rank].fd = fd;
   }
   c->epfd = epoll_create1(EPOLL_CLOEXEC);
   c->wake_fd = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
@@ -505,6 +525,51 @@ int msgt_coord_waitany(void* h, const int32_t* ranks, int nranks,
   int r = -1;
   c->cv.wait_until(lk, deadline, [&] { return (r = ready()) >= 0; });
   return r;
+}
+
+// Re-accept a connection for a dead rank (elastic recovery: a respawned
+// worker process reconnects and sends a fresh hello carrying the same
+// rank). Clears the dead flag and the peer's I/O state, re-registers the
+// socket with the progress engine. Frames completed by the previous
+// incarnation stay queued (the layer above drops stale seqs). Returns 0
+// on success, -1 on timeout / wrong-rank hello / rank not dead.
+int msgt_coord_reaccept(void* h, int rank, int64_t timeout_ms) {
+  auto* c = static_cast<Coordinator*>(h);
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  if (rank < 0 || rank >= c->n) return -1;
+  // Tolerate a rank whose HUP the progress engine hasn't processed yet
+  // (the worker process can be observed dead by the OS before the EOF is
+  // drained): wait for the dead mark within the same deadline.
+  while (true) {
+    {
+      std::lock_guard<std::mutex> lk(c->mu);
+      if (c->peers[rank].dead) break;
+    }
+    if (std::chrono::steady_clock::now() >= deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  int fd = -1;
+  if (accept_hello(c, deadline, rank, &fd) != rank) return -1;
+  set_nonblocking(fd);
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    Peer& p = c->peers[rank];
+    p.fd = fd;
+    p.dead = false;
+    p.rhdr = Header{};
+    p.rgot = 0;
+    p.rin_payload = false;
+    p.rbuf = {};
+    p.rpayload_got = 0;
+    p.sendq.clear();
+    p.sent = 0;
+  }
+  epoll_event ev{};
+  ev.events = EPOLLIN | EPOLLRDHUP;
+  ev.data.u32 = static_cast<uint32_t>(rank);
+  epoll_ctl(c->epfd, EPOLL_CTL_ADD, fd, &ev);
+  return 0;
 }
 
 // Copy the first fatal progress-engine error (empty string if none) into

@@ -75,6 +75,10 @@ def _configure(lib):
     ]
     lib.msgt_coord_is_dead.restype = ctypes.c_int
     lib.msgt_coord_is_dead.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.msgt_coord_reaccept.restype = ctypes.c_int
+    lib.msgt_coord_reaccept.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int64
+    ]
     lib.msgt_coord_error.restype = ctypes.c_int
     lib.msgt_coord_error.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
@@ -194,6 +198,19 @@ class Coordinator:
 
     def is_dead(self, rank: int) -> bool:
         return bool(self._lib.msgt_coord_is_dead(self._handle(), int(rank)))
+
+    def reaccept(self, rank: int, timeout: float = 30.0) -> None:
+        """Accept a reconnect for a dead rank (elastic recovery): a
+        respawned worker sends a fresh hello with the same rank and
+        the progress engine picks its socket back up."""
+        rc = self._lib.msgt_coord_reaccept(
+            self._handle(), int(rank), int(timeout * 1000)
+        )
+        if rc != 0:
+            raise TransportError(
+                f"rank {rank} did not reconnect within {timeout}s "
+                "(or was not dead)"
+            )
 
     def error(self) -> str:
         """First fatal progress-engine error, or ''. When non-empty,

@@ -141,10 +141,26 @@ class PoolLatencyModel:
 
     # -- prediction --------------------------------------------------------
     def sample_latencies(self, n_draws: int) -> np.ndarray:
-        """(n_draws, n_workers) matrix of sampled per-worker latencies."""
-        return np.stack(
-            [w.sample(self._rng, n_draws) for w in self.workers], axis=1
-        )
+        """(n_draws, n_workers) matrix of sampled per-worker latencies.
+
+        Workers never heard from sample from the pooled prior (mean
+        shift/rate of the observed workers) rather than zero — a silent
+        worker must not look infinitely fast to ``optimal_nwait``.
+        """
+        observed = [w for w in self.workers if w.count > 0]
+        prior = None
+        if observed:
+            prior = WorkerStats()
+            for w in observed:
+                # moment-match the pool average: same mean and floor
+                prior.count += 1
+                prior.mean += (w.mean - prior.mean) / prior.count
+                prior.min = min(prior.min, w.min)
+        cols = [
+            (w if w.count > 0 else prior or w).sample(self._rng, n_draws)
+            for w in self.workers
+        ]
+        return np.stack(cols, axis=1)
 
     def expected_epoch_time(
         self, nwait: int, *, n_draws: int = 4000
@@ -257,12 +273,19 @@ class AdaptiveNwait:
 
     def observe(self, pool) -> int:
         """Feed the model; periodically re-pick ``nwait``. Returns the
-        current choice."""
+        current choice.
+
+        Refitting needs a *quorum* of fitted workers — at least
+        ``max(kmin, 2)`` with ``min_samples`` each — not all of them: a
+        rank that dies early (or is never heard from) must not disable
+        adaptation in exactly the failure regime the controller exists
+        for; silent workers are modeled by the pooled prior."""
         self.model.observe_pool(pool)
         self._observed += 1
-        ready = (
-            min(w.count for w in self.model.workers) >= self.min_samples
+        fitted = sum(
+            w.count >= self.min_samples for w in self.model.workers
         )
+        ready = fitted >= max(self.kmin, 2)
         if ready and self._observed % self.refit_every == 0:
             self.nwait = self.model.optimal_nwait(
                 utility=self.utility, kmin=self.kmin, kmax=self.kmax

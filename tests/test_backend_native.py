@@ -52,6 +52,12 @@ def _exit_worker2(i, payload, epoch):
     return np.array([float(i + 1), float(payload[0]), float(epoch)])
 
 
+def _exit_on_negative(i, payload, epoch):
+    if i == 1 and payload[0] < 0:
+        os._exit(5)
+    return np.array([float(i + 1), float(payload[0]), float(epoch)])
+
+
 class StragglerDelay:
     def __init__(self, straggler: int, slow: float = 0.25, fast: float = 0.001):
         self.straggler = straggler
@@ -224,6 +230,36 @@ def test_remote_exception_carries_traceback():
         assert "boom from native worker" in str(err)
         assert "Traceback" in err.remote_traceback
         waitall(pool, backend)  # pool stays recoverable
+    finally:
+        backend.shutdown()
+
+
+def test_respawn_recovers_crashed_rank():
+    """Elastic recovery: a crashed rank is replaced in place and the
+    pool keeps the same index space (new capability over the reference,
+    whose dead ranks are permanent — SURVEY §5)."""
+    n = 3
+    backend = NativeProcessBackend(_exit_on_negative, n)
+    try:
+        pool = AsyncPool(n)
+        with pytest.raises(WorkerFailure):
+            asyncmap(pool, np.array([-1.0]), backend, nwait=n)
+            waitall(pool, backend)
+        waitall(pool, backend)  # drain survivors
+        # EOF is observed before the child is reapable; join to avoid
+        # racing the OS-level process teardown
+        backend._procs[1].join(timeout=10)
+        assert not backend._procs[1].is_alive()
+        with pytest.raises(RuntimeError):
+            backend.respawn(0)  # alive rank: refuse
+        backend.respawn(1)
+        for epoch in (10, 11):
+            repochs = asyncmap(
+                pool, np.array([float(epoch)]), backend,
+                nwait=n, epoch=epoch,
+            )
+            assert list(repochs) == [epoch] * n
+        assert np.asarray(pool.results[1])[0] == 2.0  # new incarnation works
     finally:
         backend.shutdown()
 

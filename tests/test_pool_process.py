@@ -117,6 +117,38 @@ def test_remote_exception_carries_traceback():
         backend.shutdown()
 
 
+def _exit_on_negative(i, payload, epoch):
+    if i == 1 and payload[0] < 0:
+        os._exit(5)
+    return np.array([float(i + 1), float(payload[0]), float(epoch)])
+
+
+def test_respawn_recovers_crashed_rank():
+    """Elastic recovery on the pipe backend: dead rank replaced in place
+    (the reference's dead ranks are permanent — SURVEY §5)."""
+    n = 3
+    backend = ProcessBackend(_exit_on_negative, n)
+    try:
+        pool = AsyncPool(n)
+        with pytest.raises(WorkerFailure):
+            asyncmap(pool, np.array([-1.0]), backend, nwait=n)
+            waitall(pool, backend)
+        waitall(pool, backend)
+        assert backend._dead[1]
+        with pytest.raises(RuntimeError):
+            backend.respawn(0)  # alive rank: refuse
+        backend.respawn(1)
+        assert not backend._dead[1]
+        for epoch in (10, 11):
+            repochs = asyncmap(
+                pool, np.array([float(epoch)]), backend,
+                nwait=n, epoch=epoch,
+            )
+            assert list(repochs) == [epoch] * n
+    finally:
+        backend.shutdown()
+
+
 def test_dead_worker_process_fails_fast_not_hangs():
     # a crashed rank hangs the reference's Waitall! forever (SURVEY §5);
     # here the EOF on its pipe surfaces as WorkerFailure at harvest

@@ -128,6 +128,44 @@ def test_adaptive_nwait_on_live_pool():
         backend.shutdown()
 
 
+def test_unheard_worker_samples_pooled_prior_not_zero():
+    n = 4
+    model = PoolLatencyModel(n, seed=5)
+    for i in range(n - 1):  # worker 3 never heard from
+        for _ in range(20):
+            model.observe(i, 0.1)
+    draws = model.sample_latencies(500)
+    # silent worker must not look infinitely fast: its draws sit at the
+    # pooled prior (~0.1), not 0
+    assert draws[:, 3].mean() == pytest.approx(0.1, rel=0.5)
+    assert draws[:, 3].min() > 0
+
+
+def test_adaptive_refit_survives_dead_worker():
+    # one rank with zero samples must not disable adaptation (quorum
+    # gating, not min-over-all)
+    n = 4
+    ctl = AdaptiveNwait(n, kmin=2, min_samples=2, refit_every=1, seed=0)
+
+    class FakePool:
+        def __init__(self):
+            self.repochs = np.zeros(n, dtype=np.int64)
+            self.latency = np.zeros(n)
+            self.results = [None] * n
+
+    pool = FakePool()
+    for epoch in range(1, 6):
+        for i in range(n - 1):  # worker 3 never responds
+            pool.repochs[i] = epoch
+            pool.latency[i] = 0.01 * (i + 1)
+            pool.results[i] = 1.0
+        ctl.observe(pool)
+    assert sum(w.count >= 2 for w in ctl.model.workers) == 3
+    # refit happened despite worker 3 having zero samples, and the silent
+    # rank (modeled by the pooled prior, not as free) is not waited for
+    assert ctl.nwait <= n - 1
+
+
 def test_observe_pool_only_counts_advanced_workers():
     n = 3
     backend = LocalBackend(lambda i, p, e: p, n)
