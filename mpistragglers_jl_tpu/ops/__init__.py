@@ -10,6 +10,8 @@ _HOME = {
     "GradientCode": "gradcode",
     "PolynomialCode": "polynomial",
     "PolyCodedGemm": "polynomial",
+    "MatDotCode": "matdot",
+    "MatDotGemm": "matdot",
     "flash_attention": "flash_attention",
 }
 

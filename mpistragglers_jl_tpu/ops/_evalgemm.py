@@ -1,0 +1,110 @@
+"""Shared scaffolding for evaluation-point coded GEMM workloads.
+
+:class:`PolyCodedGemm` (ops/polynomial.py) and :class:`MatDotGemm`
+(ops/matdot.py) are the same machine around different codes: per-worker
+static evaluations of A placed on devices, per-worker B-encode weights,
+an :class:`~..backends.xla.XLADeviceBackend` running the fused
+encode+matmul, a decodability-predicate ``nwait``, and a
+fresh-shard harvest that decodes on the pool's first device. That
+machinery lives here once; subclasses provide the code object (with
+recovery threshold ``k``), the worker computation, and the decode.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.base import DelayFn
+from ..backends.xla import XLADeviceBackend
+from ..pool import AsyncPool
+from .coding import nwait_decodable
+
+__all__ = ["EvalPointCodedGemm", "chebyshev_points"]
+
+
+def chebyshev_points(n: int) -> np.ndarray:
+    """n distinct Chebyshev nodes in (-1, 1): real-field Vandermonde
+    systems over these are far better conditioned than over equispaced
+    points — what makes MXU-matmul decode viable in f32 (SURVEY §7
+    "Float64 / conditioning")."""
+    i = np.arange(n)
+    return np.cos((2 * i + 1) * np.pi / (2 * n)).astype(np.float64)
+
+
+class EvalPointCodedGemm:
+    """Base for pool workloads computing ``A @ B`` from coded
+    evaluations. Subclasses must, in ``__init__``, set ``self.code``
+    (exposing ``k``), ``self.devices``, then call :meth:`_setup_workers`
+    — and implement ``_work(i, payload, epoch)`` plus
+    :meth:`_decode_shards`.
+    """
+
+    code = None  # set by subclass before _setup_workers
+    devices: list
+
+    def _setup_workers(
+        self,
+        coded_A,
+        B_weights,
+        n: int,
+        devices: Sequence[jax.Device] | None,
+        delay_fn: DelayFn | None,
+    ) -> None:
+        """Place per-worker A evaluations + B-encode weights round-robin
+        over the devices and wire the XLA backend."""
+        self.A_shards = [
+            jax.device_put(coded_A[i], self.devices[i % len(self.devices)])
+            for i in range(n)
+        ]
+        self.B_weights = [
+            jax.device_put(
+                jnp.asarray(B_weights[i]),
+                self.devices[i % len(self.devices)],
+            )
+            for i in range(n)
+        ]
+        self.backend = XLADeviceBackend(
+            self._work, n, devices=devices, delay_fn=delay_fn
+        )
+
+    @property
+    def k(self) -> int:
+        """Recovery threshold of the underlying code."""
+        return self.code.k
+
+    @property
+    def nwait(self):
+        """Decodability predicate: true at >= k fresh shards."""
+        return nwait_decodable(self.k)
+
+    def _decode_shards(self, shards: jax.Array, idx: np.ndarray) -> jax.Array:
+        raise NotImplementedError
+
+    def result_device(
+        self, pool: AsyncPool, epoch: int | None = None
+    ) -> jax.Array:
+        """Decode the full product from the first k fresh shards,
+        device-resident (host transfer is the slow edge, not HBM).
+        Shards are gathered onto the pool's first device — the caller
+        may have deliberately excluded other devices."""
+        fresh = pool.fresh_indices(epoch)
+        if fresh.size < self.k:
+            raise ValueError(
+                f"only {fresh.size} fresh shards at epoch "
+                f"{pool.epoch if epoch is None else epoch}, need "
+                f"k={self.k}"
+            )
+        idx = fresh[: self.k]
+        shards = jnp.stack([
+            jax.device_put(jnp.asarray(pool.results[i]), self.devices[0])
+            for i in idx
+        ])
+        return self._decode_shards(shards, idx)
+
+    def result(self, pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
+        """Host-copy variant of :meth:`result_device`."""
+        return np.asarray(self.result_device(pool, epoch))

@@ -44,9 +44,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..backends.base import DelayFn
-from ..backends.xla import XLADeviceBackend
 from ..pool import AsyncPool
-from .coding import nwait_decodable
+from ._evalgemm import EvalPointCodedGemm, chebyshev_points
 
 __all__ = ["PolynomialCode", "PolyCodedGemm"]
 
@@ -98,10 +97,7 @@ class PolynomialCode:
         self.k = self.p * self.q  # recovery threshold
         self.precision = precision
         # Chebyshev nodes: well-conditioned real Vandermonde systems
-        i = np.arange(self.n)
-        self.points = np.cos((2 * i + 1) * np.pi / (2 * self.n)).astype(
-            np.float64
-        )
+        self.points = chebyshev_points(self.n)
         # A-encode weights x_i^j, B-encode weights x_i^(l*p), decode
         # Vandermonde x_i^t for t < pq
         self.VA = (self.points[:, None] ** np.arange(self.p)).astype(dtype)
@@ -151,7 +147,7 @@ class PolynomialCode:
         ])
 
 
-class PolyCodedGemm:
+class PolyCodedGemm(EvalPointCodedGemm):
     """``C = A @ B`` from any pq of n workers, both factors partitioned.
 
     Worker i holds the static evaluation ``Ã_i`` (m/p × kd) and encodes
@@ -187,26 +183,12 @@ class PolyCodedGemm:
         self.devices = list(devices)
         self.code = PolynomialCode(p, q, n, dtype=A.dtype, precision=precision)
         self.p, self.q, self.n = p, q, n
-        self.k = p * q
         self.block_rows = m // p
         self.precision = precision
         coded = self.code.encode_A(
             jnp.asarray(A).reshape(p, m // p, A.shape[1])
         )
-        self.A_shards = [
-            jax.device_put(coded[i], self.devices[i % len(self.devices)])
-            for i in range(n)
-        ]
-        self.B_weights = [
-            jax.device_put(
-                jnp.asarray(self.code.VB[i]),
-                self.devices[i % len(self.devices)],
-            )
-            for i in range(n)
-        ]
-        self.backend = XLADeviceBackend(
-            self._work, n, devices=devices, delay_fn=delay_fn
-        )
+        self._setup_workers(coded, self.code.VB, n, devices, delay_fn)
 
     def _work(self, i: int, payload: jax.Array, epoch: int) -> jax.Array:
         if payload.shape[1] % self.q != 0:
@@ -219,29 +201,5 @@ class PolyCodedGemm:
             self.precision,
         )
 
-    @property
-    def nwait(self):
-        """Decodability predicate: pq fresh evaluations suffice."""
-        return nwait_decodable(self.k)
-
-    def result_device(
-        self, pool: AsyncPool, epoch: int | None = None
-    ) -> jax.Array:
-        """Decode the full product from the first pq fresh evaluations,
-        device-resident (host transfer is the slow edge, not HBM)."""
-        fresh = pool.fresh_indices(epoch)
-        if fresh.size < self.k:
-            raise ValueError(
-                f"only {fresh.size} fresh shards at epoch "
-                f"{pool.epoch if epoch is None else epoch}, need pq={self.k}"
-            )
-        idx = fresh[: self.k]
-        shards = jnp.stack([
-            jax.device_put(jnp.asarray(pool.results[i]), self.devices[0])
-            for i in idx
-        ])
+    def _decode_shards(self, shards, idx):
         return self.code.assemble(self.code.decode(shards, idx))
-
-    def result(self, pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
-        """Host-copy variant of :meth:`result_device`."""
-        return np.asarray(self.result_device(pool, epoch))

@@ -1,0 +1,104 @@
+"""MatDot-coded GEMM: inner-dimension partitioning, decode from 2p-1.
+
+Third coded-matmul family (after MDS row coding and polynomial codes) —
+new capability beyond the reference, consuming the same ``repochs``
+arrival-mask mechanism (SURVEY §2.1).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import AsyncPool, asyncmap, waitall
+from mpistragglers_jl_tpu.ops import MatDotCode, MatDotGemm
+
+
+class TestMatDotCode:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="n >= 2p-1"):
+            MatDotCode(3, 4)
+        with pytest.raises(ValueError, match="p >= 1"):
+            MatDotCode(0, 3)
+        code = MatDotCode(2, 5)
+        with pytest.raises(ValueError, match="distinct shard indices"):
+            code.decode_weights([0, 1, 1])
+        with pytest.raises(ValueError, match="expected 2 A-blocks"):
+            code.encode_A(np.zeros((3, 2, 2)))
+        with pytest.raises(ValueError, match="expected 3 shards"):
+            code.combine(np.zeros((2, 2, 2)), [0, 1])
+
+    def test_recovery_threshold_is_2p_minus_1(self):
+        assert MatDotCode(1, 1).k == 1
+        assert MatDotCode(2, 5).k == 3
+        assert MatDotCode(4, 8).k == 7
+
+    @pytest.mark.parametrize("p,n", [(1, 2), (2, 5), (3, 7)])
+    def test_decode_every_k_subset(self, p, n):
+        rng = np.random.default_rng(0)
+        m, kd, nc = 6, 4 * p, 5
+        A = rng.standard_normal((m, kd)).astype(np.float64)
+        B = rng.standard_normal((kd, nc)).astype(np.float64)
+        code = MatDotCode(p, n, dtype=np.float64)
+        A_blocks = A.reshape(m, p, kd // p).transpose(1, 0, 2)
+        B_blocks = B.reshape(p, kd // p, nc)
+        A_enc = np.asarray(code.encode_A(A_blocks))
+        C_true = A @ B
+        evals = []
+        for i in range(n):
+            B_enc = np.einsum("j,jkw->kw", code.VB[i], B_blocks)
+            evals.append(A_enc[i] @ B_enc)
+        for idx in itertools.combinations(range(n), code.k):
+            C = np.asarray(
+                code.combine(np.stack([evals[i] for i in idx]), list(idx))
+            )
+            np.testing.assert_allclose(C, C_true, rtol=1e-8, atol=1e-8)
+
+    def test_decode_weights_interpolate_middle_coefficient(self):
+        # w = V_S^{-T} e_{p-1}: applying it to the monomial evaluations
+        # x_i^t must give 1 at t = p-1 and 0 elsewhere
+        code = MatDotCode(3, 7)
+        idx = [0, 2, 3, 5, 6]
+        w = code.decode_weights(idx)
+        V = code.points[idx][:, None] ** np.arange(code.k)
+        picked = w @ V
+        expect = np.zeros(code.k)
+        expect[code.p - 1] = 1.0
+        np.testing.assert_allclose(picked, expect, atol=1e-9)
+
+
+class TestMatDotGemm:
+    def test_pool_workload_with_straggler(self):
+        rng = np.random.default_rng(2)
+        p, n = 2, 5
+        m, kd, nc = 12, 16, 10
+        A = rng.standard_normal((m, kd)).astype(np.float32)
+        B = rng.standard_normal((kd, nc)).astype(np.float32)
+        delays = lambda i, epoch: 0.3 if i == 4 else 0.0  # noqa: E731
+        mg = MatDotGemm(A, p=p, n=n, delay_fn=delays)
+        try:
+            pool = AsyncPool(n)
+            repochs = asyncmap(pool, B, mg.backend, nwait=mg.nwait)
+            fresh = pool.fresh_indices()
+            assert fresh.size >= mg.k
+            C = np.asarray(mg.result_device(pool))
+            scale = float(np.max(np.abs(A @ B)))
+            assert float(np.max(np.abs(C - A @ B))) / scale < 1e-4
+            # too few fresh shards must refuse, not mis-decode
+            pool2 = AsyncPool(n)
+            with pytest.raises(ValueError, match="fresh shards"):
+                mg.result_device(pool2)
+            waitall(pool, mg.backend)
+        finally:
+            mg.backend.shutdown()
+
+    def test_validation(self):
+        A = np.zeros((4, 6), dtype=np.float32)
+        with pytest.raises(ValueError, match="divide evenly"):
+            MatDotGemm(A, p=4, n=9)
+        mg = MatDotGemm(A, p=2, n=3)
+        try:
+            with pytest.raises(ValueError, match="divide evenly"):
+                mg._work(0, np.zeros((5, 2), dtype=np.float32), 1)
+        finally:
+            mg.backend.shutdown()

@@ -108,7 +108,7 @@ class TestPolyCodedGemm:
         )
         pool = AsyncPool(4)
         try:
-            with pytest.raises(ValueError, match="need pq=4"):
+            with pytest.raises(ValueError, match="need k=4"):
                 pg.result(pool)  # nothing dispatched yet
         finally:
             pg.backend.shutdown()
