@@ -12,6 +12,8 @@ _HOME = {
     "PolyCodedGemm": "polynomial",
     "MatDotCode": "matdot",
     "MatDotGemm": "matdot",
+    "DeviceRSGF256": "gf256_device",
+    "gf256_matmul": "gf256_device",
     "flash_attention": "flash_attention",
 }
 

@@ -1,0 +1,128 @@
+"""Device-resident GF(2^8) Reed-Solomon: byte-exact coding without
+leaving HBM.
+
+:class:`~..utils.rs_gf256.RSGF256` runs on the host (native C++ or
+NumPy); this is the same code — identical Cauchy generator, bit-identical
+shards — executed on device, so byte payloads that already live in HBM
+(packed checkpoints, quantized weights, serialized buffers) encode and
+decode without a host round-trip, the framework's standing rule that
+host transfer is the slow edge (SURVEY §7).
+
+GF(256) has no MXU path, so the matmul over the field is built from the
+two primitives the VPU does have: a 64 KiB product-table **gather** and
+an **XOR reduction**. ``C[i, l] = XOR_j MUL[G[i, j], D[j, l]]`` runs as a
+``lax.scan`` over the k contraction steps, each step a (rows, L) gather
++ XOR — O(k) kernel launches fused into one compiled loop, (rows, L)
+live memory instead of a (rows, k, L) intermediate.
+
+Decode inverts the k×k generator submatrix on the host (tiny, exact
+GF arithmetic) and applies it on device the same way; which k rows is
+driven by the pool's ``repochs`` arrival mask like every other decoder
+here (SURVEY §2.1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.rs_gf256 import RSGF256, _MUL, _np_invert
+
+__all__ = ["DeviceRSGF256", "gf256_matmul"]
+
+
+@partial(jax.jit, static_argnames=())
+def _gf_matmul_impl(mul_table, M, D):
+    # C[i, l] = XOR_j mul_table[M[i, j], D[j, l]]
+    def step(acc, j):
+        rows = jnp.take(mul_table, M[:, j].astype(jnp.int32), axis=0)
+        prod = jnp.take_along_axis(
+            rows, D[j].astype(jnp.int32)[None, :], axis=1
+        )  # (rows, L): rows[i, l] = mul[M[i,j], D[j,l]]
+        return acc ^ prod, None
+
+    k = M.shape[1]
+    acc0 = jnp.zeros((M.shape[0], D.shape[1]), dtype=jnp.uint8)
+    acc, _ = jax.lax.scan(step, acc0, jnp.arange(k))
+    return acc
+
+
+_MUL_DEV = None
+
+
+def _mul_table_dev():
+    # one 64 KiB H2D upload per process, not per call
+    global _MUL_DEV
+    if _MUL_DEV is None:
+        _MUL_DEV = jnp.asarray(_MUL)
+    return _MUL_DEV
+
+
+def gf256_matmul(M, D, *, mul_table=None) -> jax.Array:
+    """GF(256) matrix product of uint8 arrays ``(r, k) x (k, L)`` on
+    device (gather + XOR scan; no MXU involvement)."""
+    if mul_table is None:
+        mul_table = _mul_table_dev()
+    M = jnp.asarray(M, dtype=jnp.uint8)
+    D = jnp.asarray(D, dtype=jnp.uint8)
+    return _gf_matmul_impl(mul_table, M, D)
+
+
+class DeviceRSGF256:
+    """Systematic (n, k) Cauchy-RS over bytes, encode/decode on device.
+
+    Bit-identical to :class:`~..utils.rs_gf256.RSGF256` (the generator is
+    shared), so shards may be produced on device and decoded on the host
+    or vice versa.
+
+    >>> rs = DeviceRSGF256(n=8, k=6)
+    >>> coded = rs.encode(data_dev)          # (6, L) uint8 -> (8, L)
+    >>> back = rs.decode(coded[idx], idx)    # any 6 distinct rows
+    """
+
+    def __init__(self, n: int, k: int):
+        self.n, self.k = int(n), int(k)
+        # host codec supplies the generator (native C++ when available)
+        self._host = RSGF256(n, k)
+        self.G = self._host.G  # (n, k) uint8, systematic
+        self._G_dev = jnp.asarray(self.G)
+        self._mul_dev = _mul_table_dev()
+        self._inv_cache: dict[tuple, jnp.ndarray] = {}
+
+    def encode(self, data) -> jax.Array:
+        """(k, L) uint8 source -> (n, L) coded shards (first k = source)."""
+        data = jnp.asarray(data, dtype=jnp.uint8)
+        if data.ndim != 2 or data.shape[0] != self.k:
+            raise ValueError(
+                f"expected ({self.k}, L) uint8 array, got {data.shape}"
+            )
+        return gf256_matmul(self._G_dev, data, mul_table=self._mul_dev)
+
+    def _inverse(self, indices) -> jnp.ndarray:
+        idx = tuple(int(i) for i in indices)
+        if len(idx) != self.k or len(set(idx)) != self.k:
+            raise ValueError(
+                f"need exactly k={self.k} distinct indices, got {idx}"
+            )
+        if min(idx) < 0 or max(idx) >= self.n:
+            raise ValueError(f"indices out of range [0, {self.n}): {idx}")
+        inv = self._inv_cache.get(idx)
+        if inv is None:
+            # tiny k x k GF inversion, exact, host-side
+            inv = jnp.asarray(_np_invert(self.G[list(idx)]))
+            self._inv_cache[idx] = inv
+        return inv
+
+    def decode(self, shards, indices) -> jax.Array:
+        """Any k distinct coded rows -> the (k, L) source bytes, exactly."""
+        shards = jnp.asarray(shards, dtype=jnp.uint8)
+        if shards.ndim != 2 or shards.shape[0] != self.k:
+            raise ValueError(
+                f"expected ({self.k}, L) uint8 array, got {shards.shape}"
+            )
+        return gf256_matmul(
+            self._inverse(indices), shards, mul_table=self._mul_dev
+        )

@@ -44,7 +44,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..backends.base import DelayFn
-from ..pool import AsyncPool
 from ._evalgemm import EvalPointCodedGemm, chebyshev_points
 
 __all__ = ["PolynomialCode", "PolyCodedGemm"]

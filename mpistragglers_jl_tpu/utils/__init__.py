@@ -24,4 +24,15 @@ __all__ = [
     "save",
     "restore",
     "RSGF256",
+    "TrainCheckpointer",
 ]
+
+
+def __getattr__(name):
+    # lazy: TrainCheckpointer pulls in jax (and orbax); the rest of the
+    # utils package stays importable numpy-only
+    if name == "TrainCheckpointer":
+        from .train_checkpoint import TrainCheckpointer
+
+        return TrainCheckpointer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,132 @@
+"""Training checkpoint/resume: model pytrees + pool bookkeeping together
+(utils/train_checkpoint.py). The reference's only resume hook is the
+``epoch0`` kwarg (SURVEY §5 'Checkpoint / resume: absent')."""
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import AsyncPool, LocalBackend, asyncmap, waitall
+from mpistragglers_jl_tpu.utils import TrainCheckpointer, load_state_dict
+
+import jax.numpy as jnp
+
+
+def test_pytree_and_pool_roundtrip(tmp_path):
+    ckpt = TrainCheckpointer(tmp_path / "ck")
+    pool = AsyncPool(3, epoch0=5)
+    backend = LocalBackend(lambda i, p, e: p + i, 3)
+    try:
+        for _ in range(4):
+            asyncmap(pool, np.zeros(2), backend, nwait=3)
+        waitall(pool, backend)
+    finally:
+        backend.shutdown()
+    state = {
+        "w": jnp.arange(6.0).reshape(2, 3),
+        "opt": {"mu": jnp.ones(3), "step": 7},
+    }
+    d = ckpt.save(9, state, pool=pool)
+    assert ckpt.latest_step() == 9
+    back, pool_state, step = ckpt.restore()
+    assert step == 9
+    np.testing.assert_array_equal(np.asarray(back["w"]), np.asarray(state["w"]))
+    np.testing.assert_array_equal(
+        np.asarray(back["opt"]["mu"]), np.ones(3)
+    )
+    assert int(back["opt"]["step"]) == 7
+    pool2 = load_state_dict(pool_state)
+    assert pool2.epoch == pool.epoch == 9
+    assert pool2.epoch0 == 5
+    np.testing.assert_array_equal(pool2.repochs, pool.repochs)
+    np.testing.assert_allclose(pool2.latency, pool.latency)
+    assert d.endswith("step_9")
+
+
+def test_active_pool_refused_unless_allowed(tmp_path):
+    ckpt = TrainCheckpointer(tmp_path / "ck")
+    pool = AsyncPool(2)
+    backend = LocalBackend(
+        lambda i, p, e: p, 2,
+        delay_fn=lambda i, e: 0.2 if i == 1 else 0.0,
+    )
+    try:
+        asyncmap(pool, np.zeros(1), backend, nwait=1)
+        assert pool.active[1]
+        with pytest.raises(RuntimeError, match="still active"):
+            ckpt.save(1, {"w": jnp.zeros(1)}, pool=pool)
+        ckpt.save(1, {"w": jnp.zeros(1)}, pool=pool, allow_active=True)
+        _, pool_state, _ = ckpt.restore(1)
+        pool2 = load_state_dict(pool_state)
+        assert not pool2.active.any()  # in-flight work dropped on restore
+        waitall(pool, backend)
+    finally:
+        backend.shutdown()
+
+
+def test_keep_prunes_old_steps(tmp_path):
+    ckpt = TrainCheckpointer(tmp_path / "ck", keep=2)
+    for s in (1, 2, 3, 4):
+        ckpt.save(s, {"x": jnp.full(1, float(s))})
+    assert ckpt.steps() == [3, 4]
+    with pytest.raises(FileNotFoundError):
+        TrainCheckpointer(tmp_path / "empty").restore()
+
+
+def test_rollback_save_is_not_self_destructed(tmp_path):
+    # saving a LOWER step after a rollback must not delete itself
+    import os
+
+    ckpt = TrainCheckpointer(tmp_path / "ck", keep=2)
+    ckpt.save(10, {"x": jnp.zeros(1)})
+    ckpt.save(20, {"x": jnp.ones(1)})
+    d = ckpt.save(6, {"x": jnp.full(1, 6.0)})
+    assert os.path.isdir(d)
+    assert 6 in ckpt.steps() and len(ckpt.steps()) == 2
+    state, _, step = ckpt.restore(6)
+    assert float(np.asarray(state["x"])[0]) == 6.0 and step == 6
+
+
+def test_resume_matches_uninterrupted_training(tmp_path):
+    """Save at epoch 5, restore into a fresh coordinator, continue — the
+    final weights and epoch numbering match a run that never stopped."""
+
+    def make_backend():
+        return LocalBackend(
+            lambda i, w, e: (w - 0.1 * (w - i)) / 1.0, 4
+        )
+
+    def train(pool, backend, w, epochs):
+        for _ in range(epochs):
+            asyncmap(pool, w, backend, nwait=4)
+            w = np.mean([np.asarray(r) for r in pool.results], axis=0)
+        waitall(pool, backend)
+        return w
+
+    # uninterrupted: 10 epochs
+    b1 = make_backend()
+    try:
+        w_full = train(AsyncPool(4), b1, np.zeros(3), 10)
+    finally:
+        b1.shutdown()
+
+    # interrupted: 5 epochs, checkpoint, "crash", restore, 5 more
+    ckpt = TrainCheckpointer(tmp_path / "ck")
+    b2 = make_backend()
+    try:
+        pool = AsyncPool(4)
+        w_half = train(pool, b2, np.zeros(3), 5)
+        ckpt.save(5, {"w": jnp.asarray(w_half)}, pool=pool)
+    finally:
+        b2.shutdown()
+    del pool, w_half
+
+    state, pool_state, step = ckpt.restore()
+    pool3 = load_state_dict(pool_state)
+    assert step == 5 and pool3.epoch == 5
+    b3 = make_backend()
+    try:
+        w_resumed = train(pool3, b3, np.asarray(state["w"]), 5)
+    finally:
+        b3.shutdown()
+    np.testing.assert_allclose(w_resumed, w_full, rtol=1e-6)
+    assert pool3.epoch == 10  # epoch numbering continued, not restarted
