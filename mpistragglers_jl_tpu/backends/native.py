@@ -22,7 +22,6 @@ import multiprocessing as mp
 import pickle
 import tempfile
 import time
-import traceback
 import uuid
 from pathlib import Path
 from typing import Sequence
@@ -39,40 +38,15 @@ __all__ = ["NativeProcessBackend"]
 def _native_worker_main(
     rank: int, path: str, work_fn: WorkFn, delay_fn: DelayFn | None
 ) -> None:
-    """Worker process entry: the reference worker loop (SURVEY §3.2 —
-    receive -> stall -> compute -> send, control channel for shutdown,
-    examples/iterative_example.jl:55-82) over the native transport."""
+    """Spawned-process entry: the shared worker loop (worker.py — the
+    reference's receive -> stall -> compute -> send convention, SURVEY
+    §3.2) with errors swallowed (the coordinator sees the disconnect)."""
+    from ..worker import run_worker
+
     try:
-        w = T.Worker(path, rank)
-    except Exception:
-        return
-    try:
-        while True:
-            msg = w.recv()
-            if msg is None or msg.kind == T.KIND_CONTROL:
-                break  # coordinator gone, or shutdown broadcast
-            payload = pickle.loads(msg.payload)
-            if delay_fn is not None:
-                d = float(delay_fn(rank, msg.epoch))
-                if d > 0:
-                    time.sleep(d)
-            try:
-                out = pickle.dumps(
-                    work_fn(rank, payload, msg.epoch), protocol=5
-                )
-                kind = T.KIND_DATA
-            except BaseException as e:
-                out = pickle.dumps(
-                    (type(e).__name__, str(e), traceback.format_exc()),
-                    protocol=5,
-                )
-                kind = T.KIND_ERROR
-            if not w.send(out, seq=msg.seq, epoch=msg.epoch, kind=kind):
-                break
+        run_worker(path, rank, work_fn, delay_fn)
     except (KeyboardInterrupt, Exception):
         pass
-    finally:
-        w.close()
 
 
 class NativeProcessBackend(Backend):
@@ -87,36 +61,75 @@ class NativeProcessBackend(Backend):
 
     def __init__(
         self,
-        work_fn: WorkFn,
+        work_fn: WorkFn | None,
         n_workers: int,
         *,
         delay_fn: DelayFn | None = None,
         mp_context: str = "spawn",
         connect_timeout: float = 60.0,
         join_timeout: float = 5.0,
+        address: str | None = None,
+        spawn: bool = True,
+        accept: bool = True,
     ):
+        """``address``: Unix-socket path (default: a fresh temp path) or
+        ``tcp://host:port`` for multi-host (port 0 = ephemeral; the
+        resolved address is ``self.address``). ``spawn=False`` starts no
+        local processes — external workers (e.g. remote hosts running
+        ``python -m mpistragglers_jl_tpu.worker``) must connect within
+        ``connect_timeout``; ``work_fn`` may then be None (it runs on
+        the workers' side). ``accept=False`` defers the handshake: the
+        constructor returns immediately after binding so ``address``
+        (with its resolved ephemeral port) can be handed to workers
+        first; call :meth:`accept` before the first dispatch."""
         self.n_workers = int(n_workers)
         self.work_fn = work_fn
         self.delay_fn = delay_fn
         self._join_timeout = join_timeout
+        self._connect_timeout = connect_timeout
         self._closed = False
+        self._spawn = bool(spawn)
+        if self._spawn and work_fn is None:
+            raise ValueError("work_fn is required when spawning workers")
         self._seqs = [0] * self.n_workers
         self._epochs = [0] * self.n_workers  # epoch of in-flight dispatch
         # dispatch that failed instantly (dead worker): surfaced at the
         # next test/wait instead of raising inside the pool's send phase
         self._synthetic: list[WorkerError | None] = [None] * self.n_workers
-        sock = Path(tempfile.gettempdir()) / f"msgt-{uuid.uuid4().hex[:12]}.sock"
-        self._sock_path = str(sock)
+        if address is None:
+            address = str(
+                Path(tempfile.gettempdir())
+                / f"msgt-{uuid.uuid4().hex[:12]}.sock"
+            )
         self._mp_context = mp_context
-        self._coord = T.Coordinator(self._sock_path, self.n_workers)
+        self._coord = T.Coordinator(address, self.n_workers)
+        self._sock_path = self._coord.address  # ephemeral port resolved
         self._procs: list = [None] * self.n_workers
-        for i in range(self.n_workers):
-            self._spawn_worker(i)
+        self._accepted = False
+        if self._spawn:
+            for i in range(self.n_workers):
+                self._spawn_worker(i)
+        if accept:
+            self.accept(timeout=connect_timeout)
+
+    def accept(self, timeout: float | None = None) -> None:
+        """Complete the worker handshake (no-op if already done)."""
+        if self._accepted:
+            return
         try:
-            self._coord.accept(timeout=connect_timeout)
+            self._coord.accept(
+                timeout=self._connect_timeout if timeout is None else timeout
+            )
         except T.TransportError:
             self.shutdown()
             raise
+        self._accepted = True
+
+    @property
+    def address(self) -> str:
+        """The address workers connect to (give this to remote workers
+        in ``spawn=False`` mode)."""
+        return self._sock_path
 
     def _spawn_worker(self, i: int) -> None:
         """Start (or restart) the worker process for rank i."""
@@ -222,6 +235,11 @@ class NativeProcessBackend(Backend):
         the seq guard."""
         if self._closed:
             raise RuntimeError("backend has been shut down")
+        if not self._spawn:
+            raise RuntimeError(
+                "respawn() needs locally spawned workers; for external "
+                "workers restart the remote process and call reaccept()"
+            )
         if not self._coord.is_dead(i) and self._procs[i].is_alive():
             raise RuntimeError(f"worker {i} is alive; nothing to respawn")
         if self._procs[i].is_alive():  # pragma: no cover - zombie socket
@@ -233,6 +251,14 @@ class NativeProcessBackend(Backend):
         # _synthetic[i], if set, stays: it records a dispatch the old
         # incarnation never received — the pool must still see it fail
 
+    def reaccept(self, i: int, *, timeout: float = 60.0) -> None:
+        """External-worker recovery (``spawn=False``): after the remote
+        worker process for rank ``i`` is restarted out-of-band, accept
+        its reconnect so the rank becomes dispatchable again."""
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
+        self._coord.reaccept(i, timeout=timeout)
+
     def shutdown(self) -> None:
         if self._closed:
             return
@@ -241,8 +267,9 @@ class NativeProcessBackend(Backend):
             # control-channel broadcast (reference test/kmap2.jl:14-18)
             self._coord.isend(i, b"", kind=T.KIND_CONTROL)
         for p in self._procs:
-            p.join(timeout=self._join_timeout)
+            if p is not None:
+                p.join(timeout=self._join_timeout)
         for p in self._procs:
-            if p.is_alive():  # pragma: no cover - stuck worker
+            if p is not None and p.is_alive():  # pragma: no cover
                 p.terminate()
         self._coord.close()

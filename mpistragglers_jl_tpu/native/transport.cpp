@@ -45,8 +45,12 @@
 #include <thread>
 #include <vector>
 
+#include <arpa/inet.h>
 #include <errno.h>
+#include <netdb.h>
 #include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
@@ -79,6 +83,31 @@ struct Frame {
 
 // Blocking full read/write on a (blocking-mode) fd. Used worker-side and
 // during the coordinator's hello handshake.
+// Address forms: a filesystem path (Unix-domain socket, single host) or
+// "tcp://host:port" (TCP with TCP_NODELAY, multi-host). Port 0 binds an
+// ephemeral port readable via msgt_coord_port. Returns 0 = not a tcp://
+// address, 1 = parsed, -1 = malformed (tcp:// prefix but bad host/port
+// — a hard error, NOT a fallback to a unix path named "tcp://...").
+int parse_tcp(const char* addr, std::string* host, int* port) {
+  const char* kPrefix = "tcp://";
+  if (std::strncmp(addr, kPrefix, 6) != 0) return 0;
+  const char* rest = addr + 6;
+  const char* colon = std::strrchr(rest, ':');
+  if (!colon || colon == rest || colon[1] == '\0') return -1;
+  for (const char* p = colon + 1; *p; p++)
+    if (*p < '0' || *p > '9') return -1;  // "5O55" must not atoi to 0
+  long pt = std::atol(colon + 1);
+  if (pt < 0 || pt > 65535) return -1;
+  *host = std::string(rest, colon - rest);
+  *port = static_cast<int>(pt);
+  return 1;
+}
+
+void tune_tcp(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
 bool read_full(int fd, void* buf, size_t n) {
   auto* p = static_cast<uint8_t*>(buf);
   while (n > 0) {
@@ -135,7 +164,9 @@ struct Coordinator {
   int listen_fd = -1;
   int epfd = -1;
   int wake_fd = -1;  // eventfd: kicks the progress thread for sends/stop
-  std::string path;
+  bool tcp = false;
+  int port = 0;      // bound TCP port (after create), 0 for unix
+  std::string path;  // unix socket path to unlink, empty for tcp
   std::thread progress;
   std::atomic<bool> stopping{false};
 
@@ -348,6 +379,7 @@ int accept_hello(Coordinator* c,
     if (pr <= 0) return -1;
     int fd = ::accept(c->listen_fd, nullptr, nullptr);
     if (fd < 0) continue;
+    if (c->tcp) tune_tcp(fd);
     left = remaining_ms();
     timeval tv{};
     tv.tv_sec = left > 0 ? left / 1000 : 0;
@@ -364,9 +396,11 @@ int accept_hello(Coordinator* c,
     if (valid && expected_rank < 0 && c->peers[hello.seq].fd >= 0)
       valid = false;  // duplicate rank during initial handshake
     if (!valid) {
+      // drop and keep waiting: on a public TCP listener a stray
+      // connection (port scanner, health check) or duplicate rank must
+      // not abort the handshake — only the deadline ends it
       ::close(fd);
-      if (expected_rank >= 0) continue;  // keep waiting for our rank
-      return -1;  // initial handshake is strict: bad hello is fatal
+      continue;
     }
     *fd_out = fd;
     return static_cast<int>(hello.seq);
@@ -379,15 +413,54 @@ extern "C" {
 
 // ---------------------------------------------------------------- coordinator
 
-// Create the coordinator: bind + listen on a Unix socket at `path`.
-// Returns an opaque handle, or nullptr on failure.
-void* msgt_coord_create(const char* path, int n_workers) {
+// Create the coordinator: bind + listen at `addr` — a Unix-socket path,
+// or "tcp://host:port" for multi-host (port 0 = ephemeral; read it back
+// with msgt_coord_port). Returns an opaque handle, or nullptr on failure.
+void* msgt_coord_create(const char* addr_str, int n_workers) {
   auto* c = new Coordinator();
   c->n = n_workers;
-  c->path = path;
   c->peers.resize(n_workers);
   c->completed.resize(n_workers);
-  ::unlink(path);
+  std::string host;
+  int port = 0;
+  int ptcp = parse_tcp(addr_str, &host, &port);
+  if (ptcp < 0) {  // malformed tcp:// — refuse, don't bind a unix path
+    delete c;
+    return nullptr;
+  }
+  if (ptcp == 1) {
+    c->tcp = true;
+    c->listen_fd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (c->listen_fd < 0) {
+      delete c;
+      return nullptr;
+    }
+    int one = 1;
+    setsockopt(c->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in a{};
+    a.sin_family = AF_INET;
+    a.sin_port = htons(static_cast<uint16_t>(port));
+    if (host.empty() || host == "0.0.0.0")
+      a.sin_addr.s_addr = INADDR_ANY;
+    else if (inet_pton(AF_INET, host.c_str(), &a.sin_addr) != 1) {
+      delete c;
+      return nullptr;
+    }
+    if (::bind(c->listen_fd, reinterpret_cast<sockaddr*>(&a), sizeof(a)) !=
+            0 ||
+        ::listen(c->listen_fd, n_workers) != 0) {
+      delete c;
+      return nullptr;
+    }
+    sockaddr_in bound{};
+    socklen_t blen = sizeof(bound);
+    if (getsockname(c->listen_fd, reinterpret_cast<sockaddr*>(&bound),
+                    &blen) == 0)
+      c->port = ntohs(bound.sin_port);
+    return c;
+  }
+  c->path = addr_str;
+  ::unlink(addr_str);
   c->listen_fd = ::socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
   if (c->listen_fd < 0) {
     delete c;
@@ -399,7 +472,7 @@ void* msgt_coord_create(const char* path, int n_workers) {
     delete c;
     return nullptr;
   }
-  std::strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
+  std::strncpy(addr.sun_path, addr_str, sizeof(addr.sun_path) - 1);
   if (::bind(c->listen_fd, reinterpret_cast<sockaddr*>(&addr),
              sizeof(addr)) != 0 ||
       ::listen(c->listen_fd, n_workers) != 0) {
@@ -407,6 +480,12 @@ void* msgt_coord_create(const char* path, int n_workers) {
     return nullptr;
   }
   return c;
+}
+
+// Bound TCP port of the coordinator's listen socket (0 for unix sockets)
+// — needed when created with port 0 (ephemeral).
+int msgt_coord_port(void* h) {
+  return static_cast<Coordinator*>(h)->port;
 }
 
 // Accept all n workers (each opens with a hello frame carrying its rank in
@@ -603,22 +682,62 @@ void msgt_coord_destroy(void* h) {
 
 // ------------------------------------------------------------------- worker
 
-// Connect to the coordinator's socket and send the hello frame carrying
-// this worker's rank. Returns an opaque handle or nullptr.
-void* msgt_worker_connect(const char* path, int rank) {
+// Connect to the coordinator (Unix path or "tcp://host:port") and send
+// the hello frame carrying this worker's rank. Returns an opaque handle
+// or nullptr.
+void* msgt_worker_connect(const char* addr_str, int rank) {
   auto* w = new WorkerCtx();
-  w->fd = ::socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
-  if (w->fd < 0) {
+  std::string host;
+  int port = 0;
+  int ptcp = parse_tcp(addr_str, &host, &port);
+  if (ptcp < 0) {
     delete w;
     return nullptr;
   }
-  sockaddr_un addr{};
-  addr.sun_family = AF_UNIX;
-  std::strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
-  if (::connect(w->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
-      0) {
-    delete w;
-    return nullptr;
+  if (ptcp == 1) {
+    w->fd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (w->fd < 0) {
+      delete w;
+      return nullptr;
+    }
+    sockaddr_in a{};
+    a.sin_family = AF_INET;
+    a.sin_port = htons(static_cast<uint16_t>(port));
+    const char* h = (host.empty() || host == "0.0.0.0")
+                        ? "127.0.0.1"  // bound-any coordinator, same host
+                        : host.c_str();
+    if (inet_pton(AF_INET, h, &a.sin_addr) != 1) {
+      // not an IPv4 literal: resolve the hostname
+      addrinfo hints{};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      addrinfo* res = nullptr;
+      if (getaddrinfo(h, nullptr, &hints, &res) != 0 || res == nullptr) {
+        delete w;
+        return nullptr;
+      }
+      a.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
+    }
+    if (::connect(w->fd, reinterpret_cast<sockaddr*>(&a), sizeof(a)) != 0) {
+      delete w;
+      return nullptr;
+    }
+    tune_tcp(w->fd);
+  } else {
+    w->fd = ::socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (w->fd < 0) {
+      delete w;
+      return nullptr;
+    }
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    std::strncpy(addr.sun_path, addr_str, sizeof(addr.sun_path) - 1);
+    if (::connect(w->fd, reinterpret_cast<sockaddr*>(&addr),
+                  sizeof(addr)) != 0) {
+      delete w;
+      return nullptr;
+    }
   }
   Header hello{0, rank, 0, 0, KIND_HELLO};
   if (!write_full(w->fd, &hello, sizeof(hello))) {

@@ -52,6 +52,8 @@ class Message:
 def _configure(lib):
     lib.msgt_coord_create.restype = ctypes.c_void_p
     lib.msgt_coord_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.msgt_coord_port.restype = ctypes.c_int
+    lib.msgt_coord_port.argtypes = [ctypes.c_void_p]
     lib.msgt_coord_accept.restype = ctypes.c_int
     lib.msgt_coord_accept.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.msgt_coord_isend.restype = ctypes.c_int
@@ -121,6 +123,9 @@ class Coordinator:
     progress thread; one connection per worker rank."""
 
     def __init__(self, path: str, n_workers: int):
+        """``path`` is a Unix-socket filesystem path (single host) or
+        ``tcp://host:port`` (multi-host; port 0 binds an ephemeral port,
+        see :attr:`port`)."""
         self._lib = load_lib()
         self.n_workers = int(n_workers)
         self.path = path
@@ -129,6 +134,16 @@ class Coordinator:
         )
         if not self._h:
             raise TransportError(f"could not bind coordinator socket {path}")
+        self.port = int(self._lib.msgt_coord_port(self._h))
+
+    @property
+    def address(self) -> str:
+        """The address workers should connect to (ephemeral TCP ports
+        resolved to the actual bound port)."""
+        if self.path.startswith("tcp://"):
+            host = self.path[6:].rsplit(":", 1)[0]
+            return f"tcp://{host}:{self.port}"
+        return self.path
 
     def _handle(self):
         # a NULL handle into the C ABI would segfault, not raise

@@ -23,8 +23,6 @@ here (SURVEY §2.1).
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -34,7 +32,7 @@ from ..utils.rs_gf256 import RSGF256, _MUL, _np_invert
 __all__ = ["DeviceRSGF256", "gf256_matmul"]
 
 
-@partial(jax.jit, static_argnames=())
+@jax.jit
 def _gf_matmul_impl(mul_table, M, D):
     # C[i, l] = XOR_j mul_table[M[i, j], D[j, l]]
     def step(acc, j):

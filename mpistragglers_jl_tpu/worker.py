@@ -1,0 +1,127 @@
+"""Standalone worker entry point for multi-host pools.
+
+The reference's multi-process story is ``mpiexec`` launching every rank
+on hosts listed in a hostfile (test/runtests.jl:17); the equivalent here
+is one coordinator binding the native transport on TCP and each remote
+host launching workers against it:
+
+    # on the coordinator host
+    backend = NativeProcessBackend(work_fn, n, spawn=False,
+                                   address="tcp://0.0.0.0:5555")
+
+    # on each worker host
+    python -m mpistragglers_jl_tpu.worker \
+        --address tcp://coordinator-host:5555 --rank 3 \
+        --work mypkg.mymod:work_fn
+
+The worker loop is the reference's receive -> stall -> compute -> send
+convention (SURVEY §3.2, examples/iterative_example.jl:55-82) made a
+first-class program: frames in, pickled payloads through ``work_fn``,
+results (or captured exceptions) back, shutdown on the control frame.
+``--work`` takes ``module:attribute``; the module must be importable on
+the worker host (install your package or set PYTHONPATH).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import pickle
+import time
+import traceback
+
+from .backends.base import DelayFn
+from .native import transport as T
+
+__all__ = ["run_worker", "resolve_callable", "main"]
+
+
+def run_worker(
+    address: str,
+    rank: int,
+    work_fn,
+    delay_fn: DelayFn | None = None,
+) -> None:
+    """Connect to the coordinator and serve until shutdown.
+
+    ``work_fn(rank, payload, epoch) -> result`` with picklable results;
+    exceptions are captured and shipped back as failures, not lost the
+    way reference worker assertions die inside mpiexec (SURVEY §4).
+    """
+    w = T.Worker(address, rank)
+    try:
+        while True:
+            msg = w.recv()
+            if msg is None or msg.kind == T.KIND_CONTROL:
+                break  # coordinator gone, or shutdown broadcast
+            try:
+                # deserialization is inside the capture: an unpicklable
+                # payload (e.g. a class not importable on this host — the
+                # common multi-host failure) must ship back as an error,
+                # not kill the worker without a diagnostic
+                payload = pickle.loads(msg.payload)
+                if delay_fn is not None:
+                    d = float(delay_fn(rank, msg.epoch))
+                    if d > 0:
+                        time.sleep(d)
+                out = pickle.dumps(
+                    work_fn(rank, payload, msg.epoch), protocol=5
+                )
+                kind = T.KIND_DATA
+            except BaseException as e:
+                out = pickle.dumps(
+                    (type(e).__name__, str(e), traceback.format_exc()),
+                    protocol=5,
+                )
+                kind = T.KIND_ERROR
+            if not w.send(out, seq=msg.seq, epoch=msg.epoch, kind=kind):
+                break
+    finally:
+        w.close()
+
+
+def resolve_callable(spec: str):
+    """Import ``module.path:attribute`` and return the attribute."""
+    if ":" not in spec:
+        raise ValueError(
+            f"callable spec must be 'module:attribute', got {spec!r}"
+        )
+    mod_name, attr = spec.split(":", 1)
+    obj = importlib.import_module(mod_name)
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    if not callable(obj):
+        raise TypeError(f"{spec} resolved to non-callable {obj!r}")
+    return obj
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="python -m mpistragglers_jl_tpu.worker",
+        description="Serve one pool worker over the native transport.",
+    )
+    ap.add_argument(
+        "--address", required=True,
+        help="coordinator address: tcp://host:port or a unix socket path",
+    )
+    ap.add_argument("--rank", type=int, required=True, help="pool index")
+    ap.add_argument(
+        "--work", required=True,
+        help="work function as module:attribute, "
+        "signature (rank, payload, epoch) -> result",
+    )
+    ap.add_argument(
+        "--delay", default=None,
+        help="optional delay_fn as module:attribute (straggler injection)",
+    )
+    args = ap.parse_args(argv)
+    run_worker(
+        args.address,
+        args.rank,
+        resolve_callable(args.work),
+        resolve_callable(args.delay) if args.delay else None,
+    )
+
+
+if __name__ == "__main__":
+    main()

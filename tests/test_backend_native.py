@@ -234,6 +234,126 @@ def test_remote_exception_carries_traceback():
         backend.shutdown()
 
 
+def test_tcp_transport_pool_roundtrip():
+    """The multi-host path: same pool, TCP loopback instead of a Unix
+    socket (port 0 -> ephemeral, resolved via backend.address)."""
+    n = 3
+    backend = NativeProcessBackend(
+        _echo, n, address="tcp://127.0.0.1:0"
+    )
+    try:
+        assert backend.address.startswith("tcp://127.0.0.1:")
+        assert not backend.address.endswith(":0")  # ephemeral resolved
+        pool = AsyncPool(n)
+        sendbuf = np.array([2.5])
+        recvbuf = np.zeros(3 * n)
+        repochs = asyncmap(pool, sendbuf, backend, recvbuf, nwait=n)
+        assert list(repochs) == [1] * n
+        chunks = recvbuf.reshape(n, 3)
+        for i in range(n):
+            assert chunks[i][0] == i + 1 and chunks[i][1] == 2.5
+    finally:
+        backend.shutdown()
+
+
+def _spawn_cli_worker(address, rank):
+    """Launch `python -m mpistragglers_jl_tpu.worker` as a real external
+    process — exactly what a remote host would run."""
+    import subprocess
+    import sys
+
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    repo_root = os.path.dirname(tests_dir)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root, tests_dir, env.get("PYTHONPATH", "")]
+    )
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "mpistragglers_jl_tpu.worker",
+            "--address", address, "--rank", str(rank),
+            "--work", "test_backend_native:_echo",
+        ],
+        cwd=tests_dir,
+        env=env,
+    )
+
+
+def test_external_workers_over_cli():
+    """spawn=False + `python -m mpistragglers_jl_tpu.worker`: the
+    multi-host deployment model (coordinator binds TCP, workers join
+    from outside; the reference's analog is mpiexec + a hostfile).
+    accept=False defers the handshake so the ephemeral port is known
+    before the workers launch — no hard-coded port to collide on."""
+    n = 2
+    backend = NativeProcessBackend(
+        None, n, spawn=False, address="tcp://127.0.0.1:0", accept=False,
+    )
+    procs = [_spawn_cli_worker(backend.address, r) for r in range(n)]
+    backend.accept(timeout=60)
+    try:
+        pool = AsyncPool(n)
+        repochs = asyncmap(pool, np.array([7.0]), backend, nwait=n)
+        assert list(repochs) == [1] * n
+        for i in range(n):
+            out = np.asarray(pool.results[i])
+            assert out[0] == i + 1 and out[1] == 7.0 and out[2] == 1
+    finally:
+        backend.shutdown()
+        for p in procs:
+            p.wait(timeout=10)
+
+
+def test_malformed_tcp_address_fails_at_create():
+    # "tcp://host:5O55" (letter O) must be a bind error NOW, not a unix
+    # path or a silent ephemeral port + connect timeout later
+    for bad in ("tcp://127.0.0.1:5O55", "tcp://127.0.0.1", "tcp://:123"):
+        with pytest.raises(T.TransportError, match="could not bind"):
+            T.Coordinator(bad, 1)
+
+
+def _raise_on_unpickle():
+    raise RuntimeError("boom on unpickle")
+
+
+class ExplodingPayload:
+    """Pickles fine on the coordinator, raises when the worker loads it
+    — the shape of the classic multi-host serialization mismatch."""
+
+    def __reduce__(self):
+        return (_raise_on_unpickle, ())
+
+
+def test_undeserializable_payload_ships_error_not_dead_worker():
+    """A payload that cannot unpickle in the worker must come back as a
+    WorkerFailure with the real exception, not a dead rank."""
+    backend = NativeProcessBackend(_echo, 1)
+    try:
+        pool = AsyncPool(1)
+        with pytest.raises(WorkerFailure) as excinfo:
+            asyncmap(pool, ExplodingPayload(), backend, nwait=1)
+        err = excinfo.value.error
+        assert isinstance(err, RemoteWorkerError)
+        assert err.exc_type == "RuntimeError"
+        assert "boom on unpickle" in str(err)
+        # the rank survived: next epoch with a good payload works
+        repochs = asyncmap(pool, np.array([1.0]), backend, nwait=1, epoch=5)
+        assert list(repochs) == [5]
+    finally:
+        backend.shutdown()
+
+
+def test_resolve_callable():
+    from mpistragglers_jl_tpu.worker import resolve_callable
+
+    fn = resolve_callable("numpy:linalg.norm")
+    assert fn is np.linalg.norm
+    with pytest.raises(ValueError, match="module:attribute"):
+        resolve_callable("numpy.linalg.norm")
+    with pytest.raises(TypeError, match="non-callable"):
+        resolve_callable("numpy:pi")
+
+
 def test_respawn_recovers_crashed_rank():
     """Elastic recovery: a crashed rank is replaced in place and the
     pool keeps the same index space (new capability over the reference,
