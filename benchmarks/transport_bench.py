@@ -1,0 +1,110 @@
+"""Transport microbenchmark: native C++ backend vs Python-pipe backend.
+
+Measures the coordinator-side cost of the pool's hot path (dispatch ->
+waitany -> harvest) with trivial worker compute, isolating the transport
+(the reference's libmpi role, SURVEY component C8):
+
+* round-trip latency: tiny payload, one worker, nwait=1 epochs
+* throughput: 4 MiB payloads broadcast to 4 workers, nwait=4
+
+Prints one JSON line per configuration.
+
+Run:  python benchmarks/transport_bench.py [epochs]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mpistragglers_jl_tpu import AsyncPool, ProcessBackend, asyncmap, waitall
+
+
+def _echo_small(i, payload, epoch):
+    return payload
+
+
+def _sum_large(i, payload, epoch):
+    # touch the whole payload (forces full deserialization + a pass)
+    return np.array([float(payload.sum())])
+
+
+def bench_backend(make_backend, name, epochs=200):
+    out = []
+    # --- round-trip latency: 8-byte payload, 1 worker ---
+    backend = make_backend(_echo_small, 1)
+    try:
+        pool = AsyncPool(1)
+        payload = np.zeros(1)
+        asyncmap(pool, payload, backend, nwait=1)  # warmup
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            asyncmap(pool, payload, backend, nwait=1)
+        dt = time.perf_counter() - t0
+        out.append({
+            "metric": f"transport-roundtrip-{name}",
+            "value": round(dt / epochs * 1e6, 1),
+            "unit": "us/epoch",
+        })
+        waitall(pool, backend)
+    finally:
+        backend.shutdown()
+
+    # --- throughput: 4 MiB payload to 4 workers, full gather ---
+    n, mb = 4, 4
+    backend = make_backend(_sum_large, n)
+    try:
+        pool = AsyncPool(n)
+        payload = np.ones(mb * 1024 * 1024 // 8)  # 4 MiB of float64
+        asyncmap(pool, payload, backend, nwait=n)  # warmup
+        reps = max(epochs // 10, 5)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            asyncmap(pool, payload, backend, nwait=n)
+        dt = time.perf_counter() - t0
+        # each epoch ships the payload to all n workers
+        gbps = (mb / 1024) * n * reps / dt
+        out.append({
+            "metric": f"transport-broadcast-{name}",
+            "value": round(gbps, 2),
+            "unit": "GiB/s",
+            "payload_mib": mb,
+            "n_workers": n,
+        })
+        waitall(pool, backend)
+    finally:
+        backend.shutdown()
+    return out
+
+
+def main():
+    epochs = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    results = bench_backend(
+        lambda fn, n: ProcessBackend(fn, n), "pipes", epochs
+    )
+    try:
+        from mpistragglers_jl_tpu.backends.native import NativeProcessBackend
+
+        results += bench_backend(
+            lambda fn, n: NativeProcessBackend(fn, n), "native", epochs
+        )
+        results += bench_backend(
+            lambda fn, n: NativeProcessBackend(
+                fn, n, address="tcp://127.0.0.1:0"
+            ),
+            "native-tcp", epochs,
+        )
+    except Exception as e:  # no toolchain
+        print(f"[native transport unavailable: {e}]", file=sys.stderr)
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -93,6 +93,10 @@ class NativeProcessBackend(Backend):
             raise ValueError("work_fn is required when spawning workers")
         self._seqs = [0] * self.n_workers
         self._epochs = [0] * self.n_workers  # epoch of in-flight dispatch
+        # per-epoch payload serialization cache (see _serialize)
+        self._pick_src = None
+        self._pick_epoch = None
+        self._pick_bytes = b""
         # dispatch that failed instantly (dead worker): surfaced at the
         # next test/wait instead of raising inside the pool's send phase
         self._synthetic: list[WorkerError | None] = [None] * self.n_workers
@@ -144,17 +148,48 @@ class NativeProcessBackend(Backend):
         self._procs[i] = proc
 
     # -- Backend interface -------------------------------------------------
-    def dispatch(self, i: int, sendbuf, epoch: int, *, tag: int = 0) -> None:
-        if self._closed:
-            raise RuntimeError("backend has been shut down")
+    def begin_epoch(self, epoch: int) -> None:
+        # new epoch: the payload serialization cache is stale
+        self._pick_src = None
+        self._pick_bytes = b""
+        self._pick_epoch = None
+
+    def _serialize(self, sendbuf, epoch: int) -> bytes:
+        """Pickle the payload once per (object, epoch): asyncmap
+        broadcasts ONE stable sendbuf to every idle worker per epoch
+        (reference src/MPIAsyncPools.jl:118-139), so n dispatches — and
+        any phase-3 re-tasks — share a single serialization instead of
+        pickling the same bytes n times. Identity-keyed: a different
+        object (direct Backend-API use) always re-serializes."""
+        if sendbuf is self._pick_src and epoch == self._pick_epoch:
+            return self._pick_bytes
         payload = sendbuf
         if hasattr(payload, "__array__") and not isinstance(payload, np.ndarray):
             payload = np.asarray(payload)  # device arrays are not picklable
+        data = pickle.dumps(payload, protocol=5)
+        self._pick_src = sendbuf
+        self._pick_epoch = epoch
+        self._pick_bytes = data
+        return data
+
+    def _check_ready(self) -> None:
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
+        if not self._accepted:
+            # dispatching before the handshake would queue frames on
+            # fd-less peers and then hang the wait forever
+            raise RuntimeError(
+                "worker handshake incomplete: call backend.accept() "
+                "before dispatching (accept=False mode)"
+            )
+
+    def dispatch(self, i: int, sendbuf, epoch: int, *, tag: int = 0) -> None:
+        self._check_ready()
+        data = self._serialize(sendbuf, int(epoch))
         self._seqs[i] += 1
         self._epochs[i] = int(epoch)
         ok = self._coord.isend(
-            i, pickle.dumps(payload, protocol=5),
-            seq=self._seqs[i], epoch=int(epoch), tag=int(tag),
+            i, data, seq=self._seqs[i], epoch=int(epoch), tag=int(tag),
         )
         if not ok:  # rank already dead: fail the task, don't hang the pool
             self._synthetic[i] = WorkerError(i, epoch, WorkerProcessDied(i))
@@ -174,8 +209,7 @@ class NativeProcessBackend(Backend):
     def _next(self, i: int, *, block: bool, timeout: float | None = None):
         """Fetch the completion for worker ``i``'s current dispatch,
         skipping frames from superseded dispatches (stale seq)."""
-        if self._closed:
-            raise RuntimeError("backend has been shut down")
+        self._check_ready()
         if self._synthetic[i] is not None:
             out = self._synthetic[i]
             self._synthetic[i] = None
@@ -204,8 +238,7 @@ class NativeProcessBackend(Backend):
         return self._next(i, block=False)
 
     def wait_any(self, indices: Sequence[int]) -> tuple[int, object]:
-        if self._closed:
-            raise RuntimeError("backend has been shut down")
+        self._check_ready()
         idx = [int(j) for j in indices]
         if not idx:
             raise ValueError("wait_any over an empty index set would hang")

@@ -380,7 +380,11 @@ int accept_hello(Coordinator* c,
     int fd = ::accept(c->listen_fd, nullptr, nullptr);
     if (fd < 0) continue;
     if (c->tcp) tune_tcp(fd);
+    // cap the per-hello read at 2 s: a silent stray connection (scanner,
+    // health check that sends no bytes) must burn seconds, not the whole
+    // handshake deadline while real workers wait in the backlog
     left = remaining_ms();
+    if (left > 2000) left = 2000;
     timeval tv{};
     tv.tv_sec = left > 0 ? left / 1000 : 0;
     tv.tv_usec = left > 0 ? (left % 1000) * 1000 : 1;

@@ -304,6 +304,19 @@ def test_external_workers_over_cli():
             p.wait(timeout=10)
 
 
+def test_dispatch_before_accept_raises_not_hangs():
+    backend = NativeProcessBackend(
+        None, 1, spawn=False, address="tcp://127.0.0.1:0", accept=False
+    )
+    try:
+        with pytest.raises(RuntimeError, match="handshake incomplete"):
+            backend.dispatch(0, np.zeros(1), 1)
+        with pytest.raises(RuntimeError, match="handshake incomplete"):
+            backend.wait_any([0])
+    finally:
+        backend.shutdown()
+
+
 def test_malformed_tcp_address_fails_at_create():
     # "tcp://host:5O55" (letter O) must be a bind error NOW, not a unix
     # path or a silent ephemeral port + connect timeout later
