@@ -90,7 +90,12 @@ def main():
     )
     try:
         from mpistragglers_jl_tpu.backends.native import NativeProcessBackend
+        from mpistragglers_jl_tpu.native import transport
 
+        transport.load_lib()
+    except Exception as e:  # genuinely no toolchain; runtime errors raise
+        print(f"[native transport unavailable: {e}]", file=sys.stderr)
+    else:
         results += bench_backend(
             lambda fn, n: NativeProcessBackend(fn, n), "native", epochs
         )
@@ -100,8 +105,6 @@ def main():
             ),
             "native-tcp", epochs,
         )
-    except Exception as e:  # no toolchain
-        print(f"[native transport unavailable: {e}]", file=sys.stderr)
     for r in results:
         print(json.dumps(r))
 

@@ -149,27 +149,36 @@ class NativeProcessBackend(Backend):
 
     # -- Backend interface -------------------------------------------------
     def begin_epoch(self, epoch: int) -> None:
-        # new epoch: the payload serialization cache is stale
+        # arm the payload serialization cache for this epoch and drop the
+        # previous epoch's entry. The cache is ONLY active for an epoch
+        # announced via begin_epoch (i.e. inside asyncmap, where the
+        # coordinator is single-threaded and the sendbuf cannot mutate
+        # between the phase-2/phase-3 dispatches of one call); direct
+        # Backend-API dispatches never hit it, so their payloads are
+        # snapshotted at every dispatch as the class docstring promises.
         self._pick_src = None
         self._pick_bytes = b""
-        self._pick_epoch = None
+        self._pick_epoch = int(epoch)
 
     def _serialize(self, sendbuf, epoch: int) -> bytes:
         """Pickle the payload once per (object, epoch): asyncmap
         broadcasts ONE stable sendbuf to every idle worker per epoch
         (reference src/MPIAsyncPools.jl:118-139), so n dispatches — and
         any phase-3 re-tasks — share a single serialization instead of
-        pickling the same bytes n times. Identity-keyed: a different
-        object (direct Backend-API use) always re-serializes."""
-        if sendbuf is self._pick_src and epoch == self._pick_epoch:
+        pickling the same bytes n times. Identity-keyed, and only armed
+        for the epoch most recently announced via :meth:`begin_epoch` —
+        direct Backend-API dispatches always re-serialize, so in-place
+        payload mutation between dispatches is always observed."""
+        cacheable = epoch == self._pick_epoch
+        if cacheable and sendbuf is self._pick_src:
             return self._pick_bytes
         payload = sendbuf
         if hasattr(payload, "__array__") and not isinstance(payload, np.ndarray):
             payload = np.asarray(payload)  # device arrays are not picklable
         data = pickle.dumps(payload, protocol=5)
-        self._pick_src = sendbuf
-        self._pick_epoch = epoch
-        self._pick_bytes = data
+        if cacheable:
+            self._pick_src = sendbuf
+            self._pick_bytes = data
         return data
 
     def _check_ready(self) -> None:
@@ -206,14 +215,17 @@ class NativeProcessBackend(Backend):
             )
         return pickle.loads(msg.payload)
 
+    def _pop_synthetic(self, i: int):
+        out = self._synthetic[i]
+        self._synthetic[i] = None
+        return out
+
     def _next(self, i: int, *, block: bool, timeout: float | None = None):
         """Fetch the completion for worker ``i``'s current dispatch,
         skipping frames from superseded dispatches (stale seq)."""
         self._check_ready()
         if self._synthetic[i] is not None:
-            out = self._synthetic[i]
-            self._synthetic[i] = None
-            return out
+            return self._pop_synthetic(i)
         deadline = None if timeout is None else time.perf_counter() + timeout
         while True:
             if block:
@@ -244,9 +256,7 @@ class NativeProcessBackend(Backend):
             raise ValueError("wait_any over an empty index set would hang")
         for j in idx:  # synthetic failures first — they're already complete
             if self._synthetic[j] is not None:
-                out = self._synthetic[j]
-                self._synthetic[j] = None
-                return j, out
+                return j, self._pop_synthetic(j)
         while True:
             got = self._coord.waitany(idx, timeout=None)
             assert got is not None  # no timeout passed
@@ -296,6 +306,11 @@ class NativeProcessBackend(Backend):
         if self._closed:
             return
         self._closed = True
+        # don't pin the last payload + its pickled copy for the backend
+        # object's remaining lifetime
+        self._pick_src = None
+        self._pick_bytes = b""
+        self._pick_epoch = None
         for i in range(self.n_workers):
             # control-channel broadcast (reference test/kmap2.jl:14-18)
             self._coord.isend(i, b"", kind=T.KIND_CONTROL)

@@ -41,14 +41,21 @@ def _block_matmul(a_block: jax.Array, b: jax.Array, precision=None) -> jax.Array
     return jnp.matmul(a_block, b, precision=precision)
 
 
-def gather_rows(pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
+def gather_rows(
+    pool: AsyncPool,
+    epoch: int | None = None,
+    *,
+    row_splits: Sequence[int] | None = None,
+) -> np.ndarray:
     """Assemble the row-stacked result from per-worker results.
 
     Rows from workers whose ``repochs[i] != epoch`` are zero-filled; the
     per-row-block freshness mask is ``pool.repochs == epoch`` (i.e. the
     value ``asyncmap`` returned) — callers needing staleness policy read
-    that, this function only stacks. Raises ``ValueError`` if no worker
-    has any result at all for the requested epoch.
+    that, this function only stacks. ``row_splits`` gives each worker's
+    row count when blocks are heterogeneous (load-balanced splits);
+    without it all blocks must be the same shape. Raises ``ValueError``
+    if no worker has any result at all for the requested epoch.
     """
     if epoch is None:
         epoch = pool.epoch
@@ -65,7 +72,13 @@ def gather_rows(pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
         if all(r is None for r in pool.results):
             raise ValueError("no worker has returned any result yet")
         raise ValueError(f"no worker has a result for epoch {epoch}")
-    out = [b if b is not None else np.zeros_like(proto) for b in blocks]
+    if row_splits is None:  # homogeneous blocks: all shaped like proto
+        row_splits = [proto.shape[0]] * pool.n_workers
+    out = [
+        b if b is not None
+        else np.zeros((row_splits[i], *proto.shape[1:]), proto.dtype)
+        for i, b in enumerate(blocks)
+    ]
     return np.concatenate(out, axis=0)
 
 
@@ -83,6 +96,7 @@ class DistributedGemm:
         A: np.ndarray,
         n_workers: int,
         *,
+        row_splits: Sequence[int] | None = None,
         devices: Sequence[jax.Device] | None = None,
         delay_fn: DelayFn | None = None,
         dtype=None,
@@ -94,20 +108,36 @@ class DistributedGemm:
         # accuracy. Benchmarks may pass precision=None for peak MXU rate.
         self.precision = precision
         m = A.shape[0]
-        if m % n_workers != 0:
-            raise ValueError(
-                f"rows {m} must divide evenly over {n_workers} workers"
-            )
+        if row_splits is None:
+            if m % n_workers != 0:
+                raise ValueError(
+                    f"rows {m} must divide evenly over {n_workers} workers "
+                    "(or pass row_splits)"
+                )
+            row_splits = [m // n_workers] * n_workers
+        else:
+            row_splits = [int(r) for r in row_splits]
+            if len(row_splits) != n_workers:
+                raise ValueError(
+                    f"row_splits has {len(row_splits)} entries for "
+                    f"{n_workers} workers"
+                )
+            if any(r < 0 for r in row_splits) or sum(row_splits) != m:
+                raise ValueError(
+                    f"row_splits must be non-negative and sum to {m}, "
+                    f"got {row_splits}"
+                )
         if devices is None:
             devices = jax.devices()
         if dtype is not None:
             A = np.asarray(A, dtype=dtype)
         self.n_workers = n_workers
-        self.block_rows = m // n_workers
+        self.row_splits = row_splits
+        offsets = np.concatenate([[0], np.cumsum(row_splits)])
         # place each row block on its worker's device once, up front
         self.blocks = [
             jax.device_put(
-                A[i * self.block_rows : (i + 1) * self.block_rows],
+                A[offsets[i] : offsets[i + 1]],
                 devices[i % len(devices)],
             )
             for i in range(n_workers)
@@ -116,8 +146,25 @@ class DistributedGemm:
             self._work, n_workers, devices=devices, delay_fn=delay_fn
         )
 
+    @classmethod
+    def load_balanced(
+        cls, A: np.ndarray, model, **kwargs
+    ) -> "DistributedGemm":
+        """Split rows proportional to fitted worker speed — the uncoded
+        straggler mitigation: slow workers get less work instead of
+        being raced (``model`` is a fitted
+        :class:`~..utils.straggle.PoolLatencyModel`).
+
+        >>> model.observe_pool(pool)       # ... over some epochs
+        >>> g = DistributedGemm.load_balanced(A, model)
+        """
+        splits = model.proportional_shares(A.shape[0])
+        return cls(
+            A, model.n_workers, row_splits=splits.tolist(), **kwargs
+        )
+
     def _work(self, i: int, payload: jax.Array, epoch: int) -> jax.Array:
         return _block_matmul(self.blocks[i], payload, precision=self.precision)
 
     def result(self, pool: AsyncPool, epoch: int | None = None) -> np.ndarray:
-        return gather_rows(pool, epoch)
+        return gather_rows(pool, epoch, row_splits=self.row_splits)

@@ -304,6 +304,24 @@ def test_external_workers_over_cli():
             p.wait(timeout=10)
 
 
+def test_direct_dispatch_snapshots_despite_mutation():
+    """Direct Backend-API use (no begin_epoch): every dispatch must
+    snapshot the payload at call time — in-place mutation between two
+    same-epoch dispatches must not leak cached bytes."""
+    backend = NativeProcessBackend(_echo, 2)
+    try:
+        buf = np.array([1.0])
+        backend.dispatch(0, buf, 1)
+        buf[0] = 2.0  # mutate before the second same-epoch dispatch
+        backend.dispatch(1, buf, 1)
+        r0 = backend.wait(0, timeout=30)
+        r1 = backend.wait(1, timeout=30)
+        assert np.asarray(r0)[1] == 1.0  # worker 0 saw pre-mutation value
+        assert np.asarray(r1)[1] == 2.0  # worker 1 saw the mutation
+    finally:
+        backend.shutdown()
+
+
 def test_dispatch_before_accept_raises_not_hangs():
     backend = NativeProcessBackend(
         None, 1, spawn=False, address="tcp://127.0.0.1:0", accept=False

@@ -163,3 +163,43 @@ def test_uncoded_gemm_fastest_k_masks_straggler_rows():
 def test_gemm_wrong_shape_errors():
     with pytest.raises(ValueError):
         DistributedGemm(np.zeros((10, 4)), 3)  # 10 rows not divisible by 3
+    with pytest.raises(ValueError, match="entries for"):
+        DistributedGemm(np.zeros((10, 4)), 3, row_splits=[5, 5])
+    with pytest.raises(ValueError, match="sum to 10"):
+        DistributedGemm(np.zeros((10, 4)), 3, row_splits=[5, 4, 2])
+
+
+def test_gemm_heterogeneous_row_splits():
+    """Load-balanced splits: unequal blocks, zero-row worker included."""
+    rng = np.random.default_rng(2)
+    A = rng.standard_normal((70, 24)).astype(np.float32)
+    B = rng.standard_normal((24, 8)).astype(np.float32)
+    splits = [40, 20, 10, 0]
+    g = DistributedGemm(A, 4, row_splits=splits)
+    pool = AsyncPool(4)
+    asyncmap(pool, B, g.backend, nwait=4)
+    C = g.result(pool)
+    assert C.shape == (70, 8)
+    assert np.allclose(C, A @ B, atol=1e-4)
+    g.backend.shutdown()
+
+
+def test_gemm_load_balanced_from_latency_model():
+    """Slow workers get proportionally fewer rows (the uncoded straggler
+    mitigation driven by the fitted latency model)."""
+    from mpistragglers_jl_tpu.utils import PoolLatencyModel
+
+    model = PoolLatencyModel(4)
+    for i, mean in enumerate([0.01, 0.01, 0.02, 0.08]):
+        for _ in range(5):
+            model.observe(i, mean)
+    rng = np.random.default_rng(3)
+    A = rng.standard_normal((88, 16)).astype(np.float32)
+    B = rng.standard_normal((16, 4)).astype(np.float32)
+    g = DistributedGemm.load_balanced(A, model)
+    assert sum(g.row_splits) == 88
+    assert g.row_splits[3] < g.row_splits[2] < g.row_splits[0]
+    pool = AsyncPool(4)
+    asyncmap(pool, B, g.backend, nwait=4)
+    assert np.allclose(g.result(pool), A @ B, atol=1e-4)
+    g.backend.shutdown()
