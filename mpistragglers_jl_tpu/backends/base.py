@@ -98,6 +98,12 @@ class Backend(ABC):
         Backends may use it to reset per-epoch state (e.g. the XLA
         backend's shared-payload snapshot cache)."""
 
+    def end_epoch(self) -> None:  # pragma: no cover - default no-op
+        """Called by ``asyncmap`` when the call finishes (including on
+        error). Backends disarm any per-epoch fast paths here so direct
+        Backend-API dispatches between calls see full snapshot
+        semantics."""
+
 
 class _Slot:
     """One in-flight task slot. At most one outstanding task per worker."""

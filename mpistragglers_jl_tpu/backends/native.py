@@ -160,6 +160,14 @@ class NativeProcessBackend(Backend):
         self._pick_bytes = b""
         self._pick_epoch = int(epoch)
 
+    def end_epoch(self) -> None:
+        # disarm: a direct dispatch AFTER asyncmap returns (e.g. manual
+        # re-task of a mutated buffer at the same epoch number) must
+        # re-serialize, preserving snapshot-at-dispatch semantics
+        self._pick_src = None
+        self._pick_bytes = b""
+        self._pick_epoch = None
+
     def _serialize(self, sendbuf, epoch: int) -> bytes:
         """Pickle the payload once per (object, epoch): asyncmap
         broadcasts ONE stable sendbuf to every idle worker per epoch

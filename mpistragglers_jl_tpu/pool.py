@@ -318,6 +318,7 @@ def asyncmap(
                 if tracer is not None:
                     tracer.dispatch(i, pool.epoch, retask=True)
     finally:
+        backend.end_epoch()
         if tracer is not None:
             tracer.end(pool)
     return pool.repochs

@@ -322,6 +322,23 @@ def test_direct_dispatch_snapshots_despite_mutation():
         backend.shutdown()
 
 
+def test_direct_dispatch_after_asyncmap_snapshots_mutation():
+    """The cache armed inside asyncmap must be disarmed when it returns:
+    a manual dispatch at the SAME epoch with a mutated buffer sees the
+    new bytes (end_epoch hook)."""
+    backend = NativeProcessBackend(_echo, 2)
+    try:
+        pool = AsyncPool(2)
+        buf = np.array([1.0])
+        asyncmap(pool, buf, backend, nwait=2)
+        buf[0] = 99.0
+        backend.dispatch(0, buf, pool.epoch)  # manual re-task, same epoch
+        r0 = backend.wait(0, timeout=30)
+        assert np.asarray(r0)[1] == 99.0
+    finally:
+        backend.shutdown()
+
+
 def test_dispatch_before_accept_raises_not_hangs():
     backend = NativeProcessBackend(
         None, 1, spawn=False, address="tcp://127.0.0.1:0", accept=False
