@@ -120,3 +120,11 @@ class XLADeviceBackend(MailboxBackend):
         self._payload_cache = {
             k: v for k, v in self._payload_cache.items() if k[1] == epoch
         }
+
+    def end_epoch(self) -> None:
+        # disarm the shared-payload cache when asyncmap returns: a direct
+        # dispatch of a mutated host buffer at the same epoch number must
+        # get a fresh device snapshot (same contract as the native
+        # backend; base.py end_epoch). Also drops the device payload
+        # reference so it isn't pinned between calls.
+        self._payload_cache = {}

@@ -121,6 +121,25 @@ def test_more_workers_than_devices():
     backend.shutdown()
 
 
+def test_direct_dispatch_after_asyncmap_snapshots_mutation():
+    """The epoch-keyed payload cache must disarm when asyncmap returns
+    (end_epoch): a manual same-epoch dispatch of a mutated host buffer
+    gets a fresh device snapshot, not the cached pre-mutation one."""
+    from mpistragglers_jl_tpu.backends.xla import XLADeviceBackend
+
+    backend = XLADeviceBackend(lambda i, p, e: p * 1.0, 2)
+    try:
+        pool = AsyncPool(2)
+        buf = np.array([1.0], dtype=np.float32)
+        asyncmap(pool, buf, backend, nwait=2)
+        buf[0] = 99.0
+        backend.dispatch(0, buf, pool.epoch)  # manual re-task, same epoch
+        result = backend.wait(0, timeout=30)
+        assert float(np.asarray(result)[0]) == 99.0
+    finally:
+        backend.shutdown()
+
+
 def test_uncoded_gemm_full():
     # BASELINE config 2 shape, scaled down for CI: row-block GEMM, nwait=n
     rng = np.random.default_rng(0)
