@@ -90,6 +90,7 @@ class XLADeviceBackend(MailboxBackend):
         # one H2D transfer; keyed by epoch so direct Backend-API users
         # dispatching fresh payloads at new epochs never see stale data.
         self._payload_cache: dict = {}
+        self._cache_armed = False
         super().__init__(
             n_workers, delay_fn=delay_fn, join_timeout=5.0,
             thread_name="xla-worker",
@@ -99,7 +100,14 @@ class XLADeviceBackend(MailboxBackend):
         # Asynchronous H2D (or D2D) transfer onto the worker's device.
         # jax arrays are immutable, so this IS the payload snapshot: the
         # caller may mutate a numpy sendbuf immediately after dispatch.
+        # The per-device cache is armed only between begin_epoch and
+        # end_epoch (inside asyncmap, where the single-threaded
+        # coordinator cannot mutate sendbuf mid-call); direct
+        # Backend-API dispatches always re-snapshot, same contract as
+        # the native backend.
         dev = self.devices[i]
+        if not self._cache_armed:
+            return jax.device_put(sendbuf, dev)
         key = (dev, epoch)
         payload = self._payload_cache.get(key)
         if payload is None:
@@ -115,16 +123,14 @@ class XLADeviceBackend(MailboxBackend):
         return jax.block_until_ready(result)
 
     def begin_epoch(self, epoch: int) -> None:
-        # drop snapshots from previous epochs (memory hygiene; the
-        # epoch-keyed entries would otherwise accumulate)
-        self._payload_cache = {
-            k: v for k, v in self._payload_cache.items() if k[1] == epoch
-        }
+        # arm the shared-payload cache for this asyncmap call
+        self._payload_cache = {}
+        self._cache_armed = True
 
     def end_epoch(self) -> None:
-        # disarm the shared-payload cache when asyncmap returns: a direct
-        # dispatch of a mutated host buffer at the same epoch number must
-        # get a fresh device snapshot (same contract as the native
-        # backend; base.py end_epoch). Also drops the device payload
-        # reference so it isn't pinned between calls.
+        # disarm when asyncmap returns: any later direct dispatch of a
+        # mutated host buffer must get a fresh device snapshot (same
+        # contract as the native backend; base.py end_epoch). Clearing
+        # also drops the device payload so it isn't pinned between calls.
         self._payload_cache = {}
+        self._cache_armed = False

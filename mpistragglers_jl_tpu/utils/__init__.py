@@ -10,6 +10,7 @@ from .trace import EpochTracer, EpochRecord, Event
 from .checkpoint import state_dict, load_state_dict, save, restore
 from .rs_gf256 import RSGF256
 from .straggle import AdaptiveNwait, PoolLatencyModel, WorkerStats
+from .coded_checkpoint import CodedCheckpoint, CheckpointCorrupt
 
 __all__ = [
     "faults",
@@ -24,6 +25,8 @@ __all__ = [
     "save",
     "restore",
     "RSGF256",
+    "CodedCheckpoint",
+    "CheckpointCorrupt",
     "TrainCheckpointer",
 ]
 
