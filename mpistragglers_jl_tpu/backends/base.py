@@ -37,6 +37,30 @@ DelayFn = Callable[[int, int], float]
 _SHUTDOWN = object()
 
 
+class Deadline:
+    """Shared remaining-time arithmetic for timeout-capable waits.
+
+    ``Deadline(None)`` never expires and ``remaining()`` stays None
+    (block forever); otherwise ``remaining()`` is clamped to >= 0 so it
+    can be handed to any wait primitive directly.
+    """
+
+    __slots__ = ("_at",)
+
+    def __init__(self, timeout: float | None):
+        self._at = (
+            None if timeout is None else time.perf_counter() + timeout
+        )
+
+    def remaining(self) -> float | None:
+        if self._at is None:
+            return None
+        return max(self._at - time.perf_counter(), 0.0)
+
+    def expired(self) -> bool:
+        return self._at is not None and time.perf_counter() >= self._at
+
+
 class WorkerFailure(RuntimeError):
     """A worker raised during compute; re-raised coordinator-side at
     harvest (the reference loses worker errors entirely — assertions die
@@ -80,9 +104,12 @@ class Backend(ABC):
         if worker ``i`` has completed, else None (``MPI.Test!``)."""
 
     @abstractmethod
-    def wait_any(self, indices: Sequence[int]) -> tuple[int, object]:
+    def wait_any(
+        self, indices: Sequence[int], timeout: float | None = None
+    ) -> tuple[int, object] | None:
         """Block until any worker in ``indices`` completes; return
-        ``(i, result)`` (``MPI.Waitany!``)."""
+        ``(i, result)`` (``MPI.Waitany!``), or None if ``timeout``
+        seconds elapse first."""
 
     @abstractmethod
     def wait(self, i: int, timeout: float | None = None):
@@ -171,17 +198,27 @@ class SlotBackend(Backend):
                 return self._take(slot)
             return None
 
-    def wait_any(self, indices: Sequence[int]) -> tuple[int, object]:
+    def wait_any(
+        self, indices: Sequence[int], timeout: float | None = None
+    ) -> tuple[int, object] | None:
         idx = [int(i) for i in indices]
         if not idx:
             raise ValueError("wait_any over an empty index set would hang")
+        ready: list[int] = []
+
+        def scan() -> bool:
+            for i in idx:
+                slot = self._slots[i]
+                if slot.outstanding and slot.done:
+                    ready.append(i)
+                    return True
+            return False
+
         with self._cond:
-            while True:
-                for i in idx:
-                    slot = self._slots[i]
-                    if slot.outstanding and slot.done:
-                        return i, self._take(slot)
-                self._cond.wait()
+            if not self._cond.wait_for(scan, timeout=timeout):
+                return None
+            i = ready[-1]
+            return i, self._take(self._slots[i])
 
     def wait(self, i: int, timeout: float | None = None):
         with self._cond:

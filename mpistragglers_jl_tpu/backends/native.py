@@ -21,7 +21,6 @@ from __future__ import annotations
 import multiprocessing as mp
 import pickle
 import tempfile
-import time
 import uuid
 from pathlib import Path
 from typing import Sequence
@@ -29,7 +28,7 @@ from typing import Sequence
 import numpy as np
 
 from ..native import transport as T
-from .base import Backend, DelayFn, WorkerError
+from .base import Backend, Deadline, DelayFn, WorkerError
 from .process import RemoteWorkerError, WorkerProcessDied, WorkFn
 
 __all__ = ["NativeProcessBackend"]
@@ -234,14 +233,10 @@ class NativeProcessBackend(Backend):
         self._check_ready()
         if self._synthetic[i] is not None:
             return self._pop_synthetic(i)
-        deadline = None if timeout is None else time.perf_counter() + timeout
+        deadline = Deadline(timeout)
         while True:
             if block:
-                left = (
-                    None if deadline is None
-                    else max(deadline - time.perf_counter(), 0.0)
-                )
-                got = self._coord.waitany([i], timeout=left)
+                got = self._coord.waitany([i], timeout=deadline.remaining())
                 if got is None:
                     return None  # timeout
                 _, msg = got
@@ -257,7 +252,9 @@ class NativeProcessBackend(Backend):
     def test(self, i: int):
         return self._next(i, block=False)
 
-    def wait_any(self, indices: Sequence[int]) -> tuple[int, object]:
+    def wait_any(
+        self, indices: Sequence[int], timeout: float | None = None
+    ) -> tuple[int, object] | None:
         self._check_ready()
         idx = [int(j) for j in indices]
         if not idx:
@@ -265,9 +262,11 @@ class NativeProcessBackend(Backend):
         for j in idx:  # synthetic failures first — they're already complete
             if self._synthetic[j] is not None:
                 return j, self._pop_synthetic(j)
+        deadline = Deadline(timeout)
         while True:
-            got = self._coord.waitany(idx, timeout=None)
-            assert got is not None  # no timeout passed
+            got = self._coord.waitany(idx, timeout=deadline.remaining())
+            if got is None:
+                return None  # timed out
             j, msg = got
             if msg.kind in (T.KIND_DATA, T.KIND_ERROR) and msg.seq != self._seqs[j]:
                 continue

@@ -50,7 +50,7 @@ from typing import TYPE_CHECKING, Callable, Sequence, Union
 
 import numpy as np
 
-from .backends.base import Backend, WorkerError
+from .backends.base import Backend, Deadline, WorkerError
 
 if TYPE_CHECKING:  # runtime import would be circular (utils -> pool)
     from .utils.trace import EpochTracer
@@ -220,6 +220,7 @@ def asyncmap(
     nwait: NwaitArg | None = None,
     epoch: int | None = None,
     tag: int = 0,
+    timeout: float | None = None,
     tracer: "EpochTracer | None" = None,
 ) -> np.ndarray:
     """Broadcast ``sendbuf`` to all idle workers; wait for the fastest few.
@@ -238,6 +239,12 @@ def asyncmap(
     docstring contract :48-67; the returned array aliases ``pool.repochs``
     like the reference (:187) — callers must copy if they retain it across
     epochs (test/kmap2.jl relies on reading it before the next call).
+
+    ``timeout`` (seconds, new capability — the reference's phase-3
+    ``Waitany!`` blocks forever when ``nwait`` is unsatisfiable): bounds
+    the whole call; on expiry a :class:`DeadWorkerError` names the
+    workers still outstanding. The pool stays usable — tardy workers
+    remain active and their late results are drained by later calls.
     """
     n = pool.n_workers
     if nwait is None:
@@ -295,6 +302,7 @@ def asyncmap(
         # with the current epoch count toward integer-nwait completion;
         # stale arrivals trigger an immediate re-task and the worker
         # stays active.
+        deadline = Deadline(timeout)
         nrecv = 0
         while True:
             if callable(nwait):
@@ -305,7 +313,14 @@ def asyncmap(
                     break
             # block until any active worker responds
             # (reference MPI.Waitany! at src/MPIAsyncPools.jl:161)
-            i, result = backend.wait_any(np.flatnonzero(pool.active))
+            got = backend.wait_any(
+                np.flatnonzero(pool.active), timeout=deadline.remaining()
+            )
+            if got is None:
+                raise DeadWorkerError(
+                    [int(j) for j in np.flatnonzero(pool.active)], timeout
+                )
+            i, result = got
             _store(pool, i, result, recvbufs)
             fresh = pool.repochs[i] == pool.epoch
             if tracer is not None:
@@ -350,12 +365,9 @@ def waitall(
         # nwait field = number of workers actually being drained
         tracer.begin("waitall", pool.epoch, int(pool.active.sum()))
     try:
-        deadline = None if timeout is None else time.perf_counter() + timeout
+        deadline = Deadline(timeout)
         for i in list(np.flatnonzero(pool.active)):
-            remaining = (
-                None if deadline is None else deadline - time.perf_counter()
-            )
-            result = backend.wait(i, timeout=remaining)
+            result = backend.wait(i, timeout=deadline.remaining())
             if result is None:
                 dead = [int(j) for j in np.flatnonzero(pool.active)]
                 raise DeadWorkerError(dead, timeout)
@@ -372,7 +384,8 @@ def waitall(
 
 
 class DeadWorkerError(TimeoutError):
-    """Raised by :func:`waitall` when workers fail to respond in time.
+    """Raised by :func:`asyncmap` (with ``timeout=``) and
+    :func:`waitall` when workers fail to respond in time.
 
     The reference has no failure detection: a dead worker is
     indistinguishable from an infinite straggler and ``waitall!`` hangs

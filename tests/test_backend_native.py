@@ -391,6 +391,28 @@ def test_undeserializable_payload_ships_error_not_dead_worker():
         backend.shutdown()
 
 
+def test_asyncmap_timeout_over_native_transport():
+    from mpistragglers_jl_tpu import DeadWorkerError
+
+    n = 2
+    backend = NativeProcessBackend(
+        _echo, n, delay_fn=StragglerDelay(1, slow=0.8)
+    )
+    try:
+        pool = AsyncPool(n)
+        with pytest.raises(DeadWorkerError) as excinfo:
+            asyncmap(pool, np.zeros(1), backend, nwait=n, timeout=0.2)
+        # worker 0's first round-trip may also miss the window on a
+        # loaded machine; only the straggler is guaranteed outstanding
+        assert 1 in excinfo.value.dead
+        waitall(pool, backend)  # drains the tardy worker(s); pool reusable
+        repochs = asyncmap(pool, np.zeros(1), backend, nwait=1)
+        assert int((repochs == pool.epoch).sum()) >= 1
+        waitall(pool, backend)
+    finally:
+        backend.shutdown()
+
+
 def test_resolve_callable():
     from mpistragglers_jl_tpu.worker import resolve_callable
 

@@ -325,3 +325,36 @@ def test_results_stay_available_without_recvbuf():
     for i in range(n):
         assert pool.results[i][1] == 5.0
     backend.shutdown()
+
+
+class TestAsyncmapTimeout:
+    """asyncmap(timeout=...): bounded phase-3 wait (the reference's
+    Waitany! blocks forever when nwait is unsatisfiable, SURVEY §5)."""
+
+    def test_timeout_raises_and_pool_recovers(self):
+        n = 3
+        pool, backend = make(
+            n, delay_fn=lambda i, e: 0.6 if i == 2 else 0.0
+        )
+        try:
+            with pytest.raises(DeadWorkerError) as excinfo:
+                asyncmap(pool, np.zeros(1), backend, nwait=n, timeout=0.15)
+            assert excinfo.value.dead == [2]
+            assert pool.active[2]  # tardy worker still tasked
+            # pool stays usable: the late result is drained later
+            waitall(pool, backend)
+            assert not pool.active.any()
+            repochs = asyncmap(pool, np.zeros(1), backend, nwait=2)
+            assert int((repochs == pool.epoch).sum()) >= 2
+        finally:
+            backend.shutdown()
+
+    def test_no_timeout_when_satisfied_in_time(self):
+        pool, backend = make(2)
+        try:
+            repochs = asyncmap(
+                pool, np.zeros(1), backend, nwait=2, timeout=5.0
+            )
+            assert list(repochs) == [1, 1]
+        finally:
+            backend.shutdown()
