@@ -57,8 +57,6 @@ class Deadline:
             return None
         return max(self._at - time.perf_counter(), 0.0)
 
-    def expired(self) -> bool:
-        return self._at is not None and time.perf_counter() >= self._at
 
 
 class WorkerFailure(RuntimeError):

@@ -39,6 +39,7 @@ __all__ = [
     "straggler",
     "intermittent",
     "dead_from",
+    "from_trace",
     "compose",
     "failing",
     "FaultSchedule",
@@ -142,6 +143,66 @@ def dead_from(workers: int | Sequence[int], epoch: int, *, delay: float = 3600.0
     return (
         lambda worker, e: float(delay) if worker in ws and e >= epoch else 0.0
     )
+
+
+class from_trace:
+    """Replay recorded per-worker latencies as a delay schedule.
+
+    Closes the record -> replay loop: run a workload with an
+    :class:`~.trace.EpochTracer`, ``dump_jsonl`` it, then re-create the
+    same straggler pattern deterministically in any backend —
+    reproducing a production incident under the thread backend, or
+    A/B-ing scheduler changes (e.g. ``AdaptiveNwait``) against the
+    exact latency pattern that hurt.
+
+    Arrival times in the trace are measured *round-trips* (dispatch ->
+    arrival, the reference's ``pool.latency`` quantity); replaying them
+    as injected stalls reproduces the pattern up to the (small) true
+    compute time of the replay workload. Workers/epochs absent from the
+    trace (never arrived — e.g. still straggling at the end) replay as
+    ``missing`` seconds (default: 10x the largest recorded latency).
+
+    A class (not a closure) so it pickles into process-backend workers.
+    """
+
+    def __init__(self, path, *, missing: float | None = None):
+        import json
+
+        by_key: dict[tuple[int, int], float] = {}
+        longest = 0.0
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                dispatched: dict[int, float] = {}
+                for ev in rec.get("events", []):
+                    w = int(ev["worker"])
+                    if ev["kind"] in ("dispatch", "retask"):
+                        dispatched[w] = float(ev["t"])
+                    elif ev["kind"] in ("arrival", "drain"):
+                        t0 = dispatched.pop(w, None)
+                        if t0 is not None:
+                            lat = float(ev["t"]) - t0
+                        else:
+                            # dispatched in an earlier record (cross-
+                            # epoch straggle): the record's latency
+                            # snapshot holds this worker's measured
+                            # round-trip (reference pool.latency field)
+                            try:
+                                lat = float(rec["latency_s"][w])
+                            except (KeyError, IndexError):
+                                continue
+                        by_key[(w, int(ev["epoch"]))] = lat
+                        longest = max(longest, lat)
+        self._by_key = by_key
+        # floor the default so a trace with no computable round-trips
+        # (all workers stalled/dead) still replays absences as stalls,
+        # never as instant workers
+        self._missing = (
+            max(10.0 * longest, 1.0) if missing is None else float(missing)
+        )
+
+    def __call__(self, worker: int, epoch: int) -> float:
+        return self._by_key.get((worker, epoch), self._missing)
 
 
 def compose(*fns: DelayFn) -> DelayFn:

@@ -150,6 +150,51 @@ class TestTracer:
         assert len(lines) == 5
         assert all(len(rec["events"]) == 6 for rec in lines)
 
+    def test_trace_replay_reproduces_straggler_pattern(self, tmp_path):
+        """record -> dump_jsonl -> faults.from_trace -> replay: the
+        replayed run shows the same straggler (same worker slow, same
+        ordering of arrivals) as the recorded one."""
+        n = 3
+        record_delays = faults.per_worker([0.002, 0.002, 0.08])
+        backend = LocalBackend(echo_work, n, delay_fn=record_delays)
+        tracer = EpochTracer()
+        try:
+            pool = AsyncPool(n)
+            for _ in range(4):
+                asyncmap(pool, np.zeros(1), backend, nwait=2, tracer=tracer)
+            waitall(pool, backend, tracer=tracer)
+        finally:
+            backend.shutdown()
+        path = tmp_path / "trace.jsonl"
+        tracer.dump_jsonl(path)
+
+        replay = faults.from_trace(path)
+        # recorded latencies resurface keyed by (worker, epoch)
+        assert replay(2, 1) == pytest.approx(0.08, abs=0.02)
+        assert replay(0, 1) == pytest.approx(0.002, abs=0.01)
+        # unknown (worker, epoch) replays as a long stall, not zero
+        assert replay(0, 999) > 0.08
+
+        backend2 = LocalBackend(echo_work, n, delay_fn=replay)
+        tracer2 = EpochTracer()
+        try:
+            pool2 = AsyncPool(n)
+            for _ in range(4):
+                asyncmap(
+                    pool2, np.zeros(1), backend2, nwait=2, tracer=tracer2
+                )
+            waitall(pool2, backend2, tracer=tracer2)
+        finally:
+            backend2.shutdown()
+        # same straggler in the replay: worker 2 never fresh inside its
+        # epoch during the nwait=2 phase
+        for r in tracer2.records:
+            if r.call == "asyncmap":
+                assert r.repochs[0] == r.epoch and r.repochs[1] == r.epoch
+        assert tracer2.summary()["straggler_rate"] == pytest.approx(
+            tracer.summary()["straggler_rate"], abs=0.2
+        )
+
     def test_chrome_trace_export(self, tmp_path):
         backend = LocalBackend(
             echo_work, 3,
