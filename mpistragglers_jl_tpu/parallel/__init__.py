@@ -1,6 +1,7 @@
 _HOME = {
     "make_mesh": "mesh",
     "MeshCodedGemm": "mesh_gemm",
+    "MeshMatDotGemm": "mesh_gemm",
     "distributed_mds_decode": "collectives",
     "masked_psum_scatter_combine": "collectives",
     "ring_allgather": "collectives",

@@ -24,9 +24,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.coding import MDSCode
+from ..ops.matdot import MatDotCode, _matdot_worker
 from .collectives import distributed_mds_decode
 
-__all__ = ["MeshCodedGemm"]
+__all__ = ["MeshCodedGemm", "MeshMatDotGemm"]
 
 
 class MeshCodedGemm:
@@ -91,3 +92,103 @@ class MeshCodedGemm:
         """Host gather of the first k decoded blocks -> (m, cols)."""
         out = np.asarray(decoded)  # (n, m/k, cols)
         return out[: self.k].reshape(-1, out.shape[-1])
+
+
+class MeshMatDotGemm:
+    """MatDot-coded ``C = A @ B`` as sharded mesh programs: the decode
+    is ONE weighted ``psum`` over the mesh axis.
+
+    MatDot's linear-functional decode (``C = Σ_i w_i C̃_i``, see
+    ops/matdot.py) is the best-case shape for an ICI collective: each
+    device scales its local evaluation by its decode weight and a single
+    ``psum`` over the axis yields the full product — stale/straggling
+    devices contribute with weight 0 exactly like the masked MDS
+    combine, with no per-arrival-pattern recompilation (weights are a
+    runtime array, shapes static).
+
+    * **map**: device i computes ``Ã_i @ B̃_i`` with its resident A
+      evaluation and a B̃ encoded on-device from the replicated B — no
+      cross-device traffic;
+    * **decode**: weights from the host-side 2p-1 × 2p-1 solve (tiny,
+      float64, cached per arrival pattern), then ``psum(w_i * C̃_i)``.
+
+    >>> mg = MeshMatDotGemm(A, mesh, p=2)
+    >>> C = mg.epoch(B, repochs, epoch)      # (m, cols), replicated
+    """
+
+    def __init__(
+        self,
+        A: np.ndarray,
+        mesh: Mesh,
+        p: int,
+        *,
+        axis: str = "w",
+        precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
+    ):
+        n = mesh.shape[axis]
+        m, kd = A.shape
+        if kd % p != 0:
+            raise ValueError(
+                f"inner dim {kd} must divide evenly into p={p} blocks"
+            )
+        self.mesh = mesh
+        self.axis = axis
+        self.code = MatDotCode(p, n, dtype=A.dtype, precision=precision)
+        self.p, self.n, self.k = p, n, self.code.k
+        self.precision = precision
+        blocks = jnp.asarray(A).reshape(m, p, kd // p).transpose(1, 0, 2)
+        coded = self.code.encode_A(blocks)  # (n, m, kd/p)
+        self.A_evals = jax.device_put(
+            coded, NamedSharding(mesh, P(axis)))  # evaluation i on device i
+        self.B_weights = jax.device_put(
+            jnp.asarray(self.code.VB), NamedSharding(mesh, P(axis))
+        )  # (n, p) encode weights, row i on device i
+
+        prec = precision
+        pp = p
+
+        def _epoch(A_eval, wB, B, wC):
+            # A_eval: (1, m, kd/p) local; wB: (1, p); B replicated
+            # (kd, cols); wC: (n,) decode weights (replicated). The
+            # local B-encode + matmul is the pool path's worker program
+            # (ops/matdot._matdot_worker) — one source of truth.
+            Ct = _matdot_worker(A_eval[0], wB[0], B, pp, prec)
+            i = jax.lax.axis_index(self.axis)
+            return jax.lax.psum(wC[i] * Ct, self.axis)
+
+        self._epoch = jax.jit(jax.shard_map(
+            _epoch, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(), P()),
+            out_specs=P(),
+        ))
+        self._weights_cache: dict[tuple, np.ndarray] = {}
+
+    def decode_weights(self, repochs, epoch: int) -> np.ndarray:
+        """Per-device combine weights from the arrival mask: the first
+        2p-1 fresh devices carry the interpolation weights, everyone
+        else 0."""
+        fresh = np.flatnonzero(np.asarray(repochs) == epoch)
+        if fresh.size < self.k:
+            raise ValueError(
+                f"only {fresh.size} fresh shards, need 2p-1={self.k}"
+            )
+        sel = tuple(int(x) for x in fresh[: self.k])
+        w = self._weights_cache.get(sel)
+        if w is None:
+            w = np.zeros(self.n)
+            w[list(sel)] = self.code.decode_weights(list(sel))
+            self._weights_cache[sel] = w
+        return w
+
+    def epoch(self, B, repochs=None, epoch: int = 0) -> jax.Array:
+        """One coded epoch: on-device B encode + local matmul + one
+        weighted psum. Returns the full (m, cols) product, replicated."""
+        if repochs is None:
+            repochs = np.full(self.n, epoch)
+        w = self.decode_weights(repochs, epoch)
+        B = jax.device_put(jnp.asarray(B), NamedSharding(self.mesh, P()))
+        wC = jax.device_put(
+            jnp.asarray(w, dtype=B.dtype),
+            NamedSharding(self.mesh, P()),
+        )
+        return self._epoch(self.A_evals, self.B_weights, B, wC)

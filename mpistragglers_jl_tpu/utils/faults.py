@@ -159,8 +159,9 @@ class from_trace:
     arrival, the reference's ``pool.latency`` quantity); replaying them
     as injected stalls reproduces the pattern up to the (small) true
     compute time of the replay workload. Workers/epochs absent from the
-    trace (never arrived — e.g. still straggling at the end) replay as
-    ``missing`` seconds (default: 10x the largest recorded latency).
+    trace replay with that worker's median recorded latency; workers
+    never heard from at all replay as ``missing`` seconds (default: 10x
+    the largest recorded latency), so absences stay stalls.
 
     A class (not a closure) so it pickles into process-backend workers.
     """
@@ -194,6 +195,16 @@ class from_trace:
                         by_key[(w, int(ev["epoch"]))] = lat
                         longest = max(longest, lat)
         self._by_key = by_key
+        # per-worker typical latency: the fallback when replay dispatch
+        # epochs drift from the recorded ones (e.g. A/B-ing a different
+        # nwait shifts when workers go idle) — the worker still replays
+        # with ITS characteristic speed rather than the missing stall
+        per_worker: dict[int, list[float]] = {}
+        for (w, _e), lat in by_key.items():
+            per_worker.setdefault(w, []).append(lat)
+        self._per_worker = {
+            w: float(np.median(v)) for w, v in per_worker.items()
+        }
         # floor the default so a trace with no computable round-trips
         # (all workers stalled/dead) still replays absences as stalls,
         # never as instant workers
@@ -202,7 +213,10 @@ class from_trace:
         )
 
     def __call__(self, worker: int, epoch: int) -> float:
-        return self._by_key.get((worker, epoch), self._missing)
+        exact = self._by_key.get((worker, epoch))
+        if exact is not None:
+            return exact
+        return self._per_worker.get(worker, self._missing)
 
 
 def compose(*fns: DelayFn) -> DelayFn:

@@ -115,3 +115,44 @@ class TestMeshCodedGemm:
         decoded = mg.epoch(B, epoch=1)
         # decoded is sharded over the mesh, not gathered
         assert len(decoded.sharding.device_set) == 4
+
+
+class TestMeshMatDotGemm:
+    """MatDot on the mesh: decode = one weighted psum over the axis
+    (parallel/mesh_gemm.py MeshMatDotGemm)."""
+
+    def _setup(self, p=2, n=8):
+        from mpistragglers_jl_tpu.parallel import MeshMatDotGemm, make_mesh
+
+        rng = np.random.default_rng(0)
+        m, kd, cols = 16, 8 * p, 12
+        A = rng.standard_normal((m, kd)).astype(np.float32)
+        B = rng.standard_normal((kd, cols)).astype(np.float32)
+        mesh = make_mesh(n)
+        return MeshMatDotGemm(A, mesh, p=p), A, B
+
+    def test_full_arrival_exact(self):
+        mg, A, B = self._setup()
+        C = np.asarray(mg.epoch(B, epoch=1))
+        scale = float(np.max(np.abs(A @ B)))
+        assert float(np.max(np.abs(C - A @ B))) / scale < 1e-4
+
+    def test_straggler_masked_weighted_psum(self):
+        mg, A, B = self._setup()
+        # epochs stamped: devices 2 and 6 stale -> weight 0 in the psum
+        repochs = np.full(8, 5)
+        repochs[[2, 6]] = 4
+        C = np.asarray(mg.epoch(B, repochs, epoch=5))
+        scale = float(np.max(np.abs(A @ B)))
+        assert float(np.max(np.abs(C - A @ B))) / scale < 1e-4
+        # weights: zeros exactly on stale devices, 2p-1 nonzero
+        w = mg.decode_weights(repochs, 5)
+        assert w[2] == 0 and w[6] == 0
+        assert np.count_nonzero(w) == mg.k
+
+    def test_below_threshold_refuses(self):
+        mg, A, B = self._setup(p=4, n=8)  # k = 7
+        repochs = np.full(8, 1)
+        repochs[:2] = 0  # only 6 fresh < 7
+        with pytest.raises(ValueError, match="need 2p-1=7"):
+            mg.epoch(B, repochs, epoch=1)

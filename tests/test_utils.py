@@ -170,10 +170,15 @@ class TestTracer:
 
         replay = faults.from_trace(path)
         # recorded latencies resurface keyed by (worker, epoch)
-        assert replay(2, 1) == pytest.approx(0.08, abs=0.02)
-        assert replay(0, 1) == pytest.approx(0.002, abs=0.01)
-        # unknown (worker, epoch) replays as a long stall, not zero
-        assert replay(0, 999) > 0.08
+        # clearly the straggler, but below the missing floor (one-
+        # sided: sleep overshoot on loaded CI only pushes it up a bit)
+        assert 0.06 <= replay(2, 1) <= 0.5
+        assert replay(0, 1) < 0.05
+        # unknown epochs fall back to the worker's median latency
+        assert 0.06 <= replay(2, 999) <= 0.5
+        assert replay(0, 999) < 0.05
+        # unknown workers replay as a long stall, not zero
+        assert replay(7, 1) >= 1.0
 
         backend2 = LocalBackend(echo_work, n, delay_fn=replay)
         tracer2 = EpochTracer()
