@@ -1,0 +1,94 @@
+"""Adaptive vs fixed nwait under a drifting straggler pattern.
+
+The reference hard-codes ``nwait`` everywhere (test/kmap2.jl:32 etc.);
+this measures what that costs when the straggler MOVES. Workload: n=8
+thread workers, 5 ms base latency; the straggler (75 ms) rotates to a
+different worker every 20 epochs. Policies:
+
+* ``full gather``   — nwait = 8 (pays the straggler every epoch)
+* ``fixed k=6``     — the right constant for this fault pattern, if you
+                      somehow knew it in advance
+* ``adaptive``      — AdaptiveNwait with kmin=4, learning online
+
+Metric: mean epoch wall-clock per policy over 100 epochs (+ fresh
+results per epoch, since waiting for fewer buys time but less data).
+Prints one JSON line per policy. CPU-only (threads), deterministic.
+
+Run:  python benchmarks/adaptive_nwait_bench.py [epochs]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mpistragglers_jl_tpu import AsyncPool, LocalBackend, asyncmap, waitall
+from mpistragglers_jl_tpu.utils import AdaptiveNwait
+
+N = 8
+BASE_S = 0.005
+STRAGGLE_S = 0.075
+ROTATE_EVERY = 20
+
+
+class RotatingStraggler:
+    """The straggler moves to worker (epoch // ROTATE_EVERY) % N."""
+
+    def __call__(self, worker: int, epoch: int) -> float:
+        hot = (epoch // ROTATE_EVERY) % N
+        return STRAGGLE_S if worker == hot else BASE_S
+
+
+def run_policy(name: str, epochs: int):
+    backend = LocalBackend(
+        lambda i, p, e: p + i, N, delay_fn=RotatingStraggler()
+    )
+    ctl = (
+        AdaptiveNwait(N, kmin=4, min_samples=2, refit_every=5, seed=0)
+        if name == "adaptive"
+        else None
+    )
+    fixed = (
+        None if ctl is not None
+        else {"full-gather": N, "fixed-k6": 6}[name]  # unknown: fail fast
+    )
+    try:
+        pool = AsyncPool(N)
+        walls, fresh_counts = [], []
+        # the straggler rotation keys off pool.epoch (advanced inside
+        # asyncmap), not a loop counter
+        for _ in range(epochs):
+            nwait = ctl.nwait if ctl is not None else fixed
+            t0 = time.perf_counter()
+            asyncmap(pool, np.zeros(1), backend, nwait=nwait)
+            walls.append(time.perf_counter() - t0)
+            fresh_counts.append(int(pool.fresh_indices().size))
+            if ctl is not None:
+                ctl.observe(pool)
+        waitall(pool, backend)
+        return {
+            "metric": f"adaptive-nwait-{name}",
+            "value": round(float(np.mean(walls)) * 1e3, 2),
+            "unit": "ms/epoch",
+            "fresh_mean": round(float(np.mean(fresh_counts)), 2),
+            "epochs": epochs,
+            "final_nwait": nwait,
+        }
+    finally:
+        backend.shutdown()
+
+
+def main():
+    epochs = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    for name in ("full-gather", "fixed-k6", "adaptive"):
+        print(json.dumps(run_policy(name, epochs)))
+
+
+if __name__ == "__main__":
+    main()
