@@ -74,11 +74,14 @@ def run_policy(name: str, epochs: int):
         waitall(pool, backend)
         return {
             "metric": f"adaptive-nwait-{name}",
-            "value": round(float(np.mean(walls)) * 1e3, 2),
+            "value": round(float(np.mean(walls)) * 1e3, 2) if walls else None,
             "unit": "ms/epoch",
-            "fresh_mean": round(float(np.mean(fresh_counts)), 2),
+            "fresh_mean": (
+                round(float(np.mean(fresh_counts)), 2) if fresh_counts else None
+            ),
             "epochs": epochs,
-            "final_nwait": nwait,
+            # the controller's state AFTER its last observe/refit
+            "final_nwait": ctl.nwait if ctl is not None else fixed,
         }
     finally:
         backend.shutdown()

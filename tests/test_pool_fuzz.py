@@ -1,0 +1,142 @@
+"""Seeded invariant fuzzer for the pool state machine.
+
+Random (but reproducible) sequences of ``asyncmap`` / ``waitall`` calls
+with random nwait forms, epochs, delays, and recvbuf usage, checked
+after every step against the reference's §2.1 invariants (SURVEY):
+
+* ``active[i]`` ⇔ the backend owes worker i a result;
+* ``repochs[i] == epoch0`` iff never heard from i (results[i] is None);
+* fresh_indices ⊆ workers heard from, all stamped with the current epoch;
+* after integer-nwait asyncmap, >= nwait workers are fresh AND inactive;
+* after waitall, nobody is active;
+* recvbuf chunks of fresh workers hold exactly that worker's payload
+  echo (chunk-j <- worker-j, the MPI.Gather! layout);
+* latency entries are non-negative and only set for heard-from workers.
+
+The reference has nothing like this (its tests are 3 fixed scenarios);
+a state machine whose edge cases are its whole reason to exist deserves
+adversarial sequences.
+"""
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import (
+    AsyncPool,
+    LocalBackend,
+    asyncmap,
+    waitall,
+)
+
+
+def echo(i, payload, epoch):
+    # [worker+1, payload echo, epoch] — checkable provenance per chunk
+    return np.array([float(i + 1), float(payload[0]), float(epoch)])
+
+
+class SeededDelays:
+    def __init__(self, seed):
+        self.rng = np.random.default_rng(seed)
+        self.table = {}
+
+    def __call__(self, i, epoch):
+        key = (i, epoch)
+        if key not in self.table:
+            # mostly fast, occasional 30-60 ms straggle
+            r = self.rng.random()
+            self.table[key] = 0.03 + 0.03 * r if r > 0.8 else 0.001
+        return self.table[key]
+
+
+def check_invariants(pool, epoch0):
+    heard = np.array([r is not None for r in pool.results])
+    never = pool.repochs == epoch0
+    # repochs == epoch0 means never heard from (the fuzzer's live epochs
+    # are all > epoch0, so the implication is exact here)
+    assert not heard[never].any()
+    for i in np.flatnonzero(~heard):
+        assert pool.repochs[i] == epoch0
+        assert pool.latency[i] == 0.0
+    fresh = pool.fresh_indices()
+    assert np.all(heard[fresh])
+    assert np.all(pool.repochs[fresh] == pool.epoch)
+    assert np.all(pool.latency[np.flatnonzero(heard)] >= 0)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_op_sequences_hold_invariants(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(2, 7))
+    epoch0 = int(rng.integers(-3, 4)) * 10  # exercise epoch0 != 0
+    backend = LocalBackend(echo, n, delay_fn=SeededDelays(seed))
+    try:
+        pool = AsyncPool(n, epoch0=epoch0)
+        payload = np.zeros(1)
+        for step in range(25):
+            op = rng.random()
+            use_recv = rng.random() < 0.5
+            recvbuf = np.zeros(3 * n) if use_recv else None
+            if op < 0.70:  # asyncmap with random nwait form
+                payload[0] = float(step + 1)
+                form = rng.random()
+                if form < 0.5:
+                    nwait = int(rng.integers(0, n + 1))
+                elif form < 0.8:
+                    # wait for one specific worker
+                    target = int(rng.integers(0, n))
+                    nwait = (
+                        lambda e, rep, t=target: rep[t] == e
+                    )
+                else:
+                    nwait = n  # full gather
+                repochs = asyncmap(
+                    pool, payload, backend, recvbuf, nwait=nwait
+                )
+                assert repochs is pool.repochs  # aliasing contract
+                if isinstance(nwait, int):
+                    fresh_inactive = (
+                        (pool.repochs == pool.epoch) & ~pool.active
+                    )
+                    assert int(fresh_inactive.sum()) >= nwait
+                if use_recv:
+                    chunks = recvbuf.reshape(n, 3)
+                    for i in pool.fresh_indices():
+                        assert chunks[i][0] == i + 1  # provenance
+                        assert chunks[i][2] == pool.epoch  # epoch echo
+            else:  # waitall (sometimes with a generous timeout)
+                t = 10.0 if rng.random() < 0.5 else None
+                waitall(pool, backend, recvbuf, timeout=t)
+                assert not pool.active.any()
+            check_invariants(pool, epoch0)
+        waitall(pool, backend)
+        assert not pool.active.any()
+    finally:
+        backend.shutdown()
+
+
+def test_fuzz_with_retask_pressure():
+    """High straggle + nwait=1 maximizes the stale-harvest/re-task path
+    (reference src/MPIAsyncPools.jl:177-184); every stale chunk written
+    to recvbuf must still satisfy the echo contract for ITS epoch."""
+    n = 3
+    backend = LocalBackend(
+        echo, n, delay_fn=lambda i, e: 0.04 if i != 0 else 0.0
+    )
+    try:
+        pool = AsyncPool(n)
+        recvbuf = np.zeros(3 * n)
+        payload = np.zeros(1)
+        for epoch in range(1, 15):
+            payload[0] = epoch
+            repochs = asyncmap(pool, payload, backend, recvbuf, nwait=1)
+            chunks = recvbuf.reshape(n, 3)
+            for i in range(n):
+                if pool.results[i] is None:
+                    continue
+                # chunk holds the payload of the epoch it is stamped with
+                assert chunks[i][1] == float(repochs[i])
+                assert chunks[i][2] == float(repochs[i])
+        waitall(pool, backend, recvbuf)
+        assert not pool.active.any()
+    finally:
+        backend.shutdown()
