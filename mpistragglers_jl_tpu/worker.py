@@ -95,16 +95,39 @@ def resolve_callable(spec: str):
     return obj
 
 
+def parse_ranks(spec: str) -> list[int]:
+    """Parse a rank spec: ``3``, ``0-7``, or ``0,2,5-7``."""
+    out: list[int] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            lo, hi = int(lo), int(hi)
+            if hi < lo:
+                raise ValueError(f"descending rank range {part!r}")
+            out.extend(range(lo, hi + 1))
+        else:
+            out.append(int(part))
+    if len(set(out)) != len(out):
+        raise ValueError(f"duplicate ranks in {spec!r}")
+    return out
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(
         prog="python -m mpistragglers_jl_tpu.worker",
-        description="Serve one pool worker over the native transport.",
+        description="Serve pool worker(s) over the native transport.",
     )
     ap.add_argument(
         "--address", required=True,
         help="coordinator address: tcp://host:port or a unix socket path",
     )
-    ap.add_argument("--rank", type=int, required=True, help="pool index")
+    ap.add_argument(
+        "--rank", "--ranks", dest="ranks", required=True,
+        help="pool index, range, or list: '3', '0-7', '0,2,5-7' — one "
+        "worker process per rank (a host serving several ranks needs "
+        "only one command)",
+    )
     ap.add_argument(
         "--work", required=True,
         help="work function as module:attribute, "
@@ -115,11 +138,66 @@ def main(argv=None) -> None:
         help="optional delay_fn as module:attribute (straggler injection)",
     )
     args = ap.parse_args(argv)
+    ranks = parse_ranks(args.ranks)
+    # resolve in the parent too: a typo'd spec fails fast, before spawn
+    work_fn = resolve_callable(args.work)
+    delay_fn = resolve_callable(args.delay) if args.delay else None
+    if len(ranks) == 1:
+        run_worker(args.address, ranks[0], work_fn, delay_fn)
+        return
+    # one OS process per rank (ranks must not share a Python process:
+    # work_fn may hold the GIL, and per-rank crash isolation is the
+    # point). Children get the SPEC STRINGS and re-resolve — resolved
+    # callables may not survive spawn's pickle round-trip (bound
+    # methods, decorated functions), and the strings always do.
+    import multiprocessing as mp
+    import signal
+
+    ctx = mp.get_context("spawn")
+    procs = [
+        ctx.Process(
+            target=_spawned_rank_main,
+            args=(args.address, r, args.work, args.delay),
+            name=f"pool-cli-worker-{r}",
+        )
+        for r in ranks
+    ]
+
+    def _terminate(signum, frame):  # pragma: no cover - signal path
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+
+    # killing the one-command-per-host parent must not orphan the
+    # per-rank children (a replacement command would find duplicate
+    # live ranks)
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+    try:
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join()
+    finally:
+        for p in procs:
+            if p.is_alive():  # pragma: no cover - abnormal exit
+                p.terminate()
+    failed = [p.name for p in procs if p.exitcode not in (0, None)]
+    if failed:
+        raise SystemExit(
+            f"worker processes exited nonzero: {', '.join(failed)}"
+        )
+
+
+def _spawned_rank_main(
+    address: str, rank: int, work_spec: str, delay_spec: str | None
+) -> None:
+    """Child entry for multi-rank mode: resolve specs locally, serve."""
     run_worker(
-        args.address,
-        args.rank,
-        resolve_callable(args.work),
-        resolve_callable(args.delay) if args.delay else None,
+        address,
+        rank,
+        resolve_callable(work_spec),
+        resolve_callable(delay_spec) if delay_spec else None,
     )
 
 

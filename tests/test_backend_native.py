@@ -424,6 +424,53 @@ def test_resolve_callable():
         resolve_callable("numpy:pi")
 
 
+def test_parse_ranks():
+    from mpistragglers_jl_tpu.worker import parse_ranks
+
+    assert parse_ranks("3") == [3]
+    assert parse_ranks("0-3") == [0, 1, 2, 3]
+    assert parse_ranks("0,2,5-7") == [0, 2, 5, 6, 7]
+    with pytest.raises(ValueError, match="descending"):
+        parse_ranks("5-2")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_ranks("1,1")
+
+
+def test_cli_serves_multiple_ranks_one_command():
+    """One `-m ...worker --ranks 0-1` process serves both ranks (the
+    one-command-per-host deployment shape)."""
+    import subprocess
+    import sys
+
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(tests_dir), tests_dir, env.get("PYTHONPATH", "")]
+    )
+    backend = NativeProcessBackend(
+        None, 2, spawn=False, address="tcp://127.0.0.1:0", accept=False
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "mpistragglers_jl_tpu.worker",
+            "--address", backend.address, "--ranks", "0-1",
+            "--work", "test_backend_native:_echo",
+        ],
+        cwd=tests_dir, env=env,
+    )
+    try:
+        backend.accept(timeout=60)
+        pool = AsyncPool(2)
+        repochs = asyncmap(pool, np.array([4.0]), backend, nwait=2)
+        assert list(repochs) == [1, 1]
+        for i in range(2):
+            out = np.asarray(pool.results[i])
+            assert out[0] == i + 1 and out[1] == 4.0
+    finally:
+        backend.shutdown()
+        proc.wait(timeout=15)
+
+
 def test_respawn_recovers_crashed_rank():
     """Elastic recovery: a crashed rank is replaced in place and the
     pool keeps the same index space (new capability over the reference,
