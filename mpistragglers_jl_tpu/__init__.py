@@ -20,6 +20,7 @@ __all__ = [
     "Backend",
     "LocalBackend",
     "ProcessBackend",
+    "NativeProcessBackend",
     "XLADeviceBackend",
     "WorkerFailure",
 ]
@@ -34,4 +35,9 @@ def __getattr__(name):
         from .backends.xla import XLADeviceBackend
 
         return XLADeviceBackend
+    if name == "NativeProcessBackend":
+        # lazy: first use compiles the C++ transport
+        from .backends.native import NativeProcessBackend
+
+        return NativeProcessBackend
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
