@@ -245,5 +245,8 @@ class ProcessBackend(SlotBackend):
         for proc in self._procs:
             if proc.is_alive():  # pragma: no cover - stuck worker
                 proc.terminate()
+        for proc in self._procs:
+            if not proc.is_alive():
+                proc.close()  # release the spawn sentinel fds deterministically
         for conn in self._conns:
             conn.close()
