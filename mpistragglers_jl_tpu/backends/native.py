@@ -327,6 +327,7 @@ class NativeProcessBackend(Backend):
         for p in self._procs:
             if p is not None and p.is_alive():  # pragma: no cover
                 p.terminate()
+                p.join(timeout=self._join_timeout)  # reap before close
         for p in self._procs:
             if p is not None and not p.is_alive():
                 p.close()  # release the spawn sentinel fds deterministically

@@ -245,6 +245,7 @@ class ProcessBackend(SlotBackend):
         for proc in self._procs:
             if proc.is_alive():  # pragma: no cover - stuck worker
                 proc.terminate()
+                proc.join(timeout=self._join_timeout)  # reap before close
         for proc in self._procs:
             if not proc.is_alive():
                 proc.close()  # release the spawn sentinel fds deterministically

@@ -1,0 +1,36 @@
+"""Examples stay runnable: drive the CPU-only walkthroughs as real
+subprocesses (docs and code drift apart silently otherwise; the jax
+examples are exercised by the benchmark configs instead)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(name, *args, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join([_REPO, env.get("PYTHONPATH", "")])
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", name), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_REPO,
+    )
+
+
+def test_iterative_example_runs_and_reports_latency():
+    out = _run_example("iterative_example.py")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done: latency per worker" in out.stdout
+
+
+@pytest.mark.slow
+def test_straggler_aware_training_converges(tmp_path):
+    out = _run_example("straggler_aware_training.py", str(tmp_path))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "respawned" in out.stdout  # the injected crash was recovered
+    assert "adaptive nwait settled at" in out.stdout
+    assert (tmp_path / "training_trace.json").exists()  # Perfetto artifact
