@@ -187,7 +187,15 @@ class SlotBackend(Backend):
             slot.result = None
             slot.outstanding = True
             seq = slot.seq
-        self._start(i, sendbuf, epoch, seq, tag)
+        try:
+            self._start(i, sendbuf, epoch, seq, tag)
+        except BaseException:
+            # roll the slot back: a task that never started must not leave
+            # an outstanding slot that wait/wait_any would block on forever
+            with self._cond:
+                if self._slots[i].seq == seq:
+                    self._slots[i].outstanding = False
+            raise
 
     def test(self, i: int):
         with self._cond:

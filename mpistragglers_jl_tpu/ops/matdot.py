@@ -47,7 +47,36 @@ import numpy as np
 from ..backends.base import DelayFn
 from ._evalgemm import EvalPointCodedGemm, chebyshev_points
 
-__all__ = ["MatDotCode", "MatDotGemm"]
+__all__ = ["MatDotCode", "MatDotGemm", "MatDotWeightCache"]
+
+
+class MatDotWeightCache:
+    """Bounded per-arrival-pattern cache of masked decode weights.
+
+    ``get(sel)`` returns the length-n weight vector with the 2p-1
+    interpolation weights on the arrived indices and 0 elsewhere — the
+    form every MatDot decode path consumes (bulk-synchronous mesh epoch,
+    pool-fused psum, host combine). One source of truth for the
+    numerically sensitive Vandermonde solve, and one bound: there are
+    C(n, 2p-1) possible arrival patterns, so the dict is cleared at
+    ``max_entries`` rather than growing toward that.
+    """
+
+    def __init__(self, code: "MatDotCode", max_entries: int = 4096):
+        self.code = code
+        self.max_entries = int(max_entries)
+        self._cache: dict[tuple, np.ndarray] = {}
+
+    def get(self, sel) -> np.ndarray:
+        sel = tuple(int(x) for x in sel)
+        w = self._cache.get(sel)
+        if w is None:
+            w = np.zeros(self.code.n)
+            w[list(sel)] = self.code.decode_weights(list(sel))
+            if len(self._cache) >= self.max_entries:
+                self._cache.clear()
+            self._cache[sel] = w
+        return w
 
 
 @partial(jax.jit, static_argnames=("p", "precision"))

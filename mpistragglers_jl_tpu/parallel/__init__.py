@@ -2,6 +2,8 @@ _HOME = {
     "make_mesh": "mesh",
     "MeshCodedGemm": "mesh_gemm",
     "MeshMatDotGemm": "mesh_gemm",
+    "PoolMeshCodedGemm": "fused",
+    "PoolMeshMatDotGemm": "fused",
     "distributed_mds_decode": "collectives",
     "masked_psum_scatter_combine": "collectives",
     "ring_allgather": "collectives",

@@ -34,9 +34,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "masked_psum_scatter_combine",
+    "mds_decode_weights",
     "distributed_mds_decode",
     "ring_allgather",
 ]
+
+
+def mds_decode_weights(code, idx) -> np.ndarray:
+    """(n, n) masked decode-weight matrix for an (n, k) MDS code: row j =
+    coefficients of output block j over workers, zero column for every
+    worker not in ``idx``. The numerically sensitive inversion lives here,
+    shared by the bulk-synchronous decode below and the pool-fused decode
+    (parallel/fused.py)."""
+    idx = np.asarray(idx)
+    Winv = np.linalg.inv(code.G[idx])  # tiny k×k host solve
+    weights = np.zeros((code.n, code.n), dtype=code.G.dtype)
+    weights[: code.k, idx] = Winv
+    return weights
 
 
 def masked_psum_scatter_combine(mesh: Mesh, axis: str = "w"):
@@ -88,10 +102,7 @@ def distributed_mds_decode(mesh: Mesh, code, axis: str = "w"):
                 f"only {fresh.size} fresh shards, need k={k}"
             )
         idx = fresh[:k]
-        Winv = np.linalg.inv(code.G[idx])  # (k, k)
-        weights = np.zeros((n, n), dtype=code.G.dtype)
-        weights[:k, idx] = Winv
-        return combine(shards, jnp.asarray(weights))
+        return combine(shards, jnp.asarray(mds_decode_weights(code, idx)))
 
     return decode
 

@@ -1,0 +1,368 @@
+"""Fused pool ↔ mesh coded GEMM: asyncmap map step, in-place ICI decode.
+
+This is the integration the two sides of the framework were built for:
+
+* the **async pool** (pool.py + backends/xla.py) runs the straggle-exposed
+  map step — one independent jitted program per mesh device, a slow chip
+  delays nobody, ``repochs`` is the arrival mask (the reference's
+  fastest-k contract, src/MPIAsyncPools.jl:145-188);
+* the **masked psum_scatter decode** (parallel/collectives.py) consumes
+  the pool's *device-resident* results **in place**: the per-worker
+  ``pool.results[i]`` arrays — each already living on mesh device i —
+  are assembled into one sharded global array with
+  ``jax.make_array_from_single_device_arrays`` (zero copies, no
+  device-0 gather, no host round-trip) and decoded by one
+  reduce-scatter riding ICI.
+
+Contrast with the two unfused paths:
+
+* ``ops/coded_gemm.CodedGemm.result_device`` gathers every fresh shard
+  onto a single device and solves there — a k·blocksize hot-spot on one
+  chip's HBM;
+* ``parallel/mesh_gemm.MeshCodedGemm.epoch`` is fully sharded but
+  bulk-synchronous — its map step is a single ``shard_map`` program, so
+  a straggling chip stalls the whole epoch and ``repochs`` must be
+  synthesized by the caller.
+
+Here ``repochs`` comes from the pool (real arrivals, real stragglers)
+and the collective runs over data that never left the workers' HBM.
+
+Straggler semantics of the decode collective: the combine is
+weight-masked, so the *values* on stale devices never affect the output,
+but every mesh device still participates in the collective (the XLA
+bulk-synchronous contract — see parallel/collectives.py). A stale
+worker's device runs the combine between its queued computations; a
+permanently dead chip means reforming the mesh, which is the
+``respawn``/``reaccept`` layer's job, not the decode's.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..backends.base import DelayFn
+from ..backends.xla import XLADeviceBackend
+from ..ops.coding import MDSCode, nwait_decodable
+from ..ops.gemm import _block_matmul
+from ..ops.matdot import MatDotCode, MatDotWeightCache, _matdot_worker
+from ..pool import AsyncPool, asyncmap
+from .collectives import masked_psum_scatter_combine, mds_decode_weights
+
+__all__ = ["PoolMeshCodedGemm", "PoolMeshMatDotGemm"]
+
+
+def _mesh_axis_devices(mesh: Mesh, axis: str) -> list[jax.Device]:
+    """Device order along a 1-D pool mesh axis (pool worker i ↔ device i)."""
+    if len(mesh.axis_names) != 1 or mesh.axis_names[0] != axis:
+        raise ValueError(
+            f"pool-fused GEMM needs a 1-D ({axis!r},) mesh, got "
+            f"{mesh.axis_names}"
+        )
+    return list(mesh.devices.flatten())
+
+
+class _ShardAdopter:
+    """Zero-copy assembly of per-worker device-resident results into the
+    sharded global (n, *shard) array a decode collective consumes.
+
+    Each ``pool.results[i]`` already lives on mesh device ``i`` (the
+    backend mapped worker i there), so
+    ``jax.make_array_from_single_device_arrays`` just *adopts* the
+    buffers — this is the "no device_put gather" the fusion exists for.
+    Stale results whose shape/dtype no longer match the current epoch
+    (caller changed B's width) and never-heard workers get a zero
+    placeholder; both enter the combine with weight 0. The placeholder
+    cache keeps only the latest shape per worker so a varying payload
+    width cannot grow HBM pins without bound.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str, devices: list[jax.Device]):
+        self.mesh = mesh
+        self.axis = axis
+        self.devices = devices
+        self.n = len(devices)
+        self._placeholders: dict[int, tuple] = {}  # i -> (shape, dtype, arr)
+
+    def _placeholder(self, i: int, shape, dtype) -> jax.Array:
+        cached = self._placeholders.get(i)
+        if cached is not None and cached[0] == shape and cached[1] == dtype:
+            return cached[2]
+        ph = jax.device_put(jnp.zeros(shape, dtype=dtype), self.devices[i])
+        self._placeholders[i] = (shape, dtype, ph)
+        return ph
+
+    def assemble(self, pool: AsyncPool, ref_shape, ref_dtype) -> jax.Array:
+        shards = []
+        for i in range(self.n):
+            r = pool.results[i]
+            if (
+                r is None
+                or not isinstance(r, jax.Array)
+                or r.shape != tuple(ref_shape)
+                or r.dtype != ref_dtype
+            ):
+                r = self._placeholder(i, tuple(ref_shape), ref_dtype)
+            shards.append(r[None])  # (1, *shard) on device i
+        return jax.make_array_from_single_device_arrays(
+            (self.n,) + tuple(ref_shape),
+            NamedSharding(self.mesh, P(self.axis)),
+            shards,
+        )
+
+
+class PoolMeshCodedGemm:
+    """(n, k) MDS-coded ``C = A @ B``: pool map step, in-place mesh decode.
+
+    >>> mesh = make_mesh(8)
+    >>> fg = PoolMeshCodedGemm(A, mesh, k=6)
+    >>> pool = AsyncPool(8)
+    >>> decoded = fg.epoch(pool, B)        # asyncmap + psum_scatter decode
+    >>> C = fg.full(decoded)               # host gather on demand
+
+    The map step is ``asyncmap`` over an :class:`XLADeviceBackend` whose
+    worker i computes ``Ã_i @ B`` on mesh device i; the decode assembles
+    ``pool.results`` into a sharded array *in place* and runs the masked
+    reduce-scatter. Output block j lands on device j, still sharded.
+    """
+
+    def __init__(
+        self,
+        A: np.ndarray,
+        mesh: Mesh,
+        k: int,
+        *,
+        axis: str = "w",
+        parity: str = "cauchy",
+        precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
+        delay_fn: DelayFn | None = None,
+        dtype=None,
+    ):
+        if dtype is not None:
+            A = np.asarray(A, dtype=dtype)
+        n = mesh.shape[axis]
+        m = A.shape[0]
+        if m % k != 0:
+            raise ValueError(f"rows {m} must divide evenly into k={k} blocks")
+        self.mesh = mesh
+        self.axis = axis
+        self.devices = _mesh_axis_devices(mesh, axis)
+        self.code = MDSCode(n, k, parity=parity, dtype=A.dtype,
+                            precision=precision)
+        self.n, self.k = n, k
+        self.block_rows = m // k
+        self.precision = precision
+        coded = self.code.encode_array(A)  # (n, m/k, d)
+        # one committed coded block per mesh device — the worker-resident
+        # operand of the map step (reference: per-worker data lives with
+        # the worker; here "with" means the chip's HBM)
+        self.blocks = [
+            jax.device_put(coded[i], self.devices[i]) for i in range(n)
+        ]
+        self.backend = XLADeviceBackend(
+            self._work, n, devices=self.devices, delay_fn=delay_fn
+        )
+        self._combine = masked_psum_scatter_combine(mesh, axis)
+        self._adopter = _ShardAdopter(mesh, axis, self.devices)
+        # steady state re-uses one arrival pattern epoch after epoch; cache
+        # the device-ready weight matrix per (pattern, dtype) so the hot
+        # path pays neither the k×k inverse nor the H2D weights upload
+        self._weights_cache: dict[tuple, jax.Array] = {}
+
+    def _work(self, i: int, payload: jax.Array, epoch: int) -> jax.Array:
+        return _block_matmul(self.blocks[i], payload, precision=self.precision)
+
+    @property
+    def nwait(self):
+        """Decodability predicate for ``asyncmap(nwait=...)``."""
+        return nwait_decodable(self.k)
+
+    def _check_pool(self, pool: AsyncPool) -> None:
+        if pool.n_workers != self.n:
+            raise ValueError(
+                f"pool has {pool.n_workers} workers but the mesh pool axis "
+                f"has {self.n} devices; they must match one-to-one"
+            )
+
+    def decode_from_pool(
+        self, pool: AsyncPool, epoch: int | None = None
+    ) -> jax.Array:
+        """Masked psum_scatter decode of the pool's device-resident
+        results. Returns the decoded (n, m/k, cols) array, block j
+        resident on device j (blocks j >= k are zeros)."""
+        self._check_pool(pool)
+        fresh = pool.fresh_indices(epoch)
+        if fresh.size < self.k:
+            raise ValueError(
+                f"only {fresh.size} fresh shards at epoch "
+                f"{pool.epoch if epoch is None else epoch}, need k={self.k}"
+            )
+        idx = fresh[: self.k]
+        ref = pool.results[int(idx[0])]
+        shards = self._adopter.assemble(pool, ref.shape, ref.dtype)
+        key = (tuple(int(x) for x in idx), np.dtype(ref.dtype).str)
+        weights = self._weights_cache.get(key)
+        if weights is None:
+            weights = jnp.asarray(
+                mds_decode_weights(self.code, idx), dtype=ref.dtype
+            )
+            if len(self._weights_cache) >= 4096:  # C(n,k) patterns: bound
+                self._weights_cache.clear()
+            self._weights_cache[key] = weights
+        return self._combine(shards, weights)
+
+    # -- one fused epoch ---------------------------------------------------
+    def epoch(
+        self,
+        pool: AsyncPool,
+        B,
+        *,
+        nwait=None,
+        epoch: int | None = None,
+        timeout: float | None = None,
+        tracer=None,
+    ) -> jax.Array:
+        """One full fused epoch: ``asyncmap`` map step (fastest-k, real
+        arrivals) + in-place masked decode. ``repochs`` comes from the
+        pool — never synthesized."""
+        self._check_pool(pool)
+        if nwait is None:
+            nwait = self.nwait
+        asyncmap(
+            pool, B, self.backend,
+            nwait=nwait, epoch=epoch, timeout=timeout, tracer=tracer,
+        )
+        return self.decode_from_pool(pool)
+
+    def full(self, decoded: jax.Array) -> np.ndarray:
+        """Host gather of the first k decoded blocks -> (m, cols)."""
+        out = np.asarray(decoded)  # (n, m/k, cols)
+        return out[: self.k].reshape(-1, out.shape[-1])
+
+    def shutdown(self) -> None:
+        self.backend.shutdown()
+
+
+class PoolMeshMatDotGemm:
+    """MatDot-coded ``C = A @ B``: pool map step, decode = ONE weighted
+    ``psum`` over the pool's device-resident evaluations.
+
+    Same fusion as :class:`PoolMeshCodedGemm` but for MatDot codes
+    (ops/matdot.py — inner-dimension partitioning, recovery threshold
+    2p-1): worker i encodes B̃_i on its own device from the broadcast B
+    and computes ``Ã_i @ B̃_i``; the decode scales each resident
+    evaluation by its interpolation weight (0 for stale workers) and one
+    ``psum`` yields the full product on every device.
+    """
+
+    def __init__(
+        self,
+        A: np.ndarray,
+        mesh: Mesh,
+        p: int,
+        *,
+        axis: str = "w",
+        precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
+        delay_fn: DelayFn | None = None,
+        dtype=None,
+    ):
+        if dtype is not None:
+            A = np.asarray(A, dtype=dtype)
+        n = mesh.shape[axis]
+        m, kd = A.shape
+        if kd % p != 0:
+            raise ValueError(
+                f"inner dim {kd} must divide evenly into p={p} blocks"
+            )
+        self.mesh = mesh
+        self.axis = axis
+        self.devices = _mesh_axis_devices(mesh, axis)
+        self.code = MatDotCode(p, n, dtype=A.dtype, precision=precision)
+        self.p, self.n, self.k = p, n, self.code.k
+        self.precision = precision
+        blocks = jnp.asarray(A).reshape(m, p, kd // p).transpose(1, 0, 2)
+        coded = self.code.encode_A(blocks)  # (n, m, kd/p)
+        self.A_evals = [
+            jax.device_put(coded[i], self.devices[i]) for i in range(n)
+        ]
+        self.B_weights = [
+            jax.device_put(jnp.asarray(self.code.VB[i]), self.devices[i])
+            for i in range(n)
+        ]
+        self.backend = XLADeviceBackend(
+            self._work, n, devices=self.devices, delay_fn=delay_fn
+        )
+
+        def _wsum(ev, w):
+            # ev: (1, m, cols) local evaluation; w: (n,) replicated
+            i = jax.lax.axis_index(axis)
+            return jax.lax.psum(w[i] * ev[0], axis)
+
+        self._wsum = jax.jit(jax.shard_map(
+            _wsum, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(),
+        ))
+        self._adopter = _ShardAdopter(mesh, axis, self.devices)
+        self._weights = MatDotWeightCache(self.code)
+
+    def _work(self, i: int, payload: jax.Array, epoch: int) -> jax.Array:
+        return _matdot_worker(
+            self.A_evals[i], self.B_weights[i], payload, self.p,
+            self.precision,
+        )
+
+    @property
+    def nwait(self):
+        """Decodability predicate: 2p-1 fresh evaluations."""
+        return nwait_decodable(self.k)
+
+    def _check_pool(self, pool: AsyncPool) -> None:
+        if pool.n_workers != self.n:
+            raise ValueError(
+                f"pool has {pool.n_workers} workers but the mesh pool axis "
+                f"has {self.n} devices; they must match one-to-one"
+            )
+
+    def decode_from_pool(
+        self, pool: AsyncPool, epoch: int | None = None
+    ) -> jax.Array:
+        """One weighted psum over the pool's resident evaluations.
+        Returns the full (m, cols) product, replicated over the mesh."""
+        self._check_pool(pool)
+        fresh = pool.fresh_indices(epoch)
+        if fresh.size < self.k:
+            raise ValueError(
+                f"only {fresh.size} fresh evaluations, need 2p-1={self.k}"
+            )
+        sel = tuple(int(x) for x in fresh[: self.k])
+        w = self._weights.get(sel)
+        ref = pool.results[sel[0]]
+        ev = self._adopter.assemble(pool, ref.shape, ref.dtype)
+        wC = jax.device_put(
+            jnp.asarray(w, dtype=ref.dtype),
+            NamedSharding(self.mesh, P()),
+        )
+        return self._wsum(ev, wC)
+
+    def epoch(
+        self,
+        pool: AsyncPool,
+        B,
+        *,
+        nwait=None,
+        epoch: int | None = None,
+        timeout: float | None = None,
+        tracer=None,
+    ) -> jax.Array:
+        self._check_pool(pool)
+        if nwait is None:
+            nwait = self.nwait
+        asyncmap(
+            pool, B, self.backend,
+            nwait=nwait, epoch=epoch, timeout=timeout, tracer=tracer,
+        )
+        return self.decode_from_pool(pool)
+
+    def shutdown(self) -> None:
+        self.backend.shutdown()

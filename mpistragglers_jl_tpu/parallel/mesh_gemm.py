@@ -24,7 +24,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.coding import MDSCode
-from ..ops.matdot import MatDotCode, _matdot_worker
+from ..ops.matdot import MatDotCode, MatDotWeightCache, _matdot_worker
 from .collectives import distributed_mds_decode
 
 __all__ = ["MeshCodedGemm", "MeshMatDotGemm"]
@@ -161,7 +161,7 @@ class MeshMatDotGemm:
             in_specs=(P(axis), P(axis), P(), P()),
             out_specs=P(),
         ))
-        self._weights_cache: dict[tuple, np.ndarray] = {}
+        self._weights = MatDotWeightCache(self.code)
 
     def decode_weights(self, repochs, epoch: int) -> np.ndarray:
         """Per-device combine weights from the arrival mask: the first
@@ -172,13 +172,7 @@ class MeshMatDotGemm:
             raise ValueError(
                 f"only {fresh.size} fresh shards, need 2p-1={self.k}"
             )
-        sel = tuple(int(x) for x in fresh[: self.k])
-        w = self._weights_cache.get(sel)
-        if w is None:
-            w = np.zeros(self.n)
-            w[list(sel)] = self.code.decode_weights(list(sel))
-            self._weights_cache[sel] = w
-        return w
+        return self._weights.get(fresh[: self.k])
 
     def epoch(self, B, repochs=None, epoch: int = 0) -> jax.Array:
         """One coded epoch: on-device B encode + local matmul + one

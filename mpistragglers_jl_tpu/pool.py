@@ -205,10 +205,13 @@ def _dispatch(pool: AsyncPool, backend: Backend, i: int, sendbuf, tag: int) -> N
     re-task copy at :178-183). The payload snapshot the reference does via
     ``isendbufs[i] .= sendbuf`` (:130) is the backend's responsibility here.
     """
-    pool.active[i] = True
     pool.sepochs[i] = pool.epoch
     pool.stimestamps[i] = time.perf_counter_ns()
     backend.dispatch(i, sendbuf, pool.epoch, tag=tag)
+    # only after the backend accepted the task: a failed dispatch must not
+    # leave pool.active[i] pointing at a slot the backend never opened
+    # (waitall would then block on a completion that can never come)
+    pool.active[i] = True
 
 
 def asyncmap(
@@ -366,11 +369,21 @@ def waitall(
         tracer.begin("waitall", pool.epoch, int(pool.active.sum()))
     try:
         deadline = Deadline(timeout)
-        for i in list(np.flatnonzero(pool.active)):
-            result = backend.wait(i, timeout=deadline.remaining())
-            if result is None:
+        while pool.active.any():
+            # harvest in ARRIVAL order, not index order: waiting on worker
+            # 0 first would charge its wait time to workers 1..n-1's
+            # ``latency`` stamps (the reference shares this flaw — its
+            # ``Waitall!`` at src/MPIAsyncPools.jl:212 completes all
+            # requests before any timestamping; utils/straggle.py fits
+            # latency models to these numbers, so they must be true
+            # per-worker round-trip times)
+            got = backend.wait_any(
+                np.flatnonzero(pool.active), timeout=deadline.remaining()
+            )
+            if got is None:
                 dead = [int(j) for j in np.flatnonzero(pool.active)]
                 raise DeadWorkerError(dead, timeout)
+            i, result = got
             _store(pool, i, result, recvbufs)
             pool.active[i] = False
             if tracer is not None:

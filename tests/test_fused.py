@@ -1,0 +1,167 @@
+"""Fused pool ↔ mesh decode: asyncmap map step + in-place psum_scatter.
+
+The round-1 gap this closes: the pool path decoded by gathering shards to
+one device, and the mesh decode was only ever fed a synthetic ``repochs``.
+Here ``repochs`` comes from real asyncmap arrivals with injected
+stragglers, and the decode consumes ``pool.results`` where they sit —
+one shard per mesh device, assembled zero-copy.
+
+Reference bar: the ``repochs``-as-decode-mask contract at
+src/MPIAsyncPools.jl:145-188.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu.parallel import (
+    PoolMeshCodedGemm,
+    PoolMeshMatDotGemm,
+    make_mesh,
+)
+from mpistragglers_jl_tpu.pool import AsyncPool, asyncmap, waitall
+
+N = 8
+K = 6
+STRAGGLERS = (0, 7)
+
+
+def _delay(i, epoch):
+    # two permanent stragglers, deterministic (SURVEY §7: injection, not
+    # randomness, is the test mechanism of record)
+    return 0.25 if i in STRAGGLERS else 0.0
+
+
+@pytest.fixture
+def mesh():
+    assert len(jax.devices()) >= N, "conftest must provide 8 virtual devices"
+    return make_mesh(N)
+
+
+def test_fused_epoch_decodes_with_real_stragglers(mesh):
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((K * 16, 24)).astype(np.float32)
+    B = rng.standard_normal((24, 12)).astype(np.float32)
+    fg = PoolMeshCodedGemm(A, mesh, K, delay_fn=_delay, dtype=np.float32)
+    pool = AsyncPool(N)
+    try:
+        decoded = fg.epoch(pool, B)
+        # repochs is REAL: both stragglers must be stale at return (their
+        # 0.25 s stall dwarfs the fast workers' compute)
+        fresh = pool.fresh_indices()
+        assert len(fresh) >= K
+        for s in STRAGGLERS:
+            assert pool.repochs[s] != pool.epoch
+            assert pool.active[s]
+        np.testing.assert_allclose(fg.full(decoded), A @ B, atol=1e-3)
+    finally:
+        waitall(pool, fg.backend, timeout=5.0)
+        fg.shutdown()
+
+
+def test_decode_output_stays_sharded_no_device0_gather(mesh):
+    """The decoded array must be sharded across the mesh — one block per
+    device — not gathered onto a single device."""
+    rng = np.random.default_rng(1)
+    A = rng.standard_normal((K * 8, 16)).astype(np.float32)
+    B = rng.standard_normal((16, 8)).astype(np.float32)
+    fg = PoolMeshCodedGemm(A, mesh, K, dtype=np.float32)
+    pool = AsyncPool(N)
+    try:
+        decoded = fg.epoch(pool, B, nwait=N)
+        shard_devs = {s.device for s in decoded.addressable_shards}
+        assert len(shard_devs) == N, (
+            f"decode landed on {len(shard_devs)} device(s); expected one "
+            f"block per mesh device"
+        )
+        # the pool's result shards themselves live on their worker device
+        for i in range(N):
+            assert pool.results[i].device == fg.devices[i]
+        np.testing.assert_allclose(fg.full(decoded), A @ B, atol=1e-3)
+    finally:
+        fg.shutdown()
+
+
+def test_fused_multi_epoch_stale_harvest(mesh):
+    """Straggler results from epoch e arrive during epoch e+1: the pool
+    harvests them as stale, re-tasks, and the decode still only uses
+    fresh shards."""
+    rng = np.random.default_rng(2)
+    A = rng.standard_normal((K * 8, 16)).astype(np.float32)
+    fg = PoolMeshCodedGemm(A, mesh, K, delay_fn=_delay, dtype=np.float32)
+    pool = AsyncPool(N)
+    try:
+        for e in range(3):
+            B = rng.standard_normal((16, 8)).astype(np.float32)
+            decoded = fg.epoch(pool, B)
+            np.testing.assert_allclose(fg.full(decoded), A @ B, atol=1e-3)
+    finally:
+        waitall(pool, fg.backend, timeout=5.0)
+        fg.shutdown()
+
+
+def test_decode_from_pool_requires_k_fresh(mesh):
+    rng = np.random.default_rng(3)
+    A = rng.standard_normal((K * 4, 8)).astype(np.float32)
+    B = rng.standard_normal((8, 4)).astype(np.float32)
+    fg = PoolMeshCodedGemm(A, mesh, K, dtype=np.float32)
+    pool = AsyncPool(N)
+    try:
+        # wait for K-1 only: decode must refuse (never heard from enough)
+        asyncmap(pool, B, fg.backend, nwait=K - 1)
+        fresh = pool.fresh_indices()
+        if len(fresh) < K:  # racy fast workers may already exceed K-1
+            with pytest.raises(ValueError, match="fresh"):
+                fg.decode_from_pool(pool)
+    finally:
+        waitall(pool, fg.backend, timeout=5.0)
+        fg.shutdown()
+
+
+def test_fused_matdot_psum_decode(mesh):
+    """MatDot fusion: decode is one weighted psum over resident
+    evaluations; result replicated, exact with 2 stragglers stale."""
+    rng = np.random.default_rng(4)
+    A = rng.standard_normal((24, 16)).astype(np.float32)
+    B = rng.standard_normal((16, 8)).astype(np.float32)
+    md = PoolMeshMatDotGemm(A, mesh, p=2, delay_fn=_delay, dtype=np.float32)
+    pool = AsyncPool(N)
+    try:
+        C = md.epoch(pool, B)
+        for s in STRAGGLERS:
+            assert pool.repochs[s] != pool.epoch
+        np.testing.assert_allclose(np.asarray(C), A @ B, atol=1e-3)
+    finally:
+        waitall(pool, md.backend, timeout=5.0)
+        md.shutdown()
+
+
+def test_fused_epoch_changed_payload_width(mesh):
+    """A stale shard whose width no longer matches the current epoch's B
+    enters the combine as a zero placeholder, not a shape error."""
+    rng = np.random.default_rng(5)
+    A = rng.standard_normal((K * 4, 8)).astype(np.float32)
+    fg = PoolMeshCodedGemm(A, mesh, K, delay_fn=_delay, dtype=np.float32)
+    pool = AsyncPool(N)
+    try:
+        B1 = rng.standard_normal((8, 4)).astype(np.float32)
+        fg.epoch(pool, B1)
+        B2 = rng.standard_normal((8, 6)).astype(np.float32)  # new width
+        decoded = fg.epoch(pool, B2)
+        np.testing.assert_allclose(fg.full(decoded), A @ B2, atol=1e-3)
+    finally:
+        waitall(pool, fg.backend, timeout=5.0)
+        fg.shutdown()
+
+
+def test_pool_size_mismatch_rejected(mesh):
+    rng = np.random.default_rng(6)
+    A = rng.standard_normal((K * 4, 8)).astype(np.float32)
+    fg = PoolMeshCodedGemm(A, mesh, K, dtype=np.float32)
+    try:
+        with pytest.raises(ValueError, match="one-to-one"):
+            fg.epoch(AsyncPool(N + 2), np.zeros((8, 4), np.float32))
+        with pytest.raises(ValueError, match="one-to-one"):
+            fg.decode_from_pool(AsyncPool(N - 1))
+    finally:
+        fg.shutdown()

@@ -358,3 +358,43 @@ class TestAsyncmapTimeout:
             assert list(repochs) == [1, 1]
         finally:
             backend.shutdown()
+
+
+def test_waitall_latency_no_index_order_skew():
+    """waitall must harvest in ARRIVAL order: a slow worker 0 must not
+    inflate the latency stamps of fast workers 1..3 (round-1 flaw: the
+    index-ordered drain charged the wait on earlier indices to later
+    ones; the reference's Waitall! shares it, src/MPIAsyncPools.jl:212).
+    """
+    n = 4
+    slow, fast = 0.30, 0.02
+    pool, backend = make(
+        n,
+        delay_fn=lambda i, e: slow if i == 0 else fast,
+        work_fn=lambda i, p, e: p.copy(),
+    )
+    asyncmap(pool, np.array([1.0]), backend, nwait=0)  # dispatch only
+    waitall(pool, backend, timeout=5.0)
+    assert not pool.active.any()
+    # fast workers' latency reflects THEIR round trip, not worker 0's
+    for i in range(1, n):
+        assert pool.latency[i] < slow / 2, (
+            f"worker {i} latency {pool.latency[i]:.3f} s includes the "
+            f"slow worker's wait"
+        )
+    assert pool.latency[0] >= slow * 0.9
+    backend.shutdown()
+
+
+def test_waitall_equal_delay_equal_latency():
+    """Two equal-delay workers must get equal latency within tolerance."""
+    n = 2
+    d = 0.10
+    pool, backend = make(
+        n, delay_fn=lambda i, e: d, work_fn=lambda i, p, e: p.copy()
+    )
+    asyncmap(pool, np.array([1.0]), backend, nwait=0)
+    waitall(pool, backend, timeout=5.0)
+    assert abs(pool.latency[0] - pool.latency[1]) < d / 2, pool.latency
+    assert all(pool.latency >= d * 0.9)
+    backend.shutdown()
