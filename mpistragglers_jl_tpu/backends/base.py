@@ -17,11 +17,18 @@ shutdown         ``shutdown()``           control-channel broadcast
 ==============  =========================================================
 
 :class:`SlotBackend` is a shared implementation skeleton: one *slot* per
-worker holding at most one outstanding task (the pool's ``active`` flag
-discipline guarantees single occupancy), a completion event per slot, and
-a condition variable notified on every completion so ``wait_any`` can
-sleep instead of spinning. Subclasses only implement how a task actually
-runs (thread compute, XLA device dispatch, ...).
+(worker, tag) holding at most one outstanding task (the pool's ``active``
+flag discipline guarantees single occupancy per channel), a completion
+event per slot, and a condition variable notified on every completion so
+``wait_any`` can sleep instead of spinning. Subclasses only implement how
+a task actually runs (thread compute, XLA device dispatch, ...).
+
+Tags multiplex independent message channels over one backend, exactly as
+MPI tags multiplex one communicator (the reference separates data and
+control streams by tag — test/kmap2.jl:11-12 — and two pools can share a
+comm on distinct tags). Each tag is an isolated channel: its own slots,
+its own completions; a dispatch on tag 1 can be in flight to the same
+worker as a dispatch on tag 0, and results never cross channels.
 """
 
 from __future__ import annotations
@@ -97,22 +104,32 @@ class Backend(ABC):
         src/MPIAsyncPools.jl:130 — here the backend owns the snapshot)."""
 
     @abstractmethod
-    def test(self, i: int):
-        """Non-blocking completion probe. Returns the result exactly once
-        if worker ``i`` has completed, else None (``MPI.Test!``)."""
+    def test(self, i: int, *, tag: int = 0):
+        """Non-blocking completion probe on channel ``tag``. Returns the
+        result exactly once if worker ``i`` has completed, else None
+        (``MPI.Test!``)."""
 
     @abstractmethod
     def wait_any(
-        self, indices: Sequence[int], timeout: float | None = None
+        self,
+        indices: Sequence[int],
+        timeout: float | None = None,
+        *,
+        tags: Sequence[int] | None = None,
     ) -> tuple[int, object] | None:
-        """Block until any worker in ``indices`` completes; return
-        ``(i, result)`` (``MPI.Waitany!``), or None if ``timeout``
-        seconds elapse first."""
+        """Block until any worker in ``indices`` completes on its paired
+        channel; return ``(i, result)`` (``MPI.Waitany!``), or None if
+        ``timeout`` seconds elapse first. ``tags`` aligns with
+        ``indices`` (None = all on tag 0) — the in-flight request for
+        worker ``indices[j]`` is the one dispatched with ``tags[j]``,
+        mirroring MPI requests remembering the tag they were posted
+        with."""
 
     @abstractmethod
-    def wait(self, i: int, timeout: float | None = None):
-        """Block until worker ``i`` completes; return its result, or None
-        on timeout (building block for ``MPI.Waitall!``-style drains)."""
+    def wait(self, i: int, timeout: float | None = None, *, tag: int = 0):
+        """Block until worker ``i`` completes on channel ``tag``; return
+        its result, or None on timeout (building block for
+        ``MPI.Waitall!``-style drains)."""
 
     def shutdown(self) -> None:  # pragma: no cover - default no-op
         """Release worker resources (the reference's control-channel
@@ -143,23 +160,37 @@ class _Slot:
 
 
 class SlotBackend(Backend):
-    """Completion-event machinery shared by concrete backends."""
+    """Completion-event machinery shared by concrete backends.
+
+    Slots are per (worker, tag): ``_channels[tag]`` is a full worker-width
+    slot vector, created lazily the first time a tag is used. Channel 0
+    always exists (the default-tag fast path)."""
 
     def __init__(self, n_workers: int):
         self.n_workers = int(n_workers)
-        self._slots = [_Slot() for _ in range(self.n_workers)]
+        self._channels: dict[int, list[_Slot]] = {
+            0: [_Slot() for _ in range(self.n_workers)]
+        }
         self._cond = threading.Condition()
+
+    def _chan(self, tag: int) -> list[_Slot]:
+        """Slot vector for ``tag``; caller must hold ``self._cond``."""
+        slots = self._channels.get(tag)
+        if slots is None:
+            slots = [_Slot() for _ in range(self.n_workers)]
+            self._channels[tag] = slots
+        return slots
 
     # -- subclass surface -------------------------------------------------
     @abstractmethod
     def _start(self, i: int, sendbuf, epoch: int, seq: int, tag: int) -> None:
         """Begin asynchronous execution; must eventually call
-        ``self._complete(i, seq, result)`` from any thread."""
+        ``self._complete(i, seq, result, tag)`` from any thread."""
 
     # -- completion plumbing ---------------------------------------------
-    def _complete(self, i: int, seq: int, result) -> None:
+    def _complete(self, i: int, seq: int, result, tag: int = 0) -> None:
         with self._cond:
-            slot = self._slots[i]
+            slot = self._chan(tag)[i]
             if slot.seq != seq or not slot.outstanding:
                 return  # stale completion from a superseded dispatch
             slot.result = result
@@ -175,12 +206,13 @@ class SlotBackend(Backend):
 
     # -- Backend interface ------------------------------------------------
     def dispatch(self, i: int, sendbuf, epoch: int, *, tag: int = 0) -> None:
+        tag = int(tag)
         with self._cond:
-            slot = self._slots[i]
+            slot = self._chan(tag)[i]
             if slot.outstanding:
                 raise RuntimeError(
-                    f"worker {i} already has an outstanding task; the pool "
-                    "must only dispatch to inactive workers"
+                    f"worker {i} already has an outstanding task on tag "
+                    f"{tag}; the pool must only dispatch to inactive workers"
                 )
             slot.seq += 1
             slot.done = False
@@ -193,44 +225,53 @@ class SlotBackend(Backend):
             # roll the slot back: a task that never started must not leave
             # an outstanding slot that wait/wait_any would block on forever
             with self._cond:
-                if self._slots[i].seq == seq:
-                    self._slots[i].outstanding = False
+                if slot.seq == seq:
+                    slot.outstanding = False
             raise
 
-    def test(self, i: int):
+    def test(self, i: int, *, tag: int = 0):
         with self._cond:
-            slot = self._slots[i]
+            slot = self._chan(int(tag))[i]
             if slot.outstanding and slot.done:
                 return self._take(slot)
             return None
 
     def wait_any(
-        self, indices: Sequence[int], timeout: float | None = None
+        self,
+        indices: Sequence[int],
+        timeout: float | None = None,
+        *,
+        tags: Sequence[int] | None = None,
     ) -> tuple[int, object] | None:
         idx = [int(i) for i in indices]
         if not idx:
             raise ValueError("wait_any over an empty index set would hang")
-        ready: list[int] = []
+        tgs = [0] * len(idx) if tags is None else [int(t) for t in tags]
+        if len(tgs) != len(idx):
+            raise ValueError("tags must align one-to-one with indices")
+        ready: list[tuple[int, _Slot]] = []
 
         def scan() -> bool:
-            for i in idx:
-                slot = self._slots[i]
+            for i, t in zip(idx, tgs):
+                slot = self._chan(t)[i]
                 if slot.outstanding and slot.done:
-                    ready.append(i)
+                    ready.append((i, slot))
                     return True
             return False
 
         with self._cond:
             if not self._cond.wait_for(scan, timeout=timeout):
                 return None
-            i = ready[-1]
-            return i, self._take(self._slots[i])
+            i, slot = ready[-1]
+            return i, self._take(slot)
 
-    def wait(self, i: int, timeout: float | None = None):
+    def wait(self, i: int, timeout: float | None = None, *, tag: int = 0):
         with self._cond:
-            slot = self._slots[i]
+            slot = self._chan(int(tag))[i]
             if not slot.outstanding:
-                raise RuntimeError(f"worker {i} has no outstanding task")
+                raise RuntimeError(
+                    f"worker {i} has no outstanding task on tag {int(tag)}"
+                )
             ok = self._cond.wait_for(lambda: slot.done, timeout=timeout)
             if not ok:
                 return None
@@ -268,8 +309,13 @@ class MailboxBackend(SlotBackend):
         self.delay_fn = delay_fn
         self._closed = False
         self._join_timeout = join_timeout
+        # unbounded: occupancy is bounded by the slot discipline at one
+        # outstanding task per (worker, tag) channel, so the queue holds
+        # at most n_tags-in-use messages — a fixed depth-1 box would
+        # deadlock the coordinator when a second channel dispatches while
+        # the worker is busy with the first
         self._mailboxes: list[queue.Queue] = [
-            queue.Queue(maxsize=1) for _ in range(n_workers)
+            queue.Queue() for _ in range(n_workers)
         ]
         self._threads = [
             threading.Thread(
@@ -295,7 +341,7 @@ class MailboxBackend(SlotBackend):
             msg = mbox.get()
             if msg is _SHUTDOWN:
                 return
-            seq, payload, epoch = msg
+            seq, payload, epoch, tag = msg
             if self.delay_fn is not None:
                 d = float(self.delay_fn(i, epoch))
                 if d > 0:
@@ -304,20 +350,17 @@ class MailboxBackend(SlotBackend):
                 result = self._compute(i, payload, epoch)
             except BaseException as e:  # surfaced on harvest, not lost
                 result = WorkerError(i, epoch, e)
-            self._complete(i, seq, result)
+            self._complete(i, seq, result, tag)
 
     def _start(self, i: int, sendbuf, epoch: int, seq: int, tag: int) -> None:
         if self._closed:
             raise RuntimeError("backend has been shut down")
         payload = self._snapshot(i, sendbuf, epoch)
-        self._mailboxes[i].put((seq, payload, epoch))
+        self._mailboxes[i].put((seq, payload, epoch, tag))
 
     def shutdown(self) -> None:
         self._closed = True
         for mbox in self._mailboxes:
-            try:
-                mbox.put_nowait(_SHUTDOWN)
-            except queue.Full:
-                pass  # worker busy with a task it will never deliver; daemon
+            mbox.put_nowait(_SHUTDOWN)
         for t in self._threads:
             t.join(timeout=self._join_timeout)

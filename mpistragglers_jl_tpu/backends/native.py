@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import pickle
+import secrets
+from collections import deque
 import tempfile
 import uuid
 from pathlib import Path
@@ -35,7 +37,8 @@ __all__ = ["NativeProcessBackend"]
 
 
 def _native_worker_main(
-    rank: int, path: str, work_fn: WorkFn, delay_fn: DelayFn | None
+    rank: int, path: str, work_fn: WorkFn, delay_fn: DelayFn | None,
+    token: bytes,
 ) -> None:
     """Spawned-process entry: the shared worker loop (worker.py — the
     reference's receive -> stall -> compute -> send convention, SURVEY
@@ -43,7 +46,7 @@ def _native_worker_main(
     from ..worker import run_worker
 
     try:
-        run_worker(path, rank, work_fn, delay_fn)
+        run_worker(path, rank, work_fn, delay_fn, token=token)
     except (KeyboardInterrupt, Exception):
         pass
 
@@ -70,6 +73,7 @@ class NativeProcessBackend(Backend):
         address: str | None = None,
         spawn: bool = True,
         accept: bool = True,
+        auth: bytes | str | None = None,
     ):
         """``address``: Unix-socket path (default: a fresh temp path) or
         ``tcp://host:port`` for multi-host (port 0 = ephemeral; the
@@ -80,7 +84,18 @@ class NativeProcessBackend(Backend):
         the workers' side). ``accept=False`` defers the handshake: the
         constructor returns immediately after binding so ``address``
         (with its resolved ephemeral port) can be handed to workers
-        first; call :meth:`accept` before the first dispatch."""
+        first; call :meth:`accept` before the first dispatch.
+
+        ``auth``: shared secret every connecting worker must prove (via
+        HMAC challenge-response in the hello; the secret never crosses
+        the wire). With ``spawn=True`` a random per-backend secret is
+        generated automatically, so locally spawned pools are always
+        authenticated. With ``spawn=False`` the default is open —
+        SECURITY: an unauthenticated TCP listener admits *any* process
+        that can reach the port, and payloads are unpickled (arbitrary
+        code execution); either pass an ``auth`` secret (give workers
+        the same one via ``MSGT_AUTH`` / ``--auth-file``) or bind only
+        on a trusted network."""
         self.n_workers = int(n_workers)
         self.work_fn = work_fn
         self.delay_fn = delay_fn
@@ -90,22 +105,40 @@ class NativeProcessBackend(Backend):
         self._spawn = bool(spawn)
         if self._spawn and work_fn is None:
             raise ValueError("work_fn is required when spawning workers")
-        self._seqs = [0] * self.n_workers
-        self._epochs = [0] * self.n_workers  # epoch of in-flight dispatch
+        # seq numbers are allocated per RANK (unique across tags) so a
+        # frame identifies its dispatch unambiguously; per-channel state
+        # is keyed (rank, tag) — tags multiplex independent message
+        # streams over one connection, like MPI tags on a communicator
+        # (reference test/kmap2.jl:11-12)
+        self._seq_counter = [0] * self.n_workers
+        self._cur: dict[tuple[int, int], int] = {}     # (rank, tag) -> seq
+        self._epochs: dict[tuple[int, int], int] = {}  # epoch in flight
+        # frames that arrived for a channel other than the one being
+        # awaited; at most one live frame per channel (slot discipline)
+        self._stash: dict[tuple[int, int], deque] = {}
         # per-epoch payload serialization cache (see _serialize)
         self._pick_src = None
         self._pick_epoch = None
         self._pick_bytes = b""
         # dispatch that failed instantly (dead worker): surfaced at the
         # next test/wait instead of raising inside the pool's send phase
-        self._synthetic: list[WorkerError | None] = [None] * self.n_workers
+        self._synthetic: dict[tuple[int, int], WorkerError] = {}
         if address is None:
             address = str(
                 Path(tempfile.gettempdir())
                 / f"msgt-{uuid.uuid4().hex[:12]}.sock"
             )
+        if auth is None:
+            # spawned workers inherit the secret through the process args,
+            # so authentication costs nothing — default it on. External
+            # workers need the secret delivered out-of-band, so open is
+            # the only workable spawn=False default (documented above).
+            auth = secrets.token_bytes(16) if self._spawn else b""
+        self._token = auth.encode() if isinstance(auth, str) else bytes(auth)
         self._mp_context = mp_context
-        self._coord = T.Coordinator(address, self.n_workers)
+        self._coord = T.Coordinator(
+            address, self.n_workers, token=self._token
+        )
         self._sock_path = self._coord.address  # ephemeral port resolved
         self._procs: list = [None] * self.n_workers
         self._accepted = False
@@ -139,7 +172,8 @@ class NativeProcessBackend(Backend):
         ctx = mp.get_context(self._mp_context)
         proc = ctx.Process(
             target=_native_worker_main,
-            args=(i, self._sock_path, self.work_fn, self.delay_fn),
+            args=(i, self._sock_path, self.work_fn, self.delay_fn,
+                  self._token),
             daemon=True,
             name=f"pool-native-worker-{i}",
         )
@@ -201,19 +235,22 @@ class NativeProcessBackend(Backend):
 
     def dispatch(self, i: int, sendbuf, epoch: int, *, tag: int = 0) -> None:
         self._check_ready()
+        key = (i, int(tag))
         data = self._serialize(sendbuf, int(epoch))
-        self._seqs[i] += 1
-        self._epochs[i] = int(epoch)
+        self._seq_counter[i] += 1
+        self._cur[key] = self._seq_counter[i]
+        self._epochs[key] = int(epoch)
         ok = self._coord.isend(
-            i, data, seq=self._seqs[i], epoch=int(epoch), tag=int(tag),
+            i, data, seq=self._seq_counter[i], epoch=int(epoch),
+            tag=int(tag),
         )
         if not ok:  # rank already dead: fail the task, don't hang the pool
-            self._synthetic[i] = WorkerError(i, epoch, WorkerProcessDied(i))
+            self._synthetic[key] = WorkerError(i, epoch, WorkerProcessDied(i))
 
-    def _decode(self, i: int, msg: T.Message):
+    def _decode(self, i: int, msg: T.Message, tag: int):
         if msg.kind == T.KIND_DEATH:
             return WorkerError(
-                i, self._epochs[i], WorkerProcessDied(i)
+                i, self._epochs.get((i, tag), 0), WorkerProcessDied(i)
             )
         if msg.kind == T.KIND_ERROR:
             exc_type, text, tb = pickle.loads(msg.payload)
@@ -222,17 +259,48 @@ class NativeProcessBackend(Backend):
             )
         return pickle.loads(msg.payload)
 
-    def _pop_synthetic(self, i: int):
-        out = self._synthetic[i]
-        self._synthetic[i] = None
-        return out
+    def _route(self, j: int, msg: T.Message, want_tag: int):
+        """Classify an arriving frame against channel ``(j, want_tag)``:
+        return the frame if it is this channel's current completion,
+        stash it if it belongs to another live channel, drop it if its
+        dispatch was superseded. DEATH frames always surface (they are
+        rank-wide, and the native marker is sticky — every channel that
+        waits on a dead rank sees one)."""
+        if msg.kind == T.KIND_DEATH:
+            return msg
+        mtag = int(msg.tag)
+        if msg.seq != self._cur.get((j, mtag), -1):
+            return None  # superseded dispatch; drop
+        if mtag != int(want_tag):
+            self._stash.setdefault((j, mtag), deque()).append(msg)
+            return None
+        return msg
 
-    def _next(self, i: int, *, block: bool, timeout: float | None = None):
-        """Fetch the completion for worker ``i``'s current dispatch,
-        skipping frames from superseded dispatches (stale seq)."""
+    def _stash_pop(self, key: tuple[int, int]) -> T.Message | None:
+        st = self._stash.get(key)
+        while st:
+            msg = st.popleft()
+            # re-verify: the channel may have re-dispatched (direct
+            # Backend-API use) while the frame sat stashed
+            if msg.seq == self._cur.get(key, -1):
+                return msg
+        return None
+
+    def _next(
+        self, i: int, *, block: bool, timeout: float | None = None,
+        tag: int = 0,
+    ):
+        """Fetch the completion for channel ``(i, tag)``'s current
+        dispatch, skipping frames from superseded dispatches (stale seq)
+        and parking frames that belong to other tags."""
         self._check_ready()
-        if self._synthetic[i] is not None:
-            return self._pop_synthetic(i)
+        key = (i, int(tag))
+        syn = self._synthetic.pop(key, None)
+        if syn is not None:
+            return syn
+        stashed = self._stash_pop(key)
+        if stashed is not None:
+            return self._decode(i, stashed, key[1])
         deadline = Deadline(timeout)
         while True:
             if block:
@@ -244,36 +312,59 @@ class NativeProcessBackend(Backend):
                 msg = self._coord.poll(i)
                 if msg is None:
                     return None
-            if msg.kind == T.KIND_DATA or msg.kind == T.KIND_ERROR:
-                if msg.seq != self._seqs[i]:
-                    continue  # superseded dispatch; drop and keep looking
-            return self._decode(i, msg)
+            msg = self._route(i, msg, key[1])
+            if msg is not None:
+                return self._decode(i, msg, key[1])
 
-    def test(self, i: int):
-        return self._next(i, block=False)
+    def test(self, i: int, *, tag: int = 0):
+        return self._next(i, block=False, tag=tag)
 
     def wait_any(
-        self, indices: Sequence[int], timeout: float | None = None
+        self,
+        indices: Sequence[int],
+        timeout: float | None = None,
+        *,
+        tags: Sequence[int] | None = None,
     ) -> tuple[int, object] | None:
         self._check_ready()
         idx = [int(j) for j in indices]
         if not idx:
             raise ValueError("wait_any over an empty index set would hang")
-        for j in idx:  # synthetic failures first — they're already complete
-            if self._synthetic[j] is not None:
-                return j, self._pop_synthetic(j)
+        tgs = [0] * len(idx) if tags is None else [int(t) for t in tags]
+        if len(tgs) != len(idx):
+            raise ValueError("tags must align one-to-one with indices")
+        # the same worker may be awaited on several channels at once
+        # (wait_any([0, 0], tags=[0, 1]) — SlotBackend honors this, so
+        # must we): route against the full awaited-pair set per rank
+        awaited: dict[int, list[int]] = {}
+        for j, t in zip(idx, tgs):
+            awaited.setdefault(j, []).append(t)
+        for j, t in zip(idx, tgs):
+            syn = self._synthetic.pop((j, t), None)
+            if syn is not None:  # already complete
+                return j, syn
+            stashed = self._stash_pop((j, t))
+            if stashed is not None:
+                return j, self._decode(j, stashed, t)
         deadline = Deadline(timeout)
         while True:
             got = self._coord.waitany(idx, timeout=deadline.remaining())
             if got is None:
                 return None  # timed out
             j, msg = got
-            if msg.kind in (T.KIND_DATA, T.KIND_ERROR) and msg.seq != self._seqs[j]:
-                continue
-            return j, self._decode(j, msg)
+            if msg.kind == T.KIND_DEATH:
+                # rank-wide: surface on this rank's first awaited channel
+                # (the sticky native marker re-fires for the others)
+                return j, self._decode(j, msg, awaited[j][0])
+            mtag = int(msg.tag)
+            if msg.seq != self._cur.get((j, mtag), -1):
+                continue  # superseded dispatch; drop
+            if mtag in awaited[j]:
+                return j, self._decode(j, msg, mtag)
+            self._stash.setdefault((j, mtag), deque()).append(msg)
 
-    def wait(self, i: int, timeout: float | None = None):
-        return self._next(i, block=True, timeout=timeout)
+    def wait(self, i: int, timeout: float | None = None, *, tag: int = 0):
+        return self._next(i, block=True, timeout=timeout, tag=tag)
 
     def respawn(self, i: int, *, connect_timeout: float = 60.0) -> None:
         """Elastic recovery: replace a dead worker process with a fresh
@@ -298,8 +389,9 @@ class NativeProcessBackend(Backend):
         self._spawn_worker(i)
         # reaccept tolerates a not-yet-drained HUP within its timeout
         self._coord.reaccept(i, timeout=connect_timeout)
-        # _synthetic[i], if set, stays: it records a dispatch the old
-        # incarnation never received — the pool must still see it fail
+        # synthetic failures for rank i, if set, stay: they record
+        # dispatches the old incarnation never received — the pool must
+        # still see them fail
 
     def reaccept(self, i: int, *, timeout: float = 60.0) -> None:
         """External-worker recovery (``spawn=False``): after the remote
@@ -318,6 +410,20 @@ class NativeProcessBackend(Backend):
         self._pick_src = None
         self._pick_bytes = b""
         self._pick_epoch = None
+        if not self._accepted:
+            # handshake never completed: there is no connection to send a
+            # control frame on and nothing graceful to wait for — a
+            # join-first drain would burn join_timeout per blocked worker
+            for p in self._procs:
+                if p is not None and p.is_alive():
+                    p.terminate()
+            for p in self._procs:
+                if p is not None:
+                    p.join(timeout=self._join_timeout)
+                    if not p.is_alive():
+                        p.close()
+            self._coord.close()
+            return
         for i in range(self.n_workers):
             # control-channel broadcast (reference test/kmap2.jl:14-18)
             self._coord.isend(i, b"", kind=T.KIND_CONTROL)

@@ -63,17 +63,18 @@ def _worker_main(i: int, conn, work_fn: WorkFn, delay_fn: DelayFn | None) -> Non
             msg = conn.recv()
             if msg is None:  # shutdown sentinel (control channel)
                 break
-            seq, payload, epoch = msg
+            seq, payload, epoch, tag = msg
             if delay_fn is not None:
                 d = float(delay_fn(i, epoch))
                 if d > 0:
                     time.sleep(d)
             try:
-                out = (seq, epoch, "ok", work_fn(i, payload, epoch))
+                out = (seq, epoch, "ok", work_fn(i, payload, epoch), tag)
             except BaseException as e:
                 out = (
                     seq, epoch, "error",
                     (type(e).__name__, str(e), traceback.format_exc()),
+                    tag,
                 )
             try:
                 conn.send(out)
@@ -82,6 +83,7 @@ def _worker_main(i: int, conn, work_fn: WorkFn, delay_fn: DelayFn | None) -> Non
                     seq, epoch, "error",
                     (type(e).__name__,
                      f"worker result could not be serialized: {e}", ""),
+                    tag,
                 ))
     except (EOFError, OSError, KeyboardInterrupt):
         pass
@@ -190,13 +192,13 @@ class ProcessBackend(SlotBackend):
                 return
             if msg is None:
                 return
-            seq, epoch, kind, payload = msg
+            seq, epoch, kind, payload, tag = msg
             if kind == "error":
                 exc_type, message, tb = payload
                 payload = WorkerError(
                     i, epoch, RemoteWorkerError(exc_type, message, tb)
                 )
-            self._complete(i, seq, payload)
+            self._complete(i, seq, payload, tag)
 
     def _on_worker_death(self, i: int, conn) -> None:
         """Fail the outstanding task (if any) so waits don't hang — the
@@ -204,31 +206,40 @@ class ProcessBackend(SlotBackend):
         if self._conns[i] is not conn:
             return  # stale EOF from a pre-respawn incarnation
         self._dead[i] = True
+        # fail the outstanding task on EVERY tag channel: the process is
+        # gone, so no channel's completion can ever arrive
         with self._cond:
-            slot = self._slots[i]
-            pending = slot.outstanding and not slot.done
-            seq = slot.seq
-        if pending and not self._closed:
-            self._complete(
-                i, seq, WorkerError(i, -1, WorkerProcessDied(i))
-            )
+            pending = [
+                (tag, slots[i].seq)
+                for tag, slots in self._channels.items()
+                if slots[i].outstanding and not slots[i].done
+            ]
+        if not self._closed:
+            for tag, seq in pending:
+                self._complete(
+                    i, seq, WorkerError(i, -1, WorkerProcessDied(i)), tag
+                )
 
     # -- SlotBackend surface ----------------------------------------------
     def _start(self, i: int, sendbuf, epoch: int, seq: int, tag: int) -> None:
         if self._closed:
             raise RuntimeError("backend has been shut down")
         if self._dead[i]:  # fail fast instead of writing to a broken pipe
-            self._complete(i, seq, WorkerError(i, epoch, WorkerProcessDied(i)))
+            self._complete(
+                i, seq, WorkerError(i, epoch, WorkerProcessDied(i)), tag
+            )
             return
         payload = sendbuf
         if hasattr(payload, "__array__") and not isinstance(payload, np.ndarray):
             payload = np.asarray(payload)  # device arrays are not picklable
         try:
             with self._send_lock:
-                self._conns[i].send((seq, payload, epoch))
+                self._conns[i].send((seq, payload, epoch, tag))
         except (BrokenPipeError, OSError):
             self._dead[i] = True
-            self._complete(i, seq, WorkerError(i, epoch, WorkerProcessDied(i)))
+            self._complete(
+                i, seq, WorkerError(i, epoch, WorkerProcessDied(i)), tag
+            )
 
     def shutdown(self) -> None:
         if self._closed:

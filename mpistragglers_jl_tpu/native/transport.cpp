@@ -142,6 +142,163 @@ void set_nonblocking(int fd) {
   fcntl(fd, F_SETFL, flags | O_NONBLOCK);
 }
 
+// ---------------------------------------------------------------- auth
+// Shared-secret hello authentication (challenge-response, HMAC-SHA256 —
+// the multiprocessing-authkey pattern). Without it, any process that can
+// reach the listen socket can complete a hello and feed frames to the
+// coordinator's deserializer. The secret itself never crosses the wire:
+// the coordinator sends a random challenge, the worker proves knowledge
+// of the key by returning HMAC(key, challenge). SHA-256 per FIPS 180-4;
+// implemented inline because this image links no crypto library.
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t total = 0;
+  uint8_t buf[64];
+  size_t buflen = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {
+        0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u, 0xa54ff53au,
+        0x510e527fu, 0x9b05688cu, 0x1f83d9abu, 0x5be0cd19u};
+    std::memcpy(h, init, sizeof(h));
+  }
+
+  static uint32_t rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+  }
+
+  void block(const uint8_t* p) {
+    static const uint32_t K[64] = {
+        0x428a2f98u, 0x71374491u, 0xb5c0fbcfu, 0xe9b5dba5u, 0x3956c25bu,
+        0x59f111f1u, 0x923f82a4u, 0xab1c5ed5u, 0xd807aa98u, 0x12835b01u,
+        0x243185beu, 0x550c7dc3u, 0x72be5d74u, 0x80deb1feu, 0x9bdc06a7u,
+        0xc19bf174u, 0xe49b69c1u, 0xefbe4786u, 0x0fc19dc6u, 0x240ca1ccu,
+        0x2de92c6fu, 0x4a7484aau, 0x5cb0a9dcu, 0x76f988dau, 0x983e5152u,
+        0xa831c66du, 0xb00327c8u, 0xbf597fc7u, 0xc6e00bf3u, 0xd5a79147u,
+        0x06ca6351u, 0x14292967u, 0x27b70a85u, 0x2e1b2138u, 0x4d2c6dfcu,
+        0x53380d13u, 0x650a7354u, 0x766a0abbu, 0x81c2c92eu, 0x92722c85u,
+        0xa2bfe8a1u, 0xa81a664bu, 0xc24b8b70u, 0xc76c51a3u, 0xd192e819u,
+        0xd6990624u, 0xf40e3585u, 0x106aa070u, 0x19a4c116u, 0x1e376c08u,
+        0x2748774cu, 0x34b0bcb5u, 0x391c0cb3u, 0x4ed8aa4au, 0x5b9cca4fu,
+        0x682e6ff3u, 0x748f82eeu, 0x78a5636fu, 0x84c87814u, 0x8cc70208u,
+        0x90befffau, 0xa4506cebu, 0xbef9a3f7u, 0xc67178f2u};
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t* p, size_t n) {
+    total += n;
+    if (buflen > 0) {
+      size_t take = 64 - buflen < n ? 64 - buflen : n;
+      std::memcpy(buf + buflen, p, take);
+      buflen += take;
+      p += take;
+      n -= take;
+      if (buflen == 64) {
+        block(buf);
+        buflen = 0;
+      }
+    }
+    while (n >= 64) {
+      block(p);
+      p += 64;
+      n -= 64;
+    }
+    if (n > 0) {
+      std::memcpy(buf, p, n);
+      buflen = n;
+    }
+  }
+
+  void digest(uint8_t out[32]) {
+    uint64_t bits = total * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (buflen != 56) update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    // bypass `total` accounting for the length field itself
+    std::memcpy(buf + 56, lenb, 8);
+    block(buf);
+    for (int i = 0; i < 8; i++) {
+      out[4 * i] = uint8_t(h[i] >> 24);
+      out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8);
+      out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+void hmac_sha256(const uint8_t* key, size_t keylen, const uint8_t* msg,
+                 size_t msglen, uint8_t out[32]) {
+  uint8_t k[64] = {0};
+  if (keylen > 64) {
+    Sha256 kh;
+    kh.update(key, keylen);
+    kh.digest(k);
+  } else {
+    std::memcpy(k, key, keylen);
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; i++) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  uint8_t inner[32];
+  Sha256 hi;
+  hi.update(ipad, 64);
+  hi.update(msg, msglen);
+  hi.digest(inner);
+  Sha256 ho;
+  ho.update(opad, 64);
+  ho.update(inner, 32);
+  ho.digest(out);
+}
+
+void fill_random(uint8_t* buf, size_t n) {
+  int fd = ::open("/dev/urandom", O_RDONLY | O_CLOEXEC);
+  if (fd >= 0) {
+    bool ok = read_full(fd, buf, n);
+    ::close(fd);
+    if (ok) return;
+  }
+  // no /dev/urandom: degrade to clock+address entropy — still unique
+  // per handshake, which is what the challenge needs
+  uint64_t seed =
+      std::chrono::steady_clock::now().time_since_epoch().count() ^
+      reinterpret_cast<uintptr_t>(buf);
+  for (size_t i = 0; i < n; i++) {
+    seed = seed * 6364136223846793005ull + 1442695040888963407ull;
+    buf[i] = uint8_t(seed >> 33);
+  }
+}
+
+constexpr size_t kChallengeLen = 16;
+constexpr size_t kMacLen = 32;
+
 // Per-peer connection state owned by the progress thread.
 struct Peer {
   int fd = -1;
@@ -170,9 +327,12 @@ struct Coordinator {
   std::thread progress;
   std::atomic<bool> stopping{false};
 
+  std::string token;  // shared secret; empty = no authentication
+
   std::mutex mu;                   // guards peers' queues + completed
   std::condition_variable cv;      // notified on arrival / death
   std::vector<Peer> peers;
+  std::vector<int> parked;  // authenticated reconnects awaiting reaccept
   std::vector<std::deque<Frame>> completed;  // inbound frames per rank
   std::string error;  // first fatal progress-engine error, for diagnostics
 
@@ -182,6 +342,8 @@ struct Coordinator {
     if (wake_fd >= 0) ::close(wake_fd);
     for (auto& p : peers)
       if (p.fd >= 0) ::close(p.fd);
+    for (int fd : parked)
+      if (fd >= 0) ::close(fd);
     if (!path.empty()) ::unlink(path.c_str());
   }
 };
@@ -338,7 +500,14 @@ void progress_main(Coordinator* c) {
       if (id == WAKE_TOKEN) continue;
       int rank = static_cast<int>(id);
       Peer& p = c->peers[rank];
-      if (p.dead || p.fd < 0) continue;
+      {
+        // peer liveness is mutated by reaccept() on the caller thread;
+        // take the lock for the check so the read is ordered (a stale
+        // event for a since-replaced fd then pumps the NEW nonblocking
+        // fd, which just returns EAGAIN — benign)
+        std::lock_guard<std::mutex> lk(c->mu);
+        if (p.dead || p.fd < 0) continue;
+      }
       bool ok = true;
       if (events[i].events & (EPOLLIN | EPOLLRDHUP | EPOLLHUP | EPOLLERR))
         ok = pump_read(c, rank);
@@ -358,11 +527,40 @@ struct WorkerCtx {
   }
 };
 
-// Accept one connection and read its hello frame before `deadline`.
-// `expected_rank` = -1 accepts any rank not yet connected; otherwise the
-// hello must carry exactly that rank (others are dropped and the wait
-// continues). On success returns the rank and stores the (still
-// blocking-mode) fd in *fd_out; on timeout/failure returns -1.
+// Coordinator side of the hello auth exchange, run with SO_RCVTIMEO
+// still armed on `fd`. Always sends an ack frame telling the worker
+// whether a proof is required (len = challenge size, or 0 for open
+// transports), then verifies HMAC(token, challenge) when it is.
+bool verify_hello_auth(Coordinator* c, int fd) {
+  if (c->token.empty()) {
+    Header ack{0, 0, 0, 0, KIND_HELLO};
+    return write_full(fd, &ack, sizeof(ack));
+  }
+  uint8_t challenge[kChallengeLen];
+  fill_random(challenge, sizeof(challenge));
+  Header ack{kChallengeLen, 0, 0, 0, KIND_HELLO};
+  if (!write_full(fd, &ack, sizeof(ack))) return false;
+  if (!write_full(fd, challenge, sizeof(challenge))) return false;
+  Header resp{};
+  if (!read_full(fd, &resp, sizeof(resp))) return false;
+  if (resp.kind != KIND_HELLO || resp.len != kMacLen) return false;
+  uint8_t mac[kMacLen], expect[kMacLen];
+  if (!read_full(fd, mac, sizeof(mac))) return false;
+  hmac_sha256(reinterpret_cast<const uint8_t*>(c->token.data()),
+              c->token.size(), challenge, sizeof(challenge), expect);
+  uint8_t diff = 0;  // constant-time compare
+  for (size_t i = 0; i < kMacLen; i++) diff |= mac[i] ^ expect[i];
+  return diff == 0;
+}
+
+// Accept one connection, read its hello frame, and run the auth
+// exchange, all before `deadline`. `expected_rank` = -1 accepts any rank
+// not yet connected; otherwise the hello must carry exactly that rank —
+// authenticated reconnects from OTHER currently-dead ranks are *parked*
+// (not closed) so two concurrently restarting external workers cannot
+// lose each other's handshake (their reaccept() picks the parked socket
+// up). On success returns the rank and stores the (still blocking-mode)
+// fd in *fd_out; on timeout/failure returns -1.
 int accept_hello(Coordinator* c,
                  std::chrono::steady_clock::time_point deadline,
                  int expected_rank, int* fd_out) {
@@ -380,9 +578,9 @@ int accept_hello(Coordinator* c,
     int fd = ::accept(c->listen_fd, nullptr, nullptr);
     if (fd < 0) continue;
     if (c->tcp) tune_tcp(fd);
-    // cap the per-hello read at 2 s: a silent stray connection (scanner,
-    // health check that sends no bytes) must burn seconds, not the whole
-    // handshake deadline while real workers wait in the backlog
+    // cap the per-hello exchange at 2 s: a silent stray connection
+    // (scanner, health check that sends no bytes) must burn seconds, not
+    // the whole handshake deadline while real workers wait in the backlog
     left = remaining_ms();
     if (left > 2000) left = 2000;
     timeval tv{};
@@ -391,23 +589,43 @@ int accept_hello(Coordinator* c,
     setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     Header hello{};
     bool ok = read_full(fd, &hello, sizeof(hello));
+    bool valid = ok && hello.kind == KIND_HELLO && hello.len == 0 &&
+                 hello.seq >= 0 && hello.seq < c->n;
+    // the auth exchange runs under the same read timeout; an
+    // unauthenticated peer never gets past this point
+    if (valid) valid = verify_hello_auth(c, fd);
     timeval off{};  // back to no timeout before the caller takes over
     setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
-    bool valid = ok && hello.kind == KIND_HELLO && hello.seq >= 0 &&
-                 hello.seq < c->n;
-    if (valid && expected_rank >= 0 && hello.seq != expected_rank)
-      valid = false;  // someone else's (re)connect; not ours
-    if (valid && expected_rank < 0 && c->peers[hello.seq].fd >= 0)
-      valid = false;  // duplicate rank during initial handshake
     if (!valid) {
       // drop and keep waiting: on a public TCP listener a stray
-      // connection (port scanner, health check) or duplicate rank must
-      // not abort the handshake — only the deadline ends it
+      // connection (port scanner, bad secret) must not abort the
+      // handshake — only the deadline ends it
       ::close(fd);
       continue;
     }
+    int rank = static_cast<int>(hello.seq);
+    if (expected_rank >= 0 && rank != expected_rank) {
+      // someone else's reconnect. If that rank is currently dead this is
+      // a legitimate concurrent restart: park the authenticated socket
+      // for its own reaccept() call instead of dropping it.
+      bool parked = false;
+      {
+        std::lock_guard<std::mutex> lk(c->mu);
+        if (c->peers[rank].dead) {
+          if (c->parked[rank] >= 0) ::close(c->parked[rank]);
+          c->parked[rank] = fd;
+          parked = true;
+        }
+      }
+      if (!parked) ::close(fd);
+      continue;
+    }
+    if (expected_rank < 0 && c->peers[rank].fd >= 0) {
+      ::close(fd);  // duplicate rank during initial handshake
+      continue;
+    }
     *fd_out = fd;
-    return static_cast<int>(hello.seq);
+    return rank;
   }
 }
 
@@ -419,12 +637,21 @@ extern "C" {
 
 // Create the coordinator: bind + listen at `addr` — a Unix-socket path,
 // or "tcp://host:port" for multi-host (port 0 = ephemeral; read it back
-// with msgt_coord_port). Returns an opaque handle, or nullptr on failure.
-void* msgt_coord_create(const char* addr_str, int n_workers) {
+// with msgt_coord_port). `token`/`token_len` install a shared secret:
+// every hello must then prove knowledge of it via challenge-response
+// (HMAC-SHA256) before the rank is admitted; pass token_len = 0 for an
+// unauthenticated transport (trusted-network deployments only).
+// Returns an opaque handle, or nullptr on failure.
+void* msgt_coord_create(const char* addr_str, int n_workers,
+                        const uint8_t* token, int token_len) {
   auto* c = new Coordinator();
   c->n = n_workers;
   c->peers.resize(n_workers);
+  c->parked.assign(n_workers, -1);
   c->completed.resize(n_workers);
+  if (token != nullptr && token_len > 0)
+    c->token.assign(reinterpret_cast<const char*>(token),
+                    static_cast<size_t>(token_len));
   std::string host;
   int port = 0;
   int ptcp = parse_tcp(addr_str, &host, &port);
@@ -632,8 +859,17 @@ int msgt_coord_reaccept(void* h, int rank, int64_t timeout_ms) {
     if (std::chrono::steady_clock::now() >= deadline) return -1;
     std::this_thread::sleep_for(std::chrono::milliseconds(5));
   }
+  // an authenticated reconnect may already be parked (it arrived while a
+  // DIFFERENT rank's reaccept was listening — concurrent restarts)
   int fd = -1;
-  if (accept_hello(c, deadline, rank, &fd) != rank) return -1;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    if (c->parked[rank] >= 0) {
+      fd = c->parked[rank];
+      c->parked[rank] = -1;
+    }
+  }
+  if (fd < 0 && accept_hello(c, deadline, rank, &fd) != rank) return -1;
   set_nonblocking(fd);
   {
     std::lock_guard<std::mutex> lk(c->mu);
@@ -686,10 +922,13 @@ void msgt_coord_destroy(void* h) {
 
 // ------------------------------------------------------------------- worker
 
-// Connect to the coordinator (Unix path or "tcp://host:port") and send
-// the hello frame carrying this worker's rank. Returns an opaque handle
-// or nullptr.
-void* msgt_worker_connect(const char* addr_str, int rank) {
+// Connect to the coordinator (Unix path or "tcp://host:port"), send the
+// hello frame carrying this worker's rank, and answer the coordinator's
+// auth challenge with HMAC(token, challenge) when one is issued. Returns
+// an opaque handle or nullptr (bad address, connection refused, or the
+// coordinator requires a secret this worker doesn't hold).
+void* msgt_worker_connect(const char* addr_str, int rank,
+                          const uint8_t* token, int token_len) {
   auto* w = new WorkerCtx();
   std::string host;
   int port = 0;
@@ -748,7 +987,51 @@ void* msgt_worker_connect(const char* addr_str, int rank) {
     delete w;
     return nullptr;
   }
+  // the coordinator always acks the hello: len == 0 means open transport,
+  // len == kChallengeLen means prove knowledge of the shared secret
+  Header ack{};
+  if (!read_full(w->fd, &ack, sizeof(ack)) || ack.kind != KIND_HELLO) {
+    delete w;
+    return nullptr;
+  }
+  if (token_len > 0 && ack.len == 0) {
+    // fail closed: this worker was configured with a secret, so an
+    // "open transport" ack means the peer is NOT the coordinator we
+    // were told to trust (e.g. a rogue listener that won the bind race
+    // against our connect-retry loop). Downgrading would hand it
+    // pickled payloads to execute.
+    delete w;
+    return nullptr;
+  }
+  if (ack.len > 0) {
+    if (ack.len != static_cast<int64_t>(kChallengeLen) || token == nullptr ||
+        token_len <= 0) {
+      delete w;  // auth demanded but we can't answer
+      return nullptr;
+    }
+    uint8_t challenge[kChallengeLen], mac[kMacLen];
+    if (!read_full(w->fd, challenge, sizeof(challenge))) {
+      delete w;
+      return nullptr;
+    }
+    hmac_sha256(token, static_cast<size_t>(token_len), challenge,
+                sizeof(challenge), mac);
+    Header resp{kMacLen, rank, 0, 0, KIND_HELLO};
+    if (!write_full(w->fd, &resp, sizeof(resp)) ||
+        !write_full(w->fd, mac, sizeof(mac))) {
+      delete w;
+      return nullptr;
+    }
+  }
   return w;
+}
+
+// Standalone HMAC-SHA256 (exposed for conformance testing against a
+// reference implementation; the handshake above depends on it).
+void msgt_hmac_sha256(const uint8_t* key, int keylen, const uint8_t* msg,
+                      int msglen, uint8_t* out32) {
+  hmac_sha256(key, static_cast<size_t>(keylen), msg,
+              static_cast<size_t>(msglen), out32);
 }
 
 // Blocking read of the next frame header. Returns 0 on success, -1 on

@@ -51,7 +51,9 @@ class Message:
 
 def _configure(lib):
     lib.msgt_coord_create.restype = ctypes.c_void_p
-    lib.msgt_coord_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.msgt_coord_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int
+    ]
     lib.msgt_coord_port.restype = ctypes.c_int
     lib.msgt_coord_port.argtypes = [ctypes.c_void_p]
     lib.msgt_coord_accept.restype = ctypes.c_int
@@ -88,7 +90,14 @@ def _configure(lib):
     lib.msgt_coord_destroy.restype = None
     lib.msgt_coord_destroy.argtypes = [ctypes.c_void_p]
     lib.msgt_worker_connect.restype = ctypes.c_void_p
-    lib.msgt_worker_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.msgt_worker_connect.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int
+    ]
+    lib.msgt_hmac_sha256.restype = None
+    lib.msgt_hmac_sha256.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
     lib.msgt_worker_recv_hdr.restype = ctypes.c_int
     lib.msgt_worker_recv_hdr.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(_Header)
@@ -118,19 +127,33 @@ class TransportError(RuntimeError):
     pass
 
 
+def hmac_sha256(key: bytes, msg: bytes) -> bytes:
+    """The native HMAC-SHA256 the hello handshake authenticates with,
+    exposed so tests can check conformance against :mod:`hmac`."""
+    lib = load_lib()
+    out = (ctypes.c_uint8 * 32)()
+    lib.msgt_hmac_sha256(key, len(key), msg, len(msg), out)
+    return bytes(out)
+
+
 class Coordinator:
     """Coordinator endpoint: owns the listening socket and the native
     progress thread; one connection per worker rank."""
 
-    def __init__(self, path: str, n_workers: int):
+    def __init__(self, path: str, n_workers: int, *, token: bytes = b""):
         """``path`` is a Unix-socket filesystem path (single host) or
         ``tcp://host:port`` (multi-host; port 0 binds an ephemeral port,
-        see :attr:`port`)."""
+        see :attr:`port`). A non-empty ``token`` turns on hello
+        authentication: every worker must present the same secret
+        (proved by HMAC-SHA256 challenge-response; the secret never
+        crosses the wire) before its rank is admitted. An empty token
+        admits any connector — acceptable only on trusted networks."""
         self._lib = load_lib()
         self.n_workers = int(n_workers)
         self.path = path
+        self.token = bytes(token)
         self._h = self._lib.msgt_coord_create(
-            path.encode(), self.n_workers
+            path.encode(), self.n_workers, self.token, len(self.token)
         )
         if not self._h:
             raise TransportError(f"could not bind coordinator socket {path}")
@@ -247,15 +270,25 @@ class Coordinator:
 
 
 class Worker:
-    """Worker endpoint: blocking framed recv/send on one socket."""
+    """Worker endpoint: blocking framed recv/send on one socket.
 
-    def __init__(self, path: str, rank: int):
+    The constructor is a round trip: it sends the hello and then blocks
+    until the coordinator's ``accept``/``reaccept`` admits the rank
+    (answering the auth challenge when one is issued). Construct it on
+    a thread/process other than the one that will call ``accept`` —
+    which is how workers run anyway (worker.py)."""
+
+    def __init__(self, path: str, rank: int, *, token: bytes = b""):
         self._lib = load_lib()
         self.rank = int(rank)
-        self._h = self._lib.msgt_worker_connect(path.encode(), self.rank)
+        token = bytes(token)
+        self._h = self._lib.msgt_worker_connect(
+            path.encode(), self.rank, token, len(token)
+        )
         if not self._h:
             raise TransportError(
-                f"worker {rank} could not connect to {path}"
+                f"worker {rank} could not connect to {path} (refused, "
+                "or the coordinator rejected the auth token)"
             )
 
     def recv(self) -> Message | None:

@@ -153,7 +153,11 @@ class DeviceRSGF256:
             raise ValueError(f"indices out of range [0, {self.n}): {idx}")
         inv = self._inv_cache.get(idx)
         if inv is None:
-            # tiny k x k GF inversion, exact, host-side
+            # tiny k x k GF inversion, exact, host-side. Bounded: churning
+            # arrival patterns over many epochs would otherwise grow the
+            # cache toward C(n, k) entries; recomputing is cheap.
+            if len(self._inv_cache) >= 4096:
+                self._inv_cache.clear()
             inv = jnp.asarray(_np_invert(self.G[list(idx)]))
             self._inv_cache[idx] = inv
         return inv

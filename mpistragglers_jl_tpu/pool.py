@@ -19,9 +19,12 @@ Key design departures from the reference, all TPU-motivated:
 * **Results may stay on device.** ``recvbuf`` is optional; when omitted,
   per-worker results are retained as (possibly device-resident) arrays in
   ``pool.results`` so a decode/combine step can consume them without a
-  host round-trip. When provided, ``recvbuf`` is partitioned into
-  ``n_workers`` equal chunks exactly like ``MPI.Gather!``
-  (src/MPIAsyncPools.jl:58-61) and arrivals are copied into their chunk.
+  host round-trip. When provided, ``recvbuf`` is byte-partitioned into
+  ``n_workers`` equal chunks exactly like ``MPI.Gather!`` over the
+  reference's ``reinterpret(UInt8, ...)`` views (src/MPIAsyncPools.jl:58-61,
+  :80-84) and arrivals are *bit-copied* into their chunk — payload-
+  agnostic and never value-cast, so mixed-dtype and structured payloads
+  round-trip exactly.
 * **The hot wait loop** (reference ``MPI.Waitany!``, src/MPIAsyncPools.jl:161)
   becomes host-side polling of per-dispatch completion events / JAX array
   readiness — see backends.
@@ -72,6 +75,11 @@ class AsyncPool:
                      order, not rank order.
     ``sepochs[i]``   epoch at which the in-flight dispatch to worker ``i``
                      was initiated.
+    ``stags[i]``     tag the in-flight dispatch was posted with — the
+                     analog of an MPI request remembering its tag, so
+                     harvests probe the right backend channel even when
+                     pools multiplex one backend on distinct tags
+                     (reference convention: test/kmap2.jl:11-12).
     ``repochs[i]``   epoch of the most recently received result — the
                      freshness oracle returned to callers.
     ``active[i]``    True iff worker ``i`` has an outstanding task.
@@ -105,6 +113,7 @@ class AsyncPool:
         if not (0 <= int(nwait) <= n):
             raise ValueError(f"default nwait must be in [0, {n}], got {nwait}")
         self.sepochs = np.full(n, epoch0, dtype=np.int64)
+        self.stags = np.zeros(n, dtype=np.int64)
         self.repochs = np.full(n, epoch0, dtype=np.int64)
         self.active = np.zeros(n, dtype=bool)
         self.stimestamps = np.zeros(n, dtype=np.int64)
@@ -147,26 +156,36 @@ class AsyncPool:
 
 
 def _recv_chunks(recvbuf: np.ndarray | None, n: int) -> list[np.ndarray] | None:
-    """Partition ``recvbuf`` into n equal chunks, ``MPI.Gather!`` layout.
+    """Partition ``recvbuf`` into n equal *byte* chunks, ``MPI.Gather!``
+    layout.
 
-    Reference: byte-view partitioning at src/MPIAsyncPools.jl:80-84. We
-    slice the flat element view rather than a byte reinterpretation; the
-    chunk-j <- worker-j correspondence is the same.
+    Reference parity: the reference type-erases every caller buffer via
+    ``reinterpret(UInt8, ...)`` and slices bytes
+    (src/MPIAsyncPools.jl:80-84, :206-209), which makes a pool
+    payload-agnostic — mixed dtypes, structured records, anything with a
+    fixed byte layout round-trips bit-exactly. Arrivals are **bit-copied**
+    into their chunk (never value-cast): a worker result whose byte size
+    doesn't fill the chunk is an error, not a silent ``astype``.
     """
     if recvbuf is None:
         return None
     if not isinstance(recvbuf, np.ndarray):
         raise TypeError("recvbuf must be a numpy ndarray (host gather arena)")
-    if recvbuf.dtype == object:
+    if recvbuf.dtype.hasobject:
         raise TypeError("recvbuf eltype must be a fixed-size dtype")
-    if recvbuf.size % n != 0:
-        # reference src/MPIAsyncPools.jl:77
+    if not recvbuf.flags.c_contiguous:
+        # a non-contiguous buffer cannot be byte-viewed; silently
+        # reshaping would write into a copy the caller never sees
+        raise ValueError("recvbuf must be C-contiguous")
+    if recvbuf.nbytes % n != 0:
+        # reference src/MPIAsyncPools.jl:77 (length % n there; bytes
+        # here, since chunks are byte spans)
         raise ValueError(
-            f"recvbuf length {recvbuf.size} must be a multiple of the "
-            f"number of workers {n}"
+            f"recvbuf ({recvbuf.nbytes} bytes) must partition evenly "
+            f"into {n} worker chunks"
         )
-    flat = recvbuf.reshape(-1)
-    rl = recvbuf.size // n
+    flat = recvbuf.reshape(-1).view(np.uint8)
+    rl = recvbuf.nbytes // n
     return [flat[i * rl : (i + 1) * rl] for i in range(n)]
 
 
@@ -186,16 +205,24 @@ def _store(
         pool.active[i] = False
         result.raise_()
     pool.results[i] = result
+    pool.repochs[i] = pool.sepochs[i]
     if recvbufs is not None:
         chunk = recvbufs[i]
-        arr = np.asarray(result).reshape(-1)
-        if arr.size != chunk.size:
+        arr = np.ascontiguousarray(result)
+        if arr.nbytes != chunk.nbytes:
+            # the arrival is real (results/repochs above reflect it) and
+            # the backend slot is consumed — mark the worker idle like the
+            # WorkerError path, or a later waitall blocks forever on a
+            # completion that was already taken
+            pool.active[i] = False
             raise ValueError(
-                f"worker {i} returned {arr.size} elements but recvbuf "
-                f"chunks hold {chunk.size}"
+                f"worker {i} returned {arr.nbytes} bytes "
+                f"({arr.size} x {arr.dtype}) but recvbuf chunks hold "
+                f"{chunk.nbytes} bytes; the pool bit-copies (reference "
+                "src/MPIAsyncPools.jl:80-84) — match the recvbuf dtype "
+                "width to the worker result, it is never value-cast"
             )
-        chunk[:] = arr.astype(chunk.dtype, copy=False)
-    pool.repochs[i] = pool.sepochs[i]
+        chunk[:] = arr.reshape(-1).view(np.uint8)
 
 
 def _dispatch(pool: AsyncPool, backend: Backend, i: int, sendbuf, tag: int) -> None:
@@ -206,6 +233,7 @@ def _dispatch(pool: AsyncPool, backend: Backend, i: int, sendbuf, tag: int) -> N
     ``isendbufs[i] .= sendbuf`` (:130) is the backend's responsibility here.
     """
     pool.sepochs[i] = pool.epoch
+    pool.stags[i] = int(tag)
     pool.stimestamps[i] = time.perf_counter_ns()
     backend.dispatch(i, sendbuf, pool.epoch, tag=tag)
     # only after the backend accepted the task: a failed dispatch must not
@@ -262,6 +290,20 @@ def asyncmap(
         # reference src/MPIAsyncPools.jl:157
         raise TypeError(f"nwait must be an int or callable, got {type(nwait)}")
     recvbufs = _recv_chunks(recvbuf, n)
+    # fail BEFORE any dispatch, like the reference's cross-buffer sizeof
+    # checks (src/MPIAsyncPools.jl:72-76): an active worker's in-flight
+    # result will be harvested into this recvbuf (stale arrivals are
+    # written too, reference :167), so a chunk that can't hold what that
+    # worker last produced is caught here, not mid-epoch at harvest.
+    if recvbufs is not None:
+        for i in np.flatnonzero(pool.active):
+            nb = getattr(pool.results[i], "nbytes", None)
+            if nb is not None and nb != recvbufs[i].nbytes:
+                raise ValueError(
+                    f"recvbuf chunks hold {recvbufs[i].nbytes} bytes but "
+                    f"in-flight worker {int(i)} last produced {nb} bytes; "
+                    "size the recvbuf before dispatching"
+                )
 
     # each call to asyncmap is the start of a new epoch
     # (reference src/MPIAsyncPools.jl:87)
@@ -280,7 +322,7 @@ def asyncmap(
         for i in range(n):
             if not pool.active[i]:
                 continue
-            result = backend.test(i)
+            result = backend.test(i, tag=int(pool.stags[i]))
             if result is None:
                 continue
             _store(pool, i, result, recvbufs)
@@ -316,8 +358,9 @@ def asyncmap(
                     break
             # block until any active worker responds
             # (reference MPI.Waitany! at src/MPIAsyncPools.jl:161)
+            act = np.flatnonzero(pool.active)
             got = backend.wait_any(
-                np.flatnonzero(pool.active), timeout=deadline.remaining()
+                act, timeout=deadline.remaining(), tags=pool.stags[act]
             )
             if got is None:
                 raise DeadWorkerError(
@@ -377,8 +420,9 @@ def waitall(
             # requests before any timestamping; utils/straggle.py fits
             # latency models to these numbers, so they must be true
             # per-worker round-trip times)
+            act = np.flatnonzero(pool.active)
             got = backend.wait_any(
-                np.flatnonzero(pool.active), timeout=deadline.remaining()
+                act, timeout=deadline.remaining(), tags=pool.stags[act]
             )
             if got is None:
                 dead = [int(j) for j in np.flatnonzero(pool.active)]

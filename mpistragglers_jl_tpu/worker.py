@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import importlib
+import os
 import pickle
 import time
 import traceback
@@ -41,14 +42,23 @@ def run_worker(
     rank: int,
     work_fn,
     delay_fn: DelayFn | None = None,
+    *,
+    token: bytes = b"",
+    connect_timeout: float = 30.0,
 ) -> None:
     """Connect to the coordinator and serve until shutdown.
 
     ``work_fn(rank, payload, epoch) -> result`` with picklable results;
     exceptions are captured and shipped back as failures, not lost the
     way reference worker assertions die inside mpiexec (SURVEY §4).
+
+    The connect retries with backoff until ``connect_timeout``: a worker
+    that races the coordinator's bind, or whose hello lands while the
+    coordinator is busy reaccepting a different rank, re-attempts
+    instead of exiting and permanently losing the rank. ``token`` is the
+    shared auth secret (must match the coordinator's, if it has one).
     """
-    w = T.Worker(address, rank)
+    w = _connect_retry(address, rank, token, connect_timeout)
     try:
         while True:
             msg = w.recv()
@@ -74,10 +84,30 @@ def run_worker(
                     protocol=5,
                 )
                 kind = T.KIND_ERROR
-            if not w.send(out, seq=msg.seq, epoch=msg.epoch, kind=kind):
+            # echo seq AND tag: the coordinator routes completions to the
+            # (rank, tag) channel the dispatch was posted on
+            if not w.send(
+                out, seq=msg.seq, epoch=msg.epoch, tag=msg.tag, kind=kind
+            ):
                 break
     finally:
         w.close()
+
+
+def _connect_retry(
+    address: str, rank: int, token: bytes, timeout: float
+) -> T.Worker:
+    deadline = time.perf_counter() + timeout
+    delay = 0.05
+    while True:
+        try:
+            return T.Worker(address, rank, token=token)
+        except T.TransportError:
+            left = deadline - time.perf_counter()
+            if left <= 0:
+                raise
+            time.sleep(min(delay, left))
+            delay = min(delay * 2, 1.0)
 
 
 def resolve_callable(spec: str):
@@ -137,13 +167,20 @@ def main(argv=None) -> None:
         "--delay", default=None,
         help="optional delay_fn as module:attribute (straggler injection)",
     )
+    ap.add_argument(
+        "--auth-file", default=None,
+        help="file holding the shared auth secret (the coordinator's "
+        "`auth=` bytes); the MSGT_AUTH environment variable is the "
+        "argv-invisible alternative. No flag/env = unauthenticated",
+    )
     args = ap.parse_args(argv)
     ranks = parse_ranks(args.ranks)
+    token = _resolve_token(args.auth_file)
     # resolve in the parent too: a typo'd spec fails fast, before spawn
     work_fn = resolve_callable(args.work)
     delay_fn = resolve_callable(args.delay) if args.delay else None
     if len(ranks) == 1:
-        run_worker(args.address, ranks[0], work_fn, delay_fn)
+        run_worker(args.address, ranks[0], work_fn, delay_fn, token=token)
         return
     # one OS process per rank (ranks must not share a Python process:
     # work_fn may hold the GIL, and per-rank crash isolation is the
@@ -157,7 +194,7 @@ def main(argv=None) -> None:
     procs = [
         ctx.Process(
             target=_spawned_rank_main,
-            args=(args.address, r, args.work, args.delay),
+            args=(args.address, r, args.work, args.delay, token),
             name=f"pool-cli-worker-{r}",
         )
         for r in ranks
@@ -189,8 +226,30 @@ def main(argv=None) -> None:
         )
 
 
+def _resolve_token(auth_file: str | None) -> bytes:
+    """Auth secret from ``--auth-file`` (wins) or ``MSGT_AUTH``.
+
+    The file is read verbatim except for one trailing newline (the
+    editor artifact): secrets are arbitrary bytes, and a broad strip
+    would corrupt any token that happens to start or end with a
+    whitespace byte — HMAC then never matches and the worker is
+    refused with no hint why.
+    """
+    if auth_file is not None:
+        with open(auth_file, "rb") as f:
+            data = f.read()
+        if data.endswith(b"\n"):
+            data = data[:-1]
+        if data.endswith(b"\r"):
+            data = data[:-1]
+        return data
+    env = os.environ.get("MSGT_AUTH")
+    return env.encode() if env else b""
+
+
 def _spawned_rank_main(
-    address: str, rank: int, work_spec: str, delay_spec: str | None
+    address: str, rank: int, work_spec: str, delay_spec: str | None,
+    token: bytes = b"",
 ) -> None:
     """Child entry for multi-rank mode: resolve specs locally, serve."""
     run_worker(
@@ -198,6 +257,7 @@ def _spawned_rank_main(
         rank,
         resolve_callable(work_spec),
         resolve_callable(delay_spec) if delay_spec else None,
+        token=token,
     )
 
 

@@ -156,12 +156,26 @@ def test_transport_large_payload():
 
 def test_transport_dead_peer_is_sticky():
     """A disconnected worker polls ready with a death marker forever —
-    the anti-hang property the reference's Waitall! lacks (SURVEY §5)."""
+    the anti-hang property the reference's Waitall! lacks (SURVEY §5).
+
+    The connect runs in a thread: since the hello exchange became a
+    round trip (auth ack), ``Worker()`` blocks until the coordinator's
+    ``accept`` admits the rank, so constructing it on the accept thread
+    would deadlock."""
+    import threading
+
     coord, path = _transport_pair(1)
+    connected = []
+
+    def connect():
+        connected.append(T.Worker(path, 0))
+
+    t = threading.Thread(target=connect, daemon=True)
+    t.start()
     try:
-        w = T.Worker(path, 0)
         coord.accept(timeout=10)
-        w.close()  # peer vanishes
+        t.join(timeout=10)
+        connected[0].close()  # peer vanishes
         rank, msg = coord.waitany([0], timeout=10)
         assert rank == 0 and msg.kind == T.KIND_DEATH
         assert coord.is_dead(0)
@@ -585,5 +599,305 @@ def test_dead_worker_fails_fast_not_hangs():
         with pytest.raises(WorkerFailure):
             asyncmap(pool, np.array([2.0]), backend, nwait=n)
             waitall(pool, backend)
+    finally:
+        backend.shutdown()
+
+
+# ------------------------------------------------------------------- auth
+
+
+def test_hmac_conformance_against_stdlib():
+    """The native HMAC-SHA256 the handshake trusts must match RFC 2104
+    (checked against the stdlib implementation, including the >64-byte
+    key-hashing path)."""
+    import hashlib
+    import hmac as stdlib_hmac
+
+    for key, msg in [
+        (b"key", b"The quick brown fox jumps over the lazy dog"),
+        (b"", b""),
+        (b"k" * 100, b"m" * 1000),  # key longer than the SHA-256 block
+        (b"secret", bytes(range(256)) * 3),
+    ]:
+        want = stdlib_hmac.new(key, msg, hashlib.sha256).digest()
+        assert T.hmac_sha256(key, msg) == want
+
+
+def test_auth_token_roundtrip():
+    """Workers holding the shared secret are admitted and serve."""
+    import tempfile
+    import threading
+    import uuid
+
+    path = os.path.join(
+        tempfile.gettempdir(), f"msgt-auth-{uuid.uuid4().hex[:8]}.sock"
+    )
+    coord = T.Coordinator(path, 2, token=b"s3cret")
+
+    def worker(rank):
+        w = T.Worker(path, rank, token=b"s3cret")
+        msg = w.recv()
+        if msg is not None and msg.kind == T.KIND_DATA:
+            w.send(msg.payload + bytes([rank]), seq=msg.seq)
+            w.recv()  # control
+        w.close()
+
+    threads = [
+        __import__("threading").Thread(target=worker, args=(r,), daemon=True)
+        for r in range(2)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        coord.accept(timeout=10)
+        coord.isend(0, b"a", seq=1)
+        coord.isend(1, b"b", seq=1)
+        got = {}
+        for _ in range(2):
+            rank, msg = coord.waitany([0, 1], timeout=10)
+            got[rank] = msg.payload
+        assert got == {0: b"a\x00", 1: b"b\x01"}
+        for r in range(2):
+            coord.isend(r, b"", kind=T.KIND_CONTROL)
+        for t in threads:
+            t.join(timeout=5)
+    finally:
+        coord.close()
+
+
+def test_auth_rejects_wrong_and_missing_token():
+    """A connector without the right secret is refused: its connect
+    fails, and the coordinator handshake never admits it."""
+    import tempfile
+    import threading
+    import uuid
+
+    path = os.path.join(
+        tempfile.gettempdir(), f"msgt-auth-{uuid.uuid4().hex[:8]}.sock"
+    )
+    coord = T.Coordinator(path, 1, token=b"right")
+    outcomes = []
+
+    def bad_worker(token):
+        try:
+            w = T.Worker(path, 0, token=token)
+        except T.TransportError:
+            # no token to answer the challenge with: fails at connect
+            outcomes.append("refused-at-connect")
+            return
+        # wrong token: the worker can't distinguish acceptance until it
+        # reads — the coordinator drops the connection after the failed
+        # proof, so the first recv reports the coordinator gone
+        outcomes.append("closed" if w.recv() is None else "admitted")
+        w.close()
+
+    threads = [
+        threading.Thread(target=bad_worker, args=(tok,), daemon=True)
+        for tok in (b"wrong", b"")
+    ]
+    for t in threads:
+        t.start()
+    try:
+        with pytest.raises(T.TransportError):
+            coord.accept(timeout=1.0)  # no impostor is ever admitted
+        for t in threads:
+            t.join(timeout=10)
+        assert sorted(outcomes) == ["closed", "refused-at-connect"]
+    finally:
+        coord.close()
+
+
+def test_spawned_backend_auto_auth_end_to_end():
+    """spawn=True generates a per-backend secret automatically; the
+    spawned workers inherit it and the pool works unchanged."""
+    backend = NativeProcessBackend(_echo, 2)
+    try:
+        assert backend._token  # auto-generated, non-empty
+        pool = AsyncPool(2)
+        asyncmap(pool, np.array([5.0]), backend, nwait=2)
+        assert np.asarray(pool.results[0])[1] == 5.0
+    finally:
+        backend.shutdown()
+
+
+def test_concurrent_restarts_park_other_ranks_hello():
+    """Two external workers restarting at once must both be recoverable:
+    rank B's reconnect landing during reaccept(A) is parked, not closed,
+    and reaccept(B) adopts the parked socket (ADVICE round 1)."""
+    import tempfile
+    import threading
+    import time as time_mod
+    import uuid
+
+    path = os.path.join(
+        tempfile.gettempdir(), f"msgt-park-{uuid.uuid4().hex[:8]}.sock"
+    )
+    coord = T.Coordinator(path, 2, token=b"tok")
+
+    class EchoThread(threading.Thread):
+        def __init__(self, rank, die_after: int):
+            super().__init__(daemon=True)
+            self.rank, self.die_after = rank, die_after
+
+        def run(self):
+            w = T.Worker(path, self.rank, token=b"tok")
+            served = 0
+            while True:
+                msg = w.recv()
+                if msg is None or msg.kind == T.KIND_CONTROL:
+                    break
+                w.send(msg.payload, seq=msg.seq, epoch=msg.epoch)
+                served += 1
+                if self.die_after and served >= self.die_after:
+                    break  # simulated crash: close without shutdown
+            w.close()
+
+    gen1 = [EchoThread(r, die_after=1) for r in range(2)]
+    for t in gen1:
+        t.start()
+    try:
+        coord.accept(timeout=10)
+        for r in range(2):
+            coord.isend(r, b"x", seq=1)
+        for _ in range(2):
+            coord.waitany([0, 1], timeout=10)
+        for t in gen1:
+            t.join(timeout=5)
+        # both ranks are now dead; wait for the progress engine's marks
+        deadline = time_mod.time() + 5
+        while not (coord.is_dead(0) and coord.is_dead(1)):
+            assert time_mod.time() < deadline, "death marks never arrived"
+            time_mod.sleep(0.01)
+        # drain the death markers
+        while coord.poll(0) and coord.poll(0).kind != T.KIND_DEATH:
+            pass
+        while coord.poll(1) and coord.poll(1).kind != T.KIND_DEATH:
+            pass
+        # both restart concurrently; their hellos race into the backlog
+        gen2 = [EchoThread(r, die_after=0) for r in range(2)]
+        for t in gen2:
+            t.start()
+        time_mod.sleep(0.2)  # let both connects land before reaccept
+        coord.reaccept(0, timeout=10)  # may park rank 1's hello
+        coord.reaccept(1, timeout=10)  # adopts the parked socket
+        for r in range(2):
+            assert not coord.is_dead(r)
+            coord.isend(r, bytes([r]), seq=2)
+        got = {}
+        for _ in range(2):
+            rank, msg = coord.waitany([0, 1], timeout=10)
+            got[rank] = msg.payload
+        assert got == {0: b"\x00", 1: b"\x01"}
+        for r in range(2):
+            coord.isend(r, b"", kind=T.KIND_CONTROL)
+        for t in gen2:
+            t.join(timeout=5)
+    finally:
+        coord.close()
+
+
+def test_worker_connect_retries_until_coordinator_binds():
+    """run_worker's connect loop retries: a worker started before the
+    coordinator binds still joins (ADVICE round 1: one dropped/early
+    handshake must not permanently lose the rank)."""
+    import tempfile
+    import threading
+    import time as time_mod
+    import uuid
+
+    from mpistragglers_jl_tpu.worker import run_worker
+
+    path = os.path.join(
+        tempfile.gettempdir(), f"msgt-retry-{uuid.uuid4().hex[:8]}.sock"
+    )
+
+    def serve():
+        run_worker(path, 0, lambda r, p, e: p + 1, connect_timeout=10)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()  # connects BEFORE the coordinator exists
+    time_mod.sleep(0.3)
+    backend = NativeProcessBackend(
+        None, 1, spawn=False, address=path, connect_timeout=10
+    )
+    try:
+        pool = AsyncPool(1)
+        asyncmap(pool, 41, backend, nwait=1)
+        assert pool.results[0] == 42
+    finally:
+        backend.shutdown()
+        t.join(timeout=5)
+
+
+def test_shutdown_fast_when_handshake_never_completed():
+    """shutdown() with accept=False terminates spawned workers
+    immediately instead of burning join_timeout per worker
+    (ADVICE round 1)."""
+    import time as time_mod
+
+    backend = NativeProcessBackend(
+        _echo, 3, accept=False, join_timeout=5.0
+    )
+    t0 = time_mod.perf_counter()
+    backend.shutdown()
+    elapsed = time_mod.perf_counter() - t0
+    assert elapsed < 4.0, f"shutdown took {elapsed:.1f}s (join-timeout stall)"
+
+
+def test_token_holding_worker_refuses_open_coordinator():
+    """Fail closed against a downgrade: a worker configured with a
+    secret must refuse a peer that acks the hello as an *open*
+    transport — the connect-retry loop makes the bind race winnable by
+    a rogue listener, and unpickling its frames would be code
+    execution (round-2 review finding)."""
+    import threading
+
+    coord, path = _transport_pair(1)  # open: no token
+    outcome = []
+
+    def connect():
+        try:
+            T.Worker(path, 0, token=b"must-be-authenticated")
+        except T.TransportError:
+            outcome.append("refused")
+        else:  # pragma: no cover - the failure this test exists to catch
+            outcome.append("downgraded")
+
+    t = threading.Thread(target=connect, daemon=True)
+    t.start()
+    try:
+        # the open coordinator may briefly admit the rank before the
+        # worker walks away (the refusal is worker-side, by design);
+        # either way no authenticated session ever exists
+        try:
+            coord.accept(timeout=1.0)
+        except T.TransportError:
+            pass
+        t.join(timeout=10)
+        assert outcome == ["refused"]
+    finally:
+        coord.close()
+
+
+def _tagged_sleep_echo(i, payload, epoch):
+    import time as time_mod
+
+    time_mod.sleep(float(payload[1]))
+    return float(payload[0])
+
+
+def test_native_wait_any_duplicate_index_two_tags():
+    """wait_any([i, i], tags=[a, b]) must honor BOTH channels of one
+    worker (SlotBackend does; the native router must too)."""
+    backend = NativeProcessBackend(_tagged_sleep_echo, 1)
+    try:
+        backend.dispatch(0, np.array([10.0, 0.3]), 1, tag=0)
+        backend.dispatch(0, np.array([20.0, 0.0]), 1, tag=1)
+        got = {}
+        for _ in range(2):
+            j, result = backend.wait_any([0, 0], timeout=15, tags=[0, 1])
+            assert j == 0
+            got[float(result)] = True
+        assert sorted(got) == [10.0, 20.0]
     finally:
         backend.shutdown()

@@ -1,0 +1,163 @@
+"""Tag-multiplexed channels: two pools sharing one backend.
+
+The reference multiplexes message classes over one MPI communicator with
+tags (data tag 0 / control tag 1 convention at test/kmap2.jl:11-12, the
+``tag`` kwarg at src/MPIAsyncPools.jl:68), so two pools — or a data and
+a control stream — can share a transport without crosstalk. These tests
+pin that capability on every backend: each tag is an isolated channel
+with its own in-flight slot per worker, results never cross channels,
+and a pool harvests with the tag its dispatch was posted on
+(``pool.stags``, the analog of an MPI request remembering its tag).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import AsyncPool, asyncmap, waitall
+from mpistragglers_jl_tpu.backends.local import LocalBackend
+
+
+def _tagged_echo(i, payload, epoch):
+    """payload = [stream_id, sleep_seconds]; result identifies the
+    stream so crosstalk is detectable."""
+    stream, sleep_s = int(payload[0]), float(payload[1])
+    if sleep_s > 0:
+        time.sleep(sleep_s)
+    return np.array([stream * 10 + i], dtype=np.int64)
+
+
+def _make_backend(kind, work_fn, n):
+    if kind == "local":
+        return LocalBackend(work_fn, n)
+    if kind == "process":
+        from mpistragglers_jl_tpu.backends.process import ProcessBackend
+
+        return ProcessBackend(work_fn, n)
+    from mpistragglers_jl_tpu.native import NativeBuildError
+
+    try:
+        from mpistragglers_jl_tpu.backends.native import NativeProcessBackend
+
+        return NativeProcessBackend(work_fn, n)
+    except NativeBuildError as e:  # pragma: no cover - no compiler
+        pytest.skip(f"native transport unavailable: {e}")
+
+
+@pytest.mark.parametrize("kind", ["local", "process", "native"])
+def test_two_pools_one_backend_no_crosstalk(kind):
+    """Pool A (tag 1, slow work) and pool B (tag 2, fast work) share one
+    backend; B completes while A's work is still in flight, and each
+    pool harvests only its own stream's results."""
+    n = 2
+    backend = _make_backend(kind, _tagged_echo, n)
+    try:
+        pool_a = AsyncPool(n)
+        pool_b = AsyncPool(n)
+        # dispatch A's slow epoch and return immediately (nwait=0)
+        asyncmap(pool_a, np.array([1.0, 0.5]), backend, nwait=0, tag=1)
+        assert pool_a.active.all()
+        assert list(pool_a.stags) == [1, 1]
+        # B's fast epoch completes on its own channel while A is in flight
+        asyncmap(pool_b, np.array([2.0, 0.0]), backend, nwait=n, tag=2)
+        got_b = sorted(int(r[0]) for r in pool_b.results)
+        assert got_b == [20, 21]
+        assert pool_a.active.all()  # untouched by B's harvest
+        # now drain A; its results come from its own channel
+        waitall(pool_a, backend)
+        got_a = sorted(int(r[0]) for r in pool_a.results)
+        assert got_a == [10, 11]
+        assert not pool_a.active.any()
+        # epochs advanced independently
+        assert pool_a.repochs.tolist() == [1, 1]
+        assert pool_b.repochs.tolist() == [1, 1]
+    finally:
+        backend.shutdown()
+
+
+@pytest.mark.parametrize("kind", ["local", "process", "native"])
+def test_concurrent_channels_same_worker(kind):
+    """One worker can hold one outstanding task per tag simultaneously
+    (MPI semantics: tags are independent request streams)."""
+    backend = _make_backend(kind, _tagged_echo, 1)
+    try:
+        backend.dispatch(0, np.array([7.0, 0.2]), 1, tag=7)
+        backend.dispatch(0, np.array([3.0, 0.0]), 1, tag=3)
+        # the tag-3 result is routed to its channel even though the
+        # tag-7 dispatch is still computing
+        r3 = backend.wait(0, timeout=10, tag=3)
+        assert int(np.asarray(r3)[0]) == 30
+        r7 = backend.wait(0, timeout=10, tag=7)
+        assert int(np.asarray(r7)[0]) == 70
+    finally:
+        backend.shutdown()
+
+
+@pytest.mark.parametrize("kind", ["local", "process", "native"])
+def test_double_dispatch_same_tag_rejected(kind):
+    """The one-outstanding-per-channel discipline still holds within a
+    tag (the pool's ``active`` invariant)."""
+    backend = _make_backend(kind, _tagged_echo, 1)
+    try:
+        backend.dispatch(0, np.array([1.0, 0.3]), 1, tag=4)
+        if kind in ("local", "process"):
+            # SlotBackend enforces occupancy explicitly
+            with pytest.raises(RuntimeError, match="outstanding"):
+                backend.dispatch(0, np.array([1.0, 0.0]), 1, tag=4)
+        backend.wait(0, timeout=10, tag=4)
+    finally:
+        backend.shutdown()
+
+
+def test_wait_any_mixed_tags_local():
+    """wait_any accepts per-index tags: two pools' hot loops can block
+    on their own channels over the same worker set."""
+    backend = _make_backend("local", _tagged_echo, 2)
+    try:
+        backend.dispatch(0, np.array([5.0, 0.4]), 1, tag=5)
+        backend.dispatch(1, np.array([6.0, 0.0]), 1, tag=6)
+        got = backend.wait_any([0, 1], timeout=10, tags=[5, 6])
+        assert got is not None
+        i, result = got
+        assert i == 1 and int(np.asarray(result)[0]) == 61
+        got = backend.wait_any([0], timeout=10, tags=[5])
+        i, result = got
+        assert i == 0 and int(np.asarray(result)[0]) == 50
+        with pytest.raises(ValueError, match="align"):
+            backend.wait_any([0, 1], tags=[1])
+    finally:
+        backend.shutdown()
+
+
+def test_control_data_split_native():
+    """The kmap2 convention, library-grade: a data pool (tag 0) and a
+    low-rate control pool (tag 1) multiplex one native transport; a
+    control probe completes while data epochs run."""
+    from mpistragglers_jl_tpu.native import NativeBuildError
+
+    try:
+        from mpistragglers_jl_tpu.backends.native import NativeProcessBackend
+
+        backend = NativeProcessBackend(_tagged_echo, 2)
+    except NativeBuildError as e:  # pragma: no cover - no compiler
+        pytest.skip(f"native transport unavailable: {e}")
+    try:
+        data_pool = AsyncPool(2)
+        ctrl_pool = AsyncPool(2)
+        for epoch in range(1, 4):
+            asyncmap(
+                data_pool, np.array([1.0, 0.05]), backend,
+                nwait=0, tag=0, epoch=epoch,
+            )
+            # control heartbeat rides tag 1 while data is in flight
+            asyncmap(
+                ctrl_pool, np.array([9.0, 0.0]), backend,
+                nwait=2, tag=1, epoch=epoch,
+            )
+            assert sorted(int(r[0]) for r in ctrl_pool.results) == [90, 91]
+            waitall(data_pool, backend)
+            assert sorted(int(r[0]) for r in data_pool.results) == [10, 11]
+            assert data_pool.repochs.tolist() == [epoch, epoch]
+    finally:
+        backend.shutdown()
