@@ -1,0 +1,243 @@
+"""Genuinely rateless LT-coded GEMM: re-tasks draw *fresh* coded shards.
+
+:class:`~.coded_gemm.LTCodedGemm` fixes one window of shard ids at
+construction — a re-tasked straggler recomputes the same shard, so a
+slow epoch gains nothing from extra work. This module supplies the
+actual point of a rateless code: **incremental redundancy**. Every
+dispatch a worker receives within an epoch advances its private shard
+*generation*; the shard id is the deterministic function
+
+    shard_id(worker, generation) = worker + n_workers * generation
+
+so ids never repeat across workers or rounds and the shard stream is
+unbounded (the LT property: any prefix of distinct ids is a valid code).
+Workers encode their own coded block lazily from the source blocks —
+the on-worker-encoding pattern of :mod:`.matdot` (its workers build
+``B̃_i`` from the broadcast payload) applied to the ``A`` side — so a
+fresh shard costs one short weighted-sum + the usual MXU matmul, no
+re-setup.
+
+Arrivals are *accumulated*, not replaced: a worker whose round-1 shard
+landed and whose round-2 re-dispatch lands later contributes **two**
+shards to the epoch's decode set. The pool machinery carries this
+without modification — the decodability ``nwait`` predicate is
+re-evaluated after every arrival (reference src/MPIAsyncPools.jl:152-158)
+and closes over the epoch's collected-shard set; multi-round draws reuse
+the reference's caller-chosen-epoch contract (``asyncmap(...,
+epoch=e)`` with the same ``e``: re-dispatching idle workers at an
+unchanged epoch is exactly src/MPIAsyncPools.jl:87's "no monotonicity is
+enforced", SURVEY §2.1).
+
+Decode is peeling (ops/lt.py), identical to the fixed-window path; the
+only new state is the per-epoch ``(shard_id, shard)`` collection and a
+``stats`` record of shards consumed vs ``k`` (the rateless overhead the
+benchmark reports).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.base import DelayFn
+from ..backends.xla import XLADeviceBackend
+from ..pool import AsyncPool, DeadWorkerError, asyncmap
+from .gemm import _block_matmul
+from .lt import LTCode
+
+__all__ = ["RatelessLTGemm"]
+
+
+class RatelessLTGemm:
+    """Rateless LT-coded ``C = A @ B`` with incremental redundancy.
+
+    >>> rg = RatelessLTGemm(A, n_workers=8, k=6)
+    >>> pool = AsyncPool(8)
+    >>> C = rg.multiply(B, pool)      # draws shards until the set peels
+    >>> rg.stats["shards_used"]       # rateless overhead vs k
+
+    ``multiply`` runs rounds: each round dispatches one fresh shard per
+    idle worker and waits up to ``round_timeout`` for the collected set
+    to become peelable; workers still busy with an earlier shard are
+    left in flight (their eventual stale arrival is harvested and
+    re-tasked with a *new* shard id by the pool's phase-1/phase-3
+    machinery). A permanent straggler therefore costs one round of
+    timeout, not decodability.
+    """
+
+    def __init__(
+        self,
+        A: np.ndarray,
+        n_workers: int,
+        k: int,
+        *,
+        devices: Sequence[jax.Device] | None = None,
+        delay_fn: DelayFn | None = None,
+        seed: int = 0,
+        dtype=None,
+        precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
+        block_cache_size: int = 64,
+    ):
+        if dtype is not None:
+            A = np.asarray(A, dtype=dtype)
+        else:
+            A = np.asarray(A)
+        m = A.shape[0]
+        if m % k != 0:
+            raise ValueError(f"rows {m} must divide evenly into k={k} blocks")
+        if devices is None:
+            devices = jax.devices()
+        self.code = LTCode(k, seed=seed)
+        self.k = int(k)
+        self.n = int(n_workers)
+        self.devices = list(devices)
+        self.block_rows = m // k
+        self.precision = precision
+        # source blocks stay host-side: generation-0 coded blocks live on
+        # device (the fast path), later generations are encoded lazily on
+        # demand — a straggler-free epoch pays zero extra HBM
+        self._src = np.ascontiguousarray(A.reshape(k, m // k, *A.shape[1:]))
+        self._block_cache: dict[int, jax.Array] = {}
+        self._block_cache_size = int(block_cache_size)
+        self._gen: dict[tuple[int, int], int] = {}  # (epoch, worker) -> gen
+        # per-epoch collected shards: {shard_id: device array}; appended
+        # by worker threads at completion, read by the nwait predicate
+        # and the decoder on the coordinator thread
+        self._collected: dict[int, dict[int, jax.Array]] = {}
+        self._lock = threading.Lock()
+        self.stats: dict = {}
+        # generation 0 = the static window [0, n): pre-encode on device
+        for i in range(self.n):
+            self._coded_block(i, i)
+        self.backend = XLADeviceBackend(
+            self._work, self.n, devices=devices, delay_fn=delay_fn
+        )
+
+    # -- shard plumbing ---------------------------------------------------
+    def shard_id(self, worker: int, generation: int) -> int:
+        """Deterministic unbounded shard stream, distinct across workers
+        and rounds."""
+        return int(worker) + self.n * int(generation)
+
+    def _coded_block(self, worker: int, sid: int) -> jax.Array:
+        """The device-resident coded block Ã_sid = Σ (support blocks),
+        encoded lazily and cached (bounded). Serialized under the lock:
+        worker threads race here only on the rare fresh-shard path, and
+        the encode is a few block adds, dwarfed by the matmul."""
+        with self._lock:
+            blk = self._block_cache.get(sid)
+            if blk is not None:
+                return blk
+            sup = self.code.shard_indices(sid)
+            enc = self._src[sup[0]].copy()
+            for j in sup[1:]:
+                enc += self._src[j]
+            if len(self._block_cache) >= self._block_cache_size:
+                # keep generation 0 (the steady-state window) resident
+                for key in [
+                    s for s in self._block_cache if s >= self.n
+                ]:
+                    del self._block_cache[key]
+            blk = jax.device_put(
+                jnp.asarray(enc),
+                self.devices[worker % len(self.devices)],
+            )
+            self._block_cache[sid] = blk
+            return blk
+
+    def _work(self, i: int, payload: jax.Array, epoch: int):
+        """Worker compute: advance this worker's generation, encode the
+        fresh shard's block, multiply. Runs in the backend's per-worker
+        dispatcher thread (the XLA pool's worker side)."""
+        with self._lock:
+            gen = self._gen.get((epoch, i), 0)
+            self._gen[(epoch, i)] = gen + 1
+        sid = self.shard_id(i, gen)
+        out = _block_matmul(
+            self._coded_block(i, sid), payload, precision=self.precision
+        )
+        out = jax.block_until_ready(out)
+        with self._lock:
+            self._collected.setdefault(epoch, {})[sid] = out
+        return sid, out
+
+    # -- decode-side ------------------------------------------------------
+    def collected_ids(self, epoch: int) -> list[int]:
+        with self._lock:
+            return sorted(self._collected.get(epoch, {}))
+
+    def decodable(self, epoch: int) -> bool:
+        return self.code.peelable(self.collected_ids(epoch))
+
+    def nwait(self, epoch: int):
+        """Decodability predicate over the epoch's *collected* shard set
+        (not just the latest per-worker result): re-evaluated after
+        every arrival, reference src/MPIAsyncPools.jl:152-158."""
+
+        def pred(ep: int, repochs: np.ndarray) -> bool:
+            return self.decodable(epoch)
+
+        return pred
+
+    def multiply(
+        self,
+        B,
+        pool: AsyncPool,
+        *,
+        round_timeout: float = 5.0,
+        max_rounds: int = 8,
+    ) -> np.ndarray:
+        """Compute ``A @ B``, drawing coded shards until the set peels.
+
+        Round r re-enters ``asyncmap`` at the *same* epoch: idle workers
+        (everyone who already delivered) are re-dispatched and — because
+        their generation advanced — compute shards never seen before.
+        Workers still in flight are untouched. Raises
+        :class:`~..pool.DeadWorkerError` only if ``max_rounds`` rounds
+        all time out (every worker dead)."""
+        epoch = pool.epoch + 1
+        with self._lock:
+            # prune: only the live epoch's shards are retained
+            self._collected = {epoch: {}}
+            self._gen = {k_: v for k_, v in self._gen.items()
+                         if k_[0] == epoch}
+        pred = self.nwait(epoch)
+        last_err: DeadWorkerError | None = None
+        for _ in range(max_rounds):
+            try:
+                asyncmap(
+                    pool, B, self.backend,
+                    nwait=pred, epoch=epoch, timeout=round_timeout,
+                )
+                last_err = None
+                break
+            except DeadWorkerError as e:
+                # round timed out short of decodability: the next round
+                # re-dispatches every idle worker with a fresh shard id
+                # (incremental redundancy); stragglers stay in flight
+                last_err = e
+                if self.decodable(epoch):  # arrived during unwinding
+                    last_err = None
+                    break
+        if last_err is not None:
+            raise last_err
+        return self._decode(epoch)
+
+    def _decode(self, epoch: int) -> np.ndarray:
+        with self._lock:
+            shards_map = dict(self._collected.get(epoch, {}))
+        ids = sorted(shards_map)
+        shards = np.stack([np.asarray(shards_map[s]) for s in ids])
+        blocks = self.code.decode(shards, ids)
+        self.stats = {
+            "epoch": int(epoch),
+            "shards_used": len(ids),
+            "k": self.k,
+            "overhead": len(ids) / self.k,
+            "max_generation": max(s // self.n for s in ids) if ids else 0,
+        }
+        return blocks.reshape(-1, *blocks.shape[2:])

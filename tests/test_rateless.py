@@ -1,0 +1,128 @@
+"""Incremental redundancy: rateless LT re-tasks contribute NEW shards.
+
+VERDICT round 1, item 2: the fixed-window :class:`LTCodedGemm` recomputes
+the *same* shard on re-task, so a permanent straggler whose shard is
+load-bearing makes the epoch undecodable forever. These tests pin the
+rateless contract of :class:`~mpistragglers_jl_tpu.ops.rateless.RatelessLTGemm`:
+
+* the witness configuration (k=4, n=6, seed=0) peels with all six
+  static shards but NOT with worker 0's shard missing — verified as a
+  pure code property first;
+* the static workload under a permanent worker-0 straggler times out
+  (undecodable, as designed);
+* the rateless workload under the same straggler decodes exactly,
+  because rounds 2+ re-dispatch the five live workers with
+  generation-1 shard ids — fresh information the static window cannot
+  produce — and ``stats`` records the shards-consumed overhead.
+"""
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import AsyncPool, asyncmap
+from mpistragglers_jl_tpu.ops.coded_gemm import LTCodedGemm
+from mpistragglers_jl_tpu.ops.lt import LTCode
+from mpistragglers_jl_tpu.ops.rateless import RatelessLTGemm
+from mpistragglers_jl_tpu.pool import DeadWorkerError
+
+K, N, SEED, STRAGGLER = 4, 6, 0, 0
+
+
+def _make_ab(rng):
+    A = rng.standard_normal((8, 5))
+    B = rng.standard_normal((5, 3))
+    return A, B
+
+
+def _permanent_straggler(i, epoch, *, who=STRAGGLER, stall=30.0):
+    return stall if i == who else 0.0
+
+
+def test_witness_code_property():
+    """The chosen configuration really is the failure mode: full static
+    window peels, window minus the straggler does not, and one extra
+    generation from the live workers repairs it."""
+    code = LTCode(K, seed=SEED)
+    window = list(range(N))
+    assert code.peelable(window)
+    rest = [s for s in window if s != STRAGGLER]
+    assert not code.peelable(rest)
+    gen1 = [w + N for w in range(N) if w != STRAGGLER]
+    assert code.peelable(rest + gen1)
+
+
+def test_static_window_cannot_decode_with_straggler():
+    """The fixed-window workload under a permanent straggler never
+    becomes decodable: its re-tasks recompute the same shard, so the
+    wait can only time out."""
+    rng = np.random.default_rng(0)
+    A, B = _make_ab(rng)
+    lt = LTCodedGemm(
+        A, N, K, seed=SEED, shard_ids=list(range(N)),
+        delay_fn=_permanent_straggler,
+    )
+    try:
+        pool = AsyncPool(N)
+        with pytest.raises(DeadWorkerError):
+            asyncmap(pool, B, lt.backend, nwait=lt.nwait, timeout=2.0)
+    finally:
+        lt.backend.shutdown()
+
+
+def test_rateless_decodes_past_permanent_straggler():
+    """Same code, same seed, same straggler: rounds 2+ draw
+    generation-1 shards from the live workers and the epoch decodes
+    exactly."""
+    rng = np.random.default_rng(1)
+    A, B = _make_ab(rng)
+    rg = RatelessLTGemm(A, N, K, seed=SEED, delay_fn=_permanent_straggler)
+    try:
+        pool = AsyncPool(N)
+        C = rg.multiply(B, pool, round_timeout=1.0, max_rounds=6)
+        np.testing.assert_allclose(C, A @ B, rtol=1e-9)
+        # fresh information was actually drawn: at least one shard from
+        # a generation the static window does not contain
+        assert rg.stats["max_generation"] >= 1
+        assert rg.stats["shards_used"] > rg.stats["k"]
+        ids = rg.collected_ids(pool.epoch)
+        assert rg.shard_id(STRAGGLER, 0) not in ids  # straggler never landed
+        assert len(set(ids)) == len(ids)  # no shard ever recomputed
+    finally:
+        rg.backend.shutdown()
+
+
+def test_rateless_fast_path_no_stragglers():
+    """Without stragglers the first round decodes from generation-0
+    shards only — the rateless machinery costs nothing extra."""
+    rng = np.random.default_rng(2)
+    A, B = _make_ab(rng)
+    rg = RatelessLTGemm(A, N, K, seed=SEED)
+    try:
+        pool = AsyncPool(N)
+        C = rg.multiply(B, pool, round_timeout=10.0)
+        np.testing.assert_allclose(C, A @ B, rtol=1e-9)
+        assert rg.stats["max_generation"] == 0
+        assert rg.stats["shards_used"] <= N
+    finally:
+        rg.backend.shutdown()
+
+
+def test_rateless_repeated_epochs_and_shard_id_stream():
+    """Back-to-back multiplies stay exact (per-epoch shard state is
+    isolated), and the shard-id stream is unique across (worker, gen)."""
+    rng = np.random.default_rng(3)
+    A, B1 = _make_ab(rng)
+    B2 = rng.standard_normal(B1.shape)
+    rg = RatelessLTGemm(A, N, K, seed=SEED)
+    try:
+        pool = AsyncPool(N)
+        np.testing.assert_allclose(
+            rg.multiply(B1, pool), A @ B1, rtol=1e-9
+        )
+        np.testing.assert_allclose(
+            rg.multiply(B2, pool), A @ B2, rtol=1e-9
+        )
+    finally:
+        rg.backend.shutdown()
+    sids = {rg.shard_id(w, g) for w in range(N) for g in range(50)}
+    assert len(sids) == N * 50
