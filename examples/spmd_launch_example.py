@@ -1,0 +1,56 @@
+"""SPMD launch demo — the reference's mpiexec experience, one command:
+
+    python -m mpistragglers_jl_tpu.launch -n 5 examples/spmd_launch_example.py
+
+Every rank runs this same script (reference examples/iterative_example.jl:
+one program, rank 0 = coordinator). The coordinator runs a 10-epoch
+``nwait=1`` loop over the 4 workers; each worker stalls a deterministic
+per-(worker, epoch) amount, so which worker answers first rotates.
+"""
+
+import sys
+
+import numpy as np
+
+from mpistragglers_jl_tpu import AsyncPool, asyncmap, launch, waitall
+
+
+def work(i: int, payload: np.ndarray, epoch: int) -> np.ndarray:
+    """Echo worker id, payload value, and epoch (the reference's result
+    layout [rank, t, epoch], test/kmap2.jl)."""
+    return np.array([float(i), float(payload[0]), float(epoch)])
+
+
+def stall(i: int, epoch: int) -> float:
+    """Deterministic rotating straggler pattern."""
+    return 0.02 * ((i + epoch) % 4)
+
+
+def coordinator_main(ctx: launch.LaunchContext) -> None:
+    backend = ctx.coordinator_backend()
+    try:
+        pool = AsyncPool(ctx.n_workers, nwait=1)
+        for epoch in range(1, 11):
+            payload = np.array([np.pi * epoch])
+            repochs = asyncmap(pool, payload, backend, epoch=epoch)
+            fresh = np.flatnonzero(repochs == epoch)
+            print(
+                f"epoch {epoch}: fresh={fresh.tolist()} "
+                f"latency={np.round(pool.latency[fresh], 4).tolist()}"
+            )
+        waitall(pool, backend)
+        print(f"done: epochs={pool.epoch} workers={ctx.n_workers}")
+    finally:
+        backend.shutdown()
+
+
+def main() -> None:
+    ctx = launch.init()
+    if ctx.is_coordinator:
+        coordinator_main(ctx)
+    else:
+        ctx.serve(work, stall)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

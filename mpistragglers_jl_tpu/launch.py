@@ -1,0 +1,228 @@
+"""``mpiexec``-equivalent one-shot SPMD launcher.
+
+The reference's whole topology comes up with one command —
+``mpiexec -n N julia script.jl`` (test/runtests.jl:17): N OS processes
+run the *same* script, rank 0 is the coordinator by convention and ranks
+1..N-1 are workers. This module reproduces that experience:
+
+.. code-block:: console
+
+    python -m mpistragglers_jl_tpu.launch -n 8 my_script.py [args...]
+
+launches 8 copies of ``my_script.py``; inside the script,
+
+.. code-block:: python
+
+    from mpistragglers_jl_tpu import launch
+    ctx = launch.init()
+    if ctx.is_coordinator:
+        backend = ctx.coordinator_backend()   # all workers connected
+        ...asyncmap(pool, payload, backend)...
+        backend.shutdown()
+    else:
+        ctx.serve(work_fn)                    # blocks until shutdown
+
+mirrors the reference's ``if rank == root: coordinator_main() else:
+worker_main()`` split (examples/iterative_example.jl), with the library
+owning everything the reference left to convention: the rendezvous
+address, the shared auth secret, the worker loop, the shutdown
+broadcast, and non-zero-exit propagation (a failed rank fails the
+launch, like mpiexec).
+
+Implementation notes. The launcher picks a fresh Unix-socket address
+(or ``--address tcp://host:port`` for multi-host-style runs) and a
+random auth token, and hands both to every rank through the
+environment (``MSGT_ADDRESS`` / ``MSGT_AUTH`` / ``MSGT_RANK`` /
+``MSGT_NRANKS``). Rank 0 binds the socket; workers' connect loop
+retries until it is up (worker.py), so start order does not matter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import secrets
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from dataclasses import dataclass
+
+__all__ = ["LaunchContext", "init", "main"]
+
+_ENV_RANK = "MSGT_RANK"
+_ENV_NRANKS = "MSGT_NRANKS"
+_ENV_ADDRESS = "MSGT_ADDRESS"
+_ENV_AUTH = "MSGT_AUTH"
+
+
+@dataclass(frozen=True)
+class LaunchContext:
+    """This rank's view of a launched job (reference analog: the
+    ``MPI.Comm_rank``/``Comm_size`` pair every script starts with)."""
+
+    rank: int
+    n_ranks: int
+    address: str
+    token: bytes
+
+    @property
+    def is_coordinator(self) -> bool:
+        """Rank 0 is the coordinator, the reference's root convention
+        (examples/iterative_example.jl:10)."""
+        return self.rank == 0
+
+    @property
+    def n_workers(self) -> int:
+        """Pool size: every rank except the coordinator."""
+        return self.n_ranks - 1
+
+    @property
+    def worker_index(self) -> int:
+        """This rank's pool index (valid on worker ranks only)."""
+        if self.rank == 0:
+            raise RuntimeError("rank 0 is the coordinator, not a worker")
+        return self.rank - 1
+
+    def coordinator_backend(self, *, connect_timeout: float = 60.0, **kw):
+        """The connected :class:`~.backends.native.NativeProcessBackend`
+        over this job's workers (coordinator rank only)."""
+        if not self.is_coordinator:
+            raise RuntimeError(
+                "coordinator_backend() is for rank 0; workers call serve()"
+            )
+        from .backends.native import NativeProcessBackend
+
+        return NativeProcessBackend(
+            None,
+            self.n_workers,
+            spawn=False,
+            address=self.address,
+            auth=self.token,
+            connect_timeout=connect_timeout,
+            **kw,
+        )
+
+    def serve(self, work_fn, delay_fn=None, *,
+              connect_timeout: float = 60.0) -> None:
+        """Run this rank's worker loop until the coordinator's shutdown
+        broadcast (worker ranks only). ``work_fn(i, payload, epoch)``."""
+        from .worker import run_worker
+
+        run_worker(
+            self.address,
+            self.worker_index,
+            work_fn,
+            delay_fn,
+            token=self.token,
+            connect_timeout=connect_timeout,
+        )
+
+
+def init() -> LaunchContext:
+    """Read this process's launch environment (set by ``main``).
+
+    Raises ``RuntimeError`` when not running under the launcher — a
+    script can catch that to fall back to single-process mode.
+    """
+    rank = os.environ.get(_ENV_RANK)
+    if rank is None:
+        raise RuntimeError(
+            "not launched via `python -m mpistragglers_jl_tpu.launch`; "
+            f"{_ENV_RANK} is unset"
+        )
+    token = os.environ.get(_ENV_AUTH, "")
+    return LaunchContext(
+        rank=int(rank),
+        n_ranks=int(os.environ[_ENV_NRANKS]),
+        address=os.environ[_ENV_ADDRESS],
+        token=token.encode(),
+    )
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="python -m mpistragglers_jl_tpu.launch",
+        description="Run one script on N processes: rank 0 coordinates, "
+        "ranks 1..N-1 serve as pool workers (the mpiexec experience, "
+        "reference test/runtests.jl:17).",
+    )
+    ap.add_argument("-n", "--nranks", type=int, required=True,
+                    help="total ranks incl. the coordinator (pool size n-1)")
+    ap.add_argument(
+        "--address", default=None,
+        help="rendezvous address (default: fresh Unix socket; pass "
+        "tcp://host:port to exercise the TCP transport)",
+    )
+    ap.add_argument(
+        "--grace", type=float, default=10.0,
+        help="seconds workers get to exit after the coordinator returns "
+        "before being terminated",
+    )
+    ap.add_argument("script", help="Python script every rank executes")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER,
+                    help="arguments passed through to the script")
+    args = ap.parse_args(argv)
+    if args.nranks < 2:
+        ap.error("-n must be >= 2 (one coordinator + at least one worker)")
+
+    address = args.address or os.path.join(
+        tempfile.gettempdir(), f"msgt-launch-{uuid.uuid4().hex[:12]}.sock"
+    )
+    token = secrets.token_hex(16)
+    procs: list[subprocess.Popen] = []
+    base_env = dict(os.environ)
+    base_env[_ENV_NRANKS] = str(args.nranks)
+    base_env[_ENV_ADDRESS] = address
+    base_env[_ENV_AUTH] = token
+    try:
+        for r in range(args.nranks):
+            env = dict(base_env)
+            env[_ENV_RANK] = str(r)
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, args.script, *args.script_args],
+                    env=env,
+                )
+            )
+        # the job is over when the coordinator is: it owns the epoch
+        # loop and broadcasts shutdown on exit (backend.shutdown)
+        rc = procs[0].wait()
+        deadline = time.monotonic() + args.grace
+        codes = [rc]
+        for p in procs[1:]:
+            try:
+                codes.append(p.wait(
+                    timeout=max(0.0, deadline - time.monotonic())
+                ))
+            except subprocess.TimeoutExpired:
+                p.terminate()
+                try:
+                    codes.append(p.wait(timeout=5.0))
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    p.kill()
+                    codes.append(p.wait())
+    except KeyboardInterrupt:  # forward ^C to the whole job, mpiexec-style
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in procs:
+            try:
+                p.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        raise
+    finally:
+        if args.address is None and os.path.exists(address):
+            try:
+                os.unlink(address)
+            except OSError:  # pragma: no cover
+                pass
+    # a failed rank fails the launch, like mpiexec
+    sys.exit(max(codes, key=abs) if any(codes) else 0)
+
+
+if __name__ == "__main__":
+    main()
