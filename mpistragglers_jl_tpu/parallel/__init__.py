@@ -16,6 +16,8 @@ _HOME = {
     "make_multihost_mesh": "multihost",
     "local_worker_indices": "multihost",
     "pipeline_spmd": "pipeline",
+    "pipeline_1f1b": "pipeline",
+    "bubble_fraction": "pipeline",
     "stack_layers": "pipeline",
     "make_pipeline_train_step": "pipeline",
     "shard_params_pipeline": "pipeline",

@@ -40,6 +40,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "pipeline_spmd",
+    "pipeline_1f1b",
+    "bubble_fraction",
     "stack_layers",
     "make_pipeline_train_step",
     "pipeline_param_specs",
@@ -107,34 +109,268 @@ def stack_layers(layers: list[dict]) -> dict:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
 
 
+def bubble_fraction(pp: int, n_microbatch: int,
+                    schedule: str = "1f1b") -> float:
+    """Fraction of pipeline ticks that are bubble (no useful work).
+
+    * ``"1f1b"`` — the interleaved fwd/bwd scan of
+      :func:`pipeline_1f1b`: each device does M forward and M backward
+      microbatch steps over ``M + 2(pp-1)`` ticks, so the bubble is
+      ``2(pp-1) / (M + 2(pp-1))``.
+    * ``"gpipe"`` — the fill/drain :func:`pipeline_spmd` schedule
+      differentiated by ``jax.grad``: ``(pp-1) / (M + pp - 1)`` each
+      way (the same ratio forward and backward).
+    """
+    p, M = int(pp), int(n_microbatch)
+    if schedule == "1f1b":
+        return 2 * (p - 1) / (M + 2 * (p - 1))
+    if schedule == "gpipe":
+        return (p - 1) / (M + p - 1)
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def pipeline_1f1b(stage_fn, head_fn, stage_params, head_params, x, targets,
+                  *, axis: str = "pp", n_microbatch: int):
+    """One-forward-one-backward pipeline step; call inside shard_map.
+
+    The GPipe formulation above leans on ``jax.grad`` through the scan,
+    which checkpoints every tick's carry — activation memory grows with
+    ``M``. This schedule interleaves each microbatch's backward with
+    later microbatches' forwards in a SINGLE scan, which needs only a
+    ring of ``2·pp - 1`` residual slots (the in-flight window), the
+    1F1B memory property. The enabler is folding the *loss head* into
+    the last stage: per-token LM loss is independent across
+    microbatches, so ``dL/dy`` for microbatch m is available the tick
+    its forward exits — the backward wavefront starts immediately
+    instead of after a full forward pass.
+
+    Schedule (device d, tick t, ``T = M + 2(pp-1)`` ticks):
+
+    * forward slot: microbatch ``f = t - d`` (valid while ``0 <= f < M``);
+      stage 0 injects ``micro[f]``, stage pp-1 feeds its output straight
+      into ``head_fn`` and the same tick's backward slot.
+    * backward slot: microbatch ``b = t - (2·pp - 2 - d)`` — the reverse
+      wavefront. The stage vjp *recomputes* the forward from the saved
+      ring input (rematerialization: storing linearizations in a scan
+      carry is impossible, and remat is the standard TPU trade of FLOPs
+      for HBM anyway).
+    * two collective permutes per tick: activations to ``d+1``, grads to
+      ``d-1``. Wrap-around values are overwritten by injections, so the
+      ring permutes are schedule-exact.
+
+    ``stage_fn(stage_params, payload) -> payload`` where ``payload`` is
+    any pytree (the transformer stages use ``(activation, aux_loss)`` so
+    MoE load-balance aux rides the pipeline to the head — that is what
+    makes expert layers pipeline-legal).
+    ``head_fn(head_params, payload, tgt_micro) -> scalar loss`` (summed,
+    not meaned, over the microbatch; normalize outside).
+
+    Returns ``(loss_sum, stage_grads, head_grads, dx)`` — all *local*
+    sums: psum ``loss/head_grads/dx`` over the pipeline axis (each is
+    nonzero on one stage) and everything over the data axes, caller-side.
+    ``dx`` is (M, ...) microbatch-input grads for the embedding update.
+    """
+    p = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    B = x.shape[0]
+    M = int(n_microbatch)
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by n_microbatch {M}")
+    micro = x.reshape(M, B // M, *x.shape[1:])
+    tgt = targets.reshape(M, B // M, *targets.shape[1:])
+    R = 2 * p - 1  # residual ring: covers the 2(pp-1)-tick in-flight window
+    fwd_perm = [(j, (j + 1) % p) for j in range(p)]
+    bwd_perm = [(j, (j - 1) % p) for j in range(p)]
+
+    # the scan carry becomes varying over every manual axis the loop body
+    # touches: the pipeline axis (stage-dependent masking) plus whatever
+    # the data and params are already varying over (e.g. "dp"-sharded
+    # batches). Type the initial carry to that union up front.
+    target_vma = {axis}
+    for leaf in jax.tree.leaves((x, targets, stage_params, head_params)):
+        target_vma |= set(getattr(jax.typeof(leaf), "vma", ()))
+
+    def _varying(v):
+        def f(a):
+            need = tuple(
+                target_vma - set(getattr(jax.typeof(a), "vma", ()))
+            )
+            return jax.lax.pcast(a, need, to="varying") if need else a
+
+        return jax.tree.map(f, v)
+
+    # CRITICAL: the params must be fully varying before any vjp runs.
+    # A replicated (unvarying) operand used by a varying computation is
+    # an implicit broadcast, and the TRANSPOSE of that broadcast is a
+    # psum — jax.vjp/value_and_grad would hand every device the
+    # cross-device SUM of param grads (polluted by the masked-out
+    # warmup/cooldown evals of other stages) instead of its own
+    # partial. Caller-side psums then double-count. Varying params keep
+    # every grad a per-device partial; the caller owns the collectives.
+    stage_params = _varying(stage_params)
+    head_params = _varying(head_params)
+
+    def _pperm(v, perm):
+        return jax.tree.map(lambda a: jax.lax.ppermute(a, axis, perm), v)
+
+    def _where(c, a, b):
+        return jax.tree.map(lambda u, v: jnp.where(c, u, v), a, b)
+
+    zero_payload = (jnp.zeros_like(micro[0]), jnp.float32(0.0))
+    carry0 = dict(
+        buf_f=_varying(zero_payload),            # activation entering here
+        buf_b=_varying(zero_payload),            # grad entering here
+        ring=_varying(jax.tree.map(
+            lambda a: jnp.zeros((R,) + a.shape, a.dtype), zero_payload
+        )),
+        g_stage=_varying(jax.tree.map(jnp.zeros_like, stage_params)),
+        g_head=_varying(jax.tree.map(jnp.zeros_like, head_params)),
+        loss=_varying(jnp.float32(0.0)),
+        dx=_varying(jnp.zeros((M,) + micro.shape[1:], micro.dtype)),
+    )
+
+    def tick(c, t):
+        # ---- forward slot: microbatch f = t - idx -----------------------
+        f = t - idx
+        f_valid = jnp.logical_and(f >= 0, f < M)
+        fc = jnp.clip(f, 0, M - 1)
+        inject = (micro[fc], jnp.float32(0.0))
+        p_in = _where(idx == 0, inject, c["buf_f"])
+        # save the stage input for the backward recompute (ring slot)
+        ring = jax.tree.map(
+            lambda r, v: jnp.where(
+                f_valid,
+                jax.lax.dynamic_update_index_in_dim(r, v, fc % R, 0),
+                r,
+            ),
+            c["ring"], p_in,
+        )
+        y = stage_fn(stage_params, p_in)
+        # ---- head on the last stage: loss + dL/dy, same tick ------------
+        def head_loss(hp, payload):
+            return head_fn(hp, payload, tgt[fc])
+
+        (loss_f, (g_head_f, dy)) = jax.value_and_grad(
+            head_loss, argnums=(0, 1)
+        )(head_params, y)
+        head_valid = jnp.logical_and(idx == p - 1, f_valid)
+        loss = c["loss"] + jnp.where(head_valid, loss_f, 0.0)
+        g_head = jax.tree.map(
+            lambda acc, g: acc + jnp.where(head_valid, g, 0),
+            c["g_head"], g_head_f,
+        )
+        # ---- backward slot: microbatch b = t - (2p - 2 - idx) -----------
+        b = t - (2 * p - 2 - idx)
+        b_valid = jnp.logical_and(b >= 0, b < M)
+        bc = jnp.clip(b, 0, M - 1)
+        x_saved = jax.tree.map(
+            lambda r: jax.lax.dynamic_index_in_dim(
+                r, bc % R, 0, keepdims=False
+            ),
+            ring,
+        )
+        # on the last stage the backward microbatch IS this tick's
+        # forward microbatch (b == f there): dy feeds straight in
+        g_in = _where(idx == p - 1, dy, c["buf_b"])
+        _, vjp_fn = jax.vjp(stage_fn, stage_params, x_saved)
+        g_stage_b, g_x = vjp_fn(g_in)
+        g_stage = jax.tree.map(
+            lambda acc, g: acc + jnp.where(b_valid, g, 0),
+            c["g_stage"], g_stage_b,
+        )
+        # stage 0's input grad is the embedding grad for microbatch b
+        dx = jnp.where(
+            jnp.logical_and(idx == 0, b_valid),
+            jax.lax.dynamic_update_index_in_dim(
+                c["dx"], g_x[0], bc, 0
+            ),
+            c["dx"],
+        )
+        # ---- handoffs ---------------------------------------------------
+        buf_f = _pperm(y, fwd_perm)      # activations ride to d+1
+        buf_b = _pperm(g_x, bwd_perm)    # grads ride to d-1
+        return dict(
+            buf_f=buf_f, buf_b=buf_b, ring=ring, g_stage=g_stage,
+            g_head=g_head, loss=loss, dx=dx,
+        ), None
+
+    T = M + 2 * (p - 1)
+    c, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+    return c["loss"], c["g_stage"], c["g_head"], c["dx"]
+
+
 # ---------------------------------------------------------------- model
 
 
 def _stage_apply(stacked_local, x, pos, cfg):
-    """Apply this stage's layers-per-stage stack to one microbatch."""
+    """Apply this stage's layers-per-stage stack to one microbatch
+    (activation-only view of :func:`_stage_apply_payload`, so the two
+    schedules share one layer recipe)."""
+    return _stage_apply_payload(
+        stacked_local, (x, jnp.float32(0.0)), pos, cfg
+    )[0]
+
+
+def _stage_apply_payload(stacked_local, payload, pos, cfg):
+    """Payload-form stage for the 1F1B schedule: ``(activation, aux)``.
+
+    MoE layers are pipeline-legal here: experts live dense inside their
+    stage (a (dp, pp) mesh has no ``ep`` axis — expert parallelism
+    composes with the flat dp/sp/tp/ep program in models/transformer.py,
+    pipeline composes depth), and each layer's Switch load-balance aux
+    loss accumulates into the payload scalar that rides the pipeline to
+    the head."""
+    from ..models.moe import moe_ffn_dense
     from ..models.transformer import _attn_block, _ln, _local_attention, _mlp
 
     attn_fn = _local_attention(cfg)
+    x, aux = payload
 
-    def one_layer(h, lp):
+    def one_layer(carry, lp):
+        h, a = carry
         h = h + _attn_block(h, lp, pos, attn_fn)
         h2 = _ln(h, lp["ln2_s"], lp["ln2_b"])
-        return h + _mlp(h2, lp) + lp["b2"], None
+        if cfg.n_experts:
+            y, la = moe_ffn_dense(h2, lp, cfg.capacity_factor)
+            return (h + y, a + la), None
+        return (h + _mlp(h2, lp) + lp["b2"], a), None
 
-    x, _ = jax.lax.scan(one_layer, x, stacked_local)
-    return x
+    (x, aux), _ = jax.lax.scan(one_layer, (x, aux), stacked_local)
+    return x, aux
+
+
+def _head_loss_sum(head_params, payload, tgt, cfg):
+    """Per-microbatch loss head: final LN + tied logits + SUMMED token
+    NLL (normalization happens once, outside the pipeline), plus the
+    MoE aux term carried in by the payload."""
+    from ..models.transformer import _ln
+
+    y, aux = payload
+    h = _ln(y, head_params["lnf_s"], head_params["lnf_b"])
+    logits = jnp.einsum("bld,vd->blv", h, head_params["emb"])
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    loss = nll.sum()
+    if cfg.n_experts and cfg.moe_aux_coef:
+        # aux is a per-microbatch mean-style quantity; scale by the
+        # microbatch token count so it normalizes like the NLL sum
+        loss = loss + cfg.moe_aux_coef * aux * nll.size
+    return loss
 
 
 def pipeline_param_specs(cfg) -> dict:
     """Specs for pipeline params: stacked layers sharded over ``pp`` on
     the leading (layer) axis, embedding/final-LN replicated. Stages run
-    their layers dense (no tp psums inside ``_stage_apply``), so only
-    the layer axis is sharded."""
-    _check_dense(cfg)
-    layer_keys = (
-        "ln1_s", "ln1_b", "wq", "wk", "wv", "wo",
-        "ln2_s", "ln2_b", "w1", "b1", "w2", "b2",
-    )
+    their layers dense within the stage (pipeline composes depth; tp/ep
+    compose in the flat program), so only the layer axis is sharded —
+    including the expert tables when ``cfg.n_experts``."""
+    layer_keys = [
+        "ln1_s", "ln1_b", "wq", "wk", "wv", "wo", "ln2_s", "ln2_b",
+    ]
+    if cfg.n_experts:
+        layer_keys += ["wg", "we1", "be1", "we2", "be2"]
+    else:
+        layer_keys += ["w1", "b1", "w2", "b2"]
     return {
         "emb": P(),
         "layers": {k: P("pp") for k in layer_keys},
@@ -146,8 +382,9 @@ def pipeline_param_specs(cfg) -> dict:
 def _check_dense(cfg):
     if cfg.n_experts:
         raise NotImplementedError(
-            "pipeline stages currently use the dense MLP; MoE composes "
-            "with dp/sp/tp in models/transformer.py"
+            'the fill/drain "gpipe" schedule runs dense stages only; '
+            'MoE stages are pipeline-legal under schedule="1f1b" '
+            "(expert aux loss rides the 1F1B payload to the head)"
         )
 
 
@@ -168,10 +405,62 @@ def _pipeline_loss_local(params, tokens, targets, cfg, n_microbatch):
     return nll_loss(logits, targets, ("dp",))
 
 
+def _1f1b_loss_grads_local(params, tokens, targets, cfg, n_microbatch):
+    """Per-shard 1F1B step: returns the (replicated) mean loss and the
+    full parameter-gradient pytree, stage grads pp-local."""
+    pos = jnp.arange(tokens.shape[1])
+    x = params["emb"][tokens]
+    head_params = {
+        "emb": params["emb"],
+        "lnf_s": params["lnf_s"],
+        "lnf_b": params["lnf_b"],
+    }
+    loss_sum, g_stage, g_head, dx = pipeline_1f1b(
+        partial(_stage_apply_payload, pos=pos, cfg=cfg),
+        partial(_head_loss_sum, cfg=cfg),
+        params["layers"],
+        head_params,
+        x,
+        targets,
+        axis="pp",
+        n_microbatch=n_microbatch,
+    )
+    # loss/head grads live on the last stage, dx on stage 0: the pp psum
+    # both replicates and selects; dp psum sums the data shards. tokens
+    # are pp-replicated, so the count psums over dp only.
+    count = jax.lax.psum(jnp.float32(targets.size), "dp")
+    loss = jax.lax.psum(loss_sum, ("dp", "pp")) / count
+    g_head = jax.tree.map(
+        lambda g: jax.lax.psum(g, ("dp", "pp")) / count, g_head
+    )
+    # embedding grad: head contribution + the lookup vjp of dx
+    dxf = dx.reshape(tokens.shape[0], tokens.shape[1], -1)
+    demb = jnp.zeros_like(params["emb"]).at[tokens].add(
+        dxf.astype(params["emb"].dtype)
+    )
+    demb = jax.lax.psum(demb, ("dp", "pp")) / count
+    g_stage = jax.tree.map(
+        lambda g: jax.lax.psum(g, "dp") / count, g_stage
+    )
+    grads = {
+        "emb": g_head["emb"] + demb,
+        "layers": g_stage,
+        "lnf_s": g_head["lnf_s"],
+        "lnf_b": g_head["lnf_b"],
+    }
+    return loss, grads
+
+
 def make_pipeline_train_step(cfg, mesh: Mesh, *, n_microbatch: int,
-                             lr: float = 1e-2):
+                             lr: float = 1e-2, schedule: str = "1f1b"):
     """Jitted (params, tokens, targets) -> (params, loss) SGD step over a
     (dp, pp) mesh: batch over ``dp``, the layer stack over ``pp``.
+
+    ``schedule="1f1b"`` (default) runs the interleaved fwd/bwd scan of
+    :func:`pipeline_1f1b` — O(pp) activation memory, MoE stages legal.
+    ``schedule="gpipe"`` keeps the fill/drain forward differentiated by
+    ``jax.grad`` (dense stages only) for comparison. Bubble fractions:
+    :func:`bubble_fraction`.
 
     ``cfg.n_layers`` must divide by the pp size; params come from
     :func:`shard_params_pipeline`. Attention runs per-device full
@@ -181,19 +470,42 @@ def make_pipeline_train_step(cfg, mesh: Mesh, *, n_microbatch: int,
     """
     from ..models.transformer import sgd_step
 
-    _check_dense(cfg)
     pp = mesh.shape["pp"]
     if cfg.n_layers % pp != 0:
         raise ValueError(
             f"n_layers {cfg.n_layers} not divisible by pp size {pp}"
         )
-    loss_fn = jax.shard_map(
-        partial(_pipeline_loss_local, cfg=cfg, n_microbatch=n_microbatch),
+    if schedule == "gpipe":
+        _check_dense(cfg)
+        loss_fn = jax.shard_map(
+            partial(
+                _pipeline_loss_local, cfg=cfg, n_microbatch=n_microbatch
+            ),
+            mesh=mesh,
+            in_specs=(pipeline_param_specs(cfg), P("dp"), P("dp")),
+            out_specs=P(),
+        )
+        return sgd_step(loss_fn, lr=lr)
+    if schedule != "1f1b":
+        raise ValueError(f"unknown schedule {schedule!r}")
+    grad_fn = jax.shard_map(
+        partial(
+            _1f1b_loss_grads_local, cfg=cfg, n_microbatch=n_microbatch
+        ),
         mesh=mesh,
         in_specs=(pipeline_param_specs(cfg), P("dp"), P("dp")),
-        out_specs=P(),
+        out_specs=(P(), pipeline_param_specs(cfg)),
     )
-    return sgd_step(loss_fn, lr=lr)
+
+    @jax.jit
+    def step(params, tokens, targets):
+        loss, grads = grad_fn(params, tokens, targets)
+        params = jax.tree.map(
+            lambda p, g: p - lr * g.astype(p.dtype), params, grads
+        )
+        return params, loss
+
+    return step
 
 
 def shard_params_pipeline(params: dict, cfg, mesh: Mesh) -> dict:

@@ -161,3 +161,116 @@ def test_pipeline_validates_divisibility():
             ]
         )
         f(layers, jnp.zeros((6, 4), jnp.float32))
+
+
+# ------------------------------------------------------------------ 1F1B
+
+
+def _grads_1f1b(cfg, mesh, params, toks, tgts, n_microbatch):
+    from mpistragglers_jl_tpu.parallel.pipeline import _1f1b_loss_grads_local
+
+    grad_fn = jax.jit(
+        jax.shard_map(
+            partial(_1f1b_loss_grads_local, cfg=cfg,
+                    n_microbatch=n_microbatch),
+            mesh=mesh,
+            in_specs=(pipeline_param_specs(cfg), P("dp"), P("dp")),
+            out_specs=(P(), pipeline_param_specs(cfg)),
+        )
+    )
+    sp = shard_params_pipeline(params, cfg, mesh)
+    place = lambda a: jax.device_put(a, NamedSharding(mesh, P("dp")))
+    return grad_fn(sp, place(toks), place(tgts))
+
+
+@pytest.mark.parametrize("shape", [(2, 4), (1, 4), (4, 2)])
+def test_1f1b_loss_and_grads_match_dense(shape):
+    """The interleaved fwd/bwd schedule computes the same loss AND the
+    same gradients as the dense oracle — the hand-written backward
+    wavefront (ring residuals, vjp recompute, grad ppermutes) is exact,
+    not approximate."""
+    mesh = make_mesh(shape, ("dp", "pp"))
+    params = init_params(CFG, seed=1)
+    toks, tgts = _data(CFG)
+    want_loss = _dense_loss(params, toks, tgts, CFG)
+    g_want = jax.grad(_dense_loss)(params, toks, tgts, CFG)
+    g_want["layers"] = stack_layers(g_want["layers"])
+
+    got_loss, g_got = _grads_1f1b(CFG, mesh, params, toks, tgts, 2)
+    np.testing.assert_allclose(
+        float(got_loss), float(want_loss), atol=1e-5, rtol=1e-5
+    )
+    for a, b in zip(jax.tree.leaves(g_got), jax.tree.leaves(g_want)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-3
+        )
+
+
+@pytest.mark.parametrize("pp", [2, 4])
+def test_1f1b_moe_pipeline_loss_decreases(pp):
+    """MoE stages are pipeline-legal under 1F1B (VERDICT round 1 item 4:
+    the dense-only guard is gone): expert layers run inside their stage,
+    the Switch aux loss rides the payload to the head, and training
+    makes progress at pp=2 and pp=4."""
+    cfg = TransformerConfig(
+        vocab=61, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+        n_experts=4, moe_aux_coef=0.01,
+    )
+    mesh = make_mesh((8 // pp, pp), ("dp", "pp"))
+    params = shard_params_pipeline(init_params(cfg, seed=3), cfg, mesh)
+    step = make_pipeline_train_step(
+        cfg, mesh, n_microbatch=2, lr=0.1, schedule="1f1b"
+    )
+    toks, tgts = _data(cfg, seed=7)
+    place = lambda a: jax.device_put(a, NamedSharding(mesh, P("dp")))
+    toks, tgts = place(toks), place(tgts)
+    losses = []
+    for _ in range(10):
+        params, loss = step(params, toks, tgts)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.05, losses
+    # expert tables stay pp-sharded on the stacked layer axis
+    assert "pp" in tuple(params["layers"]["we1"].sharding.spec)
+
+
+def test_gpipe_schedule_rejects_moe():
+    """The fill/drain schedule stays dense-only, pointing at 1F1B."""
+    cfg = TransformerConfig(
+        vocab=61, d_model=32, n_heads=4, n_layers=4, d_ff=64, n_experts=4
+    )
+    mesh = make_mesh((2, 4), ("dp", "pp"))
+    with pytest.raises(NotImplementedError, match="1f1b"):
+        make_pipeline_train_step(
+            cfg, mesh, n_microbatch=2, schedule="gpipe"
+        )
+
+
+def test_bubble_fraction_metric():
+    from mpistragglers_jl_tpu.parallel.pipeline import bubble_fraction
+
+    assert bubble_fraction(1, 4) == 0.0                    # no pipeline
+    assert bubble_fraction(4, 4) == pytest.approx(6 / 10)  # 2(p-1)/(M+2(p-1))
+    assert bubble_fraction(4, 4, "gpipe") == pytest.approx(3 / 7)
+    assert bubble_fraction(4, 32) == pytest.approx(6 / 38)
+    # more microbatches always shrink the bubble
+    assert bubble_fraction(4, 64) < bubble_fraction(4, 8)
+    with pytest.raises(ValueError):
+        bubble_fraction(4, 4, "pipedream")
+
+
+def test_gpipe_schedule_train_step_reduces_loss():
+    """The fill/drain schedule's full train step stays wired (the 1F1B
+    default must not orphan it)."""
+    mesh = make_mesh((2, 4), ("dp", "pp"))
+    params = shard_params_pipeline(init_params(CFG, seed=4), CFG, mesh)
+    step = make_pipeline_train_step(
+        CFG, mesh, n_microbatch=2, lr=0.1, schedule="gpipe"
+    )
+    toks, tgts = _data(CFG, seed=9)
+    place = lambda a: jax.device_put(a, NamedSharding(mesh, P("dp")))
+    toks, tgts = place(toks), place(tgts)
+    losses = []
+    for _ in range(8):
+        params, loss = step(params, toks, tgts)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.05, losses
