@@ -19,7 +19,6 @@ catch it and build a :class:`~.process.ProcessBackend` instead.
 from __future__ import annotations
 
 import multiprocessing as mp
-import pickle
 import secrets
 from collections import deque
 import tempfile
@@ -29,6 +28,7 @@ from typing import Sequence
 
 import numpy as np
 
+from ..native import codec
 from ..native import transport as T
 from .base import Backend, Deadline, DelayFn, WorkerError
 from .process import RemoteWorkerError, WorkerProcessDied, WorkFn
@@ -55,10 +55,12 @@ class NativeProcessBackend(Backend):
     """n worker processes; all coordinator-side I/O in the C++ runtime.
 
     Same contract as :class:`~.process.ProcessBackend` (picklable
-    ``work_fn(i, payload, epoch)`` / ``delay_fn``); the payload snapshot
-    happens twice over — pickled at dispatch, then copied into the native
-    send queue — so in-flight sends survive caller mutation (the
-    reference's ``isendbuf`` discipline, src/MPIAsyncPools.jl:130).
+    ``work_fn(i, payload, epoch)`` / ``delay_fn``). Payloads travel via
+    the zero-copy codec (native/codec.py): plain ndarrays go as raw
+    bytes — ONE snapshot copy into the native send queue, shared across
+    the epoch's whole broadcast — so in-flight sends survive caller
+    mutation (the reference's ``isendbuf`` discipline,
+    src/MPIAsyncPools.jl:130) at memcpy cost, not pickle cost.
     """
 
     def __init__(
@@ -116,10 +118,13 @@ class NativeProcessBackend(Backend):
         # frames that arrived for a channel other than the one being
         # awaited; at most one live frame per channel (slot discipline)
         self._stash: dict[tuple[int, int], deque] = {}
-        # per-epoch payload serialization cache (see _serialize)
+        # per-epoch payload encoding cache (see _encode): the codec
+        # prefix plus a SHARED native snapshot of the body, taken once
+        # per broadcast instead of once per worker
         self._pick_src = None
         self._pick_epoch = None
-        self._pick_bytes = b""
+        self._pick_prefix = b""
+        self._pick_shared: T.SharedPayload | None = None
         # dispatch that failed instantly (dead worker): surfaced at the
         # next test/wait instead of raising inside the pool's send phase
         self._synthetic: dict[tuple[int, int], WorkerError] = {}
@@ -182,45 +187,59 @@ class NativeProcessBackend(Backend):
 
     # -- Backend interface -------------------------------------------------
     def begin_epoch(self, epoch: int) -> None:
-        # arm the payload serialization cache for this epoch and drop the
+        # arm the payload encoding cache for this epoch and drop the
         # previous epoch's entry. The cache is ONLY active for an epoch
         # announced via begin_epoch (i.e. inside asyncmap, where the
         # coordinator is single-threaded and the sendbuf cannot mutate
         # between the phase-2/phase-3 dispatches of one call); direct
         # Backend-API dispatches never hit it, so their payloads are
         # snapshotted at every dispatch as the class docstring promises.
-        self._pick_src = None
-        self._pick_bytes = b""
+        self._drop_cache()
         self._pick_epoch = int(epoch)
 
     def end_epoch(self) -> None:
         # disarm: a direct dispatch AFTER asyncmap returns (e.g. manual
         # re-task of a mutated buffer at the same epoch number) must
-        # re-serialize, preserving snapshot-at-dispatch semantics
-        self._pick_src = None
-        self._pick_bytes = b""
+        # re-encode, preserving snapshot-at-dispatch semantics
+        self._drop_cache()
         self._pick_epoch = None
 
-    def _serialize(self, sendbuf, epoch: int) -> bytes:
-        """Pickle the payload once per (object, epoch): asyncmap
-        broadcasts ONE stable sendbuf to every idle worker per epoch
-        (reference src/MPIAsyncPools.jl:118-139), so n dispatches — and
-        any phase-3 re-tasks — share a single serialization instead of
-        pickling the same bytes n times. Identity-keyed, and only armed
-        for the epoch most recently announced via :meth:`begin_epoch` —
-        direct Backend-API dispatches always re-serialize, so in-place
-        payload mutation between dispatches is always observed."""
+    def _drop_cache(self) -> None:
+        self._pick_src = None
+        self._pick_prefix = b""
+        if self._pick_shared is not None:
+            self._pick_shared.release()  # queued frames keep their refs
+            self._pick_shared = None
+
+    def _send_payload(self, i: int, sendbuf, epoch: int, tag: int) -> bool:
+        """Encode + enqueue one dispatch, zero-copy where possible.
+
+        asyncmap broadcasts ONE stable sendbuf to every idle worker per
+        epoch (reference src/MPIAsyncPools.jl:118-139), so inside an
+        epoch the body is snapshotted into a native SHARED payload once
+        and the n dispatches (and phase-3 re-tasks) enqueue references —
+        one memcpy per broadcast, no pickling for plain ndarrays
+        (native/codec.py). Direct Backend-API dispatches always
+        re-encode, so in-place payload mutation between dispatches is
+        always observed."""
         cacheable = epoch == self._pick_epoch
-        if cacheable and sendbuf is self._pick_src:
-            return self._pick_bytes
-        payload = sendbuf
-        if hasattr(payload, "__array__") and not isinstance(payload, np.ndarray):
-            payload = np.asarray(payload)  # device arrays are not picklable
-        data = pickle.dumps(payload, protocol=5)
-        if cacheable:
-            self._pick_src = sendbuf
-            self._pick_bytes = data
-        return data
+        if not (cacheable and sendbuf is self._pick_src):
+            prefix, body = codec.encode(sendbuf)
+            if cacheable:
+                self._drop_cache()
+                self._pick_src = sendbuf
+                self._pick_prefix = prefix
+                self._pick_shared = self._coord.payload(body)
+                self._pick_epoch = epoch  # _drop_cache left it intact
+            else:
+                return self._coord.isend2(
+                    i, prefix, body,
+                    seq=self._seq_counter[i], epoch=epoch, tag=tag,
+                )
+        return self._coord.isend_shared(
+            i, self._pick_prefix, self._pick_shared,
+            seq=self._seq_counter[i], epoch=epoch, tag=tag,
+        )
 
     def _check_ready(self) -> None:
         if self._closed:
@@ -236,14 +255,10 @@ class NativeProcessBackend(Backend):
     def dispatch(self, i: int, sendbuf, epoch: int, *, tag: int = 0) -> None:
         self._check_ready()
         key = (i, int(tag))
-        data = self._serialize(sendbuf, int(epoch))
         self._seq_counter[i] += 1
         self._cur[key] = self._seq_counter[i]
         self._epochs[key] = int(epoch)
-        ok = self._coord.isend(
-            i, data, seq=self._seq_counter[i], epoch=int(epoch),
-            tag=int(tag),
-        )
+        ok = self._send_payload(i, sendbuf, int(epoch), int(tag))
         if not ok:  # rank already dead: fail the task, don't hang the pool
             self._synthetic[key] = WorkerError(i, epoch, WorkerProcessDied(i))
 
@@ -253,11 +268,11 @@ class NativeProcessBackend(Backend):
                 i, self._epochs.get((i, tag), 0), WorkerProcessDied(i)
             )
         if msg.kind == T.KIND_ERROR:
-            exc_type, text, tb = pickle.loads(msg.payload)
+            exc_type, text, tb = codec.decode(msg.payload)
             return WorkerError(
                 i, msg.epoch, RemoteWorkerError(exc_type, text, tb)
             )
-        return pickle.loads(msg.payload)
+        return codec.decode(msg.payload)
 
     def _route(self, j: int, msg: T.Message, want_tag: int):
         """Classify an arriving frame against channel ``(j, want_tag)``:
@@ -405,10 +420,9 @@ class NativeProcessBackend(Backend):
         if self._closed:
             return
         self._closed = True
-        # don't pin the last payload + its pickled copy for the backend
-        # object's remaining lifetime
-        self._pick_src = None
-        self._pick_bytes = b""
+        # don't pin the last payload + its native snapshot for the
+        # backend object's remaining lifetime
+        self._drop_cache()
         self._pick_epoch = None
         if not self._accepted:
             # handshake never completed: there is no connection to send a

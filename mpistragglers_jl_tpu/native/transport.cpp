@@ -40,6 +40,7 @@
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -54,6 +55,7 @@
 #include <poll.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <sys/un.h>
@@ -75,10 +77,71 @@ constexpr int64_t KIND_CONTROL = 1;
 constexpr int64_t KIND_HELLO = 2;
 constexpr int64_t KIND_DEATH = 3;
 constexpr int64_t KIND_ERROR = 4;
+// Same-host zero-copy broadcast: the frame's wire payload is
+// [int64 shm_id, int64 body_len, codec prefix...] and the BODY lives in
+// a memfd region mapped by both sides; the memfd crosses the socket as
+// SCM_RIGHTS ancillary data attached to the frame's first byte. The
+// receiving transport resolves the region and presents the frame as
+// KIND_DATA with an out-of-band body view.
+constexpr int64_t KIND_SHM = 5;
 
 struct Frame {
   Header hdr;
-  std::vector<uint8_t> payload;
+  std::vector<uint8_t> payload;  // inbound frames / simple sends
+  // Outbound zero-copy path: an optional codec prefix written after the
+  // header, and an optional SHARED body — the pool broadcasts one
+  // payload to every worker per epoch, so the snapshot is taken once
+  // and the n send queues hold references, not copies.
+  std::vector<uint8_t> prefix;
+  std::shared_ptr<std::vector<uint8_t>> shared;
+  // fd to pass via SCM_RIGHTS with the frame's first byte (shm frames);
+  // owned by the frame until attached (or the frame is destroyed)
+  int pass_fd = -1;
+
+  Frame() = default;
+  Frame(const Frame&) = delete;
+  Frame& operator=(const Frame&) = delete;
+  Frame(Frame&& o) noexcept
+      : hdr(o.hdr), payload(std::move(o.payload)),
+        prefix(std::move(o.prefix)), shared(std::move(o.shared)),
+        pass_fd(o.pass_fd) {
+    o.pass_fd = -1;
+  }
+  Frame& operator=(Frame&& o) noexcept {
+    if (this != &o) {
+      if (pass_fd >= 0) ::close(pass_fd);
+      hdr = o.hdr;
+      payload = std::move(o.payload);
+      prefix = std::move(o.prefix);
+      shared = std::move(o.shared);
+      pass_fd = o.pass_fd;
+      o.pass_fd = -1;
+    }
+    return *this;
+  }
+  ~Frame() {
+    if (pass_fd >= 0) ::close(pass_fd);
+  }
+
+  size_t body_size() const {
+    return shared ? shared->size() : payload.size();
+  }
+  const uint8_t* body_data() const {
+    return shared ? shared->data() : payload.data();
+  }
+};
+
+// A coordinator-side shared-memory broadcast payload: one memfd, one
+// memcpy, any number of per-worker frames referencing it by id.
+struct ShmPayload {
+  int fd = -1;
+  void* addr = nullptr;
+  size_t len = 0;
+  int64_t id = 0;
+  ~ShmPayload() {
+    if (addr) ::munmap(addr, len);
+    if (fd >= 0) ::close(fd);
+  }
 };
 
 // Blocking full read/write on a (blocking-mode) fd. Used worker-side and
@@ -106,6 +169,22 @@ int parse_tcp(const char* addr, std::string* host, int* port) {
 void tune_tcp(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+// Large socket buffers: the pool ships multi-MiB coded shards, and the
+// default ~208 KiB buffers force a wakeup/context-switch per fraction of
+// a frame. SO_*BUFFORCE (root) ignores wmem_max/rmem_max caps; the
+// plain options are the unprivileged fallback. Best effort by design.
+void tune_bufs(int fd) {
+  int sz = 8 * 1024 * 1024;
+#ifdef SO_SNDBUFFORCE
+  if (setsockopt(fd, SOL_SOCKET, SO_SNDBUFFORCE, &sz, sizeof(sz)) != 0)
+#endif
+    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sz, sizeof(sz));
+#ifdef SO_RCVBUFFORCE
+  if (setsockopt(fd, SOL_SOCKET, SO_RCVBUFFORCE, &sz, sizeof(sz)) != 0)
+#endif
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof(sz));
 }
 
 bool read_full(int fd, void* buf, size_t n) {
@@ -348,10 +427,28 @@ struct Coordinator {
   }
 };
 
-// Serialize one frame into a flat byte vector (header + payload) so the
-// partial-write cursor is a single offset.
+// Total outbound bytes of a frame (header + prefix + body); the
+// partial-write cursor is a single offset over that concatenation.
 size_t frame_bytes(const Frame& f) {
-  return sizeof(Header) + f.payload.size();
+  return sizeof(Header) + f.prefix.size() + f.body_size();
+}
+
+// Map the partial-write offset to (segment pointer, bytes available):
+// the frame is written as header, then prefix, then body, without ever
+// materializing the concatenation.
+const uint8_t* frame_segment(const Frame& f, size_t off, size_t* avail) {
+  if (off < sizeof(Header)) {
+    *avail = sizeof(Header) - off;
+    return reinterpret_cast<const uint8_t*>(&f.hdr) + off;
+  }
+  off -= sizeof(Header);
+  if (off < f.prefix.size()) {
+    *avail = f.prefix.size() - off;
+    return f.prefix.data() + off;
+  }
+  off -= f.prefix.size();
+  *avail = f.body_size() - off;
+  return f.body_data() + off;
 }
 
 void mark_dead(Coordinator* c, int rank) {
@@ -402,8 +499,10 @@ bool pump_read(Coordinator* c, int rank) {
     }
     {
       std::lock_guard<std::mutex> lk(c->mu);
-      c->completed[rank].push_back(
-          Frame{p.rhdr, std::move(p.rbuf)});
+      Frame f;
+      f.hdr = p.rhdr;
+      f.payload = std::move(p.rbuf);
+      c->completed[rank].push_back(std::move(f));
       c->cv.notify_all();
     }
     p.rbuf = {};
@@ -425,17 +524,32 @@ bool pump_write(Coordinator* c, int rank) {
     }
     size_t total = frame_bytes(*f);
     while (p.sent < total) {
-      const uint8_t* src;
       size_t avail;
-      if (p.sent < sizeof(Header)) {
-        src = reinterpret_cast<const uint8_t*>(&f->hdr) + p.sent;
-        avail = sizeof(Header) - p.sent;
+      const uint8_t* src = frame_segment(*f, p.sent, &avail);
+      ssize_t r;
+      if (f->pass_fd >= 0 && p.sent == 0) {
+        // attach the shm fd to the frame's first byte (SCM_RIGHTS)
+        msghdr mh{};
+        iovec iov{const_cast<uint8_t*>(src), avail};
+        mh.msg_iov = &iov;
+        mh.msg_iovlen = 1;
+        alignas(cmsghdr) char cbuf[CMSG_SPACE(sizeof(int))];
+        std::memset(cbuf, 0, sizeof(cbuf));
+        mh.msg_control = cbuf;
+        mh.msg_controllen = sizeof(cbuf);
+        cmsghdr* cm = CMSG_FIRSTHDR(&mh);
+        cm->cmsg_level = SOL_SOCKET;
+        cm->cmsg_type = SCM_RIGHTS;
+        cm->cmsg_len = CMSG_LEN(sizeof(int));
+        std::memcpy(CMSG_DATA(cm), &f->pass_fd, sizeof(int));
+        r = ::sendmsg(p.fd, &mh, 0);
+        if (r > 0) {
+          ::close(f->pass_fd);  // in flight; kernel holds its own ref
+          f->pass_fd = -1;
+        }
       } else {
-        size_t off = p.sent - sizeof(Header);
-        src = f->payload.data() + off;
-        avail = f->payload.size() - off;
+        r = ::write(p.fd, src, avail);
       }
-      ssize_t r = ::write(p.fd, src, avail);
       if (r < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
         if (errno == EINTR) continue;
@@ -522,10 +636,54 @@ void progress_main(Coordinator* c) {
 
 struct WorkerCtx {
   int fd = -1;
+  // shm broadcast state: fds received via SCM_RIGHTS awaiting their
+  // frame. Region mapping/lifetime lives PYTHON-side (mmap objects),
+  // where eviction can be refused while views are still exported —
+  // a C-side munmap under a live numpy view would be a silent segfault.
+  std::deque<int> pending_fds;
+
   ~WorkerCtx() {
     if (fd >= 0) ::close(fd);
+    for (int f : pending_fds) ::close(f);
   }
 };
+
+// read_full for the worker's data phase: recvmsg so SCM_RIGHTS fds
+// riding any byte land in pending_fds instead of being discarded.
+bool worker_read_full(WorkerCtx* w, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    msghdr mh{};
+    iovec iov{p, n};
+    mh.msg_iov = &iov;
+    mh.msg_iovlen = 1;
+    alignas(cmsghdr) char cbuf[CMSG_SPACE(4 * sizeof(int))];
+    mh.msg_control = cbuf;
+    mh.msg_controllen = sizeof(cbuf);
+    ssize_t r = ::recvmsg(w->fd, &mh, MSG_CMSG_CLOEXEC);
+    if (r == 0) return false;  // EOF
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    for (cmsghdr* cm = CMSG_FIRSTHDR(&mh); cm != nullptr;
+         cm = CMSG_NXTHDR(&mh, cm)) {
+      if (cm->cmsg_level == SOL_SOCKET && cm->cmsg_type == SCM_RIGHTS) {
+        size_t nfds = (cm->cmsg_len - CMSG_LEN(0)) / sizeof(int);
+        int fds[4];
+        std::memcpy(fds, CMSG_DATA(cm),
+                    std::min(nfds, size_t(4)) * sizeof(int));
+        for (size_t i = 0; i < std::min(nfds, size_t(4)); i++)
+          w->pending_fds.push_back(fds[i]);
+      }
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+
 
 // Coordinator side of the hello auth exchange, run with SO_RCVTIMEO
 // still armed on `fd`. Always sends an ack frame telling the worker
@@ -578,6 +736,7 @@ int accept_hello(Coordinator* c,
     int fd = ::accept(c->listen_fd, nullptr, nullptr);
     if (fd < 0) continue;
     if (c->tcp) tune_tcp(fd);
+    tune_bufs(fd);
     // cap the per-hello exchange at 2 s: a silent stray connection
     // (scanner, health check that sends no bytes) must burn seconds, not
     // the whole handshake deadline while real workers wait in the backlog
@@ -646,7 +805,9 @@ void* msgt_coord_create(const char* addr_str, int n_workers,
                         const uint8_t* token, int token_len) {
   auto* c = new Coordinator();
   c->n = n_workers;
-  c->peers.resize(n_workers);
+  c->peers = std::vector<Peer>(n_workers);  // in-place default
+  // construction: Frame is move-only, so resize's
+  // move-if-noexcept fallback to copying Peers cannot compile
   c->parked.assign(n_workers, -1);
   c->completed.resize(n_workers);
   if (token != nullptr && token_len > 0)
@@ -765,6 +926,154 @@ int msgt_coord_isend(void* h, int rank, int64_t seq, int64_t epoch,
     Frame f;
     f.hdr = Header{len, seq, epoch, tag, kind};
     f.payload.assign(data, data + len);
+    p.sendq.push_back(std::move(f));
+  }
+  uint64_t one = 1;
+  (void)!::write(c->wake_fd, &one, sizeof(one));
+  return 0;
+}
+
+// Two-buffer non-blocking send: `pre` (a small codec header) and `body`
+// are snapshotted as separate segments — the caller never concatenates,
+// so a raw ndarray payload costs exactly one copy (into the queue).
+int msgt_coord_isend2(void* h, int rank, int64_t seq, int64_t epoch,
+                      int64_t tag, int64_t kind, const uint8_t* pre,
+                      int64_t pre_len, const uint8_t* body,
+                      int64_t body_len) {
+  auto* c = static_cast<Coordinator*>(h);
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    Peer& p = c->peers[rank];
+    if (p.dead) return -1;
+    Frame f;
+    f.hdr = Header{pre_len + body_len, seq, epoch, tag, kind};
+    f.prefix.assign(pre, pre + pre_len);
+    f.payload.assign(body, body + body_len);
+    p.sendq.push_back(std::move(f));
+  }
+  uint64_t one = 1;
+  (void)!::write(c->wake_fd, &one, sizeof(one));
+  return 0;
+}
+
+// ---- shared broadcast payloads -------------------------------------
+// The pool broadcasts ONE payload to every idle worker per epoch
+// (reference src/MPIAsyncPools.jl:118-139). A shared payload snapshots
+// the bytes once; isend_shared enqueues references, so an n-worker
+// broadcast is one memcpy total instead of n.
+
+void* msgt_payload_create(const uint8_t* data, int64_t len) {
+  return new std::shared_ptr<std::vector<uint8_t>>(
+      std::make_shared<std::vector<uint8_t>>(data, data + len));
+}
+
+void msgt_payload_release(void* ph) {
+  // frames still queued keep the underlying vector alive via their own
+  // shared_ptr copies; this only drops the creator's reference
+  delete static_cast<std::shared_ptr<std::vector<uint8_t>>*>(ph);
+}
+
+int msgt_coord_isend_shared(void* h, int rank, int64_t seq, int64_t epoch,
+                            int64_t tag, int64_t kind, const uint8_t* pre,
+                            int64_t pre_len, void* ph) {
+  auto* c = static_cast<Coordinator*>(h);
+  auto* sp = static_cast<std::shared_ptr<std::vector<uint8_t>>*>(ph);
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    Peer& p = c->peers[rank];
+    if (p.dead) return -1;
+    Frame f;
+    f.hdr = Header{
+        pre_len + static_cast<int64_t>((*sp)->size()), seq, epoch, tag,
+        kind};
+    f.prefix.assign(pre, pre + pre_len);
+    f.shared = *sp;
+    p.sendq.push_back(std::move(f));
+  }
+  uint64_t one = 1;
+  (void)!::write(c->wake_fd, &one, sizeof(one));
+  return 0;
+}
+
+// ---- shared-memory broadcast payloads (same-host zero-copy) ---------
+// One memfd holds the body; every worker maps it. An n-worker broadcast
+// is ONE memcpy (into the region) + tiny descriptor frames — no payload
+// bytes cross the sockets at all.
+
+void* msgt_payload_create_shm(const uint8_t* data, int64_t len) {
+  static std::atomic<int64_t> next_id{1};
+  int fd = ::memfd_create("msgt-shm", MFD_CLOEXEC);
+  if (fd < 0) return nullptr;
+  if (::ftruncate(fd, len) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* addr = nullptr;
+  if (len > 0) {
+    addr = ::mmap(nullptr, static_cast<size_t>(len),
+                  PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    if (addr == MAP_FAILED) {
+      ::close(fd);
+      return nullptr;
+    }
+    std::memcpy(addr, data, static_cast<size_t>(len));
+  }
+  auto* sp = new ShmPayload();
+  sp->fd = fd;
+  sp->addr = addr;
+  sp->len = static_cast<size_t>(len);
+  sp->id = next_id.fetch_add(1);
+  return sp;
+}
+
+void msgt_payload_release_shm(void* ph) {
+  // frames already queued carry their own dup'd fds; the region's pages
+  // live until every mapping and fd is gone
+  delete static_cast<ShmPayload*>(ph);
+}
+
+int msgt_coord_isend_shm(void* h, int rank, int64_t seq, int64_t epoch,
+                         int64_t tag, const uint8_t* pre, int64_t pre_len,
+                         void* ph) {
+  auto* c = static_cast<Coordinator*>(h);
+  auto* sp = static_cast<ShmPayload*>(ph);
+  int dupfd = ::fcntl(sp->fd, F_DUPFD_CLOEXEC, 0);
+  if (dupfd < 0) {
+    // fd exhaustion is not a dead rank: degrade to an ordinary in-frame
+    // copy straight out of the mapping, same wire semantics
+    std::lock_guard<std::mutex> lk(c->mu);
+    Peer& p = c->peers[rank];
+    if (p.dead) return -1;
+    Frame f;
+    f.hdr = Header{
+        pre_len + static_cast<int64_t>(sp->len), seq, epoch, tag,
+        KIND_DATA};
+    f.prefix.assign(pre, pre + pre_len);
+    auto* base = static_cast<const uint8_t*>(sp->addr);
+    f.payload.assign(base, base + sp->len);
+    p.sendq.push_back(std::move(f));
+    uint64_t one = 1;
+    (void)!::write(c->wake_fd, &one, sizeof(one));
+    return 0;
+  }
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    Peer& p = c->peers[rank];
+    if (p.dead) {
+      ::close(dupfd);
+      return -1;
+    }
+    Frame f;
+    // wire payload: [shm_id, body_len, prefix...]; body stays in shm
+    f.hdr = Header{
+        static_cast<int64_t>(2 * sizeof(int64_t)) + pre_len, seq, epoch,
+        tag, KIND_SHM};
+    f.payload.resize(2 * sizeof(int64_t) + pre_len);
+    int64_t meta[2] = {sp->id, static_cast<int64_t>(sp->len)};
+    std::memcpy(f.payload.data(), meta, sizeof(meta));
+    std::memcpy(f.payload.data() + sizeof(meta), pre,
+                static_cast<size_t>(pre_len));
+    f.pass_fd = dupfd;
     p.sendq.push_back(std::move(f));
   }
   uint64_t one = 1;
@@ -967,6 +1276,7 @@ void* msgt_worker_connect(const char* addr_str, int rank,
       return nullptr;
     }
     tune_tcp(w->fd);
+    tune_bufs(w->fd);
   } else {
     w->fd = ::socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
     if (w->fd < 0) {
@@ -981,6 +1291,7 @@ void* msgt_worker_connect(const char* addr_str, int rank,
       delete w;
       return nullptr;
     }
+    tune_bufs(w->fd);
   }
   Header hello{0, rank, 0, 0, KIND_HELLO};
   if (!write_full(w->fd, &hello, sizeof(hello))) {
@@ -1038,13 +1349,23 @@ void msgt_hmac_sha256(const uint8_t* key, int keylen, const uint8_t* msg,
 // EOF/error (coordinator gone).
 int msgt_worker_recv_hdr(void* h, Header* hdr_out) {
   auto* w = static_cast<WorkerCtx*>(h);
-  return read_full(w->fd, hdr_out, sizeof(Header)) ? 0 : -1;
+  return worker_read_full(w, hdr_out, sizeof(Header)) ? 0 : -1;
 }
 
 // Blocking read of `len` payload bytes following a header.
 int msgt_worker_recv_payload(void* h, uint8_t* buf, int64_t len) {
   auto* w = static_cast<WorkerCtx*>(h);
-  return read_full(w->fd, buf, static_cast<size_t>(len)) ? 0 : -1;
+  return worker_read_full(w, buf, static_cast<size_t>(len)) ? 0 : -1;
+}
+
+// Pop the next SCM_RIGHTS fd received with a shm frame (-1 if none).
+// The Python side owns the mapping and its lifetime (mmap module).
+int msgt_worker_take_fd(void* h) {
+  auto* w = static_cast<WorkerCtx*>(h);
+  if (w->pending_fds.empty()) return -1;
+  int fd = w->pending_fds.front();
+  w->pending_fds.pop_front();
+  return fd;
 }
 
 // Blocking send of one frame (header + payload).
@@ -1054,6 +1375,23 @@ int msgt_worker_send(void* h, int64_t seq, int64_t epoch, int64_t tag,
   Header hdr{len, seq, epoch, tag, kind};
   if (!write_full(w->fd, &hdr, sizeof(hdr))) return -1;
   if (len > 0 && !write_full(w->fd, data, static_cast<size_t>(len)))
+    return -1;
+  return 0;
+}
+
+// Two-buffer blocking send: header, codec prefix, then the body written
+// straight from the caller's buffer (e.g. an ndarray's memory) — the
+// worker result path is zero-copy in user space.
+int msgt_worker_send2(void* h, int64_t seq, int64_t epoch, int64_t tag,
+                      int64_t kind, const uint8_t* pre, int64_t pre_len,
+                      const uint8_t* body, int64_t body_len) {
+  auto* w = static_cast<WorkerCtx*>(h);
+  Header hdr{pre_len + body_len, seq, epoch, tag, kind};
+  if (!write_full(w->fd, &hdr, sizeof(hdr))) return -1;
+  if (pre_len > 0 && !write_full(w->fd, pre, static_cast<size_t>(pre_len)))
+    return -1;
+  if (body_len > 0 &&
+      !write_full(w->fd, body, static_cast<size_t>(body_len)))
     return -1;
   return 0;
 }

@@ -19,13 +19,19 @@ the pure-Python :class:`~..backends.process.ProcessBackend` instead.
 from __future__ import annotations
 
 import ctypes
+import mmap as _mmap
+import os as _os
+import struct as _struct
 from dataclasses import dataclass
+
+import numpy as np
 
 KIND_DATA = 0
 KIND_CONTROL = 1
 KIND_HELLO = 2
 KIND_DEATH = 3
 KIND_ERROR = 4
+KIND_SHM = 5  # transport-internal: body rides shared memory, not the wire
 
 
 class _Header(ctypes.Structure):
@@ -40,13 +46,52 @@ class _Header(ctypes.Structure):
 
 @dataclass(frozen=True)
 class Message:
-    """One received frame: bookkeeping header + raw payload bytes."""
+    """One received frame: bookkeeping header + raw payload bytes.
+
+    ``payload`` is a ``bytearray`` (or ``bytes``): the receive path
+    copies the frame exactly once, socket -> this buffer, and decoders
+    (``np.frombuffer``, ``pickle.loads``) consume it without further
+    copies."""
 
     seq: int
     epoch: int
     tag: int
     kind: int
-    payload: bytes
+    payload: "bytes | bytearray"
+    # out-of-band body (shared-memory broadcasts): the codec prefix is in
+    # ``payload`` and the bytes live in a mapped region. Valid until 4
+    # newer shm payloads arrive — copy if retaining longer.
+    body: "memoryview | None" = None
+
+
+def _addr_len(buf) -> tuple[int, int, object]:
+    """(address, nbytes, keepalive) of any contiguous readable buffer.
+
+    ``keepalive`` is whatever object OWNS the memory behind ``address``
+    (a temporary copy for non-contiguous/readonly inputs) — the caller
+    must hold it until the native call returns, or the address dangles.
+    """
+    if isinstance(buf, np.ndarray):
+        if not buf.flags.c_contiguous:
+            buf = np.ascontiguousarray(buf)
+        return buf.ctypes.data, buf.nbytes, buf
+    if isinstance(buf, bytes):
+        return ctypes.cast(buf, ctypes.c_void_p).value or 0, len(buf), buf
+    if isinstance(buf, bytearray):
+        if not buf:
+            return 0, 0, buf
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        return addr, len(buf), buf
+    mv = memoryview(buf)
+    if not mv.c_contiguous:
+        mv = memoryview(bytes(mv))
+    if mv.nbytes == 0:
+        return 0, 0, mv
+    if mv.readonly:
+        b = bytes(mv)
+        return ctypes.cast(b, ctypes.c_void_p).value or 0, len(b), b
+    export = (ctypes.c_char * mv.nbytes).from_buffer(mv)
+    return ctypes.addressof(export), mv.nbytes, export
 
 
 def _configure(lib):
@@ -111,8 +156,47 @@ def _configure(lib):
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
     ]
+    # zero-copy path: two-buffer sends + shared broadcast payloads. The
+    # buffer args are c_void_p (NOT c_char_p) so writable buffers and
+    # raw ndarray memory pass without a bytes conversion copy.
+    lib.msgt_coord_isend2.restype = ctypes.c_int
+    lib.msgt_coord_isend2.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.msgt_payload_create.restype = ctypes.c_void_p
+    lib.msgt_payload_create.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.msgt_payload_release.restype = None
+    lib.msgt_payload_release.argtypes = [ctypes.c_void_p]
+    lib.msgt_coord_isend_shared.restype = ctypes.c_int
+    lib.msgt_coord_isend_shared.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p,
+    ]
+    lib.msgt_worker_send2.restype = ctypes.c_int
+    lib.msgt_worker_send2.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
     lib.msgt_worker_close.restype = None
     lib.msgt_worker_close.argtypes = [ctypes.c_void_p]
+    # shared-memory broadcast payloads (same-host zero-copy)
+    lib.msgt_payload_create_shm.restype = ctypes.c_void_p
+    lib.msgt_payload_create_shm.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64
+    ]
+    lib.msgt_payload_release_shm.restype = None
+    lib.msgt_payload_release_shm.argtypes = [ctypes.c_void_p]
+    lib.msgt_coord_isend_shm.restype = ctypes.c_int
+    lib.msgt_coord_isend_shm.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+    ]
+    lib.msgt_worker_take_fd.restype = ctypes.c_int
+    lib.msgt_worker_take_fd.argtypes = [ctypes.c_void_p]
 
 
 def load_lib():
@@ -190,9 +274,71 @@ class Coordinator:
     ) -> bool:
         """Non-blocking send; payload is snapshotted into the native send
         queue. Returns False if the rank is dead."""
+        if not isinstance(payload, bytes):
+            payload = bytes(payload)  # c_char_p wants immutable bytes
         rc = self._lib.msgt_coord_isend(
             self._handle(), int(rank), seq, epoch, tag, kind, payload,
             len(payload),
+        )
+        return rc == 0
+
+    def isend2(
+        self, rank: int, prefix: bytes, body, *,
+        seq: int = 0, epoch: int = 0, tag: int = 0, kind: int = KIND_DATA,
+    ) -> bool:
+        """Two-buffer non-blocking send: ``prefix`` (small codec header)
+        and ``body`` (any contiguous buffer — ndarray memory passes
+        directly) are snapshotted as separate segments; the wire frame
+        is header+prefix+body with no Python-side concatenation."""
+        paddr, plen, pkeep = _addr_len(prefix)
+        baddr, blen, bkeep = _addr_len(body)
+        rc = self._lib.msgt_coord_isend2(
+            self._handle(), int(rank), seq, epoch, tag, kind,
+            paddr, plen, baddr, blen,
+        )
+        del pkeep, bkeep  # held across the (synchronously copying) call
+        return rc == 0
+
+    def payload(self, body) -> "SharedPayload | ShmPayload":
+        """Snapshot ``body`` ONCE for a broadcast; pass to
+        :meth:`isend_shared` for each rank (the pool's per-epoch
+        pattern). On same-host (Unix-socket) transports the snapshot is
+        a shared-memory region: workers map the SAME pages, so the
+        body's bytes never cross the sockets at all — one memcpy per
+        broadcast, total. TCP transports snapshot into a native buffer
+        shared across the n send queues (one memcpy instead of n)."""
+        _, n, _keep = _addr_len(body)
+        # shm pays a fixed per-epoch setup (memfd + 2 mmaps + fd pass);
+        # it wins when the broadcast is wide and the body large, loses
+        # for single workers / small frames where socket copies are cheap
+        if (
+            not self.path.startswith("tcp://")
+            and self.n_workers >= 2
+            and n >= (1 << 20)
+        ):
+            shm = ShmPayload(self._lib, body)
+            if shm._h is not None:  # memfd unavailable -> socket path
+                return shm
+        return SharedPayload(self._lib, body)
+
+    def isend_shared(
+        self, rank: int, prefix: bytes, payload, *,
+        seq: int = 0, epoch: int = 0, tag: int = 0, kind: int = KIND_DATA,
+    ) -> bool:
+        if payload._h is None:
+            raise TransportError("shared payload already released")
+        paddr, plen, pkeep = _addr_len(prefix)
+        if isinstance(payload, ShmPayload):
+            if kind != KIND_DATA:
+                raise ValueError("shm payloads carry data frames only")
+            rc = self._lib.msgt_coord_isend_shm(
+                self._handle(), int(rank), seq, epoch, tag,
+                paddr, plen, payload._h,
+            )
+            return rc == 0
+        rc = self._lib.msgt_coord_isend_shared(
+            self._handle(), int(rank), seq, epoch, tag, kind,
+            paddr, plen, payload._h,
         )
         return rc == 0
 
@@ -209,13 +355,19 @@ class Coordinator:
 
     def _take(self, rank: int, hdr: _Header) -> Message:
         n = int(hdr.len)
-        buf = (ctypes.c_uint8 * max(n, 1))()
-        got = self._lib.msgt_coord_take(self._handle(), int(rank), buf, n)
+        buf = bytearray(n)
+        cbuf = (
+            (ctypes.c_uint8 * n).from_buffer(buf) if n
+            else (ctypes.c_uint8 * 1)()
+        )
+        got = self._lib.msgt_coord_take(self._handle(), int(rank), cbuf, n)
+        del cbuf  # release the buffer export
         if got < 0:
             raise TransportError(f"take({rank}) raced: nothing available")
         return Message(
             seq=int(hdr.seq), epoch=int(hdr.epoch), tag=int(hdr.tag),
-            kind=int(hdr.kind), payload=ctypes.string_at(buf, got),
+            kind=int(hdr.kind),
+            payload=buf if got == n else bytes(buf[:got]),
         )
 
     def waitany(
@@ -290,6 +442,48 @@ class Worker:
                 f"worker {rank} could not connect to {path} (refused, "
                 "or the coordinator rejected the auth token)"
             )
+        # shm broadcast regions, id -> mmap, insertion-ordered. Owned
+        # HERE (not in C++) so eviction can be REFUSED while numpy views
+        # of a region are still alive: mmap.close() raises BufferError
+        # when buffers are exported, which downgrades "use-after-unmap
+        # segfault" to "old region stays mapped a little longer".
+        self._shm_regions: dict[int, _mmap.mmap] = {}
+        self._shm_keep = 4
+
+    def _shm_view(self, sid: int, blen: int) -> "memoryview | None":
+        """Resolve a shm region id to a read-only view, adopting the fd
+        that rode in with the frame (SCM_RIGHTS) on first sight."""
+        region = self._shm_regions.get(sid)
+        if region is not None:
+            fd = self._lib.msgt_worker_take_fd(self._h)
+            if fd >= 0:
+                _os.close(fd)  # duplicate announce of a known region
+        else:
+            fd = self._lib.msgt_worker_take_fd(self._h)
+            if fd < 0:
+                return None
+            try:
+                region = _mmap.mmap(
+                    fd, blen, _mmap.MAP_SHARED, _mmap.PROT_READ
+                )
+            except (OSError, ValueError):
+                return None
+            finally:
+                _os.close(fd)  # mmap holds its own reference
+            self._shm_regions[sid] = region
+            # bounded: evict oldest fully-released regions. A region
+            # whose views are still referenced refuses to close and is
+            # retained — payload views can never dangle.
+            extra = len(self._shm_regions) - self._shm_keep
+            if extra > 0:
+                for old_sid in list(self._shm_regions)[:extra]:
+                    old = self._shm_regions[old_sid]
+                    try:
+                        old.close()
+                    except BufferError:
+                        continue  # views alive; keep the mapping
+                    del self._shm_regions[old_sid]
+        return memoryview(region)[:blen]
 
     def recv(self) -> Message | None:
         """Block for the next frame; None means the coordinator is gone."""
@@ -297,21 +491,53 @@ class Worker:
         if self._lib.msgt_worker_recv_hdr(self._h, ctypes.byref(hdr)) != 0:
             return None
         n = int(hdr.len)
-        buf = (ctypes.c_uint8 * max(n, 1))()
-        if n > 0 and self._lib.msgt_worker_recv_payload(self._h, buf, n) != 0:
-            return None
+        buf = bytearray(n)
+        if n > 0:
+            cbuf = (ctypes.c_uint8 * n).from_buffer(buf)
+            ok = self._lib.msgt_worker_recv_payload(self._h, cbuf, n)
+            del cbuf
+            if ok != 0:
+                return None
+        if int(hdr.kind) == KIND_SHM:
+            # wire payload = [shm_id, body_len, codec prefix...]; the
+            # body lives in a mapped region — zero bytes on the wire
+            sid, blen = _struct.unpack_from("<qq", buf, 0)
+            view = self._shm_view(sid, blen)
+            if view is None:
+                return None  # region lost; coordinator sees the death
+            return Message(
+                seq=int(hdr.seq), epoch=int(hdr.epoch),
+                tag=int(hdr.tag), kind=KIND_DATA,
+                payload=bytes(memoryview(buf)[16:]), body=view,
+            )
         return Message(
             seq=int(hdr.seq), epoch=int(hdr.epoch), tag=int(hdr.tag),
-            kind=int(hdr.kind), payload=ctypes.string_at(buf, n),
+            kind=int(hdr.kind), payload=buf,
         )
 
     def send(
         self, payload: bytes, *,
         seq: int = 0, epoch: int = 0, tag: int = 0, kind: int = KIND_DATA,
     ) -> bool:
+        if not isinstance(payload, bytes):
+            payload = bytes(payload)  # c_char_p wants immutable bytes
         rc = self._lib.msgt_worker_send(
             self._h, seq, epoch, tag, kind, payload, len(payload)
         )
+        return rc == 0
+
+    def send2(
+        self, prefix: bytes, body, *,
+        seq: int = 0, epoch: int = 0, tag: int = 0, kind: int = KIND_DATA,
+    ) -> bool:
+        """Two-buffer blocking send; ``body`` is written straight from
+        the caller's buffer (zero-copy in user space for ndarrays)."""
+        paddr, plen, pkeep = _addr_len(prefix)
+        baddr, blen, bkeep = _addr_len(body)
+        rc = self._lib.msgt_worker_send2(
+            self._h, seq, epoch, tag, kind, paddr, plen, baddr, blen
+        )
+        del pkeep, bkeep  # held until the blocking write finished
         return rc == 0
 
     def close(self) -> None:
@@ -322,5 +548,58 @@ class Worker:
     def __del__(self):  # pragma: no cover - GC ordering dependent
         try:
             self.close()
+        except Exception:
+            pass
+
+
+class ShmPayload:
+    """A broadcast payload in a memfd region: every worker maps the same
+    physical pages, so broadcasting n ways moves the bytes zero times
+    over the sockets. ``_h`` is None when memfd creation failed (caller
+    falls back to :class:`SharedPayload`)."""
+
+    __slots__ = ("_lib", "_h", "nbytes")
+
+    def __init__(self, lib, body):
+        addr, n, keep = _addr_len(body)
+        self._lib = lib
+        self.nbytes = n
+        self._h = lib.msgt_payload_create_shm(addr, n)
+        del keep  # create copies synchronously
+
+    def release(self) -> None:
+        if self._h is not None:
+            self._lib.msgt_payload_release_shm(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - GC ordering dependent
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
+class SharedPayload:
+    """A broadcast payload snapshotted once in native memory; frames
+    enqueue shared references instead of copies. Frames still in a send
+    queue keep the bytes alive after :meth:`release`."""
+
+    __slots__ = ("_lib", "_h", "nbytes")
+
+    def __init__(self, lib, body):
+        addr, n, keep = _addr_len(body)
+        self._lib = lib
+        self.nbytes = n
+        self._h = lib.msgt_payload_create(addr, n)
+        del keep  # create copies synchronously
+
+    def release(self) -> None:
+        if self._h is not None:
+            self._lib.msgt_payload_release(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - GC ordering dependent
+        try:
+            self.release()
         except Exception:
             pass

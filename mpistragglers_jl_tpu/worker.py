@@ -27,11 +27,11 @@ from __future__ import annotations
 import argparse
 import importlib
 import os
-import pickle
 import time
 import traceback
 
 from .backends.base import DelayFn
+from .native import codec
 from .native import transport as T
 
 __all__ = ["run_worker", "resolve_callable", "main"]
@@ -52,6 +52,12 @@ def run_worker(
     exceptions are captured and shipped back as failures, not lost the
     way reference worker assertions die inside mpiexec (SURVEY §4).
 
+    Array payloads arrive as **read-only zero-copy views** of transport
+    memory (socket frame or shared-memory region — native/codec.py);
+    copy before mutating in place. Views may be retained indefinitely:
+    a shared-memory region stays mapped for as long as any view of it
+    is alive (eviction is refused, never dangling).
+
     The connect retries with backoff until ``connect_timeout``: a worker
     that races the coordinator's bind, or whose hello lands while the
     coordinator is busy reaccepting a different rank, re-attempts
@@ -65,29 +71,31 @@ def run_worker(
             if msg is None or msg.kind == T.KIND_CONTROL:
                 break  # coordinator gone, or shutdown broadcast
             try:
-                # deserialization is inside the capture: an unpicklable
-                # payload (e.g. a class not importable on this host — the
-                # common multi-host failure) must ship back as an error,
-                # not kill the worker without a diagnostic
-                payload = pickle.loads(msg.payload)
+                # decoding is inside the capture: an undecodable payload
+                # (e.g. a class not importable on this host — the common
+                # multi-host failure) must ship back as an error, not
+                # kill the worker without a diagnostic. Raw ndarray
+                # payloads decode as zero-copy views (native/codec.py).
+                payload = codec.decode(msg.payload, msg.body)
                 if delay_fn is not None:
                     d = float(delay_fn(rank, msg.epoch))
                     if d > 0:
                         time.sleep(d)
-                out = pickle.dumps(
-                    work_fn(rank, payload, msg.epoch), protocol=5
+                prefix, body = codec.encode(
+                    work_fn(rank, payload, msg.epoch)
                 )
                 kind = T.KIND_DATA
             except BaseException as e:
-                out = pickle.dumps(
-                    (type(e).__name__, str(e), traceback.format_exc()),
-                    protocol=5,
+                prefix, body = codec.encode(
+                    (type(e).__name__, str(e), traceback.format_exc())
                 )
                 kind = T.KIND_ERROR
             # echo seq AND tag: the coordinator routes completions to the
-            # (rank, tag) channel the dispatch was posted on
-            if not w.send(
-                out, seq=msg.seq, epoch=msg.epoch, tag=msg.tag, kind=kind
+            # (rank, tag) channel the dispatch was posted on; the result
+            # body is written straight from its buffer (send2, zero-copy)
+            if not w.send2(
+                prefix, body, seq=msg.seq, epoch=msg.epoch, tag=msg.tag,
+                kind=kind,
             ):
                 break
     finally:

@@ -1,0 +1,82 @@
+"""Zero-copy payload codec (native/codec.py) — VERDICT round 1 item 7.
+
+Raw contiguous ndarrays travel as header-prefix + raw bytes (decode is a
+frombuffer VIEW, not a copy); everything else falls back to pickle.
+Transport-level shm broadcast is exercised through the backend suites;
+here the codec contract itself is pinned.
+"""
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu.native import codec
+
+
+def _roundtrip(obj):
+    prefix, body = codec.encode(obj)
+    # socket framing: prefix + body contiguous
+    if isinstance(body, np.ndarray):
+        wire = bytearray(prefix) + bytearray(body.reshape(-1).view(np.uint8))
+    else:
+        wire = bytearray(prefix) + bytearray(body)
+    return codec.decode(wire)
+
+
+def test_raw_arrays_bit_exact():
+    for arr in [
+        np.array([np.pi, -0.0, np.inf, np.nan]),
+        np.arange(24, dtype=np.int64).reshape(2, 3, 4),
+        np.array(7.5, dtype=np.float32),          # 0-d
+        np.zeros((0, 3), dtype=np.uint8),          # empty
+        np.array([2**62, -1], dtype=np.int64),
+    ]:
+        got = _roundtrip(arr)
+        assert got.dtype == arr.dtype and got.shape == arr.shape
+        assert got.tobytes() == arr.tobytes()
+
+
+def test_raw_decode_is_a_view_not_a_copy():
+    arr = np.arange(8, dtype=np.float64)
+    prefix, body = codec.encode(arr)
+    assert body is arr  # send side: the array itself, zero-copy
+    wire = bytearray(prefix) + bytearray(body.view(np.uint8))
+    got = codec.decode(wire)
+    assert got.base is not None  # view over the frame buffer
+    wire[len(prefix)] ^= 0xFF    # mutate the buffer through the view
+    assert got[0] != arr[0]
+
+
+def test_out_of_band_body():
+    arr = np.arange(6, dtype=np.int32).reshape(2, 3)
+    prefix, body = codec.encode(arr)
+    got = codec.decode(prefix, memoryview(body.reshape(-1).view(np.uint8)))
+    assert np.array_equal(got, arr)
+
+
+def test_noncontiguous_input_is_made_contiguous():
+    arr = np.arange(16, dtype=np.float32).reshape(4, 4)[:, ::2]
+    got = _roundtrip(arr)
+    assert np.array_equal(got, arr)
+
+
+def test_pickle_fallbacks():
+    rec = np.zeros(2, dtype=[("a", np.int32), ("b", "S3")])
+    rec["a"] = [1, 2]
+    rec["b"] = [b"xy", b"zzz"]
+    for obj in [rec, {"k": [1, 2.5]}, "text", 42, None,
+                np.array([{}, []], dtype=object)]:
+        prefix, body = codec.encode(obj)
+        assert prefix[0] == codec.MAGIC_PICKLE
+        got = _roundtrip(obj)
+        if isinstance(obj, np.ndarray):
+            assert got.dtype == obj.dtype
+            assert list(got) == list(obj)
+        else:
+            assert got == obj
+
+
+def test_unknown_magic_rejected():
+    with pytest.raises(ValueError, match="magic"):
+        codec.decode(b"\x7fgarbage")
+    with pytest.raises(ValueError, match="magic"):
+        codec.decode(b"")
