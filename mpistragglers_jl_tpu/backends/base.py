@@ -66,6 +66,28 @@ class Deadline:
 
 
 
+class DeadWorkerError(TimeoutError):
+    """Raised when workers fail to respond in time: by ``asyncmap``
+    (with ``timeout=``) and ``waitall`` at the pool layer, and by
+    ``on_dead="straggle"`` backends when an unbounded wait would
+    otherwise block forever on only-dead ranks.
+
+    The reference has no failure detection: a dead worker is
+    indistinguishable from an infinite straggler and ``waitall!`` hangs
+    on it (SURVEY §5). Defined here, beside the Backend contract, so
+    backends never import the orchestration layer above them.
+    """
+
+    def __init__(self, dead, timeout):
+        self.dead = [int(d) for d in dead]  # pool indices still active
+        self.timeout = timeout
+        tail = (
+            f"within {timeout} s" if timeout is not None
+            else "(unbounded wait, all awaited ranks dead)"
+        )
+        super().__init__(f"workers {self.dead} did not respond {tail}")
+
+
 class WorkerFailure(RuntimeError):
     """A worker raised during compute; re-raised coordinator-side at
     harvest (the reference loses worker errors entirely — assertions die

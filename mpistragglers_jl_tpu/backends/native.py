@@ -30,10 +30,25 @@ import numpy as np
 
 from ..native import codec
 from ..native import transport as T
-from .base import Backend, Deadline, DelayFn, WorkerError
+from .base import Backend, Deadline, DeadWorkerError, DelayFn, WorkerError
 from .process import RemoteWorkerError, WorkerProcessDied, WorkFn
 
 __all__ = ["NativeProcessBackend"]
+
+
+def _straggle_exhausted(ranks, deadline: Deadline, timeout):
+    """Every awaited rank is dead under ``on_dead="straggle"``: burn the
+    caller's remaining timeout and report a timeout (an early None would
+    be indistinguishable from one anyway), or — with no timeout — raise
+    instead of hanging forever the way the reference's Waitall! does."""
+    if timeout is None:
+        raise DeadWorkerError(sorted({int(r) for r in ranks}), None)
+    import time as _time
+
+    left = deadline.remaining()
+    if left:
+        _time.sleep(left)
+    return None
 
 
 def _native_worker_main(
@@ -76,6 +91,7 @@ class NativeProcessBackend(Backend):
         spawn: bool = True,
         accept: bool = True,
         auth: bytes | str | None = None,
+        on_dead: str = "error",
     ):
         """``address``: Unix-socket path (default: a fresh temp path) or
         ``tcp://host:port`` for multi-host (port 0 = ephemeral; the
@@ -98,6 +114,9 @@ class NativeProcessBackend(Backend):
         code execution); either pass an ``auth`` secret (give workers
         the same one via ``MSGT_AUTH`` / ``--auth-file``) or bind only
         on a trusted network."""
+        if on_dead not in ("error", "straggle"):
+            raise ValueError(f"on_dead must be 'error'|'straggle', got {on_dead!r}")
+        self.on_dead = on_dead
         self.n_workers = int(n_workers)
         self.work_fn = work_fn
         self.delay_fn = delay_fn
@@ -259,8 +278,15 @@ class NativeProcessBackend(Backend):
         self._cur[key] = self._seq_counter[i]
         self._epochs[key] = int(epoch)
         ok = self._send_payload(i, sendbuf, int(epoch), int(tag))
-        if not ok:  # rank already dead: fail the task, don't hang the pool
-            self._synthetic[key] = WorkerError(i, epoch, WorkerProcessDied(i))
+        if not ok:
+            # rank already dead. "error": fail the task at the next
+            # harvest instead of hanging the pool. "straggle": the task
+            # is silently lost — the rank is an infinite straggler and
+            # simply never freshens (reference SURVEY §5 semantics).
+            if self.on_dead == "error":
+                self._synthetic[key] = WorkerError(
+                    i, epoch, WorkerProcessDied(i)
+                )
 
     def _decode(self, i: int, msg: T.Message, tag: int):
         if msg.kind == T.KIND_DEATH:
@@ -327,6 +353,15 @@ class NativeProcessBackend(Backend):
                 msg = self._coord.poll(i)
                 if msg is None:
                     return None
+            if msg.kind == T.KIND_DEATH and self.on_dead == "straggle":
+                # infinite-straggler semantics: a dead rank never
+                # completes; it does not error either. (Real frames a
+                # worker delivered before dying were already drained —
+                # the native poll only synthesizes the death marker on
+                # an empty queue.)
+                if not block:
+                    return None
+                return _straggle_exhausted([i], deadline, timeout)
             msg = self._route(i, msg, key[1])
             if msg is not None:
                 return self._decode(i, msg, key[1])
@@ -362,12 +397,23 @@ class NativeProcessBackend(Backend):
             if stashed is not None:
                 return j, self._decode(j, stashed, t)
         deadline = Deadline(timeout)
+        live = list(idx)
         while True:
-            got = self._coord.waitany(idx, timeout=deadline.remaining())
+            got = self._coord.waitany(live, timeout=deadline.remaining())
             if got is None:
                 return None  # timed out
             j, msg = got
             if msg.kind == T.KIND_DEATH:
+                if self.on_dead == "straggle":
+                    # infinite-straggler semantics. The marker only
+                    # surfaces once the rank's real frames drained (the
+                    # native poll synthesizes it on an empty queue), so
+                    # dropping the rank HERE — not via an is_dead
+                    # pre-filter — never loses a delivered result.
+                    live = [r for r in live if r != j]
+                    if not live:
+                        return _straggle_exhausted(idx, deadline, timeout)
+                    continue  # keep waiting on the survivors
                 # rank-wide: surface on this rank's first awaited channel
                 # (the sticky native marker re-fires for the others)
                 return j, self._decode(j, msg, awaited[j][0])

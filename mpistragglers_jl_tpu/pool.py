@@ -53,7 +53,12 @@ from typing import TYPE_CHECKING, Callable, Sequence, Union
 
 import numpy as np
 
-from .backends.base import Backend, Deadline, WorkerError
+from .backends.base import (  # noqa: F401  (DeadWorkerError re-export)
+    Backend,
+    Deadline,
+    DeadWorkerError,
+    WorkerError,
+)
 
 if TYPE_CHECKING:  # runtime import would be circular (utils -> pool)
     from .utils.trace import EpochTracer
@@ -147,6 +152,17 @@ class AsyncPool:
             [r is not None for r in self.results], dtype=bool
         )
         return np.flatnonzero((self.repochs == epoch) & heard)
+
+    def reset_worker(self, i: int) -> None:
+        """Elastic-recovery hook: forget worker ``i``'s in-flight task.
+
+        Use after a dead rank rejoins (``backend.reaccept``/``respawn``
+        under ``on_dead="straggle"``): the old incarnation's dispatch
+        can never complete, so the worker must be marked idle to become
+        dispatchable next epoch. ``repochs`` keeps its last truthful
+        value — the rank is simply stale until it answers again.
+        """
+        self.active[int(i)] = False
 
     def __repr__(self) -> str:
         return (
@@ -440,19 +456,8 @@ def waitall(
     return pool.repochs
 
 
-class DeadWorkerError(TimeoutError):
-    """Raised by :func:`asyncmap` (with ``timeout=``) and
-    :func:`waitall` when workers fail to respond in time.
-
-    The reference has no failure detection: a dead worker is
-    indistinguishable from an infinite straggler and ``waitall!`` hangs
-    (SURVEY §5 'Failure detection'). ``dead`` lists the pool indices that
-    were still active at the deadline.
-    """
-
-    def __init__(self, dead: list[int], timeout: float | None):
-        self.dead = dead
-        self.timeout = timeout
-        super().__init__(
-            f"workers {dead} did not respond within {timeout} s"
-        )
+# DeadWorkerError lives beside the Backend contract (backends/base.py) —
+# straggle-mode backends raise it too, and backends must not import the
+# orchestration layer above them. Re-exported here (imported at the top)
+# because asyncmap/waitall are its primary raisers and callers import it
+# from the pool.

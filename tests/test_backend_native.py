@@ -901,3 +901,42 @@ def test_native_wait_any_duplicate_index_two_tags():
         assert sorted(got) == [10.0, 20.0]
     finally:
         backend.shutdown()
+
+
+def test_on_dead_straggle_spawned_workers():
+    """on_dead="straggle": a crashed spawned worker becomes an infinite
+    straggler — fastest-k epochs keep making progress with NO error
+    raised, and respawn + pool.reset_worker rejoins the rank."""
+    n = 3
+    backend = NativeProcessBackend(
+        _exit_on_negative, n, on_dead="straggle"
+    )
+    try:
+        pool = AsyncPool(n)
+        sendbuf = np.array([1.0])
+        asyncmap(pool, sendbuf, backend, nwait=n)
+        # worker 1 self-destructs on the negative payload
+        sendbuf[0] = -1.0
+        asyncmap(pool, sendbuf, backend, nwait=2, epoch=2)
+        assert sorted(pool.fresh_indices(2).tolist()) == [0, 2]
+        # subsequent epochs: no failures, survivors answer, rank 1 stays
+        # an in-flight straggler
+        sendbuf[0] = 3.0
+        for ep in (3, 4):
+            repochs = asyncmap(pool, sendbuf, backend, nwait=2, epoch=ep)
+            assert sorted(pool.fresh_indices(ep).tolist()) == [0, 2]
+            assert repochs[1] != ep
+        assert pool.active[1]
+        # a bounded waitall times out naming the dead rank, not hanging
+        from mpistragglers_jl_tpu.pool import DeadWorkerError
+
+        with pytest.raises(DeadWorkerError):
+            waitall(pool, backend, timeout=1.0)
+        # elastic recovery: respawn + reset, the rank rejoins fully
+        backend.respawn(1)
+        pool.reset_worker(1)  # the lost dispatch can never complete
+        asyncmap(pool, sendbuf, backend, nwait=n, epoch=5)
+        assert sorted(pool.fresh_indices(5).tolist()) == [0, 1, 2]
+        waitall(pool, backend, timeout=10.0)
+    finally:
+        backend.shutdown()

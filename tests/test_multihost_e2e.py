@@ -1,0 +1,138 @@
+"""Multi-host pool end-to-end (VERDICT round 1, item 5).
+
+The advertised flow, actually run: a coordinator with
+``NativeProcessBackend(spawn=False, address="tcp://...")``, worker
+processes joined via the CLI (``python -m mpistragglers_jl_tpu.worker``)
+— each running **jitted** jax compute — one worker SIGKILLed mid-run and
+re-adopted via ``reaccept``, training continuing through it. Loopback
+TCP stands in for the network; the command pair for two real hosts is in
+examples/multihost_jax_worker.py.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import AsyncPool, asyncmap, waitall
+from mpistragglers_jl_tpu.native import NativeBuildError
+
+try:
+    from mpistragglers_jl_tpu.backends.native import NativeProcessBackend
+    from mpistragglers_jl_tpu.native import transport as T
+
+    T.load_lib()
+    _SKIP = None
+except NativeBuildError as e:  # pragma: no cover - no compiler in env
+    _SKIP = str(e)
+
+pytestmark = pytest.mark.skipif(
+    _SKIP is not None, reason=f"native transport unavailable: {_SKIP}"
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SECRET = "e2e-test-secret"
+
+
+def _start_cli_worker(rank: int, address: str) -> subprocess.Popen:
+    """One CLI worker process, exactly as a remote host would run it."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MSGT_AUTH"] = SECRET
+    env["JAX_PLATFORMS"] = "cpu"  # workers own their device locally
+    env["JAX_ENABLE_X64"] = "1"   # exactness vs the float64 oracle
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "mpistragglers_jl_tpu.worker",
+            "--address", address, "--ranks", str(rank),
+            "--work", "examples.multihost_jax_worker:work",
+        ],
+        env=env,
+    )
+
+
+def test_tcp_cli_workers_jitted_sgd_with_kill_and_reaccept():
+    from examples.multihost_jax_worker import DIM, reference_grad
+
+    n = 3
+    backend = NativeProcessBackend(
+        None, n, spawn=False, address="tcp://127.0.0.1:0",
+        auth=SECRET, accept=False, connect_timeout=120.0,
+        on_dead="straggle",  # elastic mode: dead ranks just never answer
+    )
+    procs: dict[int, subprocess.Popen] = {}
+    try:
+        for r in range(n):
+            procs[r] = _start_cli_worker(r, backend.address)
+        backend.accept(timeout=120.0)
+
+        pool = AsyncPool(n)
+        # non-degenerate start: at w=0 every logit is exactly 0 and the
+        # stable-BCE max/abs kinks make the subgradient
+        # implementation-defined — any nonzero w is off the kink
+        w0 = np.random.default_rng(7).standard_normal(DIM) * 0.1
+        w = w0.copy()
+        lr = 0.5
+
+        def epoch(ep, nwait):
+            nonlocal w
+            asyncmap(pool, w, backend, nwait=nwait, epoch=ep)
+            fresh = pool.fresh_indices(ep)
+            g = np.mean(
+                [np.asarray(pool.results[i]) for i in fresh], axis=0
+            )
+            w = w - lr * g
+            return fresh
+
+        # --- phase 1: all ranks healthy; jitted grads must be EXACT ---
+        epoch(1, nwait=n)
+        want = reference_grad(w0, range(n))
+        got = np.mean(
+            [np.asarray(pool.results[i]) for i in range(n)], axis=0
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12)
+        for ep in range(2, 6):
+            epoch(ep, nwait=n)
+
+        # --- phase 2: SIGKILL rank 1 mid-run; pool keeps going -------
+        # straggle mode: the dead rank is an infinite straggler
+        # (reference SURVEY §5 semantics); fastest-2 epochs continue
+        # over the survivors with no errors raised at all
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait(timeout=30)
+        ep = 6
+        for _ in range(4):
+            fresh = epoch(ep, nwait=2)
+            assert sorted(int(i) for i in fresh) == [0, 2]
+            ep += 1
+        assert backend._coord.is_dead(1)
+        assert pool.active[1]  # in-flight forever, like the reference
+
+        # --- phase 3: restart the CLI process; reaccept re-adopts it --
+        procs[1] = _start_cli_worker(1, backend.address)
+        backend.reaccept(1, timeout=120.0)
+        pool.reset_worker(1)  # the lost dispatch can never complete
+        fresh = epoch(ep, nwait=n)
+        assert sorted(int(i) for i in fresh) == [0, 1, 2]
+        ep += 1
+
+        # --- training converged through all of it ---------------------
+        final_grad = reference_grad(w, range(n))
+        first_grad = reference_grad(w0, range(n))
+        assert np.linalg.norm(final_grad) < 0.5 * np.linalg.norm(
+            first_grad
+        ), (np.linalg.norm(final_grad), np.linalg.norm(first_grad))
+        waitall(pool, backend, timeout=30.0)
+        assert not pool.active.any()
+    finally:
+        backend.shutdown()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    p.kill()
