@@ -1,0 +1,2 @@
+# Namespace package marker so bench.py (the driver's one-line contract)
+# can reuse the shared harnesses here instead of duplicating them.
