@@ -95,5 +95,68 @@ def main():
     }))
 
 
+def main_rateless():
+    """Incremental redundancy under a PERMANENT straggler: the static
+    window cannot decode (its shard never arrives), the rateless stream
+    draws generation-1 shards from the live workers and decodes anyway.
+    Reports the shards-consumed-vs-k overhead — the price of
+    ratelessness (VERDICT round 1 item 2's measured contract)."""
+    import numpy as np
+
+    from mpistragglers_jl_tpu.ops.rateless import RatelessLTGemm
+
+    m = kdim = ncols = 8192
+    n, k = 12, 8
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((m, kdim)).astype(np.float32)
+    B = rng.standard_normal((kdim, ncols)).astype(np.float32)
+    dead = 0  # permanent straggler: never returns within any round
+
+    # seed 16: worker 0's shard is load-bearing — the static window
+    # minus it does NOT peel, so decode REQUIRES generation-1 draws
+    rg = RatelessLTGemm(
+        A, n, k, seed=16,
+        delay_fn=lambda i, e: 3600.0 if i == dead else 0.0,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    try:
+        pool = AsyncPool(n)
+        # warmup: compile the worker matmul once, untimed, reusing the
+        # full B so the timed shapes match. Fresh-generation draws may
+        # still compile the (tiny) device encode once per new support
+        # degree inside the timed run — noted in the output.
+        import jax.numpy as jnp_
+
+        from mpistragglers_jl_tpu.backends.base import WorkerError
+
+        rg.backend.dispatch(1, jnp_.asarray(B), 0)
+        warm = rg.backend.wait(1, timeout=600)
+        if warm is None or isinstance(warm, WorkerError):
+            raise RuntimeError(f"warmup failed: {warm!r}")
+        t0 = time.perf_counter()
+        C = rg.multiply(B, pool, round_timeout=15.0, max_rounds=4)
+        wall = time.perf_counter() - t0
+        err = float(np.max(np.abs(C - A @ B))) / float(np.max(np.abs(C)))
+        print(json.dumps({
+            "metric": "lt-rateless-gemm-8192-permanent-straggler",
+            "value": round(wall, 4),
+            "unit": "s",
+            "decode_success": bool(err < 1e-3),
+            "decode_rel_err": err,
+            "shards_used": rg.stats["shards_used"],
+            "k": rg.stats["k"],
+            "rateless_overhead": round(rg.stats["overhead"], 3),
+            "max_generation": rg.stats["max_generation"],
+            "note": "worker 0's shard is load-bearing and never "
+            "arrives; decode required fresh-generation draws. Wall "
+            "includes one 15 s round_timeout wait per extra round, the "
+            "host-peel D2H of all collected shards, and a one-time "
+            "device-encode compile per new support degree",
+        }))
+    finally:
+        rg.backend.shutdown()
+
+
 if __name__ == "__main__":
     main()
+    main_rateless()

@@ -49,6 +49,16 @@ from ..pool import AsyncPool, DeadWorkerError, asyncmap
 from .gemm import _block_matmul
 from .lt import LTCode
 
+
+@jax.jit
+def _encode_block(src, sup):
+    """Ã_s = Σ source blocks in the shard's support — computed ON the
+    worker's device from device-resident source blocks (one compile per
+    support degree; degrees are <= k, so a handful of programs). The
+    alternative — host-encoding then shipping the coded block — puts a
+    block-sized H2D transfer on every fresh-shard draw."""
+    return src[sup].sum(axis=0)
+
 __all__ = ["RatelessLTGemm"]
 
 
@@ -97,10 +107,12 @@ class RatelessLTGemm:
         self.devices = list(devices)
         self.block_rows = m // k
         self.precision = precision
-        # source blocks stay host-side: generation-0 coded blocks live on
-        # device (the fast path), later generations are encoded lazily on
-        # demand — a straggler-free epoch pays zero extra HBM
+        # generation 0 is host-encoded at setup (below); the device
+        # copy of the source blocks is uploaded LAZILY on the first
+        # fresh-generation draw, so a straggler-free run pays zero
+        # extra HBM and fresh shards thereafter encode device-side
         self._src = np.ascontiguousarray(A.reshape(k, m // k, *A.shape[1:]))
+        self._src_dev: dict = {}
         self._block_cache: dict[int, jax.Array] = {}
         self._block_cache_size = int(block_cache_size)
         self._gen: dict[tuple[int, int], int] = {}  # (epoch, worker) -> gen
@@ -125,29 +137,44 @@ class RatelessLTGemm:
 
     def _coded_block(self, worker: int, sid: int) -> jax.Array:
         """The device-resident coded block Ã_sid = Σ (support blocks),
-        encoded lazily and cached (bounded). Serialized under the lock:
-        worker threads race here only on the rare fresh-shard path, and
-        the encode is a few block adds, dwarfed by the matmul."""
+        encoded lazily and cached (bounded).
+
+        Generation 0 (``sid < n``, the setup window) encodes host-side
+        and uploads once — no device source copy for straggler-free
+        runs. Fresh generations encode ON the worker's device from the
+        lazily-uploaded source blocks (one H2D per device, ever; the
+        numpy array goes to ``dev`` directly, no default-device bounce).
+        The encode runs OUTSIDE the lock — an XLA compile for a new
+        support degree must not stall every worker completion and the
+        decodability predicate; a racing duplicate encode is benign.
+        """
         with self._lock:
             blk = self._block_cache.get(sid)
             if blk is not None:
                 return blk
-            sup = self.code.shard_indices(sid)
+        dev = self.devices[worker % len(self.devices)]
+        sup = self.code.shard_indices(sid)
+        if sid < self.n:
             enc = self._src[sup[0]].copy()
             for j in sup[1:]:
                 enc += self._src[j]
+            blk = jax.device_put(enc, dev)
+        else:
+            with self._lock:
+                src = self._src_dev.get(dev)
+            if src is None:
+                src = jax.device_put(self._src, dev)
+                with self._lock:
+                    src = self._src_dev.setdefault(dev, src)
+            blk = _encode_block(src, jnp.asarray(sup))
+        with self._lock:
             if len(self._block_cache) >= self._block_cache_size:
                 # keep generation 0 (the steady-state window) resident
                 for key in [
                     s for s in self._block_cache if s >= self.n
                 ]:
                     del self._block_cache[key]
-            blk = jax.device_put(
-                jnp.asarray(enc),
-                self.devices[worker % len(self.devices)],
-            )
-            self._block_cache[sid] = blk
-            return blk
+            return self._block_cache.setdefault(sid, blk)
 
     def _work(self, i: int, payload: jax.Array, epoch: int):
         """Worker compute: advance this worker's generation, encode the
