@@ -11,9 +11,11 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_example(name, *args, timeout=240):
+def _run_example(name, *args, timeout=240, env_extra=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join([_REPO, env.get("PYTHONPATH", "")])
+    if env_extra:
+        env.update(env_extra)
     return subprocess.run(
         [sys.executable, os.path.join(_REPO, "examples", name), *args],
         capture_output=True, text=True, timeout=timeout, env=env,
@@ -34,3 +36,22 @@ def test_straggler_aware_training_converges(tmp_path):
     assert "respawned" in out.stdout  # the injected crash was recovered
     assert "adaptive nwait settled at" in out.stdout
     assert (tmp_path / "training_trace.json").exists()  # Perfetto artifact
+
+
+def test_rateless_gemm_example():
+    out = _run_example(
+        "rateless_gemm.py", env_extra={"JAX_PLATFORMS": "cpu"}
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "fixed window: epoch never becomes decodable" in out.stdout
+    assert "re-tasks contributed fresh information" in out.stdout
+
+
+def test_pipeline_training_example():
+    out = _run_example(
+        "pipeline_training.py", timeout=420,
+        env_extra={"JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "loss decreased" in out.stdout
+    assert "1F1B bubble" in out.stdout
