@@ -1,0 +1,76 @@
+"""Shared coalesced-dispatch machinery for pool GEMM workloads.
+
+One device = one fused stacked-matmul program per epoch
+(`XLADeviceBackend(batch_fn=...)`): the helpers here build the
+per-device stacks and dispatch against them, shared by
+:class:`~.gemm.DistributedGemm` and :class:`~.coded_gemm.CodedGemm`
+so the group-building and re-task-subset logic exist exactly once.
+
+In batch mode the per-worker blocks stay HOST-resident (the fused
+stacks are the only device copy — the per-worker dispatch path never
+runs, so device-resident individual blocks would be dead HBM).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def _stacked_matmul(blocks, payload, precision):
+    # (w, r, c) x (c, d) -> (w, r, d) as ONE large 2-D matmul: a batched
+    # einsum leaves the MXU tiling a small per-batch M (r rows); folding
+    # the worker axis into M runs at plain-matmul rate
+    w, r, c = blocks.shape
+    flat = jnp.matmul(
+        blocks.reshape(w * r, c), payload, precision=precision
+    )
+    return flat.reshape(w, r, payload.shape[1])
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def _stacked_matmul_gather(blocks_all, sel, payload, precision):
+    # re-task subsets: gather the members' blocks, then the fused matmul
+    blocks = blocks_all[sel]
+    w, r, c = blocks.shape
+    flat = jnp.matmul(
+        blocks.reshape(w * r, c), payload, precision=precision
+    )
+    return flat.reshape(w, r, payload.shape[1])
+
+
+def build_device_groups(host_blocks, n: int, devices) -> dict:
+    """Group worker ids by their (round-robin) device and place ONE
+    stacked array of each group's blocks on it.
+
+    Returns ``{worker: (ids_tuple, stacked, {worker: position})}`` —
+    every member maps to its group entry. Blocks must be equal-shaped
+    within a group (callers enforce their own split constraints).
+    """
+    by_dev: dict = {}
+    for i in range(n):
+        by_dev.setdefault(i % len(devices), []).append(i)
+    group_of: dict = {}
+    for d, ids in by_dev.items():
+        stacked = jax.device_put(
+            np.stack([np.asarray(host_blocks[i]) for i in ids]),
+            devices[d % len(devices)],
+        )
+        entry = (tuple(ids), stacked, {w: p for p, w in enumerate(ids)})
+        for i in ids:
+            group_of[i] = entry
+    return group_of
+
+
+def batch_dispatch(group_of: dict, ids, payload, precision):
+    """The shared ``batch_fn`` body: whole-group broadcasts use the
+    stack as-is; re-task subsets gather their members' positions."""
+    group_ids, stacked, pos = group_of[int(ids[0])]
+    if tuple(ids) == group_ids:
+        return _stacked_matmul(stacked, payload, precision)
+    sel = jnp.asarray([pos[int(i)] for i in ids])
+    return _stacked_matmul_gather(stacked, sel, payload, precision)

@@ -33,6 +33,7 @@ from ..pool import AsyncPool
 from .coding import MDSCode, nwait_decodable
 from functools import partial
 
+from ._batch import batch_dispatch, build_device_groups
 from .gemm import _block_matmul
 
 
@@ -48,29 +49,6 @@ def _decode_from_stack(stacked, rows, G_S, precision):
     shards = stacked[rows]
     blocks = _decode(G_S, shards, precision)
     return blocks.reshape(-1, *blocks.shape[2:])
-
-
-@partial(jax.jit, static_argnames=("precision",))
-def _stacked_matmul_gather(blocks_all, sel, payload, precision):
-    # re-task subsets: gather the members' blocks, then the fused matmul
-    blocks = blocks_all[sel]
-    w, r, c = blocks.shape
-    flat = jnp.matmul(
-        blocks.reshape(w * r, c), payload, precision=precision
-    )
-    return flat.reshape(w, r, payload.shape[1])
-
-
-@partial(jax.jit, static_argnames=("precision",))
-def _stacked_matmul(blocks, payload, precision):
-    # (w, r, c) x (c, d) -> (w, r, d) as ONE large 2-D matmul: a batched
-    # einsum leaves the MXU tiling a small per-batch M (r rows); folding
-    # the worker axis into M runs at plain-matmul rate (~4x faster here)
-    w, r, c = blocks.shape
-    flat = jnp.matmul(
-        blocks.reshape(w * r, c), payload, precision=precision
-    )
-    return flat.reshape(w, r, payload.shape[1])
 from .lt import LTCode, nwait_lt_decodable
 
 
@@ -121,28 +99,23 @@ class CodedGemm:
         # encode once (on the default device), then distribute coded
         # blocks to their workers' devices
         coded = self.code.encode_array(A)
-        self.blocks = [
-            jax.device_put(coded[i], devices[i % len(devices)])
-            for i in range(n)
-        ]
-        # batch mode: ONE device-resident stack per device group of its
-        # workers' coded blocks, built at setup; fused dispatch gathers
-        # id subsets from it dynamically (no per-subset duplicates — a
-        # re-task pattern must not grow HBM). Workers round-robin over
-        # devices, so each group's blocks are co-located.
+        # batch mode: the fused per-device stacks are the ONLY device
+        # copy (ops/_batch.py — the per-worker dispatch path never runs
+        # there, so device-resident individual blocks would be dead
+        # HBM); per-worker blocks stay host-side numpy views. Non-batch
+        # mode places each block on its worker's device as before.
         self._group_of: dict[int, tuple] = {}
         if batch:
-            by_dev: dict = {}
-            for i in range(n):
-                by_dev.setdefault(i % len(self.devices), []).append(i)
-            for ids in by_dev.values():
-                stacked = jnp.stack(
-                    [jnp.asarray(self.blocks[i]) for i in ids]
-                )
-                entry = (tuple(ids), stacked,
-                         {w: p for p, w in enumerate(ids)})
-                for i in ids:
-                    self._group_of[i] = entry
+            coded_host = np.asarray(coded)
+            self.blocks = [coded_host[i] for i in range(n)]
+            self._group_of = build_device_groups(
+                self.blocks, n, self.devices
+            )
+        else:
+            self.blocks = [
+                jax.device_put(coded[i], devices[i % len(devices)])
+                for i in range(n)
+            ]
         self.backend = XLADeviceBackend(
             self._work, n, devices=devices, delay_fn=delay_fn,
             batch_fn=self._batch_work if batch else None,
@@ -156,13 +129,7 @@ class CodedGemm:
         """Fused dispatch: the shards of every worker in ``ids`` in one
         stacked matmul (one MXU program, one dispatch round-trip). All
         ``ids`` share a device (the backend groups by device)."""
-        group_ids, stacked, pos = self._group_of[int(ids[0])]
-        if tuple(ids) == group_ids:  # the epoch broadcast: whole stack
-            return _stacked_matmul(stacked, payload, self.precision)
-        sel = jnp.asarray([pos[int(i)] for i in ids])
-        return _stacked_matmul_gather(
-            stacked, sel, payload, self.precision
-        )
+        return batch_dispatch(self._group_of, ids, payload, self.precision)
 
     @property
     def nwait(self):

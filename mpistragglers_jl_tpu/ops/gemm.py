@@ -101,11 +101,17 @@ class DistributedGemm:
         delay_fn: DelayFn | None = None,
         dtype=None,
         precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
+        batch: bool = False,
+        batch_arrival: str = "ready",
     ):
         # HIGHEST by default: the TPU MXU's native matmul accumulates in
         # bf16-ish precision (observed max err ~0.25 on a 512-deep f32
         # contraction vs 5e-5 at HIGHEST); coded decode paths need the
         # accuracy. Benchmarks may pass precision=None for peak MXU rate.
+        #
+        # ``batch=True``: coalesced dispatch — each device's workers run
+        # as ONE fused stacked matmul per epoch (see CodedGemm/PERF.md);
+        # requires homogeneous row_splits, incompatible with delay_fn.
         self.precision = precision
         m = A.shape[0]
         if row_splits is None:
@@ -134,17 +140,45 @@ class DistributedGemm:
         self.n_workers = n_workers
         self.row_splits = row_splits
         offsets = np.concatenate([[0], np.cumsum(row_splits)])
-        # place each row block on its worker's device once, up front
-        self.blocks = [
-            jax.device_put(
-                A[offsets[i] : offsets[i + 1]],
-                devices[i % len(devices)],
+        self._group_of: dict[int, tuple] = {}
+        if batch:
+            if len(set(row_splits)) != 1:
+                raise ValueError(
+                    "batch=True needs homogeneous row_splits (the fused "
+                    "program stacks equal-shaped blocks)"
+                )
+            from ._batch import build_device_groups
+
+            # fused per-device stacks are the only device copy; the
+            # per-worker blocks stay host-side views (ops/_batch.py)
+            self.blocks = [
+                A[offsets[i] : offsets[i + 1]]
+                for i in range(n_workers)
+            ]
+            self._group_of = build_device_groups(
+                self.blocks, n_workers, devices
             )
-            for i in range(n_workers)
-        ]
+        else:
+            # place each row block on its worker's device once, up front
+            self.blocks = [
+                jax.device_put(
+                    A[offsets[i] : offsets[i + 1]],
+                    devices[i % len(devices)],
+                )
+                for i in range(n_workers)
+            ]
         self.backend = XLADeviceBackend(
-            self._work, n_workers, devices=devices, delay_fn=delay_fn
+            self._work, n_workers, devices=devices, delay_fn=delay_fn,
+            batch_fn=self._batch_work if batch else None,
+            batch_arrival=batch_arrival,
         )
+
+    def _batch_work(self, ids, payload: jax.Array, epoch: int) -> jax.Array:
+        """Fused dispatch: every worker's row-block matmul in one MXU
+        program (shared machinery, ops/_batch.py)."""
+        from ._batch import batch_dispatch
+
+        return batch_dispatch(self._group_of, ids, payload, self.precision)
 
     @classmethod
     def load_balanced(

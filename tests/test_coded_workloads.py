@@ -255,3 +255,43 @@ def test_batch_mode_retask_after_stale_epoch():
         waitall(pool, cg.backend)
     finally:
         cg.backend.shutdown()
+
+
+@pytest.mark.parametrize("arrival", ["ready", "enqueue"])
+def test_distributed_gemm_batch_mode_exact(arrival):
+    """Uncoded GEMM through the coalesced-dispatch path stays exact."""
+    import jax
+
+    from mpistragglers_jl_tpu.ops import DistributedGemm
+    from mpistragglers_jl_tpu.ops.gemm import gather_rows
+
+    rng = np.random.default_rng(5)
+    A = rng.standard_normal((12, 6)).astype(np.float32)
+    B = rng.standard_normal((6, 4)).astype(np.float32)
+    g = DistributedGemm(
+        A, 4, precision=jax.lax.Precision.HIGHEST,
+        batch=True, batch_arrival=arrival,
+    )
+    try:
+        pool = AsyncPool(4)
+        asyncmap(pool, B, g.backend, nwait=4)
+        np.testing.assert_allclose(
+            gather_rows(pool), A @ B, rtol=1e-5
+        )
+        waitall(pool, g.backend)
+    finally:
+        g.backend.shutdown()
+
+
+def test_distributed_gemm_batch_rejects_heterogeneous_splits():
+    import jax
+
+    from mpistragglers_jl_tpu.ops import DistributedGemm
+
+    rng = np.random.default_rng(5)
+    A = rng.standard_normal((12, 6)).astype(np.float32)
+    with pytest.raises(ValueError, match="homogeneous"):
+        DistributedGemm(
+            A, 3, row_splits=[6, 3, 3], batch=True,
+            precision=jax.lax.Precision.HIGHEST,
+        )
