@@ -140,3 +140,46 @@ def test_fuzz_with_retask_pressure():
         assert not pool.active.any()
     finally:
         backend.shutdown()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_two_pools_tag_channels(seed):
+    """Two pools multiplex one backend on distinct tags under random
+    interleavings: channel isolation must hold at every step — each
+    pool's invariants, recvbuf provenance, and epoch bookkeeping are
+    unaffected by the other pool's traffic."""
+    rng = np.random.default_rng(1000 + seed)
+    n = int(rng.integers(2, 6))
+    backend = LocalBackend(echo, n, delay_fn=SeededDelays(seed))
+    try:
+        pools = {1: AsyncPool(n), 2: AsyncPool(n)}
+        payload = np.zeros(1)
+        for step in range(20):
+            tag = int(rng.integers(1, 3))
+            pool = pools[tag]
+            if rng.random() < 0.75:
+                # encode (tag, step) in the payload so cross-channel
+                # leakage is detectable in the echo
+                payload[0] = float(tag * 1000 + step)
+                nwait = int(rng.integers(0, n + 1))
+                recvbuf = np.zeros(3 * n) if rng.random() < 0.5 else None
+                asyncmap(
+                    pool, payload, backend, recvbuf, nwait=nwait, tag=tag
+                )
+                assert np.all(pool.stags[pool.active] == tag)
+                if recvbuf is not None:
+                    chunks = recvbuf.reshape(n, 3)
+                    for i in pool.fresh_indices():
+                        # provenance: this channel's payload, not the
+                        # other pool's
+                        assert chunks[i][1] == payload[0]
+            else:
+                waitall(pool, backend, timeout=10.0)
+                assert not pool.active.any()
+            for p in pools.values():
+                check_invariants(p, 0)
+        for p in pools.values():
+            waitall(p, backend, timeout=10.0)
+            assert not p.active.any()
+    finally:
+        backend.shutdown()
