@@ -26,8 +26,6 @@ import uuid
 from pathlib import Path
 from typing import Sequence
 
-import numpy as np
-
 from ..native import codec
 from ..native import transport as T
 from .base import Backend, Deadline, DeadWorkerError, DelayFn, WorkerError

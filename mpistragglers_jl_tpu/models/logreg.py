@@ -17,7 +17,6 @@ combination of its chunk gradients.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Sequence
 
 import jax

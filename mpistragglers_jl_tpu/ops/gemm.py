@@ -30,7 +30,7 @@ import numpy as np
 
 from ..backends.base import DelayFn
 from ..backends.xla import XLADeviceBackend
-from ..pool import AsyncPool, asyncmap
+from ..pool import AsyncPool
 
 
 from functools import partial

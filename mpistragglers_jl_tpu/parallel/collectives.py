@@ -25,12 +25,11 @@ collective, no gather-to-host, traffic n·blocksize per device.
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = [
     "masked_psum_scatter_combine",

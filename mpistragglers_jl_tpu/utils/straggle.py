@@ -28,7 +28,7 @@ no backend cooperation needed, deterministic given a seeded generator.
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Callable
 
 import numpy as np
 
