@@ -222,3 +222,43 @@ def test_gemm_load_balanced_from_latency_model():
     asyncmap(pool, B, g.backend, nwait=4)
     assert np.allclose(g.result(pool), A @ B, atol=1e-4)
     g.backend.shutdown()
+
+
+def test_batch_flush_failure_fails_members_not_strands_them():
+    """A batch_fn that raises during flush must fail its group's tasks
+    (WorkerFailure at harvest) instead of stranding their slots — a
+    stranded slot would hang every later waitall forever."""
+    calls = {"n": 0}
+
+    def batch_fn(ids, payload, epoch):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom in fused submit")
+        return jnp.stack([payload + i for i in ids])
+
+    # both workers on ONE device -> one flush group, so the failing
+    # submit fails both members (separate devices would be separate
+    # groups and only one would fail)
+    backend = XLADeviceBackend(
+        lambda i, p, e: p, 2, batch_fn=batch_fn,
+        devices=[jax.devices()[0]],
+    )
+    try:
+        pool = AsyncPool(2)
+        # timeout: if a regressed flush swallowed the error WITHOUT
+        # completing the members, this must fail loudly, not hang
+        with pytest.raises(WorkerFailure, match="boom"):
+            asyncmap(pool, jnp.zeros(3), backend, nwait=2, timeout=5.0)
+        # exactly one worker's error was consumed by the raise; the
+        # other's is still queued — pin the state, then drain it
+        assert int(pool.active.sum()) == 1
+        with pytest.raises(WorkerFailure, match="boom"):
+            waitall(pool, backend, timeout=5.0)
+        assert not pool.active.any()
+        # the pool stays usable: the next epoch goes through the (now
+        # working) batch path
+        asyncmap(pool, jnp.zeros(3), backend, nwait=2, epoch=5)
+        assert sorted(pool.fresh_indices(5).tolist()) == [0, 1]
+        waitall(pool, backend, timeout=5.0)
+    finally:
+        backend.shutdown()
