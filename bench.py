@@ -24,8 +24,10 @@ import numpy as np
 
 
 def bench_coded_gemm(m=8192, kdim=8192, ncols=8192, n=8, k=6, epochs=7):
-    # epochs=7/min: the tunneled chip's RPC latency is noisy run-to-run
-    # (~1.5x spread observed); min-of-7 isolates the framework's cost
+    # each measurement is the MEAN over `epochs` pipelined epochs (one
+    # fence per chain), and the reported value is the MIN over 3 such
+    # chains — the tunneled chip's RPC latency is ~1.5x noisy run-to-run
+    # and the best chain isolates the framework's cost
     """(n=8, k=6) MDS-coded GEMM, BASELINE config 3.
 
     8192 rows do not divide by k=6, so A is zero-padded to the next
@@ -89,13 +91,20 @@ def bench_coded_gemm(m=8192, kdim=8192, ncols=8192, n=8, k=6, epochs=7):
         asyncmap(pool, B_dev, cg.backend, nwait=k)
         float(fence(cg.result_device(pool)[:m]))
         waitall(pool, cg.backend)
-        t0 = time.perf_counter()
-        for _ in range(pipeline_epochs):
-            repochs = asyncmap(pool, B_dev, cg.backend, nwait=k)
-            C = cg.result_device(pool)[:m]
-            waitall(pool, cg.backend)
-        float(fence(C))  # one fence: every chained epoch materialized
-        per_epoch = (time.perf_counter() - t0) / pipeline_epochs
+        # min over 3 chains: tunnel RPC latency is ~1.5x noisy run to
+        # run (docs/PERF.md); the best chain isolates the framework
+        chain_s = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(pipeline_epochs):
+                repochs = asyncmap(pool, B_dev, cg.backend, nwait=k)
+                C = cg.result_device(pool)[:m]
+                waitall(pool, cg.backend)
+            float(fence(C))  # one fence: every chained epoch materialized
+            chain_s.append(
+                (time.perf_counter() - t0) / pipeline_epochs
+            )
+        per_epoch = min(chain_s)
         del repochs  # enqueue-arrival mode: submitted == arrived, so a
         # freshness count would be trivially n, not a straggler statistic
         # exactness vs an on-device f32 reference product
@@ -112,6 +121,10 @@ def bench_coded_gemm(m=8192, kdim=8192, ncols=8192, n=8, k=6, epochs=7):
     # measured chip ceiling for the MFU denominator: one raw dense
     # matmul of the same shape at the same precision, fence amortized
     def raw_rate(precision, reps=5):
+        """Measured chip ceiling, same noise treatment as the epochs:
+        min over 3 fenced chains of `reps` matmuls — an asymmetric
+        (mean ceiling vs min epochs) ratio would let tunnel noise push
+        the reported MFU above the truth."""
         a = jax.device_put(
             rng.standard_normal((m, kdim)).astype(np.float32),
             jax.devices()[0],
@@ -122,11 +135,15 @@ def bench_coded_gemm(m=8192, kdim=8192, ncols=8192, n=8, k=6, epochs=7):
         c.block_until_ready()
         fence = jax.jit(jnp.sum)
         float(fence(c))
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            c = mm(a, b)
-        float(fence(c))
-        return flops / ((time.perf_counter() - t0) / reps)
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                c = mm(a, b)
+            float(fence(c))
+            dt = (time.perf_counter() - t0) / reps
+            best = dt if best is None else min(best, dt)
+        return flops / best
 
     tpu_s, err = run_config(jax.lax.Precision.HIGHEST, epochs)
     peak = raw_rate(jax.lax.Precision.HIGHEST)
@@ -149,6 +166,7 @@ def bench_coded_gemm(m=8192, kdim=8192, ncols=8192, n=8, k=6, epochs=7):
         # == arrived on one time-sliced chip (see docs/PERF.md)
         "decode_rel_err": err,
         "epochs_pipelined": epochs,
+        "chains_min_of": 3,
         "adaptive_nwait": bench_adaptive_nwait(),
         "bf16_rung": {
             "value": round(bf16_s, 4),
@@ -246,12 +264,15 @@ def bench_uncoded_gemm(m=4096, k=4096, n=4096, n_workers=4, epochs=7):
     asyncmap(pool, B_dev, g.backend, nwait=n_workers)  # warmup
     fence_all()
     waitall(pool, g.backend)
-    t0 = time.perf_counter()
-    for _ in range(epochs):
-        asyncmap(pool, B_dev, g.backend, nwait=n_workers)
-        waitall(pool, g.backend)
-    fence_all()  # the final epoch's chains cover all prior epochs
-    tpu_s = (time.perf_counter() - t0) / epochs
+    chain_s = []
+    for _ in range(3):  # min-of-3 chains, same treatment as config 3
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            asyncmap(pool, B_dev, g.backend, nwait=n_workers)
+            waitall(pool, g.backend)
+        fence_all()  # the final epoch's chains cover all prior epochs
+        chain_s.append((time.perf_counter() - t0) / epochs)
+    tpu_s = min(chain_s)
     g.backend.shutdown()
 
     flops = 2.0 * m * k * n
@@ -263,6 +284,7 @@ def bench_uncoded_gemm(m=4096, k=4096, n=4096, n_workers=4, epochs=7):
         "gflops_per_chip": round(flops / tpu_s / 1e9, 1),
         "cpu_baseline_s": round(cpu_s, 3),
         "epochs_pipelined": epochs,
+        "chains_min_of": 3,
         "arrival_mode": "enqueue",
     }
 
