@@ -1,0 +1,219 @@
+// ThreadSanitizer harness for the native transport (transport.cpp).
+//
+// The transport's concurrency surface — epoll progress thread vs caller
+// threads (isend/poll/waitany/reaccept), worker threads doing blocking
+// frame I/O, death marking under the mutex — is exactly the kind of
+// code where a "benign" unlocked read becomes real UB (ADVICE round 1
+// flagged one such race, fixed since). This harness compiles the whole
+// transport with -fsanitize=thread and drives the hot paths end to end:
+//
+//   1. coordinator + 4 worker threads over a Unix socket, HMAC auth on;
+//   2. 200 epochs of broadcast -> compute-echo -> waitany harvest, with
+//      concurrent poll() probes from a second coordinator-side thread
+//      (the pool's phase-1 drain running against the progress engine);
+//   3. one worker killed mid-run (socket closed), death observed via the
+//      sticky marker, then re-admitted through reaccept while traffic
+//      continues on the survivors;
+//   4. shared + shm broadcast payload paths (payload handles are
+//      created/released by the caller thread while the progress thread
+//      writes frames referencing them);
+//   5. clean shutdown (control frames, worker exits, destroy).
+//
+// Any data race TSAN finds aborts the process non-zero
+// (halt_on_error=1 is set by the pytest driver); exit 0 means the run
+// completed with a clean report. Built on demand by
+// tests/test_tsan_transport.py; no Python in the loop — TSAN must own
+// the whole address space, which it cannot do as a .so loaded into a
+// non-TSAN interpreter.
+
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+// The transport's C ABI (declared here rather than a header; the .cpp
+// is compiled into this binary directly).
+extern "C" {
+void* msgt_coord_create(const char* addr, int n, const uint8_t* token,
+                        int token_len);
+int msgt_coord_accept(void* h, int64_t timeout_ms);
+int msgt_coord_isend(void* h, int rank, int64_t seq, int64_t epoch,
+                     int64_t tag, int64_t kind, const uint8_t* data,
+                     int64_t len);
+void* msgt_payload_create(const uint8_t* data, int64_t len);
+void msgt_payload_release(void* ph);
+int msgt_coord_isend_shared(void* h, int rank, int64_t seq, int64_t epoch,
+                            int64_t tag, int64_t kind, const uint8_t* pre,
+                            int64_t pre_len, void* ph);
+void* msgt_payload_create_shm(const uint8_t* data, int64_t len);
+void msgt_payload_release_shm(void* ph);
+int msgt_coord_isend_shm(void* h, int rank, int64_t seq, int64_t epoch,
+                         int64_t tag, const uint8_t* pre, int64_t pre_len,
+                         void* ph);
+struct Hdr {
+  int64_t len, seq, epoch, tag, kind;
+};
+int msgt_coord_poll(void* h, int rank, Hdr* out);
+int64_t msgt_coord_take(void* h, int rank, uint8_t* buf, int64_t cap);
+int msgt_coord_waitany(void* h, const int32_t* ranks, int n,
+                       int64_t timeout_ms);
+int msgt_coord_is_dead(void* h, int rank);
+int msgt_coord_reaccept(void* h, int rank, int64_t timeout_ms);
+void msgt_coord_destroy(void* h);
+void* msgt_worker_connect(const char* addr, int rank, const uint8_t* token,
+                          int token_len);
+int msgt_worker_recv_hdr(void* h, Hdr* out);
+int msgt_worker_recv_payload(void* h, uint8_t* buf, int64_t len);
+int msgt_worker_send(void* h, int64_t seq, int64_t epoch, int64_t tag,
+                     int64_t kind, const uint8_t* data, int64_t len);
+int msgt_worker_take_fd(void* h);
+void msgt_worker_close(void* h);
+}
+
+namespace {
+
+constexpr int64_t KIND_DATA = 0;
+constexpr int64_t KIND_CONTROL = 1;
+constexpr int64_t KIND_SHM = 5;
+const uint8_t kToken[] = "tsan-secret";
+constexpr int kTokenLen = sizeof(kToken) - 1;
+
+void worker_main(const std::string& path, int rank, int die_after) {
+  void* w = msgt_worker_connect(path.c_str(), rank, kToken, kTokenLen);
+  if (!w) {
+    std::fprintf(stderr, "worker %d: connect failed\n", rank);
+    std::abort();
+  }
+  int served = 0;
+  while (true) {
+    Hdr hdr{};
+    if (msgt_worker_recv_hdr(w, &hdr) != 0) break;
+    std::vector<uint8_t> payload(hdr.len > 0 ? hdr.len : 1);
+    if (hdr.len > 0 &&
+        msgt_worker_recv_payload(w, payload.data(), hdr.len) != 0)
+      break;
+    if (hdr.kind == KIND_CONTROL) break;
+    if (hdr.kind == KIND_SHM) {
+      // adopt + immediately drop the region fd: the harness checks the
+      // fd-passing plumbing for races, not the mapping contents
+      int fd = msgt_worker_take_fd(w);
+      if (fd >= 0) ::close(fd);
+    }
+    uint8_t echo[8];
+    std::memcpy(echo, &hdr.epoch, sizeof(int64_t));
+    if (msgt_worker_send(w, hdr.seq, hdr.epoch, hdr.tag, KIND_DATA, echo,
+                         sizeof(echo)) != 0)
+      break;
+    served++;
+    if (die_after > 0 && served >= die_after) break;  // simulated crash
+  }
+  msgt_worker_close(w);
+}
+
+}  // namespace
+
+int main() {
+  const std::string path =
+      "/tmp/msgt-tsan-" + std::to_string(::getpid()) + ".sock";
+  constexpr int N = 4;
+  constexpr int EPOCHS = 200;
+  void* c = msgt_coord_create(path.c_str(), N, kToken, kTokenLen);
+  if (!c) {
+    std::fprintf(stderr, "coordinator create failed\n");
+    return 2;
+  }
+  std::vector<std::thread> workers;
+  for (int r = 0; r < N; r++)
+    workers.emplace_back(worker_main, path, r, r == 1 ? 40 : 0);
+  auto bail = [&](const char* why) {
+    std::fprintf(stderr, "%s\n", why);
+    // detach in-scope threads: destroying a joinable std::thread calls
+    // std::terminate, which would replace rc=2 with SIGABRT and bury
+    // the diagnostic
+    for (auto& t : workers)
+      if (t.joinable()) t.detach();
+    std::_Exit(2);
+  };
+  if (msgt_coord_accept(c, 10000) != 0) bail("accept failed");
+
+  // concurrent phase-1-style prober: non-blocking polls racing the
+  // progress engine's completions (results are harvested by the main
+  // loop's waitany; the prober only peeks headers)
+  std::atomic<bool> stop{false};
+  std::thread prober([&] {
+    Hdr hdr{};
+    while (!stop.load(std::memory_order_relaxed)) {
+      for (int r = 0; r < N; r++) (void)msgt_coord_poll(c, r, &hdr);
+      std::this_thread::sleep_for(std::chrono::microseconds(200));
+    }
+  });
+
+  int64_t seq = 0;
+  bool reaccepted = false;
+  uint8_t small[16] = {1};
+  for (int epoch = 1; epoch <= EPOCHS; epoch++) {
+    // rotate payload styles: direct, shared-buffer, shm
+    int style = epoch % 3;
+    void* ph = nullptr;
+    for (int r = 0; r < N; r++) {
+      if (msgt_coord_is_dead(c, r)) continue;
+      ++seq;
+      if (style == 0) {
+        msgt_coord_isend(c, r, seq, epoch, 0, KIND_DATA, small,
+                         sizeof(small));
+      } else if (style == 1) {
+        if (!ph) ph = msgt_payload_create(small, sizeof(small));
+        msgt_coord_isend_shared(c, r, seq, epoch, 0, KIND_DATA, small, 4,
+                                ph);
+      } else {
+        if (!ph) ph = msgt_payload_create_shm(small, sizeof(small));
+        msgt_coord_isend_shm(c, r, seq, epoch, 0, small, 4, ph);
+      }
+    }
+    if (ph && style == 1) msgt_payload_release(ph);
+    if (ph && style == 2) msgt_payload_release_shm(ph);
+    // harvest whatever the live set produces this epoch
+    int32_t ranks[N];
+    int live = 0;
+    for (int r = 0; r < N; r++)
+      if (!msgt_coord_is_dead(c, r)) ranks[live++] = r;
+    int got = 0;
+    while (got < live) {
+      int r = msgt_coord_waitany(c, ranks, live, 5000);
+      if (r < 0) bail("waitany timeout");
+      Hdr hdr{};
+      if (!msgt_coord_poll(c, r, &hdr)) continue;  // prober peeked; retry
+      uint8_t buf[64];
+      if (msgt_coord_take(c, r, buf, sizeof(buf)) < 0) continue;
+      got++;  // data frame, or a death marker settling the slot
+    }
+    // mid-run: worker 1 died around epoch ~40; re-admit it once
+    if (!reaccepted && msgt_coord_is_dead(c, 1)) {
+      std::thread w(worker_main, path, 1, 0);
+      if (msgt_coord_reaccept(c, 1, 10000) != 0) {
+        w.detach();
+        bail("reaccept failed");
+      }
+      w.detach();  // serves until the shutdown broadcast
+      reaccepted = true;
+    }
+  }
+  if (!reaccepted) bail("worker 1 never died/reaccepted");
+  stop.store(true);
+  prober.join();
+  for (int r = 0; r < N; r++)
+    msgt_coord_isend(c, r, 0, 0, 0, KIND_CONTROL, small, 0);
+  for (auto& t : workers)
+    if (t.joinable()) t.join();
+  // give the detached reaccepted worker a beat to exit on the control
+  // frame before the coordinator (and its socket) is destroyed
+  std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  msgt_coord_destroy(c);
+  std::printf("tsan harness: %d epochs, reaccept ok\n", EPOCHS);
+  return 0;
+}
