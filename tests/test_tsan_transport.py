@@ -25,7 +25,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE = os.path.join(_REPO, "mpistragglers_jl_tpu", "native")
 
 
-def _have_tsan() -> bool:
+def _sanitizer_usable(flag: str) -> bool:
     import shutil
     import tempfile
 
@@ -38,8 +38,7 @@ def _have_tsan() -> bool:
             f.write("int main(){return 0;}\n")
         probe = os.path.join(d, "t")
         r = subprocess.run(
-            [gxx, "-fsanitize=thread", src, "-o", probe],
-            capture_output=True,
+            [gxx, flag, src, "-o", probe], capture_output=True
         )
         if r.returncode != 0:
             return False
@@ -50,13 +49,24 @@ def _have_tsan() -> bool:
 
 
 @pytest.mark.slow
-def test_transport_under_thread_sanitizer(tmp_path):
-    if not _have_tsan():
-        pytest.skip("no g++ / libtsan on this host")
-    binary = str(tmp_path / "tsan_harness")
+@pytest.mark.parametrize(
+    "flag,env_opts",
+    [
+        ("-fsanitize=thread", {"TSAN_OPTIONS": "halt_on_error=1 exitcode=66"}),
+        # ASAN implies LeakSanitizer: frame/payload buffers, payload
+        # handles, shm regions, and peer state must all be released by
+        # destroy/close — a leak or heap error fails the run
+        ("-fsanitize=address", {"ASAN_OPTIONS": "halt_on_error=1 exitcode=66 detect_leaks=1"}),
+    ],
+    ids=["tsan", "asan+lsan"],
+)
+def test_transport_under_sanitizer(tmp_path, flag, env_opts):
+    if not _sanitizer_usable(flag):
+        pytest.skip(f"g++ {flag} not usable on this host")
+    binary = str(tmp_path / "san_harness")
     build = subprocess.run(
         [
-            "g++", "-std=c++17", "-O1", "-g", "-fsanitize=thread",
+            "g++", "-std=c++17", "-O1", "-g", flag,
             os.path.join(_NATIVE, "tsan_harness.cpp"),
             os.path.join(_NATIVE, "transport.cpp"),
             "-o", binary, "-lpthread",
@@ -65,13 +75,13 @@ def test_transport_under_thread_sanitizer(tmp_path):
     )
     assert build.returncode == 0, build.stderr[-3000:]
     env = dict(os.environ)
-    env["TSAN_OPTIONS"] = "halt_on_error=1 exitcode=66"
+    env.update(env_opts)
     run = subprocess.run(
         [binary], capture_output=True, text=True, timeout=600, env=env,
     )
     sys.stderr.write(run.stderr[-4000:])
     assert run.returncode == 0, (
-        f"TSAN-instrumented transport run failed "
+        f"{flag}-instrumented transport run failed "
         f"(rc={run.returncode}):\n{run.stderr[-4000:]}"
     )
     assert "reaccept ok" in run.stdout
