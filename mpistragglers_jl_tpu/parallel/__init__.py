@@ -17,6 +17,8 @@ _HOME = {
     "local_worker_indices": "multihost",
     "pipeline_spmd": "pipeline",
     "pipeline_1f1b": "pipeline",
+    "pipeline_circular": "pipeline",
+    "pipeline_param_specs_circular": "pipeline",
     "bubble_fraction": "pipeline",
     "stack_layers": "pipeline",
     "make_pipeline_train_step": "pipeline",

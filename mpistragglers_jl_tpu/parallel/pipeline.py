@@ -41,6 +41,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "pipeline_spmd",
     "pipeline_1f1b",
+    "pipeline_circular",
+    "pipeline_param_specs_circular",
     "bubble_fraction",
     "stack_layers",
     "make_pipeline_train_step",
@@ -126,7 +128,118 @@ def bubble_fraction(pp: int, n_microbatch: int,
         return 2 * (p - 1) / (M + 2 * (p - 1))
     if schedule == "gpipe":
         return (p - 1) / (M + p - 1)
+    if schedule == "circular" or (
+        schedule.startswith("circular:")
+        and schedule.split(":", 1)[1].isdigit()
+    ):
+        # "circular:v" — v virtual chunks per device; ticks are 1/v the
+        # work of a gpipe tick, so the fill/drain bubble shrinks by v:
+        # wall = (v*M + p - 1) ticks * (L / (v*p)) = (M + (p-1)/v) * L/p
+        v = int(schedule.split(":", 1)[1]) if ":" in schedule else 2
+        return (p - 1) / (v * M + p - 1)
     raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def pipeline_circular(chunk_fn, chunk_params, x, *, axis: str = "pp",
+                      n_microbatch: int, v: int = 2):
+    """Interleaved virtual stages: each device holds ``v`` NON-contiguous
+    layer chunks and microbatches lap the device ring ``v`` times —
+    call inside shard_map.
+
+    The fill/drain schedule (:func:`pipeline_spmd`) idles ``pp - 1``
+    FULL-stage ticks each way. Here a tick applies one CHUNK (1/v of a
+    device's layers), and the ring is collision-free by construction:
+    chunk ``c`` lives on device ``c mod pp`` (device-major interleaving
+    — device d's local chunk ``j`` is global chunk ``j*pp + d``), and a
+    payload's stage counter rides with it, so at any tick each device
+    hosts exactly one microbatch, at a stage congruent to the device
+    index mod pp. Injection is seamless: the wrap-around arrival at
+    device 0 is either a FINISHED microbatch (stage == v*pp — emitted
+    and replaced by the next injection) or a lap-in-progress (passed
+    through to its next chunk). Bubble: ``(pp-1)/(v*M + pp - 1)`` —
+    the gpipe ratio divided by ~v (``bubble_fraction("circular:v")``).
+
+    ``chunk_fn(local_chunks, j, micro) -> micro`` applies this device's
+    ``j``-th local chunk (``j`` is a traced index into the leading
+    ``v``-axis of ``local_chunks``). ``x``: the full local batch,
+    ``n_microbatch`` must divide it and be a multiple of the ``pp``
+    size (seamless waves need full ring occupancy). Differentiable:
+    ``jax.grad`` through the scan reverses the ring, giving the
+    backward wave the same 1/v bubble (activation memory is O(scan
+    length), like the gpipe path; use :func:`pipeline_1f1b` when memory
+    is the binding constraint instead).
+    """
+    p = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    B = x.shape[0]
+    M = int(n_microbatch)
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by n_microbatch {M}")
+    if M % p != 0:
+        raise ValueError(
+            f"n_microbatch {M} must be a multiple of the pipeline size "
+            f"{p} (seamless circular waves need full ring occupancy)"
+        )
+    C = v * p  # total chunks = virtual stages
+    micro = x.reshape(M, B // M, *x.shape[1:])
+    perm = [(j, (j + 1) % p) for j in range(p)]
+
+    def _varying(a):
+        if axis in getattr(jax.typeof(a), "vma", ()):
+            return a
+        return jax.lax.pcast(a, (axis,), to="varying")
+
+    buf0 = _varying(jnp.zeros_like(micro[0]))
+    # stage counter rides with the payload: s < C live (next chunk = s),
+    # s == C finished (emit on arrival at device 0), s == C+1 empty slot
+    s0 = _varying(jnp.full((), C + 1, jnp.int32))
+    out0 = _varying(jnp.zeros_like(micro))
+    inj0 = _varying(jnp.zeros((), jnp.int32))   # injections so far
+    emit0 = _varying(jnp.zeros((), jnp.int32))  # emissions so far
+
+    def tick(carry, t):
+        buf, s, out, inj, emit = carry
+        # --- device 0: emit a finished arrival, refill the freed slot --
+        # (FIFO: injection order == ring order == emission order, so
+        # per-device counters — only device 0's ever advance — give the
+        # microbatch ids; tick arithmetic would break across waves)
+        arr_done = jnp.logical_and(idx == 0, s == C)
+        arr_free = jnp.logical_and(idx == 0, s >= C)
+        o_valid = jnp.logical_and(arr_done, emit < M)
+        oc = jnp.clip(emit, 0, M - 1)
+        cur = jax.lax.dynamic_slice_in_dim(out, oc, 1, axis=0)
+        upd = jnp.where(o_valid, buf[None].astype(out.dtype), cur)
+        out = jax.lax.dynamic_update_slice_in_dim(out, upd, oc, axis=0)
+        emit = emit + o_valid.astype(jnp.int32)
+        can_inject = jnp.logical_and(arr_free, inj < M)
+        ic = jnp.clip(inj, 0, M - 1)
+        buf = jnp.where(can_inject, micro[ic], buf)
+        # a consumed finished slot parks as empty so it cannot re-emit
+        s = jnp.where(
+            can_inject, 0, jnp.where(arr_done, C + 1, s)
+        )
+        inj = inj + can_inject.astype(jnp.int32)
+        # --- apply this device's local chunk j = s // p ---------------
+        # (every live payload here has s ≡ idx (mod p), by construction)
+        j = jnp.clip(s // p, 0, v - 1)
+        live = s < C
+        y = chunk_fn(chunk_params, j, buf)
+        buf = jnp.where(live, y, buf)
+        s = jnp.where(live, s + 1, s)
+        # --- rotate payload + its stage counter to the next device ----
+        buf = jax.lax.ppermute(buf, axis, perm)
+        s = jax.lax.ppermute(s, axis, perm)
+        return (buf, s, out, inj, emit), None
+
+    # wave w (p microbatches) injects during ticks [w*C, w*C + p); the
+    # last microbatch (inj = M-1) enters at (M/p - 1)*C + p - 1 and its
+    # finished payload arrives back at device 0 C ticks later
+    T = v * M + p
+    (_, _, out, _, _), _ = jax.lax.scan(
+        tick, (buf0, s0, out0, inj0, emit0), jnp.arange(T)
+    )
+    out = jax.lax.psum(out, axis)  # populated on device 0 only
+    return out.reshape(B, *x.shape[1:])
 
 
 def pipeline_1f1b(stage_fn, head_fn, stage_params, head_params, x, targets,
@@ -388,18 +501,69 @@ def _check_dense(cfg):
         )
 
 
-def _pipeline_loss_local(params, tokens, targets, cfg, n_microbatch):
+def _chunk_apply(local_chunks, j, x, pos, cfg, v):
+    """Circular-schedule chunk: dynamic-index the local ``v`` axis, then
+    run that chunk's layers (the shard keeps a singleton device axis in
+    front: local leaves are (1, v, layers_per_chunk, ...))."""
+    leaf = jax.tree.leaves(local_chunks)[0]
+    if leaf.shape[1] != v:
+        # dynamic_index CLAMPS out-of-range j, so a layout/schedule v
+        # mismatch (params sharded for one v, step built for another)
+        # would silently apply only a prefix of each device's chunks
+        raise ValueError(
+            f"params are laid out with {leaf.shape[1]} virtual stages "
+            f"per device but the schedule runs v={v}; pass the same "
+            "virtual_stages to shard_params_pipeline and "
+            "make_pipeline_train_step"
+        )
+    lp = jax.tree.map(
+        lambda a: jax.lax.dynamic_index_in_dim(
+            a[0], j, 0, keepdims=False
+        ),
+        local_chunks,
+    )
+    return _stage_apply(lp, x, pos, cfg)
+
+
+def pipeline_param_specs_circular(cfg) -> dict:
+    """Specs for the circular layout: stacked layers reorganized
+    device-major to ``(pp, v, layers_per_chunk, ...)`` and sharded on
+    the leading device axis (device d holds chunks d, pp+d, 2pp+d, ...).
+    Dense stages only (MoE rides the 1F1B schedule); the key set and
+    specs are the stage layout's — only the array layout differs."""
+    _check_dense(cfg)
+    return pipeline_param_specs(cfg)
+
+
+def _circular_loss_local(params, tokens, targets, cfg, n_microbatch, v):
+    return _pipeline_loss_local(
+        params, tokens, targets, cfg, n_microbatch,
+        engine=lambda pos, layers, x: pipeline_circular(
+            partial(_chunk_apply, pos=pos, cfg=cfg, v=v),
+            layers, x, axis="pp", n_microbatch=n_microbatch, v=v,
+        ),
+    )
+
+
+def _pipeline_loss_local(params, tokens, targets, cfg, n_microbatch,
+                         engine=None):
+    """Shared per-shard loss: embed -> pipeline engine -> LN -> tied
+    logits -> dp-mean NLL. ``engine(pos, layers, x)`` defaults to the
+    fill/drain gpipe schedule; the circular schedule passes its own."""
     from ..models.transformer import _ln, nll_loss
 
     pos = jnp.arange(tokens.shape[1])
     x = params["emb"][tokens]
-    x = pipeline_spmd(
-        partial(_stage_apply, pos=pos, cfg=cfg),
-        params["layers"],
-        x,
-        axis="pp",
-        n_microbatch=n_microbatch,
-    )
+    if engine is None:
+        x = pipeline_spmd(
+            partial(_stage_apply, pos=pos, cfg=cfg),
+            params["layers"],
+            x,
+            axis="pp",
+            n_microbatch=n_microbatch,
+        )
+    else:
+        x = engine(pos, params["layers"], x)
     x = _ln(x, params["lnf_s"], params["lnf_b"])
     logits = jnp.einsum("bld,vd->blv", x, params["emb"])
     return nll_loss(logits, targets, ("dp",))
@@ -452,15 +616,20 @@ def _1f1b_loss_grads_local(params, tokens, targets, cfg, n_microbatch):
 
 
 def make_pipeline_train_step(cfg, mesh: Mesh, *, n_microbatch: int,
-                             lr: float = 1e-2, schedule: str = "1f1b"):
+                             lr: float = 1e-2, schedule: str = "1f1b",
+                             virtual_stages: int = 2):
     """Jitted (params, tokens, targets) -> (params, loss) SGD step over a
     (dp, pp) mesh: batch over ``dp``, the layer stack over ``pp``.
 
     ``schedule="1f1b"`` (default) runs the interleaved fwd/bwd scan of
     :func:`pipeline_1f1b` — O(pp) activation memory, MoE stages legal.
-    ``schedule="gpipe"`` keeps the fill/drain forward differentiated by
-    ``jax.grad`` (dense stages only) for comparison. Bubble fractions:
-    :func:`bubble_fraction`.
+    ``schedule="circular"`` runs :func:`pipeline_circular` with
+    ``virtual_stages`` chunks per device — the interleaved-virtual-stage
+    schedule whose fill/drain bubble is 1/v of gpipe's (dense stages;
+    autodiff backward; ``n_microbatch`` must be a multiple of pp and
+    ``cfg.n_layers`` of ``v*pp``). ``schedule="gpipe"`` keeps the
+    fill/drain forward differentiated by ``jax.grad`` (dense stages
+    only) for comparison. Bubble fractions: :func:`bubble_fraction`.
 
     ``cfg.n_layers`` must divide by the pp size; params come from
     :func:`shard_params_pipeline`. Attention runs per-device full
@@ -486,6 +655,24 @@ def make_pipeline_train_step(cfg, mesh: Mesh, *, n_microbatch: int,
             out_specs=P(),
         )
         return sgd_step(loss_fn, lr=lr)
+    if schedule == "circular":
+        _check_dense(cfg)
+        v = int(virtual_stages)
+        if cfg.n_layers % (v * pp) != 0:
+            raise ValueError(
+                f"n_layers {cfg.n_layers} not divisible by v*pp = "
+                f"{v * pp} (circular chunks must be equal)"
+            )
+        loss_fn = jax.shard_map(
+            partial(
+                _circular_loss_local, cfg=cfg,
+                n_microbatch=n_microbatch, v=v,
+            ),
+            mesh=mesh,
+            in_specs=(pipeline_param_specs_circular(cfg), P("dp"), P("dp")),
+            out_specs=P(),
+        )
+        return sgd_step(loss_fn, lr=lr)
     if schedule != "1f1b":
         raise ValueError(f"unknown schedule {schedule!r}")
     grad_fn = jax.shard_map(
@@ -508,13 +695,42 @@ def make_pipeline_train_step(cfg, mesh: Mesh, *, n_microbatch: int,
     return step
 
 
-def shard_params_pipeline(params: dict, cfg, mesh: Mesh) -> dict:
-    """Stack the per-layer params and place them per
-    :func:`pipeline_param_specs` (layer axis over ``pp``)."""
+def shard_params_pipeline(params: dict, cfg, mesh: Mesh,
+                          *, virtual_stages: int | None = None) -> dict:
+    """Stack the per-layer params and place them on the mesh.
+
+    Default (``virtual_stages=None``): contiguous stage layout — layer
+    axis over ``pp`` (gpipe / 1F1B schedules). With ``virtual_stages=v``
+    (circular schedule): device-major interleaved layout — stacked
+    layers reorganized to ``(pp, v, layers_per_chunk, ...)`` so device d
+    holds chunks ``d, pp+d, ..., (v-1)pp+d``."""
     stacked = dict(params)
     stacked["layers"] = stack_layers(params["layers"])
+    if virtual_stages is None:
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            stacked,
+            pipeline_param_specs(cfg),
+        )
+    v = int(virtual_stages)
+    pp = mesh.shape["pp"]
+    L = cfg.n_layers
+    if L % (v * pp) != 0:
+        raise ValueError(
+            f"n_layers {L} not divisible by v*pp = {v * pp}"
+        )
+    lpc = L // (v * pp)
+
+    def devmajor(a):
+        # (L, ...) -> (C=v*pp, lpc, ...) -> (v, pp, lpc, ...) ->
+        # (pp, v, lpc, ...): chunk j*pp + d lands at [d, j]
+        a = a.reshape(v * pp, lpc, *a.shape[1:])
+        a = a.reshape(v, pp, lpc, *a.shape[2:])
+        return jnp.swapaxes(a, 0, 1)
+
+    stacked["layers"] = jax.tree.map(devmajor, stacked["layers"])
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         stacked,
-        pipeline_param_specs(cfg),
+        pipeline_param_specs_circular(cfg),
     )
