@@ -22,6 +22,8 @@ import time
 
 import numpy as np
 
+from benchmarks.transformer_train_bench import bench_transformer_train
+
 
 def bench_coded_gemm(m=8192, kdim=8192, ncols=8192, n=8, k=6, epochs=7):
     # each measurement is the MEAN over `epochs` pipelined epochs (one
@@ -168,6 +170,12 @@ def bench_coded_gemm(m=8192, kdim=8192, ncols=8192, n=8, k=6, epochs=7):
         "epochs_pipelined": epochs,
         "chains_min_of": 3,
         "adaptive_nwait": bench_adaptive_nwait(),
+        # round-3 flagship rung: the REAL train step (shard_map +
+        # Ulysses + Pallas flash attention under Mosaic) on this chip.
+        # Not wrapped in try/except on purpose: if the non-interpret
+        # flash path stops compiling, the whole bench fails loudly
+        # (VERDICT r2 item 1).
+        "transformer_train": _transformer_rungs(),
         "bf16_rung": {
             "value": round(bf16_s, 4),
             "gflops_per_chip": round(flops / bf16_s / 1e9, 1),
@@ -175,6 +183,27 @@ def bench_coded_gemm(m=8192, kdim=8192, ncols=8192, n=8, k=6, epochs=7):
             "decode_rel_err": bf16_err,
         },
     }
+
+
+def _transformer_rungs():
+    """Flagship train-step metric + a larger-model MFU rung (MFU rises
+    with d_model as the GEMMs fatten; the 470M rung shows the headroom
+    the 134M default leaves on the table)."""
+    tt = bench_transformer_train()
+    big = bench_transformer_train(
+        batch=4, d_model=2048, n_heads=16, d_ff=8192, steps=3, chains=2
+    )
+    tt["large_model_rung"] = {
+        k: big[k]
+        for k in (
+            "value",
+            "tokens_per_s",
+            "model_tflops_per_s",
+            "mfu_vs_raw_matmul",
+            "params_m",
+        )
+    }
+    return tt
 
 
 def bench_adaptive_nwait(epochs=80, n=8):
@@ -295,5 +324,10 @@ if __name__ == "__main__":
         print(json.dumps(bench_coded_gemm()))
     elif which == "uncoded":
         print(json.dumps(bench_uncoded_gemm()))
+    elif which == "transformer":
+        print(json.dumps(bench_transformer_train()))
     else:
-        sys.exit(f"unknown benchmark {which!r}; choose 'coded' or 'uncoded'")
+        sys.exit(
+            f"unknown benchmark {which!r}; "
+            "choose 'coded', 'uncoded' or 'transformer'"
+        )

