@@ -112,6 +112,14 @@ class AsyncPool:
         self.ranks: list[int] = [int(r) for r in ranks]
         if len(set(self.ranks)) != len(self.ranks):
             raise ValueError(f"ranks must be unique, got {self.ranks}")
+        if self.ranks and min(self.ranks) < 0:
+            raise ValueError(f"ranks must be >= 0, got {self.ranks}")
+        # pool index <-> backend rank: every backend call below routes
+        # through ranks[i], so a pool over a rank SUBSET of a shared
+        # backend addresses exactly those workers (reference
+        # src/MPIAsyncPools.jl:21 `MPIAsyncPool([1,4,5])`, routed at
+        # :137-138 — the pool sends to ranks[i], not to i)
+        self._idx_of_rank = {r: j for j, r in enumerate(self.ranks)}
         n = len(self.ranks)
         if nwait is None:
             nwait = n
@@ -251,7 +259,7 @@ def _dispatch(pool: AsyncPool, backend: Backend, i: int, sendbuf, tag: int) -> N
     pool.sepochs[i] = pool.epoch
     pool.stags[i] = int(tag)
     pool.stimestamps[i] = time.perf_counter_ns()
-    backend.dispatch(i, sendbuf, pool.epoch, tag=tag)
+    backend.dispatch(pool.ranks[i], sendbuf, pool.epoch, tag=tag)
     # only after the backend accepted the task: a failed dispatch must not
     # leave pool.active[i] pointing at a slot the backend never opened
     # (waitall would then block on a completion that can never come)
@@ -306,6 +314,16 @@ def asyncmap(
         # reference src/MPIAsyncPools.jl:157
         raise TypeError(f"nwait must be an int or callable, got {type(nwait)}")
     recvbufs = _recv_chunks(recvbuf, n)
+    # ranks must be addressable backend slots — checked up front so a
+    # subset pool misconfigured against a narrower backend fails with
+    # the mapping spelled out, not an IndexError inside the transport
+    bn = getattr(backend, "n_workers", None)
+    if bn is not None and n and max(pool.ranks) >= bn:
+        raise ValueError(
+            f"pool.ranks {pool.ranks} address workers beyond the "
+            f"backend's {bn} slots; the pool routes pool index i to "
+            "backend worker ranks[i] (reference src/MPIAsyncPools.jl:21)"
+        )
     # fail BEFORE any dispatch, like the reference's cross-buffer sizeof
     # checks (src/MPIAsyncPools.jl:72-76): an active worker's in-flight
     # result will be harvested into this recvbuf (stale arrivals are
@@ -338,7 +356,7 @@ def asyncmap(
         for i in range(n):
             if not pool.active[i]:
                 continue
-            result = backend.test(i, tag=int(pool.stags[i]))
+            result = backend.test(pool.ranks[i], tag=int(pool.stags[i]))
             if result is None:
                 continue
             _store(pool, i, result, recvbufs)
@@ -380,13 +398,16 @@ def asyncmap(
             # (reference MPI.Waitany! at src/MPIAsyncPools.jl:161)
             act = np.flatnonzero(pool.active)
             got = backend.wait_any(
-                act, timeout=deadline.remaining(), tags=pool.stags[act]
+                [pool.ranks[j] for j in act],
+                timeout=deadline.remaining(),
+                tags=pool.stags[act],
             )
             if got is None:
                 raise DeadWorkerError(
                     [int(j) for j in np.flatnonzero(pool.active)], timeout
                 )
-            i, result = got
+            rank, result = got
+            i = pool._idx_of_rank[rank]
             _store(pool, i, result, recvbufs)
             fresh = pool.repochs[i] == pool.epoch
             if tracer is not None:
@@ -443,12 +464,15 @@ def waitall(
             # per-worker round-trip times)
             act = np.flatnonzero(pool.active)
             got = backend.wait_any(
-                act, timeout=deadline.remaining(), tags=pool.stags[act]
+                [pool.ranks[j] for j in act],
+                timeout=deadline.remaining(),
+                tags=pool.stags[act],
             )
             if got is None:
                 dead = [int(j) for j in np.flatnonzero(pool.active)]
                 raise DeadWorkerError(dead, timeout)
-            i, result = got
+            rank, result = got
+            i = pool._idx_of_rank[rank]
             _store(pool, i, result, recvbufs)
             pool.active[i] = False
             if tracer is not None:

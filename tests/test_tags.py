@@ -161,3 +161,31 @@ def test_control_data_split_native():
             assert data_pool.repochs.tolist() == [epoch, epoch]
     finally:
         backend.shutdown()
+
+
+@pytest.mark.parametrize("kind", ["local", "process", "native"])
+def test_subset_pools_with_tags(kind):
+    """Rank-subset routing (pool index i -> ranks[i], reference
+    src/MPIAsyncPools.jl:21,:137-138) composes with tag channels:
+    disjoint-subset pools on distinct tags of one backend each drive
+    exactly their own workers, and an OVERLAPPING worker can serve two
+    pools simultaneously on different tags (one outstanding task per
+    (worker, tag) channel — MPI request semantics)."""
+    backend = _make_backend(kind, _tagged_echo, 6)
+    try:
+        pa = AsyncPool([0, 2, 4])
+        pb = AsyncPool([1, 3])
+        # A's slow epoch in flight on tag 1; B completes on tag 2
+        asyncmap(pa, np.array([1.0, 0.3]), backend, nwait=0, tag=1)
+        asyncmap(pb, np.array([2.0, 0.0]), backend, nwait=2, tag=2)
+        # results encode stream*10 + BACKEND worker id: proof of routing
+        assert sorted(int(r[0]) for r in pb.results) == [21, 23]
+        # worker 2 is busy for pool A on tag 1 — a different pool can
+        # still task it on tag 3 while that dispatch is outstanding
+        pc = AsyncPool([2, 5])
+        asyncmap(pc, np.array([3.0, 0.0]), backend, nwait=2, tag=3)
+        assert sorted(int(r[0]) for r in pc.results) == [32, 35]
+        waitall(pa, backend)
+        assert sorted(int(r[0]) for r in pa.results) == [10, 12, 14]
+    finally:
+        backend.shutdown()
