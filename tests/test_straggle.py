@@ -181,3 +181,97 @@ def test_observe_pool_only_counts_advanced_workers():
         assert all(w.count == 2 for w in model.workers)
     finally:
         backend.shutdown()
+
+
+class _FakePool:
+    """Minimal pool stand-in: every epoch all workers 'arrive' with the
+    given latencies (repochs advance together)."""
+
+    def __init__(self, n):
+        self.n_workers = n
+        self.repochs = np.zeros(n, dtype=np.int64)
+        self.latency = np.zeros(n)
+        self.results = [None] * n
+
+    def tick(self, latencies):
+        self.repochs += 1
+        self.latency[:] = latencies
+        self.results = [np.zeros(1)] * self.n_workers
+
+
+def test_cusum_fires_on_regime_shift_and_resets_one_worker():
+    w = WorkerStats(change_detect=True)
+    rng = np.random.default_rng(0)
+    for x in 0.005 + rng.exponential(0.001, 50):
+        w.observe(x)
+    assert w.resets == 0
+    # straggler lands on this worker: 75 ms instead of ~6 ms
+    fired_at = None
+    for j in range(10):
+        if w.observe(0.075 + rng.exponential(0.001)):
+            fired_at = j
+            break
+    assert fired_at is not None and fired_at <= 3
+    # the fit now reflects ONLY the new regime
+    assert w.mean > 0.05
+    assert w.count <= 10
+
+
+def test_cusum_quiet_on_stationary_trace():
+    # false-alarm guard: 500 stationary shifted-exponential samples
+    # should essentially never reset (ARL far above the bench length)
+    w = WorkerStats(change_detect=True)
+    rng = np.random.default_rng(1)
+    for x in 0.005 + rng.exponential(0.002, 500):
+        w.observe(x)
+    assert w.resets <= 1
+
+
+def test_model_reports_shifted_worker_only():
+    n = 4
+    model = PoolLatencyModel(n, change_detect=True)
+    pool = _FakePool(n)
+    rng = np.random.default_rng(2)
+    for _ in range(30):
+        pool.tick(0.005 + rng.exponential(0.0005, n))
+        model.observe_pool(pool)
+    assert model.shifted_last_observe == []
+    lat = 0.005 + rng.exponential(0.0005, n)
+    lat[2] = 0.08  # straggler moves onto worker 2
+    shifted = set()
+    for _ in range(5):
+        pool.tick(lat)
+        model.observe_pool(pool)
+        shifted |= set(model.shifted_last_observe)
+    assert shifted == {2}
+    # other workers keep their full history
+    assert model.workers[0].count >= 30
+    assert model.workers[2].count < 6
+
+
+def test_adaptive_nwait_catches_up_after_shift():
+    """After the straggler moves, the controller must re-decide within
+    a few epochs (shift boost), not wait out the refit cadence."""
+    n = 8
+    ctl = AdaptiveNwait(n, kmin=6, min_samples=2, refit_every=10, seed=0)
+    pool = _FakePool(n)
+    rng = np.random.default_rng(3)
+
+    def epoch(hot):
+        lat = 0.004 + rng.exponential(0.0004, n)
+        if hot is not None:
+            lat[hot] = 0.06
+        pool.tick(lat)
+        ctl.observe(pool)
+
+    for _ in range(20):
+        epoch(hot=0)
+    assert ctl.nwait <= n - 1  # learned to dodge the straggler
+    # straggler moves 0 -> 5; the boost refits within refit_every epochs
+    before = ctl.nwait
+    for _ in range(5):
+        epoch(hot=5)
+    assert ctl.model.workers[5].resets >= 1
+    assert ctl.nwait <= n - 1  # still dodging after the move
+    # worker 0's fit restarted too (it got FASTER — also a regime shift)
+    assert ctl.model.workers[0].resets >= 1 or before <= n - 1
