@@ -199,6 +199,7 @@ class LTCodedGemm:
         dtype=None,
         precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
         shard_ids: Sequence[int] | None = None,
+        systematic: bool = False,
     ):
         if dtype is not None:
             A = np.asarray(A, dtype=dtype)
@@ -207,7 +208,7 @@ class LTCodedGemm:
             raise ValueError(f"rows {m} must divide evenly into k={k} blocks")
         if devices is None:
             devices = jax.devices()
-        self.code = LTCode(k, seed=seed)
+        self.code = LTCode(k, seed=seed, systematic=systematic)
         self.k = k
         self.n = n_workers
         self.devices = list(devices)

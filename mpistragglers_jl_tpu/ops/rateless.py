@@ -91,7 +91,14 @@ class RatelessLTGemm:
         dtype=None,
         precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
         block_cache_size: int = 64,
+        systematic: bool = True,
     ):
+        """``systematic=True`` (default): the generation-0 window's
+        first k shards ARE the source blocks, so a straggler-free epoch
+        peels from k arrivals and a straggler costs only the draws
+        until its missing block is covered — measured overhead drops
+        from ~1.6x to ~1.25x of k at (n=8, k=8) (docs/PERF.md round 3).
+        Set False for the classic all-soliton stream."""
         if dtype is not None:
             A = np.asarray(A, dtype=dtype)
         else:
@@ -101,7 +108,7 @@ class RatelessLTGemm:
             raise ValueError(f"rows {m} must divide evenly into k={k} blocks")
         if devices is None:
             devices = jax.devices()
-        self.code = LTCode(k, seed=seed)
+        self.code = LTCode(k, seed=seed, systematic=systematic)
         self.k = int(k)
         self.n = int(n_workers)
         self.devices = list(devices)
@@ -120,6 +127,9 @@ class RatelessLTGemm:
         # by worker threads at completion, read by the nwait predicate
         # and the decoder on the coordinator thread
         self._collected: dict[int, dict[int, jax.Array]] = {}
+        # epoch whose shards _work may retain; None until the first
+        # multiply() (direct Backend-API users collect every epoch)
+        self._live_epoch: int | None = None
         self._lock = threading.Lock()
         self.stats: dict = {}
         # generation 0 = the static window [0, n): pre-encode on device
@@ -189,7 +199,15 @@ class RatelessLTGemm:
         )
         out = jax.block_until_ready(out)
         with self._lock:
-            self._collected.setdefault(epoch, {})[sid] = out
+            # only the live epoch accumulates: a straggler still in
+            # flight from a pruned epoch must not re-create its dict
+            # (that entry would never be pruned again and would pin the
+            # shard in HBM for the object's life — ADVICE r2). The
+            # shard itself is still returned so the pool's stale-
+            # arrival bookkeeping stays intact; it is simply not
+            # retained here.
+            if self._live_epoch is None or epoch == self._live_epoch:
+                self._collected.setdefault(epoch, {})[sid] = out
         return sid, out
 
     # -- decode-side ------------------------------------------------------
@@ -228,7 +246,10 @@ class RatelessLTGemm:
         all time out (every worker dead)."""
         epoch = pool.epoch + 1
         with self._lock:
-            # prune: only the live epoch's shards are retained
+            # prune: only the live epoch's shards are retained, and
+            # _work drops late arrivals from any other epoch from here
+            # on (see _work)
+            self._live_epoch = epoch
             self._collected = {epoch: {}}
             self._gen = {k_: v for k_, v in self._gen.items()
                          if k_[0] == epoch}

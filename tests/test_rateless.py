@@ -16,6 +16,8 @@ rateless contract of :class:`~mpistragglers_jl_tpu.ops.rateless.RatelessLTGemm`:
   produce — and ``stats`` records the shards-consumed overhead.
 """
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -75,7 +77,12 @@ def test_rateless_decodes_past_permanent_straggler():
     exactly."""
     rng = np.random.default_rng(1)
     A, B = _make_ab(rng)
-    rg = RatelessLTGemm(A, N, K, seed=SEED, delay_fn=_permanent_straggler)
+    # systematic=False: this test pins the CLASSIC all-soliton stream's
+    # incremental-redundancy machinery (the systematic default decodes
+    # this trace within generation 0, which is the point of
+    # test_systematic_overhead_beats_plain_lt, not of this test)
+    rg = RatelessLTGemm(A, N, K, seed=SEED, delay_fn=_permanent_straggler,
+                        systematic=False)
     try:
         pool = AsyncPool(N)
         C = rg.multiply(B, pool, round_timeout=1.0, max_rounds=6)
@@ -126,3 +133,71 @@ def test_rateless_repeated_epochs_and_shard_id_stream():
         rg.backend.shutdown()
     sids = {rg.shard_id(w, g) for w in range(N) for g in range(50)}
     assert len(sids) == N * 50
+
+
+def test_systematic_prefix_is_identity():
+    from mpistragglers_jl_tpu.ops.lt import LTCode
+
+    code = LTCode(8, seed=1, systematic=True)
+    for s in range(8):
+        assert code.shard_indices(s).tolist() == [s]
+    # coded tail still draws soliton supports
+    assert any(len(code.shard_indices(s)) > 1 for s in range(8, 24))
+    # straggler-free window peels trivially
+    assert code.peelable(list(range(8)))
+
+
+def test_systematic_overhead_beats_plain_lt():
+    """VERDICT r2 item 4: expected shards-consumed at one permanent
+    straggler drops to <= 1.3x k with the systematic prefix (plain LT
+    measures ~1.6x on the same trace ensemble)."""
+    from mpistragglers_jl_tpu.ops.lt import LTCode
+
+    def consumed(systematic, trials=60, k=8, n=8, straggler=3):
+        used = []
+        for t in range(trials):
+            code = LTCode(k, seed=t, systematic=systematic)
+            arrived, sid = [], 0
+            while True:
+                if sid % n != straggler:
+                    arrived.append(sid)
+                    if code.peelable(arrived):
+                        break
+                sid += 1
+            used.append(len(arrived))
+        return sum(used) / len(used)
+
+    plain = consumed(False)
+    syst = consumed(True)
+    assert syst <= 1.3 * 8
+    assert syst < plain
+
+
+def test_rateless_systematic_decodes_exactly():
+    """Systematic stream through the real pool path: same exactness as
+    the classic stream (peeling decode unchanged)."""
+    rng = np.random.default_rng(5)
+    A = rng.standard_normal((24, 6)).astype(np.float64)
+    B = rng.standard_normal((6, 5)).astype(np.float64)
+    rg = RatelessLTGemm(A, 4, 4, seed=5, dtype=np.float64,
+                        precision=jax.lax.Precision.HIGHEST)
+    assert rg.code.systematic
+    pool = AsyncPool(4)
+    C = rg.multiply(B, pool)
+    np.testing.assert_allclose(C, A @ B, rtol=1e-9)
+    assert rg.stats["shards_used"] >= 4
+
+
+def test_stale_epoch_arrival_not_retained():
+    """ADVICE r2: a worker completing after multiply() pruned its epoch
+    must not re-create the dead epoch's dict (HBM pin)."""
+    rng = np.random.default_rng(6)
+    A = rng.standard_normal((8, 4)).astype(np.float64)
+    B = rng.standard_normal((4, 3)).astype(np.float64)
+    rg = RatelessLTGemm(A, 2, 2, seed=6, dtype=np.float64)
+    pool = AsyncPool(2)
+    rg.multiply(B, pool)
+    live = rg._live_epoch
+    # simulate a straggler's late completion from a pruned epoch
+    rg._work(0, jnp.asarray(B), live - 1)
+    assert set(rg._collected) == {live}
