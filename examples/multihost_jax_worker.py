@@ -1,9 +1,21 @@
 """Multi-host pool, end-to-end: remote workers running JITTED jax compute.
 
 The reference's multi-host story is ``mpiexec`` + a hostfile
-(test/runtests.jl:17). The equivalent here is one coordinator binding
-the native transport on TCP and each host joining its workers with one
-CLI command — the two-host command pair:
+(test/runtests.jl:17). The equivalent here is ONE command on the
+launching host (round 3 — the launcher fans out over ssh with mpiexec
+hostfile semantics, each host running its rank span; see launch.py):
+
+.. code-block:: console
+
+    python -m mpistragglers_jl_tpu.launch -n 5 --hosts hostA:1,hostB \
+        examples/multihost_spmd.py
+
+(hostA runs the rank-0 coordinator, hostB serves the four workers; the
+launcher owns the TCP rendezvous address and the shared auth secret.)
+
+The manual form remains available when the hosts are not ssh-reachable
+— one coordinator binding the native transport on TCP and each host
+joining its workers with one CLI command:
 
 .. code-block:: console
 

@@ -35,6 +35,27 @@ random auth token, and hands both to every rank through the
 environment (``MSGT_ADDRESS`` / ``MSGT_AUTH`` / ``MSGT_RANK`` /
 ``MSGT_NRANKS``). Rank 0 binds the socket; workers' connect loop
 retries until it is up (worker.py), so start order does not matter.
+
+**Multi-host** (``mpiexec --hostfile`` equivalent, reference
+test/runtests.jl:17 via libmpi):
+
+.. code-block:: console
+
+    python -m mpistragglers_jl_tpu.launch -n 16 --hosts hostA,hostB my_script.py
+    python -m mpistragglers_jl_tpu.launch -n 16 --hostfile hosts.txt my_script.py
+
+Ranks are block-assigned to hosts in order (``hostA:slots`` caps a
+host's share; a hostfile holds one ``host[:slots]`` per line, ``#``
+comments allowed). The first host gets rank 0 and should be the
+launching machine (or reachable at the ``--address`` host). Each
+remote host gets ONE ssh session running this module in span mode
+(``--_span A:B``), which forks its rank processes locally and exits
+with the span's worst code — so a failed remote rank fails the launch
+exactly like a local one. Assumptions are mpiexec's: passwordless ssh
+and the same filesystem layout (script path + package importable) on
+every host. ``--launcher`` substitutes the ssh command (the e2e test
+fakes two hosts as two local process groups with separate tmpdirs
+this way).
 """
 
 from __future__ import annotations
@@ -142,6 +163,205 @@ def init() -> LaunchContext:
     )
 
 
+def parse_hosts(hosts_arg: str | None, hostfile: str | None
+                ) -> list[tuple[str, int | None]]:
+    """``--hosts a,b:4`` / hostfile lines ``host[:slots]`` (or mpiexec's
+    ``host slots=K``) -> [(host, slots-or-None), ...]."""
+    entries: list[str] = []
+    if hosts_arg:
+        entries.extend(h.strip() for h in hosts_arg.split(",") if h.strip())
+    if hostfile:
+        with open(hostfile) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if line:
+                    entries.append(line.replace(" slots=", ":"))
+    out: list[tuple[str, int | None]] = []
+    for e in entries:
+        if ":" in e:
+            host, slots = e.rsplit(":", 1)
+            out.append((host, int(slots)))
+        else:
+            out.append((e, None))
+    return out
+
+
+def assign_ranks(n: int, hosts: list[tuple[str, int | None]]
+                 ) -> list[tuple[str, range]]:
+    """Block-assign ranks 0..n-1 to hosts in order (mpiexec fill
+    semantics): capped hosts take their slot count, uncapped hosts split
+    the remainder evenly (earlier hosts take the extra)."""
+    caps = [s for _, s in hosts]
+    free = [i for i, s in enumerate(caps) if s is None]
+    fixed = sum(s for s in caps if s is not None)
+    rest = n - fixed
+    if free:
+        if rest < 0:
+            raise ValueError(f"host slots sum to {fixed} > -n {n}")
+        share, extra = divmod(max(rest, 0), len(free))
+        for j, i in enumerate(free):
+            caps[i] = share + (1 if j < extra else 0)
+    elif fixed != n:
+        raise ValueError(
+            f"host slots sum to {fixed} but -n is {n}; they must match "
+            "(or leave a host uncapped to absorb the remainder)"
+        )
+    spans, start = [], 0
+    for (host, _), c in zip(hosts, caps):
+        if c:
+            spans.append((host, range(start, start + c)))
+            start += c
+    if start != n:
+        raise ValueError(f"assigned {start} ranks for -n {n}")
+    return spans
+
+
+def _is_local(host: str) -> bool:
+    import socket
+
+    return host in (
+        "localhost", "127.0.0.1", socket.gethostname(),
+        socket.getfqdn(),
+    )
+
+
+def _spawn_rank(r: int, base_env: dict, script: str,
+                script_args: list[str]) -> subprocess.Popen:
+    env = dict(base_env)
+    env[_ENV_RANK] = str(r)
+    return subprocess.Popen(
+        [sys.executable, script, *script_args], env=env
+    )
+
+
+def _remote_cmd(launcher: str, host: str, span: range, base_env: dict,
+                grace: float, script: str, script_args: list[str]
+                ) -> list[str]:
+    """One ssh(-like) invocation running this module in span mode on
+    ``host``. The rendezvous env rides explicit ``env`` assignments
+    (ssh does not forward the environment); cwd is re-entered so the
+    same relative script path resolves (mpiexec's same-layout
+    assumption)."""
+    import shlex
+
+    exports = " ".join(
+        f"{k}={shlex.quote(base_env[k])}"
+        for k in (_ENV_NRANKS, _ENV_ADDRESS, _ENV_AUTH)
+    )
+    remote = (
+        f"cd {shlex.quote(os.getcwd())} && env {exports} "
+        f"{shlex.quote(sys.executable)} -m mpistragglers_jl_tpu.launch "
+        f"--_span {span.start}:{span.stop} --grace {grace} "
+        f"-n {base_env[_ENV_NRANKS]} "
+        + " ".join(shlex.quote(a) for a in [script, *script_args])
+    )
+    return [*shlex.split(launcher), host, remote]
+
+
+def _span_stdin_watchdog(procs: list[subprocess.Popen]) -> None:
+    """Tie a span runner's life to its ssh channel: when the launcher
+    dies or aborts the job, the ssh client goes away, this process's
+    stdin hits EOF, and the watchdog kills the span's rank processes
+    instead of orphaning them on the remote host (ssh without a pty
+    delivers no signal on channel close — EOF on stdin is the only
+    portable death notice). Exits with the span's worst *already
+    observed* rank code so an early rank failure survives a
+    grace-expiry teardown of a hung sibling.
+
+    Armed only when stdin is a pipe or socket (what sshd and the
+    launcher's stdin=PIPE provide): a manual span-mode run with a tty
+    or /dev/null stdin must not see instant EOF and kill its ranks at
+    startup."""
+    import stat
+    import threading
+
+    try:
+        mode = os.fstat(0).st_mode
+    except OSError:  # pragma: no cover - no stdin at all
+        return
+    if not (stat.S_ISFIFO(mode) or stat.S_ISSOCK(mode)):
+        return
+
+    def watch():
+        try:
+            # raw os.read, NOT sys.stdin.buffer: a daemon thread
+            # blocked in a buffered read holds the buffer lock through
+            # interpreter shutdown and CPython aborts with a fatal
+            # _enter_buffered_busy error when the span exits normally
+            while os.read(0, 4096):
+                pass  # the launcher never writes; wait for EOF
+        except OSError:  # pragma: no cover - stdin already closed
+            pass
+        codes = []
+        for p in procs:
+            rc = p.poll()
+            if rc is None:
+                p.terminate()
+            else:
+                codes.append(rc)
+        deadline = time.monotonic() + 5.0
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                p.kill()
+        worst = max(codes, key=abs) if any(codes) else 0
+        os._exit(abs(worst) if worst else 0)
+
+    threading.Thread(target=watch, daemon=True, name="span-watchdog").start()
+
+
+def _wait_span(procs: list[subprocess.Popen], ranks: list[int],
+               grace: float) -> list[int]:
+    """Wait a group of rank processes: if rank 0 is in the group it
+    finishes first (it owns the shutdown broadcast), then the rest get
+    ``grace`` seconds before termination; a group without rank 0 waits
+    for the broadcast-driven exits unboundedly (mpiexec semantics)."""
+    codes: list[int] = []
+    rest = list(zip(ranks, procs))
+    if 0 in ranks:
+        i0 = ranks.index(0)
+        codes.append(procs[i0].wait())
+        rest = [rp for rp in rest if rp[0] != 0]
+        deadline = time.monotonic() + grace
+        for _, p in rest:
+            try:
+                codes.append(
+                    p.wait(timeout=max(0.0, deadline - time.monotonic()))
+                )
+            except subprocess.TimeoutExpired:
+                if p.stdin is not None:
+                    # remote span: closing the ssh channel EOFs the
+                    # remote watchdog, which kills its ranks and exits
+                    # with the span's worst already-observed code —
+                    # collect THAT, so an early remote rank failure is
+                    # not masked by a hung sibling
+                    try:
+                        p.stdin.close()
+                    except OSError:  # pragma: no cover
+                        pass
+                    try:
+                        codes.append(p.wait(timeout=15.0))
+                        continue
+                    except subprocess.TimeoutExpired:  # pragma: no cover
+                        pass
+                # the launcher is killing this rank itself (grace
+                # expired after a clean coordinator exit) — that is
+                # cleanup, not a rank failure, so it must not mask a
+                # real failure code from another rank
+                p.terminate()
+                try:
+                    p.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    p.kill()
+                    p.wait()
+                codes.append(0)
+    else:
+        for _, p in rest:
+            codes.append(p.wait())
+    return codes
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(
         prog="python -m mpistragglers_jl_tpu.launch",
@@ -153,14 +373,34 @@ def main(argv=None) -> None:
                     help="total ranks incl. the coordinator (pool size n-1)")
     ap.add_argument(
         "--address", default=None,
-        help="rendezvous address (default: fresh Unix socket; pass "
-        "tcp://host:port to exercise the TCP transport)",
+        help="rendezvous address (default: fresh Unix socket, or "
+        "tcp://<this-host>:<random port> under --hosts)",
+    )
+    ap.add_argument(
+        "--hosts", default=None,
+        help="comma-separated host[:slots] list; ranks are block-"
+        "assigned in order, the first host takes rank 0 (mpiexec "
+        "hostfile semantics over ssh)",
+    )
+    ap.add_argument(
+        "--hostfile", default=None,
+        help="file of host[:slots] lines (mpiexec 'host slots=K' "
+        "accepted); combined after --hosts",
+    )
+    ap.add_argument(
+        "--launcher", default="ssh -o BatchMode=yes",
+        help="command prefix to reach a remote host (default "
+        "'ssh -o BatchMode=yes'; the e2e test substitutes a local "
+        "fake to model two hosts as two process groups)",
     )
     ap.add_argument(
         "--grace", type=float, default=10.0,
         help="seconds workers get to exit after the coordinator returns "
         "before being terminated",
     )
+    ap.add_argument(
+        "--_span", default=None, help=argparse.SUPPRESS,
+    )  # internal: 'A:B' — run ranks A..B-1 locally (remote side of ssh)
     ap.add_argument("script", help="Python script every rank executes")
     ap.add_argument("script_args", nargs=argparse.REMAINDER,
                     help="arguments passed through to the script")
@@ -168,44 +408,85 @@ def main(argv=None) -> None:
     if args.nranks < 2:
         ap.error("-n must be >= 2 (one coordinator + at least one worker)")
 
-    address = args.address or os.path.join(
-        tempfile.gettempdir(), f"msgt-launch-{uuid.uuid4().hex[:12]}.sock"
-    )
+    if args._span is not None:
+        # span mode: this process IS one host's share of the job; the
+        # rendezvous env was injected by the launching side
+        a, b = (int(x) for x in args._span.split(":"))
+        base_env = dict(os.environ)
+        for key in (_ENV_NRANKS, _ENV_ADDRESS, _ENV_AUTH):
+            if key not in base_env:
+                ap.error(f"span mode requires {key} in the environment")
+        procs = [
+            _spawn_rank(r, base_env, args.script, args.script_args)
+            for r in range(a, b)
+        ]
+        _span_stdin_watchdog(procs)
+        codes = _wait_span(procs, list(range(a, b)), args.grace)
+        sys.exit(max(codes, key=abs) if any(codes) else 0)
+
+    hosts = parse_hosts(args.hosts, args.hostfile)
+    if hosts:
+        spans = assign_ranks(args.nranks, hosts)
+        if args.address is None:
+            import socket
+
+            port = 20000 + secrets.randbelow(40000)
+            # rank 0 binds on the FIRST host, so the address host must
+            # be that machine's name as the OTHER hosts resolve it: the
+            # first --hosts entry verbatim when it is remote, this
+            # machine's hostname when the first entry is a local alias
+            # ("localhost" would make remote workers dial themselves)
+            first = hosts[0][0]
+            host0 = socket.gethostname() if _is_local(first) else first
+            address = f"tcp://{host0}:{port}"
+        else:
+            address = args.address
+        if not address.startswith("tcp://"):
+            ap.error("--hosts requires a tcp:// --address")
+    else:
+        spans = [("localhost", range(args.nranks))]
+        address = args.address or os.path.join(
+            tempfile.gettempdir(), f"msgt-launch-{uuid.uuid4().hex[:12]}.sock"
+        )
     token = secrets.token_hex(16)
-    procs: list[subprocess.Popen] = []
     base_env = dict(os.environ)
     base_env[_ENV_NRANKS] = str(args.nranks)
     base_env[_ENV_ADDRESS] = address
     base_env[_ENV_AUTH] = token
+
+    procs: list[subprocess.Popen] = []
+    ranks_of: list[list[int]] = []  # local rank lists; [-1] = remote span
     try:
-        for r in range(args.nranks):
-            env = dict(base_env)
-            env[_ENV_RANK] = str(r)
-            procs.append(
-                subprocess.Popen(
-                    [sys.executable, args.script, *args.script_args],
-                    env=env,
-                )
-            )
-        # the job is over when the coordinator is: it owns the epoch
-        # loop and broadcasts shutdown on exit (backend.shutdown)
-        rc = procs[0].wait()
-        deadline = time.monotonic() + args.grace
-        codes = [rc]
-        for p in procs[1:]:
-            try:
-                codes.append(p.wait(
-                    timeout=max(0.0, deadline - time.monotonic())
+        for host, span in spans:
+            if _is_local(host):
+                for r in span:
+                    procs.append(
+                        _spawn_rank(r, base_env, args.script,
+                                    args.script_args)
+                    )
+                    ranks_of.append([r])
+            else:
+                # stdin=PIPE, held open for the job's life: the remote
+                # span runner's watchdog treats EOF on this channel as
+                # the launch dying and tears its ranks down (no orphaned
+                # remote processes on abort — see _span_stdin_watchdog)
+                procs.append(subprocess.Popen(
+                    _remote_cmd(
+                        args.launcher, host, span, base_env, args.grace,
+                        args.script, args.script_args,
+                    ),
+                    stdin=subprocess.PIPE,
                 ))
-            except subprocess.TimeoutExpired:
-                p.terminate()
-                try:
-                    codes.append(p.wait(timeout=5.0))
-                except subprocess.TimeoutExpired:  # pragma: no cover
-                    p.kill()
-                    codes.append(p.wait())
+                ranks_of.append([-1] if 0 not in span else [0])
+        flat_ranks = [r for rs in ranks_of for r in rs]
+        codes = _wait_span(procs, flat_ranks, args.grace)
     except KeyboardInterrupt:  # forward ^C to the whole job, mpiexec-style
         for p in procs:
+            if p.stdin is not None:  # remote span: EOF the channel so
+                try:  # the remote watchdog reaps its ranks (a signal
+                    p.stdin.close()  # to the ssh client never crosses)
+                except OSError:
+                    pass
             if p.poll() is None:
                 p.send_signal(signal.SIGINT)
         for p in procs:
@@ -215,12 +496,16 @@ def main(argv=None) -> None:
                 p.kill()
         raise
     finally:
-        if args.address is None and os.path.exists(address):
+        if (
+            args.address is None
+            and not address.startswith("tcp://")
+            and os.path.exists(address)
+        ):
             try:
                 os.unlink(address)
             except OSError:  # pragma: no cover
                 pass
-    # a failed rank fails the launch, like mpiexec
+    # a failed rank (local or remote span) fails the launch, like mpiexec
     sys.exit(max(codes, key=abs) if any(codes) else 0)
 
 
