@@ -57,6 +57,7 @@
 #include <sys/eventfd.h>
 #include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/syscall.h>
 #include <sys/time.h>
 #include <sys/un.h>
 #include <unistd.h>
@@ -357,26 +358,50 @@ void hmac_sha256(const uint8_t* key, size_t keylen, const uint8_t* msg,
   ho.digest(out);
 }
 
-void fill_random(uint8_t* buf, size_t n) {
+bool fill_random(uint8_t* buf, size_t n) {
+  // getrandom(2) first: no fd needed, works in empty containers and
+  // cannot be starved by a chroot without /dev (ADVICE r2: a clock-
+  // seeded fallback makes challenges predictable, enabling MAC replay
+  // — when no strong entropy exists the HANDSHAKE must fail, not
+  // degrade; callers with a token configured treat false as fatal)
+  size_t got = 0;
+  while (got < n) {
+    long r = ::syscall(SYS_getrandom, buf + got, n - got, 0);
+    if (r > 0) {
+      got += static_cast<size_t>(r);
+      continue;
+    }
+    if (r < 0 && errno == EINTR) continue;
+    break;  // ENOSYS (pre-3.17 kernel) or other failure: try urandom
+  }
+  if (got == n) return true;
   int fd = ::open("/dev/urandom", O_RDONLY | O_CLOEXEC);
   if (fd >= 0) {
     bool ok = read_full(fd, buf, n);
     ::close(fd);
-    if (ok) return;
+    if (ok) return true;
   }
-  // no /dev/urandom: degrade to clock+address entropy — still unique
-  // per handshake, which is what the challenge needs
-  uint64_t seed =
-      std::chrono::steady_clock::now().time_since_epoch().count() ^
-      reinterpret_cast<uintptr_t>(buf);
-  for (size_t i = 0; i < n; i++) {
-    seed = seed * 6364136223846793005ull + 1442695040888963407ull;
-    buf[i] = uint8_t(seed >> 33);
-  }
+  return false;
 }
 
 constexpr size_t kChallengeLen = 16;
 constexpr size_t kMacLen = 32;
+
+// Direction tags for the mutual handshake's domain separation: the
+// worker proves HMAC(token, 0x01||C), the coordinator proves
+// HMAC(token, 0x02||W) — a transcript from one direction can never be
+// replayed as the other's proof.
+constexpr uint8_t kTagWorkerProof = 0x01;
+constexpr uint8_t kTagCoordProof = 0x02;
+
+void hmac_tagged(const std::string& token, uint8_t tag,
+                 const uint8_t* challenge, size_t len, uint8_t out[32]) {
+  uint8_t buf[1 + kChallengeLen];
+  buf[0] = tag;
+  std::memcpy(buf + 1, challenge, len);
+  hmac_sha256(reinterpret_cast<const uint8_t*>(token.data()),
+              token.size(), buf, 1 + len, out);
+}
 
 // Per-peer connection state owned by the progress thread.
 struct Peer {
@@ -688,27 +713,41 @@ bool worker_read_full(WorkerCtx* w, void* buf, size_t n) {
 // Coordinator side of the hello auth exchange, run with SO_RCVTIMEO
 // still armed on `fd`. Always sends an ack frame telling the worker
 // whether a proof is required (len = challenge size, or 0 for open
-// transports), then verifies HMAC(token, challenge) when it is.
+// transports). The exchange is MUTUAL (ADVICE r2: one-way auth let a
+// rogue listener that issues a fake challenge feed the worker pickled
+// frames): the worker returns HMAC(token, 0x01||C) plus its own
+// challenge W, and the coordinator must answer HMAC(token, 0x02||W)
+// before the worker enters the data phase — the multiprocessing-
+// authkey pattern, both directions.
 bool verify_hello_auth(Coordinator* c, int fd) {
   if (c->token.empty()) {
     Header ack{0, 0, 0, 0, KIND_HELLO};
     return write_full(fd, &ack, sizeof(ack));
   }
   uint8_t challenge[kChallengeLen];
-  fill_random(challenge, sizeof(challenge));
+  if (!fill_random(challenge, sizeof(challenge)))
+    return false;  // no strong entropy + token configured: fail closed
   Header ack{kChallengeLen, 0, 0, 0, KIND_HELLO};
   if (!write_full(fd, &ack, sizeof(ack))) return false;
   if (!write_full(fd, challenge, sizeof(challenge))) return false;
   Header resp{};
   if (!read_full(fd, &resp, sizeof(resp))) return false;
-  if (resp.kind != KIND_HELLO || resp.len != kMacLen) return false;
-  uint8_t mac[kMacLen], expect[kMacLen];
+  if (resp.kind != KIND_HELLO ||
+      resp.len != static_cast<int64_t>(kMacLen + kChallengeLen))
+    return false;
+  uint8_t mac[kMacLen], wchal[kChallengeLen], expect[kMacLen];
   if (!read_full(fd, mac, sizeof(mac))) return false;
-  hmac_sha256(reinterpret_cast<const uint8_t*>(c->token.data()),
-              c->token.size(), challenge, sizeof(challenge), expect);
+  if (!read_full(fd, wchal, sizeof(wchal))) return false;
+  hmac_tagged(c->token, kTagWorkerProof, challenge, kChallengeLen, expect);
   uint8_t diff = 0;  // constant-time compare
   for (size_t i = 0; i < kMacLen; i++) diff |= mac[i] ^ expect[i];
-  return diff == 0;
+  if (diff != 0) return false;
+  // prove ourselves back: the worker rejects the transport otherwise
+  uint8_t proof[kMacLen];
+  hmac_tagged(c->token, kTagCoordProof, wchal, kChallengeLen, proof);
+  Header ph{kMacLen, 0, 0, 0, KIND_HELLO};
+  if (!write_full(fd, &ph, sizeof(ph))) return false;
+  return write_full(fd, proof, sizeof(proof));
 }
 
 // Accept one connection, read its hello frame, and run the auth
@@ -1325,12 +1364,38 @@ void* msgt_worker_connect(const char* addr_str, int rank,
       delete w;
       return nullptr;
     }
-    hmac_sha256(token, static_cast<size_t>(token_len), challenge,
-                sizeof(challenge), mac);
-    Header resp{kMacLen, rank, 0, 0, KIND_HELLO};
-    if (!write_full(w->fd, &resp, sizeof(resp)) ||
-        !write_full(w->fd, mac, sizeof(mac))) {
+    const std::string tok(reinterpret_cast<const char*>(token),
+                          static_cast<size_t>(token_len));
+    hmac_tagged(tok, kTagWorkerProof, challenge, sizeof(challenge), mac);
+    // mutual auth (ADVICE r2): attach our own challenge and demand the
+    // peer prove knowledge of the token before we unpickle anything it
+    // sends — a rogue listener that merely issues a 16-byte challenge
+    // must not pass. No strong entropy for W => abort, never degrade.
+    uint8_t wchal[kChallengeLen];
+    if (!fill_random(wchal, sizeof(wchal))) {
       delete w;
+      return nullptr;
+    }
+    Header resp{kMacLen + kChallengeLen, rank, 0, 0, KIND_HELLO};
+    if (!write_full(w->fd, &resp, sizeof(resp)) ||
+        !write_full(w->fd, mac, sizeof(mac)) ||
+        !write_full(w->fd, wchal, sizeof(wchal))) {
+      delete w;
+      return nullptr;
+    }
+    Header ph{};
+    uint8_t proof[kMacLen], expect[kMacLen];
+    if (!read_full(w->fd, &ph, sizeof(ph)) || ph.kind != KIND_HELLO ||
+        ph.len != static_cast<int64_t>(kMacLen) ||
+        !read_full(w->fd, proof, sizeof(proof))) {
+      delete w;
+      return nullptr;
+    }
+    hmac_tagged(tok, kTagCoordProof, wchal, sizeof(wchal), expect);
+    uint8_t diff = 0;  // constant-time compare
+    for (size_t i = 0; i < kMacLen; i++) diff |= proof[i] ^ expect[i];
+    if (diff != 0) {
+      delete w;  // peer holds the socket but not the secret
       return nullptr;
     }
   }

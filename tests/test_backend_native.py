@@ -682,13 +682,14 @@ def test_auth_rejects_wrong_and_missing_token():
         try:
             w = T.Worker(path, 0, token=token)
         except T.TransportError:
-            # no token to answer the challenge with: fails at connect
+            # no token: can't answer the challenge, fails at connect.
+            # wrong token: round 3's MUTUAL handshake also fails at
+            # connect — the coordinator rejects the worker's proof and
+            # closes before sending its own, so the worker never
+            # receives the coordinator proof it now requires
             outcomes.append("refused-at-connect")
             return
-        # wrong token: the worker can't distinguish acceptance until it
-        # reads — the coordinator drops the connection after the failed
-        # proof, so the first recv reports the coordinator gone
-        outcomes.append("closed" if w.recv() is None else "admitted")
+        outcomes.append("admitted")  # must not happen
         w.close()
 
     threads = [
@@ -702,9 +703,70 @@ def test_auth_rejects_wrong_and_missing_token():
             coord.accept(timeout=1.0)  # no impostor is ever admitted
         for t in threads:
             t.join(timeout=10)
-        assert sorted(outcomes) == ["closed", "refused-at-connect"]
+        assert outcomes == ["refused-at-connect", "refused-at-connect"]
     finally:
         coord.close()
+
+
+def test_worker_rejects_rogue_coordinator():
+    """ADVICE r2 (medium): the handshake is mutual. A rogue listener
+    that wins the bind race and ISSUES a well-formed challenge — the
+    exact scenario one-way auth waved through — must be rejected by
+    the worker, because it cannot produce HMAC(token, 0x02||W) for the
+    worker's own challenge W. The worker must fail at connect and
+    never enter the data phase (where frames get unpickled)."""
+    import socket
+    import struct
+    import tempfile
+    import threading
+    import uuid
+
+    path = os.path.join(
+        tempfile.gettempdir(), f"msgt-rogue-{uuid.uuid4().hex[:8]}.sock"
+    )
+    HDR = struct.Struct("<5q")  # len, seq, epoch, tag, kind (KIND_HELLO=2)
+    saw = {}
+    bound = threading.Event()
+
+    def rogue():
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(path)
+        srv.listen(1)
+        srv.settimeout(10)
+        bound.set()
+        conn, _ = srv.accept()
+        conn.settimeout(10)
+        try:
+            hello = conn.recv(HDR.size, socket.MSG_WAITALL)
+            saw["hello"] = HDR.unpack(hello)
+            # issue a perfectly-formed 16-byte challenge like a real
+            # coordinator would
+            conn.sendall(HDR.pack(16, 0, 0, 0, 2) + b"C" * 16)
+            # the worker answers mac(32) + its challenge W(16)
+            resp = conn.recv(HDR.size + 48, socket.MSG_WAITALL)
+            saw["resp_len"] = HDR.unpack(resp[: HDR.size])[0]
+            # ...but we don't know the token: send a garbage proof
+            conn.sendall(HDR.pack(32, 0, 0, 0, 2) + b"X" * 32)
+            # if the worker were fooled it would proceed to the data
+            # phase; give it a beat, then see if it sent anything more
+            conn.settimeout(1.0)
+            try:
+                saw["post"] = conn.recv(4096)
+            except socket.timeout:
+                saw["post"] = b""
+        finally:
+            conn.close()
+            srv.close()
+
+    t = threading.Thread(target=rogue, daemon=True)
+    t.start()
+    assert bound.wait(timeout=10)
+    with pytest.raises(T.TransportError):
+        T.Worker(path, 0, token=b"s3cret")
+    t.join(timeout=15)
+    assert saw["hello"][4] == 2  # worker sent a hello
+    assert saw["resp_len"] == 48  # mac + worker challenge: mutual form
+    assert saw.get("post", b"") == b""  # no data ever followed
 
 
 def test_spawned_backend_auto_auth_end_to_end():
