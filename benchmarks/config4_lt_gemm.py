@@ -34,18 +34,62 @@ DELAY_S = 5.0
 EPOCHS = 3
 
 
+def _run_chained(A, B, precision, C_ref, ref_scale, fence, maxabs):
+    """One precision rung: chained epochs, one fence, min of 3 chains.
+    Returns (t_coded, err, fresh_counts, rtt, t_all)."""
+    import numpy as np
+
+    delay_fn = lambda i, e: DELAY_S if i in STRAGGLERS else 0.0
+    lt = LTCodedGemm(
+        A, N_WORKERS, K,
+        delay_fn=delay_fn,
+        precision=precision,
+    )
+    pool = AsyncPool(N_WORKERS)
+    try:
+        asyncmap(pool, B, lt.backend, nwait=lt.nwait)  # warmup
+        float(fence(lt.result_device(pool)))
+        waitall(pool, lt.backend, timeout=3 * DELAY_S)
+
+        z = jax.device_put(np.ones(8, np.float32), lt.devices[0])
+        float(fence(z))
+        rtts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(fence(z))
+            rtts.append(time.perf_counter() - t0)
+        rtt = min(rtts)
+
+        chain_s, fresh_counts = [], []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(EPOCHS):
+                repochs = asyncmap(pool, B, lt.backend, nwait=lt.nwait)
+                fresh_counts.append(int((repochs == pool.epoch).sum()))
+                C = lt.result_device(pool)
+            float(fence(C))  # in-order device stream: covers every epoch
+            chain_s.append((time.perf_counter() - t0 - rtt) / EPOCHS)
+        t_coded = min(chain_s)
+        err = float(maxabs(C, C_ref)) / ref_scale
+        waitall(pool, lt.backend, timeout=3 * DELAY_S)
+
+        # baseline: bulk-synchronous epoch, pays the injected stragglers
+        t0 = time.perf_counter()
+        asyncmap(pool, B, lt.backend, nwait=N_WORKERS)
+        C_all = lt.result_device(pool)
+        float(fence(C_all))
+        t_all = time.perf_counter() - t0
+        return t_coded, err, fresh_counts, rtt, t_all
+    finally:
+        lt.backend.shutdown()
+
+
 def main():
     key = jax.random.key(0)
     ka, kb = jax.random.split(key)
     A = jax.random.normal(ka, (M, KDIM), jnp.float32)
     B = jax.random.normal(kb, (KDIM, NCOLS), jnp.float32)
 
-    delay_fn = lambda i, e: DELAY_S if i in STRAGGLERS else 0.0
-    lt = LTCodedGemm(
-        A, N_WORKERS, K,
-        delay_fn=delay_fn,
-        precision=jax.lax.Precision.HIGHEST,
-    )
     fence = jax.jit(jnp.sum)
     maxabs = jax.jit(lambda c, r: jnp.max(jnp.abs(c - r)))
 
@@ -55,31 +99,14 @@ def main():
     )(A, B)
     ref_scale = float(jnp.max(jnp.abs(C_ref)))
 
-    pool = AsyncPool(N_WORKERS)
-    # warmup epoch: compiles + decode + fence (all workers, untimed)
-    asyncmap(pool, B, lt.backend, nwait=lt.nwait)
-    float(fence(lt.result_device(pool)))
-    waitall(pool, lt.backend)
-
-    times, fresh_counts = [], []
-    for _ in range(EPOCHS):
-        t0 = time.perf_counter()
-        repochs = asyncmap(pool, B, lt.backend, nwait=lt.nwait)
-        fresh_counts.append(int((repochs == pool.epoch).sum()))
-        C = lt.result_device(pool)
-        float(fence(C))
-        times.append(time.perf_counter() - t0)
-        waitall(pool, lt.backend)
-    t_coded = min(times)
-    err = float(maxabs(C, C_ref)) / ref_scale
-
-    # baseline: bulk-synchronous epoch, pays the injected stragglers
-    t0 = time.perf_counter()
-    asyncmap(pool, B, lt.backend, nwait=N_WORKERS)
-    C_all = lt.result_device(pool)
-    float(fence(C_all))
-    t_all = time.perf_counter() - t0
-    lt.backend.shutdown()
+    t_coded, err, fresh_counts, rtt, t_all = _run_chained(
+        A, B, jax.lax.Precision.HIGHEST, C_ref, ref_scale, fence, maxabs
+    )
+    # DEFAULT-precision rung: same epochs, same f32 decode — decode
+    # success is unchanged, the worker matmuls ride the fast passes
+    t_def, err_def, _, _, _ = _run_chained(
+        A, B, None, C_ref, ref_scale, fence, maxabs
+    )
 
     print(json.dumps({
         "metric": "lt-coded-gemm-16384-16w-wallclock",
@@ -92,8 +119,17 @@ def main():
         "decode_rel_err": err,
         "gflops_per_chip": round(2.0 * M * KDIM * NCOLS / t_coded / 1e9, 1),
         "injected_straggler_delay_s": DELAY_S,
+        "epochs_pipelined": EPOCHS,
+        "chains_min_of": 3,
+        "fence_rtt_s": round(rtt, 4),
+        "default_precision_rung": {
+            "value": round(t_def, 4),
+            "gflops_per_chip": round(
+                2.0 * M * KDIM * NCOLS / t_def / 1e9, 1
+            ),
+            "decode_rel_err": err_def,
+        },
     }))
-
 
 def main_rateless():
     """Incremental redundancy under a PERMANENT straggler: the static
@@ -112,10 +148,13 @@ def main_rateless():
     B = rng.standard_normal((kdim, ncols)).astype(np.float32)
     dead = 0  # permanent straggler: never returns within any round
 
-    # seed 16: worker 0's shard is load-bearing — the static window
-    # minus it does NOT peel, so decode REQUIRES generation-1 draws
+    # seed 16 + systematic=False: worker 0's CLASSIC-stream shard is
+    # load-bearing — the static window minus it does NOT peel, so
+    # decode REQUIRES generation-1 draws (the systematic default would
+    # peel this trace within generation 0 and demonstrate nothing; its
+    # overhead win is measured by bench.py's rateless_overhead rung)
     rg = RatelessLTGemm(
-        A, n, k, seed=16,
+        A, n, k, seed=16, systematic=False,
         delay_fn=lambda i, e: 3600.0 if i == dead else 0.0,
         precision=jax.lax.Precision.HIGHEST,
     )
@@ -129,12 +168,23 @@ def main_rateless():
 
         from mpistragglers_jl_tpu.backends.base import WorkerError
 
-        rg.backend.dispatch(1, jnp_.asarray(B), 0)
+        # B goes device-resident FIRST: a host payload would re-ride
+        # the ~26 MB/s tunnel H2D edge (256 MB ~ 10 s) inside every
+        # round and can blow the round timeout outright (observed
+        # round 3); HBM residency is the coordinator working-memory
+        # discipline every other config follows
+        B_dev = jax.device_put(jnp_.asarray(B), jax.devices()[0])
+        # classic streams build the device source stack on the first
+        # fresh-generation draw — a full A upload; pull it off the
+        # clock (and out of the round timeouts) like every other
+        # one-time setup cost
+        rg.prefetch_source()
+        rg.backend.dispatch(1, B_dev, 0)
         warm = rg.backend.wait(1, timeout=600)
         if warm is None or isinstance(warm, WorkerError):
             raise RuntimeError(f"warmup failed: {warm!r}")
         t0 = time.perf_counter()
-        C = rg.multiply(B, pool, round_timeout=15.0, max_rounds=4)
+        C = rg.multiply(B_dev, pool, round_timeout=60.0, max_rounds=4)
         wall = time.perf_counter() - t0
         err = float(np.max(np.abs(C - A @ B))) / float(np.max(np.abs(C)))
         print(json.dumps({

@@ -55,7 +55,14 @@ def main():
         lt.backend.shutdown()
 
     # --- rateless: generation-1 draws repair it -----------------------
-    rg = RatelessLTGemm(A, N, K, seed=SEED, delay_fn=permanent_straggler)
+    # systematic=False: this example demonstrates the CLASSIC stream's
+    # incremental redundancy (fresh generation-1 draws rescuing an
+    # undecodable window). The systematic default (round 3) peels this
+    # trace within generation 0 — better in production, but then there
+    # is nothing to demonstrate; its overhead win is measured by
+    # bench.py's rateless_overhead rung.
+    rg = RatelessLTGemm(A, N, K, seed=SEED, delay_fn=permanent_straggler,
+                        systematic=False)
     try:
         pool = AsyncPool(N)
         C = rg.multiply(B, pool, round_timeout=3.0, max_rounds=6)

@@ -201,3 +201,31 @@ def test_stale_epoch_arrival_not_retained():
     # simulate a straggler's late completion from a pruned epoch
     rg._work(0, jnp.asarray(B), live - 1)
     assert set(rg._collected) == {live}
+
+
+def test_device_src_single_flight():
+    """Round-3 fix: concurrent fresh-generation draws must share ONE
+    device source stack — the old racing None-check paid n-1 serialized
+    full-A uploads through the tunnel and blew every round timeout."""
+    import threading
+
+    rng = np.random.default_rng(7)
+    A = rng.standard_normal((16, 4)).astype(np.float64)
+    rg = RatelessLTGemm(A, 4, 4, seed=7, dtype=np.float64)
+    dev = rg.devices[0]
+    results, barrier = [], threading.Barrier(6)
+
+    def grab():
+        barrier.wait()
+        results.append(rg._device_src(dev))
+
+    threads = [threading.Thread(target=grab) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(results) == 6
+    assert all(r is results[0] for r in results)  # one object, shared
+    # systematic stream: the stack matches the host source exactly and
+    # was built from the resident identity blocks (no fresh upload)
+    np.testing.assert_array_equal(np.asarray(results[0]), rg._src)
