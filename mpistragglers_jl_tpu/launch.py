@@ -178,7 +178,22 @@ def parse_hosts(hosts_arg: str | None, hostfile: str | None
                     entries.append(line.replace(" slots=", ":"))
     out: list[tuple[str, int | None]] = []
     for e in entries:
-        if ":" in e:
+        if e.startswith("["):  # bracketed IPv6 literal: [addr][:slots]
+            addr, _, rest = e[1:].partition("]")
+            if rest.startswith(":"):
+                out.append((addr, int(rest[1:])))
+            elif not rest:
+                out.append((addr, None))
+            else:
+                raise ValueError(f"malformed host entry {e!r}")
+        elif e.count(":") > 1:
+            # a bare IPv6 literal is ambiguous with host:slots —
+            # rsplit would silently eat the last address group
+            raise ValueError(
+                f"ambiguous host entry {e!r}: bracket IPv6 literals "
+                "([fe80::1] or [fe80::1]:4)"
+            )
+        elif ":" in e:
             host, slots = e.rsplit(":", 1)
             out.append((host, int(slots)))
         else:
@@ -246,8 +261,12 @@ def _remote_cmd(launcher: str, host: str, span: range, base_env: dict,
 
     exports = " ".join(
         f"{k}={shlex.quote(base_env[k])}"
-        for k in (_ENV_NRANKS, _ENV_ADDRESS, _ENV_AUTH)
+        for k in (_ENV_NRANKS, _ENV_ADDRESS)
     )
+    # the auth secret is deliberately NOT in the exports: anything on
+    # the ssh command line lands in `ps` output on BOTH hosts for the
+    # job's lifetime. It rides the already-open stdin pipe instead
+    # (first line; see the span-mode reader in main)
     remote = (
         f"cd {shlex.quote(os.getcwd())} && env {exports} "
         f"{shlex.quote(sys.executable)} -m mpistragglers_jl_tpu.launch "
@@ -413,7 +432,18 @@ def main(argv=None) -> None:
         # rendezvous env was injected by the launching side
         a, b = (int(x) for x in args._span.split(":"))
         base_env = dict(os.environ)
-        for key in (_ENV_NRANKS, _ENV_ADDRESS, _ENV_AUTH):
+        if _ENV_AUTH not in base_env:
+            # the secret arrives as the FIRST stdin line (never on the
+            # ssh command line — argv is world-readable via ps); read
+            # it before the watchdog takes over the pipe
+            line = sys.stdin.buffer.readline()
+            if not line.strip():
+                ap.error(
+                    f"span mode needs {_ENV_AUTH} in the environment or "
+                    "the secret on the first stdin line"
+                )
+            base_env[_ENV_AUTH] = line.strip().decode()
+        for key in (_ENV_NRANKS, _ENV_ADDRESS):
             if key not in base_env:
                 ap.error(f"span mode requires {key} in the environment")
         procs = [
@@ -470,13 +500,18 @@ def main(argv=None) -> None:
                 # span runner's watchdog treats EOF on this channel as
                 # the launch dying and tears its ranks down (no orphaned
                 # remote processes on abort — see _span_stdin_watchdog)
-                procs.append(subprocess.Popen(
+                p = subprocess.Popen(
                     _remote_cmd(
                         args.launcher, host, span, base_env, args.grace,
                         args.script, args.script_args,
                     ),
                     stdin=subprocess.PIPE,
-                ))
+                )
+                # first stdin line = the auth secret (see _remote_cmd);
+                # the pipe then stays open as the job-liveness channel
+                p.stdin.write((token + "\n").encode())
+                p.stdin.flush()
+                procs.append(p)
                 ranks_of.append([-1] if 0 not in span else [0])
         flat_ranks = [r for rs in ranks_of for r in rs]
         codes = _wait_span(procs, flat_ranks, args.grace)

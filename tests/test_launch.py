@@ -169,3 +169,28 @@ def test_multihost_remote_rank_failure_propagates(tmp_path):
         capture_output=True, text=True, timeout=180, env=env,
     )
     assert proc.returncode == 7, (proc.returncode, proc.stderr[-2000:])
+
+
+def test_parse_hosts_ipv6():
+    import pytest
+
+    from mpistragglers_jl_tpu.launch import parse_hosts
+
+    assert parse_hosts("[fe80::1]:4,[::1]", None) == [
+        ("fe80::1", 4), ("::1", None)
+    ]
+    with pytest.raises(ValueError, match="bracket IPv6"):
+        parse_hosts("fe80::1", None)
+
+
+def test_remote_cmd_keeps_secret_off_argv():
+    """The auth token must never appear on the ssh command line (argv
+    is world-readable via ps on both hosts); it rides stdin."""
+    from mpistragglers_jl_tpu.launch import _remote_cmd
+
+    env = {"MSGT_NRANKS": "4", "MSGT_ADDRESS": "tcp://h:1", 
+           "MSGT_AUTH": "topsecret123"}
+    cmd = _remote_cmd("ssh", "hostB", range(1, 4), env, 5.0,
+                      "job.py", [])
+    assert not any("topsecret123" in part for part in cmd)
+    assert any("MSGT_ADDRESS" in part for part in cmd)
