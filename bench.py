@@ -158,7 +158,7 @@ def bench_coded_gemm(m=8192, kdim=8192, ncols=8192, n=8, k=6, epochs=7):
     bf16_peak = raw_rate(jax.lax.Precision.DEFAULT)
 
     return {
-        "metric": "mds-coded-gemm-8192-n8k6-wallclock",
+        "metric": f"mds-coded-gemm-{m}-n{n}k{k}-wallclock",
         "value": round(tpu_s, 4),
         "unit": "s",
         "vs_baseline": round(cpu_s / tpu_s, 2),
@@ -207,15 +207,18 @@ def bench_rateless_overhead(m=2048, ncols=256, n=8, k=8, seeds=(0, 1, 2)):
     A = rng.standard_normal((m, 512)).astype(np.float32)
     B = rng.standard_normal((512, ncols)).astype(np.float32)
 
-    # staggered arrivals (25-100 ms, deterministic): at full scale each
-    # shard's matmul takes real time, so the decodability predicate —
-    # re-evaluated per arrival — stops the stream at the first covering
-    # shard. With instant toy shards a whole round lands between
-    # predicate evaluations and the measured overhead is round-
-    # granular, not draw-granular; the stagger restores the statistic
-    # the full-scale run exhibits.
+    # staggered arrivals (0.15-0.6 s, deterministic): at full scale
+    # each shard's matmul takes real time, so the decodability
+    # predicate — re-evaluated per arrival — stops the stream at the
+    # first covering shard. With instant toy shards a whole round
+    # lands between predicate evaluations and the measured overhead is
+    # round-granular, not draw-granular. The stagger must also
+    # dominate the tunnel's per-dispatch jitter (~10-30 ms), or chip
+    # noise re-bunches arrivals — 25 ms steps measured round-granular
+    # on the real chip where the same code measured draw-granular on
+    # CPU.
     def delays(i, e):
-        return 3600.0 if i == 3 else 0.025 * ((i * 7 + e) % 4 + 1)
+        return 3600.0 if i == 3 else 0.15 * ((i * 7 + e) % 4 + 1)
 
     out = {}
     for name, syst in (("systematic", True), ("classic", False)):
@@ -226,7 +229,14 @@ def bench_rateless_overhead(m=2048, ncols=256, n=8, k=8, seeds=(0, 1, 2)):
             )
             try:
                 pool = AsyncPool(n)
-                C = rg.multiply(B, pool, round_timeout=2.0, max_rounds=8)
+                # warmup multiply, discarded: first-use compiles (the
+                # device-src stack, encode, matmul) run ~10 s each
+                # through the tunnel's remote-compile path and would
+                # otherwise land inside the measured rounds' timeouts
+                # and bunch arrivals into round-granular counts
+                rg.prefetch_source()
+                rg.multiply(B, pool, round_timeout=20.0, max_rounds=8)
+                C = rg.multiply(B, pool, round_timeout=6.0, max_rounds=8)
                 err = float(np.max(np.abs(C - A @ B))) / float(
                     np.max(np.abs(C))
                 )
@@ -421,27 +431,32 @@ def bench_uncoded_gemm(m=4096, k=4096, n=4096, n_workers=4, epochs=40):
     cpu_s = time.perf_counter() - t0
     del A0, B0
 
-    default_4096 = run_rung(m, None, epochs)
-    highest_4096 = run_rung(m, jax.lax.Precision.HIGHEST, epochs)
-    rung_8192 = run_rung(8192, None, max(epochs // 2, 10))
+    default_rung = run_rung(m, None, epochs)
+    highest_rung = run_rung(m, jax.lax.Precision.HIGHEST, epochs)
 
-    tpu_s = default_4096["per_epoch_ms"] / 1e3
-    return {
-        "metric": "uncoded-gemm-4096-wallclock",
+    tpu_s = default_rung["per_epoch_ms"] / 1e3
+    out = {
+        "metric": f"uncoded-gemm-{m}-wallclock",
         "value": round(tpu_s, 5),
         "unit": "s",
+        "size": m,
         "vs_baseline": round(cpu_s / tpu_s, 2),
         "cpu_baseline_s": round(cpu_s, 3),
         "fence_rtt_s": round(rtt, 4),
         "epochs_pipelined": epochs,
         "chains_min_of": 3,
         "arrival_mode": "enqueue",
-        # 4096/DEFAULT is dispatch-bound (compute ~= host enqueue):
-        # the two rungs below isolate utilization where compute wins
-        "default_4096": default_4096,
-        "highest_4096": highest_4096,
-        "default_8192_rung": rung_8192,
+        # small-size/DEFAULT is dispatch-bound (compute ~= host
+        # enqueue): the rungs isolate utilization where compute wins
+        "default": default_rung,
+        "highest": highest_rung,
     }
+    if m < 8192:
+        # fixed amortization rung — pointless (and a duplicate
+        # multi-minute measurement) when the primary size is already
+        # there, e.g. under the config2 CLI's --size sweep
+        out["default_8192_rung"] = run_rung(8192, None, max(epochs // 2, 10))
+    return out
 
 
 if __name__ == "__main__":

@@ -1,8 +1,19 @@
-"""BASELINE config 2: uncoded distributed GEMM 4096^2, nwait=n.
+"""BASELINE config 2: uncoded distributed GEMM, ``nwait = n``.
 
-Thin wrapper over the repo-root bench module's secondary metric.
+CLI front-end over the repo-root bench's measurement (one JSON line per
+size), parameterized so the amortization story is reproducible at any
+rung — the 4096³/DEFAULT point is dispatch-bound by construction and
+only a sweep shows where compute takes over (docs/PERF.md "Config 2
+closed"):
+
+.. code-block:: console
+
+    python benchmarks/config2_uncoded_gemm.py                 # default 4096
+    python benchmarks/config2_uncoded_gemm.py --size 8192 --workers 8
+    python benchmarks/config2_uncoded_gemm.py --size 2048 4096 8192
 """
 
+import argparse
 import json
 import os
 import sys
@@ -11,5 +22,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import bench_uncoded_gemm
 
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--size", type=int, nargs="+", default=[4096],
+        help="square GEMM size(s); one JSON line per size",
+    )
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument(
+        "--epochs", type=int, default=40,
+        help="pipelined epochs per chain (min of 3 chains)",
+    )
+    args = ap.parse_args(argv)
+    for m in args.size:
+        print(json.dumps(bench_uncoded_gemm(
+            m=m, k=m, n=m, n_workers=args.workers, epochs=args.epochs,
+        )))
+
+
 if __name__ == "__main__":
-    print(json.dumps(bench_uncoded_gemm()))
+    main()

@@ -194,3 +194,14 @@ def test_remote_cmd_keeps_secret_off_argv():
                       "job.py", [])
     assert not any("topsecret123" in part for part in cmd)
     assert any("MSGT_ADDRESS" in part for part in cmd)
+
+
+def test_multihost_spmd_example_single_host():
+    """The one-liner example (examples/multihost_spmd.py) also runs
+    single-host under the launcher — same script, no --hosts."""
+    proc = _run_launcher(
+        3, os.path.join(REPO, "examples", "multihost_spmd.py"),
+        timeout=150,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "done: workers=2" in proc.stdout
