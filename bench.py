@@ -255,9 +255,12 @@ def bench_rateless_overhead(m=2048, ncols=256, n=8, k=8, seeds=(0, 1, 2)):
 
 
 def _transformer_rungs():
-    """Flagship train-step metric + a larger-model MFU rung (MFU rises
-    with d_model as the GEMMs fatten; the 470M rung shows the headroom
-    the 134M default leaves on the table)."""
+    """Flagship train-step metric + two rungs: a larger model (MFU
+    rises with d_model as the GEMMs fatten — the 470M rung shows the
+    headroom the 134M default leaves on the table) and long context
+    (16 k tokens in one sequence through the flash kernels, dense-
+    oracle-checked; the 32 k point, where the materializing oracle
+    cannot even fit, is recorded in docs/PERF.md)."""
     tt = bench_transformer_train()
     big = bench_transformer_train(
         batch=4, d_model=2048, n_heads=16, d_ff=8192, steps=3, chains=2
@@ -270,6 +273,18 @@ def _transformer_rungs():
             "model_tflops_per_s",
             "mfu_vs_raw_matmul",
             "params_m",
+        )
+    }
+    lc = bench_transformer_train(batch=1, seq=16384, steps=3, chains=2)
+    tt["long_context_rung"] = {
+        k: lc[k]
+        for k in (
+            "value",
+            "tokens_per_s",
+            "model_tflops_per_s",
+            "mfu_vs_raw_matmul",
+            "seq",
+            "loss_vs_oracle_rel_err",
         )
     }
     return tt

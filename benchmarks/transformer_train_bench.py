@@ -80,6 +80,7 @@ def bench_transformer_train(
     n_heads: int = 8,
     d_ff: int = 4096,
     vocab: int = 32768,
+    oracle: bool = True,
 ) -> dict:
     import jax
     import jax.numpy as jnp
@@ -136,7 +137,13 @@ def bench_transformer_train(
         nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
         return nll.mean()
 
-    loss_oracle = float(oracle_loss(params, inp, tgt))
+    # oracle=False for sequence lengths where the MATERIALIZING
+    # reference cannot fit (B*H*L^2 f32 scores — reference_attention
+    # accumulates in float32, so L=32k is ~34 GB for the score
+    # matrices alone): flash attention existing is precisely what
+    # makes those lengths runnable, and their numerics are covered by
+    # the shorter oracled rungs
+    loss_oracle = float(oracle_loss(params, inp, tgt)) if oracle else None
 
     # warmup: compiles the full program (flash fwd + bwd under Mosaic,
     # shard_map collectives, donated update). Failure here IS the
@@ -223,9 +230,12 @@ def bench_transformer_train(
         "loss_first": round(loss0, 4),
         "loss_last": round(float(loss), 4),
         "loss_decreased": bool(sanity),
-        "loss_oracle": round(loss_oracle, 4),
-        "loss_vs_oracle_rel_err": round(
-            abs(loss0 - loss_oracle) / max(abs(loss_oracle), 1e-9), 6
+        "loss_oracle": (
+            round(loss_oracle, 4) if loss_oracle is not None else None
+        ),
+        "loss_vs_oracle_rel_err": (
+            round(abs(loss0 - loss_oracle) / max(abs(loss_oracle), 1e-9), 6)
+            if loss_oracle is not None else None
         ),
         "compile_s": round(compile_s, 1),
         "fence_rtt_s": round(rtt, 4),
