@@ -95,6 +95,13 @@ class TransformerConfig:
     # bit-identical to the dense oracle (local vs global token means
     # differ), nonzero is what real training wants
     moe_aux_coef: float = 0.0
+    # remat=True wraps every transformer layer in jax.checkpoint: the
+    # backward recomputes the layer's activations instead of keeping
+    # them resident — the standard FLOPs-for-HBM trade for long
+    # sequences / deep stacks. Same math: loss matches exactly and
+    # gradients to float tolerance (rtol 1e-6, since the recomputed
+    # backward may fuse/order differently — tests/test_transformer.py).
+    remat: bool = False
     dtype: Any = jnp.float32
 
     def __post_init__(self):
@@ -256,16 +263,21 @@ def _forward_dense_aux(params, tokens, cfg: TransformerConfig):
     pos = jnp.arange(tokens.shape[1])
     x = params["emb"][tokens]
     attn_fn = _local_attention(cfg)
-    aux = jnp.float32(0.0)
-    for lp in params["layers"]:
+
+    def one_layer(x, lp):
         attn_out = _attn_block(x, lp, pos, attn_fn)
         x = x + attn_out
         h = _ln(x, lp["ln2_s"], lp["ln2_b"])
         if cfg.n_experts:
             y, a = moe_ffn_dense(h, lp, cfg.capacity_factor)
-            x, aux = x + y, aux + a
-        else:
-            x = x + _mlp(h, lp) + lp["b2"]
+            return x + y, a
+        return x + _mlp(h, lp) + lp["b2"], jnp.float32(0.0)
+
+    layer_fn = jax.checkpoint(one_layer) if cfg.remat else one_layer
+    aux = jnp.float32(0.0)
+    for lp in params["layers"]:
+        x, a = layer_fn(x, lp)
+        aux = aux + a
     x = _ln(x, params["lnf_s"], params["lnf_b"])
     return jnp.einsum("bld,vd->blv", x, params["emb"]), aux  # tied head
 
@@ -285,8 +297,8 @@ def _forward_local(params, tokens, cfg: TransformerConfig):
     else:
         raise ValueError(f"unknown sharded attention kind {cfg.attn!r}")
     x = params["emb"][tokens]
-    aux = jnp.float32(0.0)
-    for lp in params["layers"]:
+
+    def one_layer(x, lp):
         attn_out = _attn_block(x, lp, pos, attn)
         # tp combine: heads were a shard, the out-projection partial-sums
         attn_out = jax.lax.psum(attn_out, "tp")
@@ -296,11 +308,18 @@ def _forward_local(params, tokens, cfg: TransformerConfig):
             y, ybias, a = moe_ffn_sharded(h, lp, cfg.capacity_factor)
             # expert hidden dims are tp shards; bias rides outside the
             # psum (it is tp-replicated, see moe_ffn_sharded)
-            x = x + jax.lax.psum(y, "tp") + ybias
-            aux = aux + a
-        else:
-            y = jax.lax.psum(_mlp(h, lp), "tp")  # d_ff shard partial-sum
-            x = x + y + lp["b2"]  # b2 outside the psum (replicated)
+            return x + jax.lax.psum(y, "tp") + ybias, a
+        y = jax.lax.psum(_mlp(h, lp), "tp")  # d_ff shard partial-sum
+        return x + y + lp["b2"], jnp.float32(0.0)  # b2 replicated
+
+    # remat recomputes each layer's activations in the backward — the
+    # collectives inside (tp psum, ring ppermute / ulysses all_to_all,
+    # MoE all_to_all) replay under jax.checkpoint like any other op
+    layer_fn = jax.checkpoint(one_layer) if cfg.remat else one_layer
+    aux = jnp.float32(0.0)
+    for lp in params["layers"]:
+        x, a = layer_fn(x, lp)
+        aux = aux + a
     x = _ln(x, params["lnf_s"], params["lnf_b"])
     return jnp.einsum("bld,vd->blv", x, params["emb"]), aux
 
