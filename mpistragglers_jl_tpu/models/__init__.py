@@ -7,6 +7,8 @@ _HOME = {
     "forward_dense": "transformer",
     "make_forward": "transformer",
     "make_train_step": "transformer",
+    "make_optax_train_step": "transformer",
+    "optax_step": "transformer",
     "shard_params": "transformer",
     "batch_axes": "transformer",
     "data_spec": "transformer",
