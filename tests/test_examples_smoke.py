@@ -55,3 +55,20 @@ def test_pipeline_training_example():
     assert out.returncode == 0, out.stderr[-2000:]
     assert "loss decreased" in out.stdout
     assert "1F1B bubble" in out.stdout
+
+
+def test_long_context_training_example():
+    out = _run_example(
+        "long_context_training.py", "--steps", "4",
+        env_extra={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        },
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "remat=on adamw" in out.stdout
+    # the virtual mesh must actually materialize — the axon plugin
+    # silently overrides JAX_PLATFORMS and would degrade this to a
+    # single-device dp=1 sp=1 tp=1 run that exercises no sharding
+    assert "over 8 devices" in out.stdout, out.stdout[-500:]
+    assert "sp=4" in out.stdout
