@@ -277,15 +277,19 @@ def _remote_cmd(launcher: str, host: str, span: range, base_env: dict,
     return [*shlex.split(launcher), host, remote]
 
 
-def _span_stdin_watchdog(procs: list[subprocess.Popen]) -> None:
+def _span_stdin_watchdog(
+    procs: list[subprocess.Popen], verdict: dict
+) -> None:
     """Tie a span runner's life to its ssh channel: when the launcher
     dies or aborts the job, the ssh client goes away, this process's
     stdin hits EOF, and the watchdog kills the span's rank processes
     instead of orphaning them on the remote host (ssh without a pty
     delivers no signal on channel close — EOF on stdin is the only
-    portable death notice). Exits with the span's worst *already
-    observed* rank code so an early rank failure survives a
-    grace-expiry teardown of a hung sibling.
+    portable death notice). Before killing, it records the span's
+    worst *already observed* rank code in ``verdict`` and the main
+    thread exits with THAT — an early rank failure must survive the
+    teardown of a hung sibling, and the main thread's own waits would
+    otherwise race to report the watchdog's SIGTERM instead.
 
     Armed only when stdin is a pipe or socket (what sshd and the
     launcher's stdin=PIPE provide): a manual span-mode run with a tty
@@ -311,21 +315,26 @@ def _span_stdin_watchdog(procs: list[subprocess.Popen]) -> None:
                 pass  # the launcher never writes; wait for EOF
         except OSError:  # pragma: no cover - stdin already closed
             pass
-        codes = []
+        codes = [
+            rc for p in procs if (rc := p.poll()) is not None
+        ]
+        worst = max(codes, key=abs) if any(codes) else 0
+        verdict["worst"] = abs(worst) if worst else 0
         for p in procs:
-            rc = p.poll()
-            if rc is None:
+            if p.poll() is None:
                 p.terminate()
-            else:
-                codes.append(rc)
         deadline = time.monotonic() + 5.0
         for p in procs:
             try:
                 p.wait(timeout=max(0.0, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:  # pragma: no cover
                 p.kill()
-        worst = max(codes, key=abs) if any(codes) else 0
-        os._exit(abs(worst) if worst else 0)
+        # last resort: if a child is unreapable even after SIGKILL
+        # (D-state), the main thread's unbounded wait would hang the
+        # span and the launcher would misreport the failure as cleanup;
+        # exiting here carries the SAME code the main thread would use,
+        # so whichever side wins the race reports identically
+        os._exit(verdict["worst"])
 
     threading.Thread(target=watch, daemon=True, name="span-watchdog").start()
 
@@ -450,8 +459,13 @@ def main(argv=None) -> None:
             _spawn_rank(r, base_env, args.script, args.script_args)
             for r in range(a, b)
         ]
-        _span_stdin_watchdog(procs)
+        verdict: dict = {}
+        _span_stdin_watchdog(procs, verdict)
         codes = _wait_span(procs, list(range(a, b)), args.grace)
+        if "worst" in verdict:
+            # channel EOF tore the span down: report the failure the
+            # watchdog observed BEFORE killing, not the kill signals
+            sys.exit(verdict["worst"])
         sys.exit(max(codes, key=abs) if any(codes) else 0)
 
     hosts = parse_hosts(args.hosts, args.hostfile)

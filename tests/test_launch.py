@@ -205,3 +205,73 @@ def test_multihost_spmd_example_single_host():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "done: workers=2" in proc.stdout
+
+
+def test_span_watchdog_reaps_on_stdin_eof(tmp_path):
+    """The remote-side guarantee: when the launch channel (stdin pipe)
+    EOFs — launcher death or abort — the span runner kills its rank
+    processes instead of orphaning them, and exits with the worst
+    ALREADY-OBSERVED rank code so an early failure survives teardown."""
+    import signal
+
+    import pytest
+    import time
+
+    script = tmp_path / "mixed.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        from mpistragglers_jl_tpu import launch
+        ctx = launch.init()
+        open(os.environ["PIDDIR"] + f"/rank{ctx.rank}.pid", "w").write(
+            str(os.getpid()))
+        if ctx.rank == 1:
+            sys.exit(5)       # early failure, must survive teardown
+        time.sleep(300)       # hang: only the watchdog can end this
+    """))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MSGT_NRANKS"] = "3"
+    env["MSGT_ADDRESS"] = "tcp://127.0.0.1:1"  # never dialed here
+    env["PIDDIR"] = str(tmp_path)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mpistragglers_jl_tpu.launch",
+         "--_span", "1:3", "-n", "3", "--grace", "2", str(script)],
+        stdin=subprocess.PIPE, env=env,
+    )
+    proc.stdin.write(b"secret\n")  # the auth line the span expects
+    proc.stdin.flush()
+    # wait until rank 2 is up AND rank 1 has fully exited with its
+    # failure code — the watchdog must OBSERVE the failure before the
+    # channel dies, which is the scenario this test pins
+    deadline = time.monotonic() + 30
+    while True:
+        if time.monotonic() >= deadline:
+            proc.kill()
+            pytest.fail(
+                "span never reached the armed state (rank files: "
+                f"{sorted(p.name for p in tmp_path.iterdir())})"
+            )
+        if (tmp_path / "rank2.pid").exists() and (
+            tmp_path / "rank1.pid"
+        ).exists():
+            pid1 = int((tmp_path / "rank1.pid").read_text())
+            try:
+                os.kill(pid1, 0)
+            except ProcessLookupError:
+                break  # rank 1 is gone (exit 5 recorded)
+        time.sleep(0.1)
+    pid2 = int((tmp_path / "rank2.pid").read_text())
+    proc.stdin.close()  # the launch channel dies
+    rc = proc.wait(timeout=30)
+    assert rc == 5, rc  # rank 1's observed failure, not a kill code
+    # the hung rank was reaped, not orphaned
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid2, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.2)
+    else:
+        os.kill(pid2, signal.SIGKILL)
+        raise AssertionError(f"rank 2 (pid {pid2}) survived stdin EOF")
