@@ -79,7 +79,7 @@ class DeadWorkerError(TimeoutError):
     """
 
     def __init__(self, dead, timeout):
-        self.dead = [int(d) for d in dead]  # pool indices still active
+        self.dead = [int(d) for d in dead]  # backend ranks still active
         self.timeout = timeout
         tail = (
             f"within {timeout} s" if timeout is not None

@@ -349,8 +349,34 @@ def _wait_span(procs: list[subprocess.Popen], ranks: list[int],
     rest = list(zip(ranks, procs))
     if 0 in ranks:
         i0 = ranks.index(0)
-        codes.append(procs[i0].wait())
+        p0 = procs[i0]
         rest = [rp for rp in rest if rp[0] != 0]
+        # Poll ALL procs while the coordinator runs: a sibling (local
+        # rank or whole remote span) that dies nonzero early must abort
+        # the job promptly, mpiexec-style — otherwise a span that died
+        # before serving (bad host, ssh crash after the token write)
+        # leaves the coordinator waiting for workers that will never
+        # connect and the launch hangs unboundedly (advisor r3).
+        while True:
+            rc0 = p0.poll()
+            if rc0 is not None:
+                codes.append(rc0)
+                break
+            failed = next(
+                ((r, p, p.poll()) for r, p in rest
+                 if p.poll() not in (None, 0)),
+                None,
+            )
+            if failed is not None:
+                r, _, rc = failed
+                what = f"rank {r}" if r >= 0 else "remote span"
+                print(
+                    f"launch: {what} exited {rc} before the job "
+                    "finished; aborting", file=sys.stderr,
+                )
+                _teardown(procs)
+                return [rc]
+            time.sleep(0.05)
         deadline = time.monotonic() + grace
         for _, p in rest:
             try:
@@ -388,6 +414,25 @@ def _wait_span(procs: list[subprocess.Popen], ranks: list[int],
         for _, p in rest:
             codes.append(p.wait())
     return codes
+
+
+def _teardown(procs: list[subprocess.Popen]) -> None:
+    """Tear the whole job down: EOF remote liveness channels (the span
+    watchdog reaps its ranks — a signal to the ssh client never
+    crosses), SIGINT local ranks, then wait/kill."""
+    for p in procs:
+        if p.stdin is not None:
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
+        if p.poll() is None:
+            p.send_signal(signal.SIGINT)
+    for p in procs:
+        try:
+            p.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            p.kill()
 
 
 def main(argv=None) -> None:
@@ -523,26 +568,30 @@ def main(argv=None) -> None:
                 )
                 # first stdin line = the auth secret (see _remote_cmd);
                 # the pipe then stays open as the job-liveness channel
-                p.stdin.write((token + "\n").encode())
-                p.stdin.flush()
                 procs.append(p)
                 ranks_of.append([-1] if 0 not in span else [0])
+                try:
+                    p.stdin.write((token + "\n").encode())
+                    p.stdin.flush()
+                except OSError as e:
+                    # ssh died immediately (bad host, ssh not on PATH):
+                    # the token write hits a broken pipe. Treat it as a
+                    # failed span — reap this proc's code and tear the
+                    # rest of the job down via the shared cleanup below
+                    # instead of escaping with a raw traceback that
+                    # orphans already-spawned ranks (advisor r3 finding).
+                    code = p.wait()
+                    print(
+                        f"launch: span on {host!r} failed before start "
+                        f"(exit {code}): {e}",
+                        file=sys.stderr,
+                    )
+                    _teardown(procs)
+                    sys.exit(code if code else 1)
         flat_ranks = [r for rs in ranks_of for r in rs]
         codes = _wait_span(procs, flat_ranks, args.grace)
     except KeyboardInterrupt:  # forward ^C to the whole job, mpiexec-style
-        for p in procs:
-            if p.stdin is not None:  # remote span: EOF the channel so
-                try:  # the remote watchdog reaps its ranks (a signal
-                    p.stdin.close()  # to the ssh client never crosses)
-                except OSError:
-                    pass
-            if p.poll() is None:
-                p.send_signal(signal.SIGINT)
-        for p in procs:
-            try:
-                p.wait(timeout=5.0)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        _teardown(procs)
         raise
     finally:
         if (

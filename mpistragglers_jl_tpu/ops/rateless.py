@@ -228,6 +228,15 @@ class RatelessLTGemm:
                 entry["src"] = jax.device_put(self._src, dev)
             return entry["src"]
         finally:
+            if entry["src"] is None:
+                # Build failed (e.g. transient HBM pressure during the
+                # device_put). Drop the dead entry under the lock BEFORE
+                # releasing waiters so a later call can retry instead of
+                # hitting a permanently poisoned device for the object's
+                # lifetime; current waiters still get the RuntimeError.
+                with self._lock:
+                    if self._src_dev.get(dev) is entry:
+                        del self._src_dev[dev]
             entry["ready"].set()
 
     def prefetch_source(self) -> None:

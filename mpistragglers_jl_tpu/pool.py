@@ -403,8 +403,12 @@ def asyncmap(
                 tags=pool.stags[act],
             )
             if got is None:
+                # Report backend ranks, not pool indices: a subset pool
+                # over ranks [1,4,5] must name the dead worker as 4, not
+                # the misleading pool-local 1 (advisor r3 finding).
                 raise DeadWorkerError(
-                    [int(j) for j in np.flatnonzero(pool.active)], timeout
+                    [int(pool.ranks[j]) for j in np.flatnonzero(pool.active)],
+                    timeout,
                 )
             rank, result = got
             i = pool._idx_of_rank[rank]
@@ -469,7 +473,10 @@ def waitall(
                 tags=pool.stags[act],
             )
             if got is None:
-                dead = [int(j) for j in np.flatnonzero(pool.active)]
+                # Translated backend ranks, as in asyncmap above.
+                dead = [
+                    int(pool.ranks[j]) for j in np.flatnonzero(pool.active)
+                ]
                 raise DeadWorkerError(dead, timeout)
             rank, result = got
             i = pool._idx_of_rank[rank]

@@ -275,3 +275,113 @@ def test_span_watchdog_reaps_on_stdin_eof(tmp_path):
     else:
         os.kill(pid2, signal.SIGKILL)
         raise AssertionError(f"rank 2 (pid {pid2}) survived stdin EOF")
+
+
+def test_remote_span_broken_pipe_fails_clean(monkeypatch, capsys):
+    """Advisor r3: an ssh process that dies before reading the auth
+    token (bad host, ssh missing) breaks the stdin pipe; the launcher
+    must tear down already-spawned ranks and exit with a clean nonzero
+    code — not escape with a BrokenPipeError traceback that orphans
+    them."""
+    import pytest
+
+    from mpistragglers_jl_tpu import launch
+
+    events = []
+
+    class FakeLocal:
+        stdin = None
+
+        def __init__(self):
+            self.signaled = False
+
+        def poll(self):
+            return 0 if self.signaled else None
+
+        def send_signal(self, sig):
+            self.signaled = True
+            events.append(("signal", sig))
+
+        def wait(self, timeout=None):
+            events.append("local-reaped")
+            return 0
+
+        def kill(self):  # pragma: no cover
+            events.append("local-killed")
+
+    class FakeStdin:
+        def write(self, b):
+            raise BrokenPipeError("Broken pipe")
+
+        def flush(self):  # pragma: no cover
+            pass
+
+        def close(self):
+            pass
+
+    class FakeRemote:
+        def __init__(self, *a, **kw):
+            self.stdin = FakeStdin()
+
+        def poll(self):
+            return 255
+
+        def wait(self, timeout=None):
+            return 255
+
+        def send_signal(self, sig):  # pragma: no cover
+            pass
+
+        def kill(self):  # pragma: no cover
+            pass
+
+    local = FakeLocal()
+    monkeypatch.setattr(launch, "_spawn_rank", lambda *a, **kw: local)
+    monkeypatch.setattr(launch.subprocess, "Popen", FakeRemote)
+    with pytest.raises(SystemExit) as ei:
+        launch.main(
+            ["-n", "2", "--hosts", "localhost:1,deadhost",
+             "--address", "tcp://127.0.0.1:1", "script.py"]
+        )
+    assert ei.value.code == 255  # the dead span's exit code wins
+    # the already-spawned local rank was interrupted and reaped
+    assert ("signal", __import__("signal").SIGINT) in events
+    assert "local-reaped" in events
+    err = capsys.readouterr().err
+    assert "span on 'deadhost' failed before start" in err
+
+
+def test_remote_span_dying_after_token_aborts_promptly(tmp_path):
+    """The sibling of the broken-pipe case: the ssh process consumes the
+    auth token, THEN crashes. The job must abort with the span's code
+    promptly — not hang until the coordinator's own timeout while it
+    waits for workers that will never connect."""
+    import socket
+    import time
+
+    fake = tmp_path / "fake_ssh_die.py"
+    fake.write_text(
+        "import sys, time\n"
+        "sys.stdin.readline()\n"
+        "time.sleep(0.5)\n"
+        "sys.exit(9)\n"
+    )
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpistragglers_jl_tpu.launch",
+         "-n", "3", "--hosts", "localhost:2,deadhost",
+         "--address", f"tcp://127.0.0.1:{port}",
+         "--launcher", f"{sys.executable} {fake}",
+         os.path.join(REPO, "examples", "spmd_launch_example.py")],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    took = time.monotonic() - t0
+    assert proc.returncode == 9, (proc.returncode, proc.stderr[-2000:])
+    assert "remote span exited 9" in proc.stderr
+    # prompt: well under the coordinator's connect timeout
+    assert took < 30, took

@@ -227,6 +227,25 @@ def test_two_disjoint_subset_pools_share_backend():
     backend.shutdown()
 
 
+def test_subset_pool_dead_worker_reported_by_backend_rank():
+    # A subset pool over ranks [1, 4, 5] with backend worker 4 dead must
+    # name 4 in DeadWorkerError — not the pool-local index 1, which would
+    # misdirect debugging in exactly the subset configuration (advisor r3).
+    pool = AsyncPool([1, 4, 5])
+    backend = LocalBackend(
+        echo_worker, 8, delay_fn=lambda i, e: 10.0 if i == 4 else 0.0
+    )
+    try:
+        with pytest.raises(DeadWorkerError) as ei:
+            asyncmap(pool, np.zeros(1), backend, nwait=3, timeout=0.2)
+        assert ei.value.dead == [4]
+        with pytest.raises(DeadWorkerError) as ei:
+            waitall(pool, backend, timeout=0.05)
+        assert ei.value.dead == [4]
+    finally:
+        backend.shutdown()
+
+
 def test_subset_pool_ranks_beyond_backend_rejected():
     pool = AsyncPool([0, 9])
     backend = LocalBackend(lambda i, p, e: np.zeros(1), 4)

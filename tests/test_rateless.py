@@ -229,3 +229,36 @@ def test_device_src_single_flight():
     # systematic stream: the stack matches the host source exactly and
     # was built from the resident identity blocks (no fresh upload)
     np.testing.assert_array_equal(np.asarray(results[0]), rg._src)
+
+
+def test_device_src_failed_build_is_retryable(monkeypatch):
+    """Advisor r3: a failed source-stack build (e.g. transient HBM
+    pressure in device_put) must not poison the device entry for the
+    object's lifetime — the dead entry is dropped and a later call
+    rebuilds."""
+    rng = np.random.default_rng(11)
+    A = rng.standard_normal((16, 4)).astype(np.float64)
+    # classic (non-systematic) stream: _device_src goes through
+    # jax.device_put(self._src, dev), the patchable path
+    rg = RatelessLTGemm(A, 4, 4, seed=11, dtype=np.float64,
+                        systematic=False)
+    dev = rg.devices[0]
+    from mpistragglers_jl_tpu.ops import rateless as rl
+
+    real_put = jax.device_put
+    calls = {"n": 0}
+
+    def flaky_put(x, d=None, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient HBM pressure")
+        return real_put(x, d, **kw)
+
+    monkeypatch.setattr(rl.jax, "device_put", flaky_put)
+    with pytest.raises(RuntimeError, match="transient HBM pressure"):
+        rg._device_src(dev)
+    assert dev not in rg._src_dev  # dead entry dropped, not poisoned
+    src = rg._device_src(dev)  # retry succeeds
+    np.testing.assert_array_equal(np.asarray(src), rg._src)
+    # and subsequent calls hit the cache
+    assert rg._device_src(dev) is src
