@@ -12,6 +12,15 @@ _HOME = {
     "shard_params": "transformer",
     "batch_axes": "transformer",
     "data_spec": "transformer",
+    "init_cache": "decode",
+    "cache_specs": "decode",
+    "shard_cache": "decode",
+    "prefill_dense": "decode",
+    "decode_step_dense": "decode",
+    "generate_dense": "decode",
+    "make_prefill": "decode",
+    "make_decode_step": "decode",
+    "make_generate": "decode",
     "init_moe_layer": "moe",
     "moe_layer_specs": "moe",
     "switch_route": "moe",

@@ -82,6 +82,12 @@ class TransformerConfig:
     vocab: int = 256
     d_model: int = 64
     n_heads: int = 4
+    # grouped-query attention: number of K/V heads; None = n_heads (MHA),
+    # 1 = MQA. Q heads h and h+1.. share kv head h // (n_heads //
+    # n_kv_heads) — the grouping every kernel (reference, flash, ring,
+    # Ulysses) implements natively, so K/V projections, the KV cache and
+    # the ring/all_to_all K/V traffic all shrink by the group factor.
+    n_kv_heads: int | None = None
     n_layers: int = 2
     d_ff: int = 256
     attn: str = "ring"  # "ring" | "ulysses" | used inside shard_map
@@ -127,16 +133,29 @@ class TransformerConfig:
                 f"RoPE requires even head_dim, got "
                 f"{self.d_model // self.n_heads}"
             )
+        if self.n_kv_heads is not None and (
+            self.n_kv_heads < 1 or self.n_heads % self.n_kv_heads != 0
+        ):
+            raise ValueError(
+                f"n_kv_heads {self.n_kv_heads} must divide n_heads "
+                f"{self.n_heads}"
+            )
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        """Resolved K/V head count (n_heads when n_kv_heads is None)."""
+        return self.n_heads if self.n_kv_heads is None else self.n_kv_heads
 
 
 def init_params(cfg: TransformerConfig, seed: int = 0) -> dict:
     """Plain pytree-of-arrays parameters (replicable / shardable)."""
     rng = np.random.default_rng(seed)
     D, H, Dh, F = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    Hkv = cfg.kv_heads
     sd = lambda *s: jnp.asarray(
         rng.standard_normal(s) / np.sqrt(s[0]), cfg.dtype
     )
@@ -146,8 +165,8 @@ def init_params(cfg: TransformerConfig, seed: int = 0) -> dict:
             "ln1_s": jnp.ones((D,), cfg.dtype),
             "ln1_b": jnp.zeros((D,), cfg.dtype),
             "wq": sd(D, H, Dh),
-            "wk": sd(D, H, Dh),
-            "wv": sd(D, H, Dh),
+            "wk": sd(D, Hkv, Dh),
+            "wv": sd(D, Hkv, Dh),
             # NB float(): an np.float64 scalar would silently promote
             # the param to f64 under jax_enable_x64
             "wo": sd(H, Dh, D) / float(np.sqrt(cfg.n_layers)),
@@ -180,14 +199,40 @@ def init_params(cfg: TransformerConfig, seed: int = 0) -> dict:
     }
 
 
-def param_specs(cfg: TransformerConfig) -> dict:
+def _kv_tp_sharded(cfg: TransformerConfig, mesh: Mesh | None) -> bool:
+    """Whether the K/V projections shard their (narrower) head dim over
+    ``tp``. With GQA/MQA the kv-head count can drop below the tp degree;
+    then wk/wv stay replicated and each tp member slices the one kv head
+    its q-head shard reads (``_forward_local``). Requires kv_heads % tp
+    == 0 or tp % kv_heads == 0 — anything else has no aligned grouping."""
+    if mesh is None or "tp" not in mesh.axis_names:
+        return True
+    tp = mesh.shape["tp"]
+    if cfg.n_heads % tp != 0:
+        raise ValueError(
+            f"n_heads {cfg.n_heads} must divide over tp={tp}"
+        )
+    if cfg.kv_heads % tp == 0:
+        return True
+    if tp % cfg.kv_heads == 0:
+        return False
+    raise ValueError(
+        f"kv_heads {cfg.kv_heads} and tp={tp} need one to divide the "
+        "other (grouped q-head shards must align to whole kv heads)"
+    )
+
+
+def param_specs(cfg: TransformerConfig, mesh: Mesh | None = None) -> dict:
     """PartitionSpecs matching :func:`init_params`: heads and d_ff over
-    ``tp`` (Megatron split), everything else replicated."""
+    ``tp`` (Megatron split), everything else replicated. Pass ``mesh``
+    so GQA configs whose kv_heads < tp degree fall back to replicated
+    K/V projections (see :func:`_kv_tp_sharded`)."""
+    kv = P(None, "tp", None) if _kv_tp_sharded(cfg, mesh) else P()
     layer = {
         "ln1_s": P(), "ln1_b": P(),
         "wq": P(None, "tp", None),
-        "wk": P(None, "tp", None),
-        "wv": P(None, "tp", None),
+        "wk": kv,
+        "wv": kv,
         "wo": P("tp", None, None),
         "ln2_s": P(), "ln2_b": P(),
     }
@@ -231,14 +276,19 @@ def _rope(x, pos):
     )
 
 
-def _attn_block(x, lp, pos, attn_fn):
+def _attn_block(x, lp, pos, attn_fn, kv_slice=None):
     """Attention half-block on (B, L?, D) activations; the head dim may
     be the tp-local shard — the caller supplies matching weights and the
-    tp psum when sharded (``attn_fn`` closes over sp specifics)."""
+    tp psum when sharded (``attn_fn`` closes over sp specifics).
+    ``kv_slice`` post-selects kv heads from tp-replicated K/V
+    projections (the GQA kv_heads < tp case — see
+    :func:`_kv_tp_sharded`)."""
     h = _ln(x, lp["ln1_s"], lp["ln1_b"])
     q = jnp.einsum("bld,dhk->blhk", h, lp["wq"])
     k = jnp.einsum("bld,dhk->blhk", h, lp["wk"])
     v = jnp.einsum("bld,dhk->blhk", h, lp["wv"])
+    if kv_slice is not None:
+        k, v = kv_slice(k), kv_slice(v)
     q, k = _rope(q, pos), _rope(k, pos)
     o = attn_fn(q, k, v)
     return jnp.einsum("blhk,hkd->bld", o, lp["wo"])
@@ -247,6 +297,26 @@ def _attn_block(x, lp, pos, attn_fn):
 def _mlp(x, lp):
     a = jax.nn.gelu(jnp.einsum("bld,df->blf", x, lp["w1"]) + lp["b1"])
     return jnp.einsum("blf,fd->bld", a, lp["w2"])
+
+
+def make_kv_slice(cfg: TransformerConfig):
+    """GQA with kv_heads < tp (call inside shard_map): wk/wv arrive
+    tp-REPLICATED (:func:`_kv_tp_sharded`); this device's q-head shard
+    [t*H/tp, (t+1)*H/tp) reads exactly one kv head, t*kv_heads // tp —
+    the returned callable slices it so the attention kernels see the
+    aligned local grouping (all local q heads -> local kv head 0).
+    Returns None when kv heads shard evenly (nothing to slice). Shared
+    by the training forward and the decode path (models/decode.py) so
+    the index math cannot drift between them."""
+    tp = jax.lax.axis_size("tp")
+    if cfg.kv_heads % tp == 0:
+        return None
+
+    def kv_slice(a):
+        idx = jax.lax.axis_index("tp") * cfg.kv_heads // tp
+        return jax.lax.dynamic_slice_in_dim(a, idx, 1, axis=2)
+
+    return kv_slice
 
 
 def _local_attention(cfg: TransformerConfig):
@@ -298,10 +368,11 @@ def _forward_local(params, tokens, cfg: TransformerConfig):
         )
     else:
         raise ValueError(f"unknown sharded attention kind {cfg.attn!r}")
+    kv_slice = make_kv_slice(cfg)
     x = params["emb"][tokens]
 
     def one_layer(x, lp):
-        attn_out = _attn_block(x, lp, pos, attn)
+        attn_out = _attn_block(x, lp, pos, attn, kv_slice)
         # tp combine: heads were a shard, the out-projection partial-sums
         attn_out = jax.lax.psum(attn_out, "tp")
         x = x + attn_out
@@ -388,7 +459,7 @@ def make_forward(cfg: TransformerConfig, mesh: Mesh):
     f = jax.shard_map(
         fwd_local,
         mesh=mesh,
-        in_specs=(param_specs(cfg), data_spec(cfg)),
+        in_specs=(param_specs(cfg, mesh), data_spec(cfg)),
         out_specs=data_spec(cfg),
         # interpret-mode Pallas (flash attn on the CPU test mesh) trips
         # the vma checker — see parallel/ring_attention._make_wrapped;
@@ -423,7 +494,7 @@ def _make_loss_fn(cfg: TransformerConfig, mesh: Mesh):
     return jax.shard_map(
         partial(_loss_local, cfg=cfg),
         mesh=mesh,
-        in_specs=(param_specs(cfg), data_spec(cfg), data_spec(cfg)),
+        in_specs=(param_specs(cfg, mesh), data_spec(cfg), data_spec(cfg)),
         out_specs=P(),
         check_vma=not _flash_interpreted(cfg.attn_impl),
     )
@@ -474,5 +545,5 @@ def shard_params(params: dict, cfg: TransformerConfig, mesh: Mesh) -> dict:
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params,
-        param_specs(cfg),
+        param_specs(cfg, mesh),
     )

@@ -138,8 +138,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
         lse_ref[0] = (m_sc[:, :1] + jnp.log(l)).astype(jnp.float32)
 
 
-def _fwd(q3, k3, v3, scale, causal, bq, bk, interpret):
-    """q3/k3/v3: (BH, L, D) -> (o (BH, L, D), lse (BH, L))."""
+def _fwd(q3, k3, v3, scale, causal, bq, bk, g, interpret):
+    """q3: (B*H, L, D); k3/v3: (B*Hkv, L, D) -> (o (B*H, L, D),
+    lse (B*H, L, 1)). GQA costs nothing here: the grid runs over q
+    heads and the K/V BlockSpec index maps divide the flattened
+    batch*head index by the group size ``g`` — flattened q index
+    b = batch*H + h reads k3[b // g] = batch*Hkv + h // g, so grouped
+    K/V blocks are simply fetched g times from the same HBM pages, no
+    repeated/materialized K ever exists."""
     BH, Lq, D = q3.shape
     Lk = k3.shape[1]
     nq, nk = Lq // bq, Lk // bk
@@ -151,8 +157,8 @@ def _fwd(q3, k3, v3, scale, causal, bq, bk, interpret):
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // g, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // g, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
@@ -264,7 +270,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, interpret):
+def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, g, interpret):
     BH, Lq, D = q3.shape
     Lk = k3.shape[1]
     nq, nk = Lq // bq, Lk // bk
@@ -280,8 +286,8 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, interpret):
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // g, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // g, j, 0)),
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
@@ -293,15 +299,22 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, interpret):
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
 
-    dk, dv = pl.pallas_call(
+    # dk/dv: each grid-b is ONE q head, writing its own (B*H)-indexed
+    # output block — per-q-head partials, no cross-head write conflicts
+    # under the parallel grid axis. The group-sum down to the B*Hkv kv
+    # heads happens outside the kernel: flattened q index b = batch*H +
+    # hkv*g + g_idx = (batch*Hkv + hkv)*g + g_idx, so a (B*Hkv, g, Lk,
+    # D) reshape puts the group on axis 1 and one XLA reduction
+    # finishes the job.
+    dkq, dvq = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq
         ),
         grid=(BH, nk, nq),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b // g, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b // g, j, 0)),
             pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
@@ -321,6 +334,11 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, interpret):
         compiler_params=_grid_params(),
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
+    if g == 1:
+        return dq, dkq, dvq
+    BHkv = BH // g
+    dk = dkq.reshape(BHkv, g, Lk, D).sum(axis=1).astype(k3.dtype)
+    dv = dvq.reshape(BHkv, g, Lk, D).sum(axis=1).astype(v3.dtype)
     return dq, dk, dv
 
 
@@ -329,20 +347,22 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, interpret):
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash3(q3, k3, v3, scale, causal, bq, bk, interpret):
-    o, _ = _fwd(q3, k3, v3, scale, causal, bq, bk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash3(q3, k3, v3, scale, causal, bq, bk, g, interpret):
+    o, _ = _fwd(q3, k3, v3, scale, causal, bq, bk, g, interpret)
     return o
 
 
-def _flash3_fwd(q3, k3, v3, scale, causal, bq, bk, interpret):
-    o, lse = _fwd(q3, k3, v3, scale, causal, bq, bk, interpret)
+def _flash3_fwd(q3, k3, v3, scale, causal, bq, bk, g, interpret):
+    o, lse = _fwd(q3, k3, v3, scale, causal, bq, bk, g, interpret)
     return o, (q3, k3, v3, o, lse)
 
 
-def _flash3_bwd(scale, causal, bq, bk, interpret, res, do3):
+def _flash3_bwd(scale, causal, bq, bk, g, interpret, res, do3):
     q3, k3, v3, o3, lse = res
-    return _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, interpret)
+    return _bwd(
+        q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, g, interpret
+    )
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
@@ -376,6 +396,12 @@ def flash_attention(
     """
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
+    Hkv = k.shape[2]
+    if H % Hkv != 0:
+        raise ValueError(
+            f"q heads ({H}) must be a multiple of kv heads ({Hkv})"
+        )
+    g = H // Hkv
     if scale is None:
         scale = D ** -0.5
     if interpret is None:
@@ -383,11 +409,11 @@ def flash_attention(
     bq = _pick_block(Lq, block_q)
     bk = _pick_block(Lk, block_k)
 
-    def to3(x, L):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    def to3(x, L, h):
+        return x.transpose(0, 2, 1, 3).reshape(B * h, L, D)
 
     o3 = _flash3(
-        to3(q, Lq), to3(k, Lk), to3(v, Lk),
-        float(scale), bool(causal), bq, bk, bool(interpret),
+        to3(q, Lq, H), to3(k, Lk, Hkv), to3(v, Lk, Hkv),
+        float(scale), bool(causal), bq, bk, g, bool(interpret),
     )
     return o3.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)

@@ -53,15 +53,51 @@ __all__ = [
 _NEG = -1e30  # large-negative mask value; -inf breaks the m-update exp
 
 
+def _group_scores(q, kc, scale):
+    """(B, Lq, H, D) x (B, Lk, Hkv, D) -> (B, H, Lq, Lk) scores with
+    GQA grouping: q head h reads kv head h // (H // Hkv). The 5D einsum
+    keeps the MXU contraction batched per kv head — no repeated K."""
+    Hq, Hkv = q.shape[2], kc.shape[2]
+    if Hq == Hkv:
+        return jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kc, preferred_element_type=jnp.float32
+        ) * scale
+    B, Lq, _, D = q.shape
+    g = Hq // Hkv
+    q5 = q.reshape(B, Lq, Hkv, g, D)
+    s5 = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", q5, kc, preferred_element_type=jnp.float32
+    ) * scale
+    # (hkv, g) flattens to h = hkv*g + g_idx — exactly q's head order
+    return s5.reshape(B, Hq, Lq, kc.shape[1])
+
+
+def _group_pv(p, vc):
+    """(B, H, Lq, Lk) probs x (B, Lk, Hkv, D) values -> (B, Lq, H, D)
+    f32, with the same GQA head grouping as :func:`_group_scores`."""
+    Hq, Hkv = p.shape[1], vc.shape[2]
+    vf = vc.astype(jnp.float32)
+    if Hq == Hkv:
+        return jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vf, preferred_element_type=jnp.float32
+        )
+    B, _, Lq, Lk = p.shape
+    g = Hq // Hkv
+    p5 = p.reshape(B, Hkv, g, Lq, Lk)
+    o5 = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", p5, vf, preferred_element_type=jnp.float32
+    )
+    return o5.reshape(B, Lq, Hq, vc.shape[-1])
+
+
 def _block_update(q, kc, vc, o, m, l, qpos, kpos, scale, causal):
     """One online-softmax accumulation step against K/V block (kc, vc).
 
-    q: (B, Lq, H, D); kc/vc: (B, Lk, H, D); o: (B, Lq, H, D) f32;
-    m, l: (B, H, Lq) f32 running max / normalizer.
+    q: (B, Lq, H, D); kc/vc: (B, Lk, Hkv, D) where Hkv divides H (GQA;
+    Hkv == H is plain MHA); o: (B, Lq, H, D) f32; m, l: (B, H, Lq) f32
+    running max / normalizer.
     """
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, kc, preferred_element_type=jnp.float32
-    ) * scale
+    s = _group_scores(q, kc, scale)
     if causal:
         mask = kpos[None, :] <= qpos[:, None]  # (Lq, Lk)
         s = jnp.where(mask[None, None], s, _NEG)
@@ -72,10 +108,7 @@ def _block_update(q, kc, vc, o, m, l, qpos, kpos, scale, causal):
         p = jnp.where(mask[None, None], p, 0.0)
     corr = jnp.exp(m - m_new)  # (B, H, Lq)
     l = l * corr + p.sum(axis=-1)
-    o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
-        "bhqk,bkhd->bqhd", p, vc.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
+    o = o * corr.transpose(0, 2, 1)[..., None] + _group_pv(p, vc)
     return o, m_new, l
 
 
@@ -157,6 +190,15 @@ def ulysses_attention(
     as the fused Pallas kernel (ops/flash_attention.py) instead of the
     materializing reference — the memory-sane choice at long L, since
     the device holds the *full* sequence here.
+
+    GQA/MQA: k/v may carry Hkv < H heads. When ``Hkv % n == 0`` the K/V
+    all_to_all splits the kv heads like the q heads (Hkv/n per device,
+    group alignment is automatic because H % Hkv == 0). When instead
+    ``n % Hkv == 0`` the kv heads are first replicated n/Hkv-fold so the
+    head axis reaches n and each device lands exactly the ONE kv head
+    its q-head slice reads — K/V traffic grows back toward MHA only in
+    this sp-overshard regime, and never beyond it. Anything else is
+    rejected (q-head slices would straddle kv-head boundaries).
     """
     n = jax.lax.axis_size(axis)
     if q.shape[2] % n != 0:
@@ -164,6 +206,16 @@ def ulysses_attention(
             f"ulysses needs heads ({q.shape[2]}) divisible by the "
             f"sequence-parallel degree ({n})"
         )
+    Hkv = k.shape[2]
+    if Hkv % n != 0:
+        if n % Hkv != 0:
+            raise ValueError(
+                f"ulysses with GQA needs kv heads ({Hkv}) and the "
+                f"sequence-parallel degree ({n}) to divide one another"
+            )
+        r = n // Hkv
+        k = jnp.repeat(k, r, axis=2)  # now n heads; device d gets d//r
+        v = jnp.repeat(v, r, axis=2)
     # (B, L/n, H, D) -> (B, L, H/n, D): split heads, concat sequence
     a2a = partial(
         jax.lax.all_to_all, axis_name=axis, split_axis=2, concat_axis=1,
@@ -193,9 +245,15 @@ def resolve_attention_impl(impl: str):
 
 def reference_attention(q, k, v, *, causal=False, scale=None):
     """Plain full-materialization attention (the correctness oracle and
-    the per-device kernel inside Ulysses). (B, L, H, D) layout."""
+    the per-device kernel inside Ulysses). (B, L, H, D) layout; k/v may
+    carry fewer (grouped) heads — GQA/MQA — expanded here by repeat,
+    the obviously-correct oracle form."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    g = q.shape[2] // k.shape[2]
+    if g > 1:
+        k = jnp.repeat(k, g, axis=2)  # head h <- kv head h // g
+        v = jnp.repeat(v, g, axis=2)
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale

@@ -1,0 +1,217 @@
+"""KV-cache inference path (VERDICT r3 missing #2).
+
+Correctness contract: the incremental forward is the SAME function as
+the training forward, evaluated causally — so teacher-forced decode
+logits must match ``forward_dense`` position by position, prefill must
+match it on the prompt, and the sharded (dp x tp) programs must match
+the dense oracle; greedy generation must agree between the dense and
+sharded programs, GQA/MQA and replicated-groups cache layouts included.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpistragglers_jl_tpu.models.decode import (
+    cache_specs,
+    decode_step_dense,
+    generate_dense,
+    init_cache,
+    make_decode_step,
+    make_generate,
+    make_prefill,
+    prefill_dense,
+    shard_cache,
+)
+from mpistragglers_jl_tpu.models.transformer import (
+    TransformerConfig,
+    forward_dense,
+    init_params,
+    shard_params,
+)
+from mpistragglers_jl_tpu.parallel import make_mesh
+
+CFG = TransformerConfig(
+    vocab=61, d_model=64, n_heads=8, n_kv_heads=2, n_layers=2, d_ff=128
+)
+
+
+def _tokens(cfg, B=2, L=12, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, cfg.vocab, (B, L)), jnp.int32)
+
+
+@pytest.mark.parametrize("hkv", [None, 2, 1])
+def test_teacher_forced_decode_matches_dense_forward(hkv):
+    """Prefill the first half, decode the second half teacher-forced;
+    every step's logits must equal the training forward's at that
+    position."""
+    cfg = dataclasses.replace(CFG, n_kv_heads=hkv)
+    params = init_params(cfg, seed=1)
+    toks = _tokens(cfg, B=2, L=12)
+    want = forward_dense(params, toks, cfg)  # (B, L, V)
+
+    Tp = 6
+    cache = init_cache(cfg, 2, 12)
+    logits, cache = prefill_dense(params, toks[:, :Tp], cache, cfg)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(want[:, :Tp]), atol=1e-4, rtol=1e-4
+    )
+    # kv cache holds kv_heads heads — the GQA memory win is structural
+    assert cache[0]["k"].shape == (2, 12, cfg.kv_heads, cfg.head_dim)
+    for t in range(Tp, 12):
+        lg, cache = decode_step_dense(
+            params, toks[:, t], cache, jnp.int32(t), cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg), np.asarray(want[:, t]), atol=1e-4, rtol=1e-4,
+            err_msg=f"position {t}",
+        )
+
+
+def test_prefill_flash_matches_reference_prefill():
+    cfg = dataclasses.replace(CFG, attn="ulysses", attn_impl="flash")
+    params = init_params(cfg, seed=2)
+    toks = _tokens(cfg, B=2, L=8)
+    c0 = init_cache(cfg, 2, 8)
+    lg_flash, c_flash = prefill_dense(params, toks, c0, cfg)
+    lg_ref, c_ref = prefill_dense(
+        params, toks, c0, dataclasses.replace(cfg, attn_impl="reference")
+    )
+    np.testing.assert_allclose(
+        np.asarray(lg_flash), np.asarray(lg_ref), atol=1e-4, rtol=1e-4
+    )
+    for a, b in zip(jax.tree.leaves(c_flash), jax.tree.leaves(c_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "shape,hkv",
+    [
+        ((2, 4), 4),  # kv heads shard over tp
+        ((2, 4), 2),  # kv_heads < tp: replicated-groups cache
+        ((1, 8), 1),  # MQA at tp=8
+    ],
+)
+def test_sharded_prefill_and_decode_match_dense(shape, hkv):
+    cfg = dataclasses.replace(CFG, n_kv_heads=hkv)
+    mesh = make_mesh(shape, ("dp", "tp"))
+    params = init_params(cfg, seed=3)
+    toks = _tokens(cfg, B=4, L=12, seed=3)
+    want = forward_dense(params, toks, cfg)
+
+    sp = shard_params(params, cfg, mesh)
+    cache = shard_cache(init_cache(cfg, 4, 12, mesh), cfg, mesh)
+    prefill = make_prefill(cfg, mesh)
+    step = make_decode_step(cfg, mesh)
+    tok_sh = NamedSharding(mesh, P("dp", None))
+    Tp = 6
+    lg, cache = prefill(sp, jax.device_put(toks[:, :Tp], tok_sh), cache)
+    np.testing.assert_allclose(
+        np.asarray(lg), np.asarray(want[:, Tp - 1]), atol=1e-4, rtol=1e-4
+    )
+    for t in range(Tp, 12):
+        lg, cache = step(
+            sp,
+            jax.device_put(toks[:, t], NamedSharding(mesh, P("dp"))),
+            cache, jnp.int32(t),
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg), np.asarray(want[:, t]), atol=1e-4, rtol=1e-4,
+            err_msg=f"position {t}",
+        )
+
+
+@pytest.mark.parametrize("hkv", [2, 1])
+def test_sharded_generate_matches_dense_generate(hkv):
+    cfg = dataclasses.replace(CFG, n_kv_heads=hkv)
+    mesh = make_mesh((2, 4), ("dp", "tp"))
+    params = init_params(cfg, seed=4)
+    prompt = _tokens(cfg, B=2, L=8, seed=5)
+    want = generate_dense(params, prompt, 6, cfg)
+    assert want.shape == (2, 6)
+
+    gen = make_generate(cfg, mesh, n_new=6)
+    got = gen(
+        shard_params(params, cfg, mesh),
+        jax.device_put(prompt, NamedSharding(mesh, P("dp", None))),
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_generate_dense_is_greedy_self_consistent():
+    """Feeding generated tokens back through the training forward
+    reproduces the same greedy choices (the cache is not drifting)."""
+    cfg = CFG
+    params = init_params(cfg, seed=6)
+    prompt = _tokens(cfg, B=1, L=5, seed=7)
+    out = generate_dense(params, prompt, 5, cfg)
+    seq = jnp.concatenate([prompt, out], axis=1)
+    logits = forward_dense(params, seq, cfg)
+    # position t's logits predict token t+1 greedily, for the generated tail
+    pred = jnp.argmax(logits[:, prompt.shape[1] - 1:-1], axis=-1)
+    np.testing.assert_array_equal(np.asarray(pred), np.asarray(out))
+
+
+def test_moe_decode_dense_oracle():
+    cfg = dataclasses.replace(
+        CFG, n_experts=2, d_model=32, n_heads=4, n_kv_heads=2, d_ff=64
+    )
+    params = init_params(cfg, seed=8)
+    toks = _tokens(cfg, B=2, L=10, seed=8)
+    want = forward_dense(params, toks, cfg)
+    cache = init_cache(cfg, 2, 10)
+    lg, cache = prefill_dense(params, toks[:, :5], cache, cfg)
+    np.testing.assert_allclose(
+        np.asarray(lg), np.asarray(want[:, :5]), atol=1e-4, rtol=1e-4
+    )
+    for t in range(5, 10):
+        lg, cache = decode_step_dense(
+            params, toks[:, t], cache, jnp.int32(t), cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg), np.asarray(want[:, t]), atol=1e-4, rtol=1e-4
+        )
+
+
+def test_moe_sharded_decode_rejected():
+    cfg = dataclasses.replace(CFG, n_experts=2)
+    mesh = make_mesh((2, 4), ("dp", "tp"))
+    with pytest.raises(NotImplementedError, match="dense FFN"):
+        make_prefill(cfg, mesh)
+
+
+def test_cache_overflow_guards():
+    """dynamic_update_slice clamps silently; the API must error instead
+    of corrupting the last cache slot (review finding)."""
+    params = init_params(CFG, seed=0)
+    prompt = _tokens(CFG, B=1, L=8)
+    with pytest.raises(ValueError, match="clamp into the last cache slot"):
+        generate_dense(params, prompt, 8, CFG, max_len=10)
+    cache = init_cache(CFG, 1, 4)
+    with pytest.raises(ValueError, match="does not fit the cache"):
+        prefill_dense(params, prompt, cache, CFG)
+    mesh = make_mesh((1, 4), ("dp", "tp"))
+    with pytest.raises(ValueError, match="clamp into the last cache slot"):
+        make_generate(CFG, mesh, n_new=8, max_len=10)(
+            shard_params(params, CFG, mesh),
+            jax.device_put(prompt, NamedSharding(mesh, P("dp", None))),
+        )
+
+
+def test_generate_dense_compile_cached():
+    """Same (cfg, shapes) -> the jitted runner is reused, not retraced
+    (review finding: a per-call @jax.jit forced a recompile every
+    generation)."""
+    from mpistragglers_jl_tpu.models.decode import _dense_runner
+
+    params = init_params(CFG, seed=0)
+    prompt = _tokens(CFG, B=1, L=5)
+    generate_dense(params, prompt, 3, CFG)
+    hits0 = _dense_runner.cache_info().hits
+    generate_dense(params, prompt, 3, CFG)
+    assert _dense_runner.cache_info().hits == hits0 + 1

@@ -1,0 +1,268 @@
+"""Grouped-query / multi-query attention (VERDICT r3 missing #1).
+
+The grouping contract everywhere: q head ``h`` reads kv head
+``h // (H // Hkv)``. The gold oracle is *expansion equivalence*: a GQA
+model is mathematically identical to the MHA model whose wk/wv repeat
+each kv head ``H // Hkv`` times along the head axis. Every kernel
+(reference, flash Pallas, ring, Ulysses incl. its kv-replication
+branch) and every sharding (tp-sharded kv heads, tp-replicated + sliced
+kv heads when kv_heads < tp) is pinned against that oracle, gradients
+included.
+"""
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpistragglers_jl_tpu.models.transformer import (
+    TransformerConfig,
+    _loss_local,
+    forward_dense,
+    init_params,
+    make_forward,
+    make_train_step,
+    param_specs,
+    shard_params,
+)
+from mpistragglers_jl_tpu.ops.flash_attention import flash_attention
+from mpistragglers_jl_tpu.parallel import make_mesh
+from mpistragglers_jl_tpu.parallel.ring_attention import (
+    make_ring_attention,
+    make_ulysses_attention,
+    reference_attention,
+)
+
+CFG = TransformerConfig(
+    vocab=61, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2, d_ff=64
+)
+
+
+def _tokens(cfg, B=4, L=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, cfg.vocab, (B, L)), dtype=jnp.int32)
+
+
+def _expand_to_mha(params, cfg):
+    """The MHA twin: repeat each kv head G times (head h <- kv h // G)."""
+    g = cfg.n_heads // cfg.kv_heads
+    out = jax.tree.map(lambda x: x, params)  # copy structure
+    for lp in out["layers"]:
+        lp["wk"] = jnp.repeat(lp["wk"], g, axis=1)
+        lp["wv"] = jnp.repeat(lp["wv"], g, axis=1)
+    return out
+
+
+def _qkv(Hq, Hkv, B=2, L=32, D=8, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda h, s: jnp.asarray(
+        rng.standard_normal((B, L, h, D)), dtype
+    )
+    return mk(Hq, 1), mk(Hkv, 2), mk(Hkv, 3)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="must divide n_heads"):
+        TransformerConfig(n_heads=4, n_kv_heads=3)
+    assert TransformerConfig(n_heads=4).kv_heads == 4
+    assert TransformerConfig(n_heads=4, n_kv_heads=1).kv_heads == 1
+
+
+@pytest.mark.parametrize("hkv", [1, 2])
+def test_dense_gqa_equals_expanded_mha(hkv):
+    cfg = dataclasses.replace(CFG, n_kv_heads=hkv)
+    cfg_mha = dataclasses.replace(CFG, n_kv_heads=None)
+    params = init_params(cfg, seed=1)
+    assert params["layers"][0]["wk"].shape == (32, hkv, 8)
+    toks = _tokens(cfg)
+    got = forward_dense(params, toks, cfg)
+    want = forward_dense(_expand_to_mha(params, cfg), toks, cfg_mha)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("hkv", [1, 2, 4])
+def test_flash_gqa_matches_reference_values_and_grads(causal, hkv):
+    """The Pallas kernel's b//g K/V indexing vs the repeat oracle —
+    forward and all three gradients."""
+    q, k, v = _qkv(4, hkv)
+
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=causal) ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=causal) ** 2).sum()
+
+    o_got = flash_attention(q, k, v, causal=causal)
+    o_want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(o_got), np.asarray(o_want), atol=1e-5, rtol=1e-5
+    )
+    g_got = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_got, g_want, "qkv"):
+        assert a.shape == b.shape, name
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4,
+            err_msg=f"d{name}",
+        )
+
+
+@pytest.mark.parametrize("hkv", [1, 2])
+def test_ring_gqa_matches_reference(hkv):
+    mesh = make_mesh((4,), ("sp",))
+    q, k, v = _qkv(4, hkv, L=32)
+    ring = make_ring_attention(mesh, causal=True)
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    got = ring(*(jax.device_put(x, spec) for x in (q, k, v)))
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize(
+    "hkv,sp",
+    [
+        (4, 2),  # kv heads divide sp-wise like q heads
+        (2, 2),  # Hkv == sp: one kv head per device, no replication
+        (1, 2),  # MQA: sp % Hkv == 0 -> kv replication branch
+        (2, 4),  # GQA replication branch: r = 2
+    ],
+)
+def test_ulysses_gqa_matches_reference(hkv, sp):
+    mesh = make_mesh((sp,), ("sp",))
+    q, k, v = _qkv(8, hkv, L=32)  # 8 q heads: divisible by sp=2 and 4
+    uly = make_ulysses_attention(mesh, causal=True)
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    got = uly(*(jax.device_put(x, spec) for x in (q, k, v)))
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_ulysses_gqa_indivisible_rejected():
+    mesh = make_mesh((4,), ("sp",))
+    q, k, v = _qkv(8, 3, L=32)
+    uly = make_ulysses_attention(mesh, causal=True)
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    with pytest.raises(ValueError, match="divide one another"):
+        uly(*(jax.device_put(x, spec) for x in (q, k, v)))
+
+
+@pytest.mark.parametrize(
+    "shape,attn,hkv",
+    [
+        ((2, 2, 2), "ring", 2),     # kv heads shard over tp (2 % 2 == 0)
+        ((2, 2, 2), "ring", 1),     # MQA: kv replicated + sliced, tp=2
+        ((1, 2, 4), "ring", 2),     # kv_heads < tp: replicated + sliced
+        ((2, 2, 2), "ulysses", 2),
+        ((1, 2, 2), "ulysses", 1),  # MQA through the ulysses a2a
+        ((1, 2, 4), "ulysses", 2),
+    ],
+)
+def test_sharded_gqa_forward_matches_dense(shape, attn, hkv):
+    cfg = dataclasses.replace(
+        CFG, n_heads=8, d_model=64, n_kv_heads=hkv, attn=attn
+    )
+    mesh = make_mesh(shape, ("dp", "sp", "tp"))
+    params = init_params(cfg, seed=1)
+    toks = _tokens(cfg)
+    want = forward_dense(params, toks, cfg)
+    fwd = make_forward(cfg, mesh)
+    got = fwd(
+        shard_params(params, cfg, mesh),
+        jax.device_put(toks, NamedSharding(mesh, P("dp", "sp"))),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_kv_spec_replicated_when_kv_heads_below_tp():
+    cfg = dataclasses.replace(CFG, n_heads=8, d_model=64, n_kv_heads=2)
+    mesh = make_mesh((1, 2, 4), ("dp", "sp", "tp"))
+    specs = param_specs(cfg, mesh)
+    assert specs["layers"][0]["wk"] == P()
+    assert specs["layers"][0]["wq"] == P(None, "tp", None)
+    # and with a dividing tp the kv heads shard as usual
+    mesh2 = make_mesh((2, 2, 2), ("dp", "sp", "tp"))
+    assert param_specs(cfg, mesh2)["layers"][0]["wk"] == P(None, "tp", None)
+
+
+def test_kv_tp_misaligned_rejected():
+    cfg = dataclasses.replace(CFG, n_heads=12, d_model=96, n_kv_heads=3)
+    mesh = make_mesh((1, 2, 4), ("dp", "sp", "tp"))  # 3 vs tp=4
+    with pytest.raises(ValueError, match="divide the other"):
+        param_specs(cfg, mesh)
+
+
+@pytest.mark.parametrize(
+    "shape,attn,hkv",
+    [
+        ((2, 2, 2), "ring", 2),
+        ((1, 2, 4), "ring", 2),   # replicated-kv slice path, grads incl.
+        ((2, 2, 2), "ulysses", 1),
+    ],
+)
+def test_sharded_gqa_grads_match_dense(shape, attn, hkv):
+    cfg = dataclasses.replace(
+        CFG, n_heads=8, d_model=64, n_kv_heads=hkv, attn=attn
+    )
+    mesh = make_mesh(shape, ("dp", "sp", "tp"))
+    params = init_params(cfg, seed=4)
+    rng = np.random.default_rng(5)
+    data = jnp.asarray(rng.integers(0, cfg.vocab, (4, 17)), jnp.int32)
+    toks, tgts = data[:, :-1], data[:, 1:]
+
+    def dense_loss(p):
+        logits = forward_dense(p, toks, cfg).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, tgts[..., None], axis=-1).mean()
+
+    g_want = jax.grad(dense_loss)(params)
+    loss_fn = jax.jit(
+        jax.shard_map(
+            partial(_loss_local, cfg=cfg),
+            mesh=mesh,
+            in_specs=(param_specs(cfg, mesh), P("dp", "sp"), P("dp", "sp")),
+            out_specs=P(),
+        )
+    )
+    sh = NamedSharding(mesh, P("dp", "sp"))
+    g_got = jax.grad(loss_fn)(
+        shard_params(params, cfg, mesh),
+        jax.device_put(toks, sh), jax.device_put(tgts, sh),
+    )
+    for a, b in zip(jax.tree.leaves(g_got), jax.tree.leaves(g_want)):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-3
+        )
+
+
+def test_gqa_train_step_reduces_loss():
+    cfg = dataclasses.replace(
+        CFG, n_heads=8, d_model=64, n_kv_heads=2, attn="ulysses",
+        attn_impl="flash",
+    )
+    mesh = make_mesh((1, 2, 4), ("dp", "sp", "tp"))
+    params = shard_params(init_params(cfg, seed=2), cfg, mesh)
+    rng = np.random.default_rng(3)
+    data = jnp.asarray(rng.integers(0, cfg.vocab, (4, 17)), jnp.int32)
+    sh = NamedSharding(mesh, P("dp", "sp"))
+    toks = jax.device_put(data[:, :-1], sh)
+    tgts = jax.device_put(data[:, 1:], sh)
+    step = make_train_step(cfg, mesh, lr=0.1)
+    losses = []
+    for _ in range(6):
+        params, loss = step(params, toks, tgts)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.1, losses
