@@ -255,9 +255,12 @@ def _dense_runner(cfg: TransformerConfig, B: int, Tp: int, n_new: int,
             nxt = jnp.argmax(lg, axis=-1).astype(tok.dtype)
             return (nxt, c), tok
 
-        (_, _), toks = jax.lax.scan(
-            step, (tok, c), Tp + jnp.arange(n_new)
+        # n_new - 1 decode forwards: the last emitted token is the final
+        # carry, so no forward is spent computing a discarded successor
+        (tok, _), toks = jax.lax.scan(
+            step, (tok, c), Tp + jnp.arange(n_new - 1)
         )
+        toks = jnp.concatenate([toks, tok[None]], axis=0)
         return toks.swapaxes(0, 1)  # (B, n_new)
 
     return run
@@ -394,9 +397,12 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
             nxt = jnp.argmax(lg[:, 0], axis=-1).astype(tok.dtype)
             return (nxt, cache), tok
 
-        (_, _), toks = jax.lax.scan(
-            step, (tok, cache), Tp + jnp.arange(n_new)
+        # n_new - 1 decode forwards, as in the dense runner: the final
+        # token comes out of the carry, not a discarded extra forward
+        (tok, _), toks = jax.lax.scan(
+            step, (tok, cache), Tp + jnp.arange(n_new - 1)
         )
+        toks = jnp.concatenate([toks, tok[None]], axis=0)
         return toks.swapaxes(0, 1)
 
     f = jax.shard_map(

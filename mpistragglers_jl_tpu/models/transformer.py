@@ -520,11 +520,44 @@ def make_optax_train_step(
     family needs.
     """
     step = optax_step(_make_loss_fn(cfg, mesh), tx, donate=donate)
+    return step, make_opt_init(tx)
+
+
+def make_opt_init(tx):
+    """(params) -> optimizer state whose param-like leaves (moments)
+    carry their parameter's sharding FROM INIT, not only after the
+    first step. ``jax.jit(tx.init)`` alone does NOT propagate input
+    shardings to its outputs (measured: every moment lands
+    single-device; the round-3 assertion only passed because it ran
+    after a step had resharded the state). The state's sharding pytree
+    is built up front (param-like leaves take their parameter's
+    sharding via ``optax.tree_map_params`` over an ``eval_shape``
+    skeleton, step counts replicate) and passed as jit
+    ``out_shardings`` — so the state MATERIALIZES sharded and no
+    unsharded copy ever exists, which matters at exactly the scale
+    where sharded moments are the point."""
+    import optax
 
     def init_state(params):
-        return jax.jit(tx.init)(params)
+        shardings = [
+            p.sharding for p in jax.tree.leaves(params)
+            if isinstance(p, jax.Array)
+            and isinstance(p.sharding, NamedSharding)
+        ]
+        if not shardings:
+            return jax.jit(tx.init)(params)  # dense/single-device
+        replicated = NamedSharding(shardings[0].mesh, P())
+        skeleton = jax.eval_shape(tx.init, params)
+        out_shardings = optax.tree_map_params(
+            tx,
+            lambda _, p: p.sharding,
+            skeleton,
+            params,
+            transform_non_params=lambda _: replicated,
+        )
+        return jax.jit(tx.init, out_shardings=out_shardings)(params)
 
-    return step, init_state
+    return init_state
 
 
 def make_train_step(

@@ -63,14 +63,45 @@ def _pick_block(L: int, block: int) -> int:
     Mosaic tilings; anything else compiles only in interpret mode).
     When L has no 8-aligned divisor <= ``block`` (odd/prime lengths),
     the fallback is the whole dimension in one block — legal but VMEM-
-    bounded, so very large such L may exceed VMEM; pad the sequence to
-    a multiple of 8 upstream for those shapes."""
+    bounded; :func:`_check_vmem` rejects fallback blocks whose working
+    set cannot fit the 16 MiB scoped budget instead of letting Mosaic
+    OOM mid-compile."""
     b = min(block, L)
     while b > 0:
         if L % b == 0 and (b % 8 == 0 or b == L):
             return b
         b -= 1
     return L
+
+
+_VMEM_BUDGET = 16 * 2 ** 20  # Mosaic's scoped VMEM allocation (bytes)
+
+
+def _check_vmem(bq: int, bk: int, D: int, itemsize: int) -> None:
+    """Reject whole-dimension fallback blocks that cannot fit VMEM.
+
+    Only the odd-length fallback (block not sublane-aligned — see
+    :func:`_pick_block`) is checked: the tuned aligned defaults are
+    measured-good, while a prime 100k-token sequence would otherwise
+    hand Mosaic an impossible tiling and die mid-compile with an
+    opaque allocation error. The estimate is the per-grid-step working
+    set of the heaviest kernel (dk/dv backward): f32 scratch
+    accumulators + m/l lanes + resident q/k/v/do blocks + the (bq, bk)
+    score/probability intermediates."""
+    if bq % 8 == 0 and bk % 8 == 0:
+        return
+    est = 4 * (2 * bk * D + 2 * bq * _LANE + 2 * bq * bk) + itemsize * (
+        2 * bq * D + 2 * bk * D
+    )
+    if est > _VMEM_BUDGET:
+        raise ValueError(
+            f"flash attention fallback block ({bq}x{bk}, head_dim {D}) "
+            f"needs ~{est / 2**20:.0f} MiB of VMEM, over the "
+            f"{_VMEM_BUDGET // 2**20} MiB scoped budget: the sequence "
+            "length has no 8-aligned divisor, so the kernel would take "
+            "it in one block. Pad the sequence to a multiple of 8 "
+            "(ideally 1024) upstream."
+        )
 
 
 def _sds(shape, dtype, like):
@@ -408,6 +439,8 @@ def flash_attention(
         interpret = _use_interpret()
     bq = _pick_block(Lq, block_q)
     bk = _pick_block(Lk, block_k)
+    if not interpret:  # the interpreter has no VMEM to blow
+        _check_vmem(bq, bk, D, q.dtype.itemsize)
 
     def to3(x, L, h):
         return x.transpose(0, 2, 1, 3).reshape(B * h, L, D)

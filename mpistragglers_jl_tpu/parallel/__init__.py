@@ -20,8 +20,10 @@ _HOME = {
     "pipeline_circular": "pipeline",
     "pipeline_param_specs_circular": "pipeline",
     "bubble_fraction": "pipeline",
+    "measure_bubble": "pipeline",
     "stack_layers": "pipeline",
     "make_pipeline_train_step": "pipeline",
+    "make_optax_pipeline_train_step": "pipeline",
     "shard_params_pipeline": "pipeline",
 }
 

@@ -254,6 +254,13 @@ def test_optax_train_step_adamw():
     tx = optax.adamw(1e-2)
     step, init_state = make_optax_train_step(cfg, mesh, tx)
     opt_state = init_state(params)
+    # shardings must hold AT INIT, before any step reshards the state
+    # (round-4 fix: jit(tx.init) alone left every moment single-device)
+    adam0 = next(s for s in opt_state if hasattr(s, "mu"))
+    for p_leaf, m_leaf in zip(
+        jax.tree.leaves(params), jax.tree.leaves(adam0.mu)
+    ):
+        assert p_leaf.sharding == m_leaf.sharding
     losses = []
     for _ in range(5):
         params, opt_state, loss = step(params, opt_state, inp, tgt)
