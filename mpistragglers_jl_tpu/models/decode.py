@@ -271,6 +271,8 @@ def generate_dense(params, prompt, n_new: int, cfg: TransformerConfig,
     """Greedy generation, dense single-program: prefill + lax.scan of
     decode steps under one jit (compiled once per shape, cached).
     Returns (B, n_new) tokens."""
+    if n_new < 1:
+        raise ValueError(f"n_new must be >= 1, got {n_new}")
     B, Tp = prompt.shape
     if max_len is None:
         max_len = Tp + n_new
@@ -363,6 +365,8 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
     """
 
     _check_sharded_decode(cfg)
+    if n_new < 1:
+        raise ValueError(f"n_new must be >= 1, got {n_new}")
 
     def local(params, prompt):
         B, Tp = prompt.shape

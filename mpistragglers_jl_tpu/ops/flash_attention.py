@@ -78,29 +78,34 @@ _VMEM_BUDGET = 16 * 2 ** 20  # Mosaic's scoped VMEM allocation (bytes)
 
 
 def _check_vmem(bq: int, bk: int, D: int, itemsize: int) -> None:
-    """Reject whole-dimension fallback blocks that cannot fit VMEM.
+    """Reject block choices that cannot fit VMEM, with a clear error
+    instead of an opaque Mosaic mid-compile allocation failure.
 
-    Only the odd-length fallback (block not sublane-aligned — see
-    :func:`_pick_block`) is checked: the tuned aligned defaults are
-    measured-good, while a prime 100k-token sequence would otherwise
-    hand Mosaic an impossible tiling and die mid-compile with an
-    opaque allocation error. The estimate is the per-grid-step working
-    set of the heaviest kernel (dk/dv backward): f32 scratch
-    accumulators + m/l lanes + resident q/k/v/do blocks + the (bq, bk)
-    score/probability intermediates."""
-    if bq % 8 == 0 and bk % 8 == 0:
-        return
+    Covers both the odd-length whole-dimension fallback (see
+    :func:`_pick_block`) and explicitly tuned oversize blocks (e.g.
+    ``block_q=2048`` at head_dim 128 — the PERF round-4 block sweep hit
+    exactly that OOM). The estimate is the per-grid-step working set of
+    the heaviest kernel (dk/dv backward): f32 scratch accumulators +
+    m/l lanes + the (bq, bk) score/probability intermediates + resident
+    q/k/v/do blocks. The tuned 1024x1024 default at head_dim 128
+    estimates ~11.5 MiB — inside the 16 MiB budget with the same
+    headroom Mosaic's double-buffering eats in practice."""
     est = 4 * (2 * bk * D + 2 * bq * _LANE + 2 * bq * bk) + itemsize * (
         2 * bq * D + 2 * bk * D
     )
     if est > _VMEM_BUDGET:
+        aligned = bq % 8 == 0 and bk % 8 == 0
+        why = (
+            "lower block_q/block_k"
+            if aligned
+            else "the sequence length has no 8-aligned divisor, so the "
+            "kernel would take it in one block; pad the sequence to a "
+            "multiple of 8 (ideally 1024) upstream"
+        )
         raise ValueError(
-            f"flash attention fallback block ({bq}x{bk}, head_dim {D}) "
-            f"needs ~{est / 2**20:.0f} MiB of VMEM, over the "
-            f"{_VMEM_BUDGET // 2**20} MiB scoped budget: the sequence "
-            "length has no 8-aligned divisor, so the kernel would take "
-            "it in one block. Pad the sequence to a multiple of 8 "
-            "(ideally 1024) upstream."
+            f"flash attention block ({bq}x{bk}, head_dim {D}) needs "
+            f"~{est / 2**20:.0f} MiB of VMEM, over the "
+            f"{_VMEM_BUDGET // 2**20} MiB scoped budget: {why}."
         )
 
 

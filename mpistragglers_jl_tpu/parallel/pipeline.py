@@ -565,9 +565,13 @@ def _head_loss_sum(head_params, payload, tgt, cfg):
 
     y, aux = payload
     h = _ln(y, head_params["lnf_s"], head_params["lnf_b"])
-    logits = jnp.einsum("bld,vd->blv", h, head_params["emb"])
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    logits = jnp.einsum(
+        "bld,vd->blv", h, head_params["emb"]
+    ).astype(jnp.float32)
+    # logsumexp form: no materialized f32 log_softmax (see nll_loss)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tl = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+    nll = lse - tl
     loss = nll.sum()
     if cfg.n_experts and cfg.moe_aux_coef:
         # aux is a per-microbatch mean-style quantity; scale by the
@@ -742,6 +746,8 @@ def make_pipeline_train_step(cfg, mesh: Mesh, *, n_microbatch: int,
     shard_map program in models/transformer.py when sequence sharding is
     needed; pipeline targets the deep-model regime).
     """
+    from ..models.transformer import sgd_step_from_grads
+
     pp = mesh.shape["pp"]
     if cfg.n_layers % pp != 0:
         raise ValueError(
@@ -750,16 +756,7 @@ def make_pipeline_train_step(cfg, mesh: Mesh, *, n_microbatch: int,
     grad_fn = _pipeline_grad_fn(
         cfg, mesh, n_microbatch, schedule, virtual_stages
     )
-
-    @jax.jit
-    def step(params, tokens, targets):
-        loss, grads = grad_fn(params, tokens, targets)
-        params = jax.tree.map(
-            lambda p, g: p - lr * g.astype(p.dtype), params, grads
-        )
-        return params, loss
-
-    return step
+    return sgd_step_from_grads(grad_fn, lr=lr)
 
 
 def _pipeline_grad_fn(cfg, mesh: Mesh, n_microbatch: int, schedule: str,
@@ -833,7 +830,7 @@ def make_optax_pipeline_train_step(
     owning stage, no replicated optimizer copies in HBM).
     ``donate=True`` donates params AND opt_state for in-place updates.
     """
-    import optax
+    from ..models.transformer import make_opt_init, optax_step_from_grads
 
     pp = mesh.shape["pp"]
     if cfg.n_layers % pp != 0:
@@ -843,16 +840,7 @@ def make_optax_pipeline_train_step(
     grad_fn = _pipeline_grad_fn(
         cfg, mesh, n_microbatch, schedule, virtual_stages
     )
-
-    def step(params, opt_state, tokens, targets):
-        loss, grads = grad_fn(params, tokens, targets)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    step = jax.jit(step, donate_argnums=(0, 1) if donate else ())
-    from ..models.transformer import make_opt_init
-
+    step = optax_step_from_grads(grad_fn, tx, donate=donate)
     return step, make_opt_init(tx)
 
 

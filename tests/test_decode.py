@@ -215,3 +215,15 @@ def test_generate_dense_compile_cached():
     hits0 = _dense_runner.cache_info().hits
     generate_dense(params, prompt, 3, CFG)
     assert _dense_runner.cache_info().hits == hits0 + 1
+
+
+def test_generate_rejects_n_new_zero():
+    """n_new=0 used to return one token (the n_new-1 scan rewrite's
+    unconditional concat); it must be rejected up front."""
+    params = init_params(CFG, seed=0)
+    prompt = _tokens(CFG, B=1, L=4)
+    with pytest.raises(ValueError, match="n_new must be >= 1"):
+        generate_dense(params, prompt, 0, CFG)
+    mesh = make_mesh((1, 4), ("dp", "tp"))
+    with pytest.raises(ValueError, match="n_new must be >= 1"):
+        make_generate(CFG, mesh, n_new=0)

@@ -153,3 +153,16 @@ def test_odd_length_fallback_vmem_guard():
     q = jnp.zeros((1, L, 1, 64), jnp.bfloat16)
     with pytest.raises(ValueError, match="multiple of 8"):
         flash_attention(q, q, q, causal=True, interpret=False)
+
+
+def test_oversize_aligned_block_vmem_guard():
+    """Explicitly tuned oversize blocks get the same clear error as the
+    odd-L fallback (the PERF round-4 sweep's 2048-block Mosaic OOM)."""
+    import pytest
+
+    q = jnp.zeros((1, 2048, 1, 128), jnp.bfloat16)
+    with pytest.raises(ValueError, match="lower block_q/block_k"):
+        flash_attention(
+            q, q, q, causal=True, block_q=2048, block_k=2048,
+            interpret=False,
+        )
