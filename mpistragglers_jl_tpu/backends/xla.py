@@ -80,6 +80,17 @@ class StackedSlice:
         shape = self.stacked.shape[1:]
         return int(_np.prod(shape)) * self.stacked.dtype.itemsize
 
+    @property
+    def shape(self) -> tuple:
+        """The member result's shape (one row of the stack) — lets
+        shape-driven consumers (the fused adopter) treat slices like
+        the arrays they stand for."""
+        return tuple(self.stacked.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.stacked.dtype
+
     def materialize(self):
         return self.stacked[self.index]
 

@@ -24,6 +24,7 @@ _HOME = {
     "init_moe_layer": "moe",
     "moe_layer_specs": "moe",
     "switch_route": "moe",
+    "switch_route_indices": "moe",
     "moe_ffn_dense": "moe",
     "moe_ffn_sharded": "moe",
 }

@@ -15,9 +15,15 @@ Design (TPU-first, GShard/Switch lineage):
   (they ride the residual connection, the standard Switch behavior).
   The router gradient flows through the gate probability that scales
   the combined expert output.
-* **Dispatch/combine as einsums.** The (tokens, experts, capacity)
-  one-hot dispatch tensor turns routing into two MXU-friendly einsums
-  (gather-free), exactly the Mesh-TensorFlow formulation.
+* **Dispatch/combine as gather/scatter.** Routing materializes a
+  static (experts, capacity) token-index table
+  (:func:`switch_route_indices`); dispatch is one gather, combine one
+  scatter-add — O(E*C*D) HBM traffic and no MXU work. The classic
+  Mesh-TF one-hot einsum formulation (:func:`switch_route`) is kept as
+  the oracle the gather form is tested equal against: its (T, E*C, D)
+  dispatch matmuls are quadratic in token count and cost more than the
+  expert FFNs themselves at flagship token counts (docs/PERF.md
+  round 4).
 * **Expert parallelism = all_to_all over ``"ep"``.** Experts are
   sharded over the ``ep`` mesh axis and the *batch* is sharded over
   ``(dp, ep)`` — every ep member holds distinct tokens, so the tiled
@@ -43,6 +49,7 @@ __all__ = [
     "init_moe_layer",
     "moe_layer_specs",
     "switch_route",
+    "switch_route_indices",
     "moe_ffn_dense",
     "moe_ffn_sharded",
 ]
@@ -99,21 +106,80 @@ def switch_route(x2d: jax.Array, wg: jax.Array, capacity: int):
       router probability of expert e; 1.0 at perfect balance.
     """
     E = wg.shape[1]
-    logits = x2d.astype(jnp.float32) @ wg.astype(jnp.float32)  # (T, E)
-    probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)  # (T,)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    expert, slot, gate, aux = _route(x2d, wg)
     onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (T, E)
-    # slot within the chosen expert, in token order; >= capacity drops
-    slot = (jnp.cumsum(onehot, axis=0) - onehot) * onehot  # (T, E)
-    slot = slot.sum(axis=1).astype(jnp.int32)  # (T,)
     dispatch = onehot[:, :, None] * jax.nn.one_hot(
         slot, capacity, dtype=jnp.float32
     )[:, None, :]  # (T, E, C); one_hot(slot >= C) is all-zero = dropped
     combine = dispatch * gate[:, None, None].astype(jnp.float32)
-    frac = onehot.mean(axis=0)
-    aux = E * jnp.sum(frac * probs.mean(axis=0))
     return dispatch, combine, aux
+
+
+def _route(x2d: jax.Array, wg: jax.Array):
+    """The router core shared by both routing forms: top-1 expert,
+    cumsum slot (in token order), gate probability, Switch aux loss.
+    Returns ``(expert (T,), slot (T,), gate (T,) f32, aux)``."""
+    logits = x2d.astype(jnp.float32) @ wg.astype(jnp.float32)  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(expert, wg.shape[1], dtype=jnp.float32)
+    # slot within the chosen expert, in token order; >= capacity drops
+    slot = (jnp.cumsum(onehot, axis=0) - onehot) * onehot  # (T, E)
+    slot = slot.sum(axis=1).astype(jnp.int32)  # (T,)
+    frac = onehot.mean(axis=0)
+    aux = wg.shape[1] * jnp.sum(frac * probs.mean(axis=0))
+    return expert, slot, gate, aux
+
+
+def switch_route_indices(x2d: jax.Array, wg: jax.Array, capacity: int):
+    """Top-1 routing as static-shape INDEX TABLES (the gather/scatter
+    form of :func:`switch_route`).
+
+    The one-hot ``dispatch``/``combine`` tensors of the Mesh-TF
+    formulation turn routing into (T, E*C, D) matmuls — quadratic in
+    token count (T=16k tokens at the flagship rung shape is ~0.7
+    TFLOP per layer of pure dispatch, more than the expert FFNs
+    themselves). This form replaces them with a (E, C) token-index
+    table: dispatch is a gather, combine is a scatter-add — O(E*C*D)
+    HBM traffic, zero MXU work, identical semantics (same cumsum slot
+    assignment, same capacity drops; measured-equal to the one-hot
+    path in tests/test_moe.py).
+
+    Returns ``(table, expert, gate, aux)``: ``table[e, c]`` is the
+    token index occupying slot c of expert e, or ``T`` (a sentinel one
+    past the last token) for empty slots; ``expert`` (T,) each token's
+    chosen expert; ``gate`` (T,) f32 router probabilities of the chosen
+    expert; ``aux`` the Switch load-balance loss.
+    """
+    T = x2d.shape[0]
+    E = wg.shape[1]
+    expert, slot, gate, aux = _route(x2d, wg)
+    # mode="drop": tokens whose slot >= capacity never enter the table
+    table = jnp.full((E, capacity), T, jnp.int32).at[expert, slot].set(
+        jnp.arange(T, dtype=jnp.int32), mode="drop"
+    )
+    return table, expert, gate, aux
+
+
+def _gather_dispatch(x2d, table):
+    """(T, D) tokens -> (E, C, D) expert slots; empty slots are zeros
+    (the sentinel row T gathers the zero pad)."""
+    x_pad = jnp.concatenate(
+        [x2d, jnp.zeros((1, x2d.shape[1]), x2d.dtype)], axis=0
+    )
+    return x_pad[table]
+
+
+def _scatter_combine(weighted, table, T):
+    """(E, C, D) weighted expert outputs -> (T, D) by scatter-add at
+    the table's token indices; empty slots land on the discarded
+    sentinel row, dropped tokens receive zero (the caller's residual
+    carries them)."""
+    E, C, D = weighted.shape
+    y = jnp.zeros((T + 1, D), weighted.dtype)
+    y = y.at[table.reshape(-1)].add(weighted.reshape(E * C, D))
+    return y[:T]
 
 
 def _expert_ffn(xe, mp):
@@ -135,12 +201,15 @@ def moe_ffn_dense(x: jax.Array, mp: dict, capacity_factor: float):
     """
     B, L, D = x.shape
     E = mp["wg"].shape[1]
-    C = _capacity(B * L, E, capacity_factor)
-    x2d = x.reshape(B * L, D)
-    dispatch, combine, aux = switch_route(x2d, mp["wg"], C)
-    xe = jnp.einsum("td,tec->ecd", x2d, dispatch.astype(x.dtype))
+    T = B * L
+    C = _capacity(T, E, capacity_factor)
+    x2d = x.reshape(T, D)
+    table, _, gate, aux = switch_route_indices(x2d, mp["wg"], C)
+    xe = _gather_dispatch(x2d, table)
     ye = _expert_ffn(xe, mp) + mp["be2"][:, None, :]
-    y = jnp.einsum("ecd,tec->td", ye, combine.astype(x.dtype))
+    gate_pad = jnp.concatenate([gate, jnp.zeros((1,), gate.dtype)])
+    g = gate_pad[table].astype(x.dtype)  # (E, C); empty slots 0
+    y = _scatter_combine(ye * g[..., None], table, T)
     return y.reshape(B, L, D), aux
 
 
@@ -165,11 +234,14 @@ def moe_ffn_sharded(x: jax.Array, mp: dict, capacity_factor: float,
     B, L, D = x.shape
     E_local = mp["we1"].shape[0]
     E = E_local * ep
-    C = _capacity(B * L, E, capacity_factor)
-    x2d = x.reshape(B * L, D)
-    # router: wg is replicated; logits over ALL E experts
-    dispatch, combine, aux = switch_route(x2d, mp["wg"], C)
-    xe = jnp.einsum("td,tec->ecd", x2d, dispatch.astype(x.dtype))
+    T = B * L
+    C = _capacity(T, E, capacity_factor)
+    x2d = x.reshape(T, D)
+    # router: wg is replicated; logits over ALL E experts. Gather-form
+    # dispatch (see switch_route_indices) — the (E, C, D) slot tensor
+    # the all_to_all ships is built by a gather, not a T x E*C matmul.
+    table, expert, gate, aux = switch_route_indices(x2d, mp["wg"], C)
+    xe = _gather_dispatch(x2d, table)
     # (E, C, D) -> ship expert-group j to ep member j; receive my
     # E_local experts' slots from every member: (E_local, ep*C, D)
     xe = jax.lax.all_to_all(
@@ -180,12 +252,17 @@ def moe_ffn_sharded(x: jax.Array, mp: dict, capacity_factor: float,
     ye = jax.lax.all_to_all(
         ye, ep_axis, split_axis=1, concat_axis=0, tiled=True
     )  # (E, C, D), tp-partial
-    y = jnp.einsum("ecd,tec->td", ye, combine.astype(x.dtype))
-    # be2 is replicated over tp, so it must bypass the caller's tp psum;
-    # gather the full (E, D) table (E is small) and weight it per token
-    # by the gate mass of its non-dropped slot, matching the dense path
+    gate_pad = jnp.concatenate([gate, jnp.zeros((1,), gate.dtype)])
+    g = gate_pad[table].astype(x.dtype)  # (E, C); empty slots 0
+    y = _scatter_combine(ye * g[..., None], table, T)
+    # be2 is replicated over tp, so it must bypass the caller's tp psum.
+    # It is a rank-1 per-token quantity: kept-gate[t] * be2[expert[t]] —
+    # O(T*D) (one small scatter for the kept mask + one row gather),
+    # NOT an (E, C, D) broadcast + second full scatter (review r4).
     be2 = jax.lax.all_gather(mp["be2"], ep_axis, axis=0, tiled=True)
-    ybias = jnp.einsum("ed,tec->td", be2, combine.astype(x.dtype))
+    kept = jnp.zeros((T + 1,), bool).at[table.reshape(-1)].set(True)[:T]
+    kg = jnp.where(kept, gate, 0.0).astype(x.dtype)  # (T,)
+    ybias = kg[:, None] * be2[expert]
     return y.reshape(B, L, D), ybias.reshape(B, L, D), aux
 
 

@@ -44,8 +44,15 @@ def _stacked_matmul_gather(blocks_all, sel, payload, precision):
 
 
 def build_device_groups(host_blocks, n: int, devices) -> dict:
-    """Group worker ids by their (round-robin) device and place ONE
-    stacked array of each group's blocks on it.
+    """Group worker ids by their DEVICE and place ONE stacked array of
+    each group's blocks on it.
+
+    ``devices`` maps worker i to ``devices[i % len(devices)]`` — a
+    short list is the round-robin layout, a length-n list is an
+    explicit per-worker map (the fused folded pool uses a blocked one).
+    Grouping is by device identity, matching how the backend coalesces
+    dispatches, so both layouts produce the same groups the batch_fn
+    will be called with.
 
     Returns ``{worker: (ids_tuple, stacked, {worker: position})}`` —
     every member maps to its group entry. Blocks must be equal-shaped
@@ -53,12 +60,11 @@ def build_device_groups(host_blocks, n: int, devices) -> dict:
     """
     by_dev: dict = {}
     for i in range(n):
-        by_dev.setdefault(i % len(devices), []).append(i)
+        by_dev.setdefault(devices[i % len(devices)], []).append(i)
     group_of: dict = {}
-    for d, ids in by_dev.items():
+    for dev, ids in by_dev.items():
         stacked = jax.device_put(
-            np.stack([np.asarray(host_blocks[i]) for i in ids]),
-            devices[d % len(devices)],
+            np.stack([np.asarray(host_blocks[i]) for i in ids]), dev
         )
         entry = (tuple(ids), stacked, {w: p for p, w in enumerate(ids)})
         for i in ids:

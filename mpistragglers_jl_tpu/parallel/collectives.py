@@ -52,26 +52,39 @@ def mds_decode_weights(code, idx) -> np.ndarray:
     return weights
 
 
-def masked_psum_scatter_combine(mesh: Mesh, axis: str = "w"):
+def masked_psum_scatter_combine(mesh: Mesh, axis: str = "w",
+                                fold: int = 1):
     """Build the jitted masked weighted-combine over a pool mesh.
 
     Returns ``combine(shards, weights)`` where ``shards`` is sharded
-    (n, rows, cols) with one block per device along ``axis`` and
-    ``weights`` is a replicated (n, n) matrix (row j = coefficients of
-    output block j over workers; zero column for every stale worker).
-    Output: (n, rows, cols), block j resident on device j — i.e. the
-    combined result, still sharded, ready for the next sharded consumer.
+    (n, rows, cols) with ``fold`` worker blocks per device along
+    ``axis`` (``n = fold * mesh.shape[axis]``; fold=1 is the one-
+    worker-per-device layout) and ``weights`` is a replicated (n, n)
+    matrix (row j = coefficients of output block j over workers; zero
+    column for every stale worker). Output: (n, rows, cols), block j
+    resident on device j // fold — the combined result, still sharded,
+    ready for the next sharded consumer. ``fold > 1`` is the folded
+    pool (more workers than mesh devices — e.g. an (8, 6) pool on the
+    single bench chip): each device contributes its local group with
+    one einsum and the same reduce-scatter places the output groups.
     """
 
     def _combine(shard, weights):
-        # shard: (1, rows, cols) this device's block; weights: (n, n)
+        # shard: (fold, rows, cols) this device's blocks; weights (n, n)
         w = jax.lax.axis_index(axis)
-        contrib = weights[:, w][:, None, None] * shard[0][None]  # (n, r, c)
-        # reduce-scatter: sums contributions AND places block j on dev j
-        out = jax.lax.psum_scatter(
-            contrib, axis, scatter_dimension=0, tiled=False
-        )
-        return out[None] if out.ndim == 2 else out
+        rows = w * fold + jnp.arange(fold)  # global worker ids held here
+        wsel = weights[:, rows]  # (n, fold)
+        # HIGHEST: this contraction IS the decode arithmetic — TPU
+        # default precision (bf16 passes) costs ~3 decimal digits of
+        # decode accuracy (measured 5e-3 vs 1e-6 rel err, round 4)
+        contrib = jnp.einsum(
+            "jl,lrc->jrc", wsel, shard,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # (n, r, c)
+        # reduce-scatter: sums contributions AND places group j on dev j
+        return jax.lax.psum_scatter(
+            contrib, axis, scatter_dimension=0, tiled=True
+        )  # (fold, r, c)
 
     f = jax.shard_map(
         _combine,

@@ -79,11 +79,13 @@ class _ShardAdopter:
     width cannot grow HBM pins without bound.
     """
 
-    def __init__(self, mesh: Mesh, axis: str, devices: list[jax.Device]):
+    def __init__(self, mesh: Mesh, axis: str, devices: list[jax.Device],
+                 fold: int = 1):
         self.mesh = mesh
         self.axis = axis
-        self.devices = devices
+        self.devices = devices  # per-WORKER device (len n), block layout
         self.n = len(devices)
+        self.fold = int(fold)  # workers per mesh device (1 = adoption)
         self._placeholders: dict[int, tuple] = {}  # i -> (shape, dtype, arr)
 
     def _placeholder(self, i: int, shape, dtype) -> jax.Array:
@@ -94,18 +96,63 @@ class _ShardAdopter:
         self._placeholders[i] = (shape, dtype, ph)
         return ph
 
+    def _result(self, pool: AsyncPool, i: int, ref_shape, ref_dtype):
+        from ..backends.xla import StackedSlice
+
+        r = pool.results[i]
+        if isinstance(r, StackedSlice):
+            r = r.materialize()  # device-side slice of the fused stack
+        if (
+            r is None
+            or not isinstance(r, jax.Array)
+            or r.shape != tuple(ref_shape)
+            or r.dtype != ref_dtype
+        ):
+            r = self._placeholder(i, tuple(ref_shape), ref_dtype)
+        return r
+
+    def _group_stack(self, pool: AsyncPool, dd: int, ref_shape, ref_dtype):
+        """One mesh device's (fold, *shard) block. Fast path: in batch
+        mode the map step already computed the whole group as ONE
+        stacked array on the device — every member is a StackedSlice
+        into it, in group order — so that stack is adopted directly,
+        zero copies. Otherwise the group is stacked device-side (one
+        concat, no cross-device traffic)."""
+        from ..backends.xla import StackedSlice
+
+        lo = dd * self.fold
+        group = [pool.results[lo + l] for l in range(self.fold)]
+        first = group[0]
+        if (
+            isinstance(first, StackedSlice)
+            and all(
+                isinstance(r, StackedSlice)
+                and r.stacked is first.stacked
+                and r.index == l
+                for l, r in enumerate(group)
+            )
+            and first.stacked.shape == (self.fold,) + tuple(ref_shape)
+            and first.stacked.dtype == ref_dtype
+        ):
+            return first.stacked
+        return jnp.stack(
+            [
+                self._result(pool, lo + l, ref_shape, ref_dtype)
+                for l in range(self.fold)
+            ]
+        )
+
     def assemble(self, pool: AsyncPool, ref_shape, ref_dtype) -> jax.Array:
-        shards = []
-        for i in range(self.n):
-            r = pool.results[i]
-            if (
-                r is None
-                or not isinstance(r, jax.Array)
-                or r.shape != tuple(ref_shape)
-                or r.dtype != ref_dtype
-            ):
-                r = self._placeholder(i, tuple(ref_shape), ref_dtype)
-            shards.append(r[None])  # (1, *shard) on device i
+        if self.fold == 1:
+            shards = [
+                self._result(pool, i, ref_shape, ref_dtype)[None]
+                for i in range(self.n)
+            ]  # (1, *shard) on device i — pure adoption, no copies
+        else:
+            shards = [
+                self._group_stack(pool, dd, ref_shape, ref_dtype)
+                for dd in range(self.n // self.fold)
+            ]
         return jax.make_array_from_single_device_arrays(
             (self.n,) + tuple(ref_shape),
             NamedSharding(self.mesh, P(self.axis)),
@@ -135,37 +182,81 @@ class PoolMeshCodedGemm:
         k: int,
         *,
         axis: str = "w",
+        n_workers: int | None = None,
         parity: str = "cauchy",
         precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
         delay_fn: DelayFn | None = None,
         dtype=None,
+        batch: bool = False,
+        batch_arrival: str = "ready",
     ):
+        """``n_workers`` defaults to the mesh axis size (one worker per
+        device — the pure zero-copy layout). ``n_workers > mesh size``
+        FOLDS the pool: contiguous groups of ``n/d`` workers share a
+        device (the single-bench-chip case: an (8, 6) pool on a
+        1-device mesh), the adopter stacks each group device-side, and
+        the combine reduce-scatters groups (collectives.py ``fold``).
+
+        ``batch=True`` coalesces each device's workers into ONE stacked
+        map program per epoch (ops/_batch.py, like ops/coded_gemm's
+        batch mode) — on a dispatch-latency-bound link this collapses
+        ``fold`` enqueues into one, and the adopter then adopts the
+        already-stacked group result with zero copies (the fully fused
+        epoch: one map program + one combine program per device).
+        ``batch_arrival`` defaults to ``"ready"`` like every other
+        batch-capable workload — real completion order, so ``repochs``
+        keeps its straggler meaning; pass ``"enqueue"`` only for
+        dispatch-latency benches that fence explicitly."""
         if dtype is not None:
             A = np.asarray(A, dtype=dtype)
-        n = mesh.shape[axis]
+        d = mesh.shape[axis]
+        n = int(n_workers) if n_workers is not None else d
+        if n % d != 0:
+            raise ValueError(
+                f"n_workers {n} must be a multiple of the mesh axis "
+                f"size {d} (whole worker groups per device)"
+            )
+        fold = n // d
         m = A.shape[0]
         if m % k != 0:
             raise ValueError(f"rows {m} must divide evenly into k={k} blocks")
         self.mesh = mesh
         self.axis = axis
-        self.devices = _mesh_axis_devices(mesh, axis)
+        axis_devs = _mesh_axis_devices(mesh, axis)
+        # blocked worker -> device map: group g = workers [g*fold, ...)
+        self.devices = [axis_devs[i // fold] for i in range(n)]
+        self.fold = fold
         self.code = MDSCode(n, k, parity=parity, dtype=A.dtype,
                             precision=precision)
         self.n, self.k = n, k
         self.block_rows = m // k
         self.precision = precision
         coded = self.code.encode_array(A)  # (n, m/k, d)
-        # one committed coded block per mesh device — the worker-resident
-        # operand of the map step (reference: per-worker data lives with
-        # the worker; here "with" means the chip's HBM)
-        self.blocks = [
-            jax.device_put(coded[i], self.devices[i]) for i in range(n)
-        ]
+        self._group_of: dict = {}
+        if batch:
+            # batch mode: the fused per-device stacks are the only
+            # device copy (ops/_batch.py); per-worker blocks stay host
+            coded_host = np.asarray(coded)
+            self.blocks = [coded_host[i] for i in range(n)]
+            from ..ops._batch import build_device_groups
+
+            self._group_of = build_device_groups(
+                self.blocks, n, self.devices
+            )
+        else:
+            # one committed coded block per worker slot — the worker-
+            # resident operand of the map step (reference: per-worker
+            # data lives with the worker; here "with" is the chip's HBM)
+            self.blocks = [
+                jax.device_put(coded[i], self.devices[i]) for i in range(n)
+            ]
         self.backend = XLADeviceBackend(
-            self._work, n, devices=self.devices, delay_fn=delay_fn
+            self._work, n, devices=self.devices, delay_fn=delay_fn,
+            batch_fn=self._batch_work if batch else None,
+            batch_arrival=batch_arrival,
         )
-        self._combine = masked_psum_scatter_combine(mesh, axis)
-        self._adopter = _ShardAdopter(mesh, axis, self.devices)
+        self._combine = masked_psum_scatter_combine(mesh, axis, fold=fold)
+        self._adopter = _ShardAdopter(mesh, axis, self.devices, fold=fold)
         # steady state re-uses one arrival pattern epoch after epoch; cache
         # the device-ready weight matrix per (pattern, dtype) so the hot
         # path pays neither the k×k inverse nor the H2D weights upload
@@ -173,6 +264,13 @@ class PoolMeshCodedGemm:
 
     def _work(self, i: int, payload: jax.Array, epoch: int) -> jax.Array:
         return _block_matmul(self.blocks[i], payload, precision=self.precision)
+
+    def _batch_work(self, ids, payload: jax.Array, epoch: int) -> jax.Array:
+        """Fused dispatch: every worker in ``ids`` (one device's group)
+        as one stacked matmul program."""
+        from ..ops._batch import batch_dispatch
+
+        return batch_dispatch(self._group_of, ids, payload, self.precision)
 
     @property
     def nwait(self):
@@ -182,8 +280,9 @@ class PoolMeshCodedGemm:
     def _check_pool(self, pool: AsyncPool) -> None:
         if pool.n_workers != self.n:
             raise ValueError(
-                f"pool has {pool.n_workers} workers but the mesh pool axis "
-                f"has {self.n} devices; they must match one-to-one"
+                f"pool has {pool.n_workers} workers but this workload "
+                f"is laid out for {self.n} (n_workers; {self.fold} per "
+                "mesh device) — they must match one-to-one"
             )
 
     def decode_from_pool(
