@@ -34,22 +34,24 @@ DELAY_S = 5.0
 EPOCHS = 3
 
 
-def _run_chained(A, B, precision, C_ref, ref_scale, fence, maxabs):
-    """One precision rung: chained epochs, one fence, min of 3 chains.
+def _run_chained(A, B, precision, C_ref, ref_scale, fence, maxabs, *,
+                 n_workers=N_WORKERS, k=K, delay_s=DELAY_S,
+                 epochs=EPOCHS, stragglers=STRAGGLERS, chains=3):
+    """One precision rung: chained epochs, one fence, min of ``chains``.
     Returns (t_coded, err, fresh_counts, rtt, t_all)."""
     import numpy as np
 
-    delay_fn = lambda i, e: DELAY_S if i in STRAGGLERS else 0.0
+    delay_fn = lambda i, e: delay_s if i in stragglers else 0.0
     lt = LTCodedGemm(
-        A, N_WORKERS, K,
+        A, n_workers, k,
         delay_fn=delay_fn,
         precision=precision,
     )
-    pool = AsyncPool(N_WORKERS)
+    pool = AsyncPool(n_workers)
     try:
         asyncmap(pool, B, lt.backend, nwait=lt.nwait)  # warmup
         float(fence(lt.result_device(pool)))
-        waitall(pool, lt.backend, timeout=3 * DELAY_S)
+        waitall(pool, lt.backend, timeout=3 * delay_s + 10)
 
         z = jax.device_put(np.ones(8, np.float32), lt.devices[0])
         float(fence(z))
@@ -61,27 +63,65 @@ def _run_chained(A, B, precision, C_ref, ref_scale, fence, maxabs):
         rtt = min(rtts)
 
         chain_s, fresh_counts = [], []
-        for _ in range(3):
+        for _ in range(chains):
             t0 = time.perf_counter()
-            for _ in range(EPOCHS):
+            for _ in range(epochs):
                 repochs = asyncmap(pool, B, lt.backend, nwait=lt.nwait)
                 fresh_counts.append(int((repochs == pool.epoch).sum()))
                 C = lt.result_device(pool)
             float(fence(C))  # in-order device stream: covers every epoch
-            chain_s.append((time.perf_counter() - t0 - rtt) / EPOCHS)
+            chain_s.append((time.perf_counter() - t0 - rtt) / epochs)
         t_coded = min(chain_s)
         err = float(maxabs(C, C_ref)) / ref_scale
-        waitall(pool, lt.backend, timeout=3 * DELAY_S)
+        waitall(pool, lt.backend, timeout=3 * delay_s + 10)
 
         # baseline: bulk-synchronous epoch, pays the injected stragglers
         t0 = time.perf_counter()
-        asyncmap(pool, B, lt.backend, nwait=N_WORKERS)
+        asyncmap(pool, B, lt.backend, nwait=n_workers)
         C_all = lt.result_device(pool)
         float(fence(C_all))
         t_all = time.perf_counter() - t0
         return t_coded, err, fresh_counts, rtt, t_all
     finally:
         lt.backend.shutdown()
+
+
+def bench_rung(m=8192, n_workers=16, k=8, delay_s=1.0, epochs=2,
+               chains=2):
+    """Scaled config-4 rung for bench.py's JSON contract: half-size
+    operands and 1 s stragglers bound the runtime (the full-size CLI
+    below is the comparable-to-BASELINE run). Same machinery: variable
+    decodability nwait, chained epochs, one fence, straggler-mitigation
+    factor vs the bulk-synchronous epoch."""
+    key = jax.random.key(0)
+    ka, kb = jax.random.split(key)
+    A = jax.random.normal(ka, (m, m), jnp.float32)
+    B = jax.random.normal(kb, (m, m), jnp.float32)
+    fence = jax.jit(jnp.sum)
+    maxabs = jax.jit(lambda c, r: jnp.max(jnp.abs(c - r)))
+    C_ref = jax.jit(
+        lambda a, b: jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+    )(A, B)
+    ref_scale = float(jnp.max(jnp.abs(C_ref)))
+    stragglers = (3, 11) if n_workers > 11 else (1,)
+    t_coded, err, fresh_counts, rtt, t_all = _run_chained(
+        A, B, jax.lax.Precision.HIGHEST, C_ref, ref_scale, fence, maxabs,
+        n_workers=n_workers, k=k, delay_s=delay_s, epochs=epochs,
+        stragglers=stragglers, chains=chains,
+    )
+    return {
+        "metric": f"lt-coded-gemm-{m}-{n_workers}w-scaled",
+        "value": round(t_coded, 4),
+        "unit": "s",
+        "vs_nwait_all": round(t_all / t_coded, 2),
+        "decode_rel_err": err,
+        "fresh_at_return": fresh_counts,
+        "gflops_per_chip": round(2.0 * m**3 / t_coded / 1e9, 1),
+        "injected_straggler_delay_s": delay_s,
+        "epochs_pipelined": epochs,
+        "chains_min_of": chains,
+        "fence_rtt_s": round(rtt, 4),
+    }
 
 
 def main():

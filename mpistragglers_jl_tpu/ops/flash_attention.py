@@ -306,6 +306,133 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dkp_ref, dvp_ref, dq_acc,
+                      *, scale, causal, bq, bk, nk):
+    """Single-pass backward: one (i, j) sweep computes dq (accumulated
+    over the inner j sweep in scratch) AND per-q-block dk/dv partials
+    (reduced outside). The split kernels recompute s and dp twice —
+    7 block-dots + 2 exps per (i, j); this shares them: 5 dots + 1 exp,
+    a ~25% executed-FLOP cut exactly where the short-sequence
+    attention tax lives (docs/PERF.md round-4 phase table)."""
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (j * bk <= i * bq + bq - 1) if causal else True
+
+    @pl.when(run)
+    def _update():
+        q = q_ref[0]
+        kb = k_ref[0]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG)
+        p = jnp.exp(s - lse_ref[0])  # (bq, bk)
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0])
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, kb.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dvp_ref[0, 0] = jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dvp_ref.dtype)
+        dkp_ref[0, 0] = (
+            jax.lax.dot_general(
+                ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+        ).astype(dkp_ref.dtype)
+
+    if causal:
+        @pl.when(jnp.logical_not(run))
+        def _zero():
+            # skipped causal blocks still own their partial output block
+            dkp_ref[0, 0] = jnp.zeros_like(dkp_ref[0, 0])
+            dvp_ref[0, 0] = jnp.zeros_like(dvp_ref[0, 0])
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_fused(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, g,
+               interpret):
+    """Fused backward dispatch: dq + f32 dk/dv partials per q block,
+    reduced by one XLA sum (and group-summed for GQA). Partial HBM is
+    (BH, nq, Lk, D) f32 — the traffic that made this variant measure
+    SLOWER than the split kernels on the chip (``_use_fused_bwd``);
+    it runs only under an explicit ``bwd_impl="fused"``."""
+    BH, Lq, D = q3.shape
+    Lk = k3.shape[1]
+    nq, nk = Lq // bq, Lk // bk
+    delta = jnp.sum(
+        do3.astype(jnp.float32) * o3.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
+    dq, dkp, dvp = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+            nk=nk,
+        ),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // g, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // g, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, i, j: (b, i, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, i, j: (b, i, j, 0)),
+        ],
+        out_shape=[
+            _sds((BH, Lq, D), q3.dtype, q3),
+            _sds((BH, nq, Lk, D), jnp.float32, k3),
+            _sds((BH, nq, Lk, D), jnp.float32, v3),
+        ],
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=_grid_params(),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    BHkv = BH // g
+    dk = (
+        dkp.reshape(BHkv, g * nq, Lk, D).sum(axis=1).astype(k3.dtype)
+    )
+    dv = (
+        dvp.reshape(BHkv, g * nq, Lk, D).sum(axis=1).astype(v3.dtype)
+    )
+    return dq, dk, dv
+
+
+def _use_fused_bwd() -> bool:
+    """auto -> split, always. MEASURED NEGATIVE RESULT (round 4, real
+    chip, flagship shape B=8 L=2048 H=8 Dh=128): the fused kernel's
+    5-vs-7 block-dot saving is outweighed by its (BH, nq, Lk, D) f32
+    partial writes + reduction — 27.5 ms vs the split kernels' 16.6 ms
+    for the 8-layer attention phase. The kernel is VPU/HBM-co-bound at
+    these shapes, so cutting MXU dots does not pay while the extra
+    ~nq x f32 dk/dv traffic does. Kept selectable (bwd_impl="fused")
+    so the measurement stays reproducible; docs/PERF.md round 4."""
+    return False
+
+
 def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, g, interpret):
     BH, Lq, D = q3.shape
     Lk = k3.shape[1]
@@ -383,20 +510,22 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, g, interpret):
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash3(q3, k3, v3, scale, causal, bq, bk, g, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash3(q3, k3, v3, scale, causal, bq, bk, g, fused_bwd, interpret):
     o, _ = _fwd(q3, k3, v3, scale, causal, bq, bk, g, interpret)
     return o
 
 
-def _flash3_fwd(q3, k3, v3, scale, causal, bq, bk, g, interpret):
+def _flash3_fwd(q3, k3, v3, scale, causal, bq, bk, g, fused_bwd,
+                interpret):
     o, lse = _fwd(q3, k3, v3, scale, causal, bq, bk, g, interpret)
     return o, (q3, k3, v3, o, lse)
 
 
-def _flash3_bwd(scale, causal, bq, bk, g, interpret, res, do3):
+def _flash3_bwd(scale, causal, bq, bk, g, fused_bwd, interpret, res, do3):
     q3, k3, v3, o3, lse = res
-    return _bwd(
+    impl = _bwd_fused if fused_bwd else _bwd
+    return impl(
         q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, g, interpret
     )
 
@@ -413,6 +542,7 @@ def flash_attention(
     scale: float | None = None,
     block_q: int = 1024,
     block_k: int = 1024,
+    bwd_impl: str = "auto",
     interpret: bool | None = None,
 ) -> jax.Array:
     """Fused flash attention on (B, L, H, D) tensors; differentiable.
@@ -429,6 +559,16 @@ def flash_attention(
     size whose backward kernels stay inside the 16 MiB VMEM scoped
     allocation (2048-blocks compile for the forward but OOM the dk/dv
     kernel's scratch).
+
+    ``bwd_impl``: ``"split"`` runs the classic two backward kernels
+    (dq over the k sweep; dk/dv over the q sweep — each recomputes
+    s/dp, 7 block-dots total); ``"fused"`` runs one kernel sharing the
+    recompute (5 block-dots) at the cost of an (BH, nq, Lk, D) f32
+    dk/dv-partial buffer reduced outside. ``"auto"`` (default)
+    resolves to split: the fused variant measured SLOWER on the chip
+    at the flagship shape (27.5 vs 16.6 ms for the 8-layer phase) —
+    the partial-buffer HBM traffic outweighs the dot saving on this
+    VPU/HBM-co-bound kernel (see ``_use_fused_bwd``; docs/PERF.md).
     """
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
@@ -446,12 +586,21 @@ def flash_attention(
     bk = _pick_block(Lk, block_k)
     if not interpret:  # the interpreter has no VMEM to blow
         _check_vmem(bq, bk, D, q.dtype.itemsize)
+    if bwd_impl == "auto":
+        fused_bwd = _use_fused_bwd()
+    elif bwd_impl in ("split", "fused"):
+        fused_bwd = bwd_impl == "fused"
+    else:
+        raise ValueError(
+            f"bwd_impl must be 'auto'|'split'|'fused', got {bwd_impl!r}"
+        )
 
     def to3(x, L, h):
         return x.transpose(0, 2, 1, 3).reshape(B * h, L, D)
 
     o3 = _flash3(
         to3(q, Lq, H), to3(k, Lk, Hkv), to3(v, Lk, Hkv),
-        float(scale), bool(causal), bq, bk, g, bool(interpret),
+        float(scale), bool(causal), bq, bk, g, fused_bwd,
+        bool(interpret),
     )
     return o3.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)

@@ -72,3 +72,19 @@ def test_long_context_training_example():
     # single-device dp=1 sp=1 tp=1 run that exercises no sharding
     assert "over 8 devices" in out.stdout, out.stdout[-500:]
     assert "sp=4" in out.stdout
+
+
+def test_serving_decode_example():
+    out = _run_example(
+        "serving_decode.py",
+        env_extra={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        },
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    # the sharded KV-cache generation really ran on the 8-device mesh
+    # with the GQA cache, and matched the dense oracle exactly
+    assert "mesh dp=2 tp=4" in out.stdout, out.stdout[-500:]
+    assert "kv cache heads: 2 vs 8 MHA" in out.stdout
+    assert "sharded generation == dense oracle: ok" in out.stdout
