@@ -344,6 +344,9 @@ def bench_decode(
         dt = time.perf_counter() - t0 - rtt
         best_g = dt if best_g is None else min(best_g, dt)
 
+    # the generation program runs n_new - 1 cached decode forwards
+    # (the first token comes out of prefill — models/decode.py scan)
+    n_dec = max(n_new - 1, 1)
     decode_s = max(best_g - best_p, 1e-9)
     Hkv = cfg.kv_heads
     cache_mb = (
@@ -361,8 +364,8 @@ def bench_decode(
         "prefill_s": round(best_p, 4),
         "prefill_tokens_per_s": round(batch * prompt_len / best_p, 1),
         "generate_total_s": round(best_g, 4),
-        "decode_ms_per_token": round(decode_s / n_new * 1e3, 3),
-        "decode_tokens_per_s": round(n_new * batch / decode_s, 1),
+        "decode_ms_per_token": round(decode_s / n_dec * 1e3, 3),
+        "decode_tokens_per_s": round(n_dec * batch / decode_s, 1),
         "compile_s": round(prefill_compile_s + gen_compile_s, 1),
         "fence_rtt_s": round(rtt, 4),
         "chains_min_of": chains,

@@ -236,23 +236,70 @@ def decode_step_dense(params, token, cache, pos, cfg: TransformerConfig):
     return logits[:, 0], cache
 
 
+def _pick_token(logits, pos, key, temperature, top_k, dtype, row0=0):
+    """Next-token choice shared by the dense and sharded generators:
+    greedy at ``temperature == 0`` (static), else softmax sampling at
+    the given temperature, optionally truncated to the top-k logits.
+
+    The per-draw key folds the global position AND the GLOBAL batch
+    row (``row0`` = this shard's batch offset, ``axis_index("dp") *
+    B_local`` under shard_map): a fixed key then yields one stream per
+    (row, position) regardless of how the batch is sharded — dense and
+    dp-sharded programs sample identical tokens, and every tp member
+    draws the same token from the identical post-psum logits."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(dtype)
+    lg = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        # lax.top_k's partial reduction, NOT a full-vocab sort: this
+        # runs per token inside the latency-critical decode scan
+        kth = jax.lax.top_k(lg, int(top_k))[0][..., -1:]
+        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    kpos = jax.random.fold_in(key, pos)
+    rows = row0 + jnp.arange(lg.shape[0])
+    return jax.vmap(
+        lambda r, ll: jax.random.categorical(
+            jax.random.fold_in(kpos, r), ll
+        )
+    )(rows, lg).astype(dtype)
+
+
+def _check_sampling_params(temperature, top_k) -> None:
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+
+
+def _check_sampling(temperature, top_k, key) -> None:
+    _check_sampling_params(temperature, top_k)
+    if temperature == 0.0 and key is not None:
+        raise ValueError("a PRNG key is only meaningful with "
+                         "temperature > 0 (greedy decoding is "
+                         "deterministic)")
+    if temperature > 0.0 and key is None:
+        raise ValueError("temperature > 0 needs a jax.random key")
+
+
 @functools.lru_cache(maxsize=64)
 def _dense_runner(cfg: TransformerConfig, B: int, Tp: int, n_new: int,
-                  max_len: int):
-    """Shape-keyed jitted prefill+scan greedy program (one compile per
-    (cfg, shapes); the cache is built inside the jit, not baked in as a
-    constant)."""
+                  max_len: int, temperature: float, top_k: int | None):
+    """Shape-keyed jitted prefill+scan generation program (one compile
+    per (cfg, shapes, sampling); the cache is built inside the jit, not
+    baked in as a constant)."""
 
     @jax.jit
-    def run(params, prompt):
+    def run(params, prompt, key):
         c = init_cache(cfg, B, max_len)
         logits, c = prefill_dense(params, prompt, c, cfg)
-        tok = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+        tok = _pick_token(
+            logits[:, -1], Tp - 1, key, temperature, top_k, prompt.dtype
+        )
 
         def step(carry, pos):
             tok, c = carry
             lg, c = decode_step_dense(params, tok, c, pos, cfg)
-            nxt = jnp.argmax(lg, axis=-1).astype(tok.dtype)
+            nxt = _pick_token(lg, pos, key, temperature, top_k, tok.dtype)
             return (nxt, c), tok
 
         # n_new - 1 decode forwards: the last emitted token is the final
@@ -267,12 +314,16 @@ def _dense_runner(cfg: TransformerConfig, B: int, Tp: int, n_new: int,
 
 
 def generate_dense(params, prompt, n_new: int, cfg: TransformerConfig,
-                   max_len: int | None = None):
-    """Greedy generation, dense single-program: prefill + lax.scan of
-    decode steps under one jit (compiled once per shape, cached).
-    Returns (B, n_new) tokens."""
+                   max_len: int | None = None, *,
+                   temperature: float = 0.0, top_k: int | None = None,
+                   key=None):
+    """Generation, dense single-program: prefill + lax.scan of decode
+    steps under one jit (compiled once per shape, cached). Greedy by
+    default; ``temperature > 0`` samples (optionally top-k-truncated)
+    with the given ``key``. Returns (B, n_new) tokens."""
     if n_new < 1:
         raise ValueError(f"n_new must be >= 1, got {n_new}")
+    _check_sampling(temperature, top_k, key)
     B, Tp = prompt.shape
     if max_len is None:
         max_len = Tp + n_new
@@ -281,7 +332,11 @@ def generate_dense(params, prompt, n_new: int, cfg: TransformerConfig,
             f"max_len {max_len} < prompt {Tp} + n_new {n_new}: decode "
             "positions would clamp into the last cache slot"
         )
-    return _dense_runner(cfg, B, Tp, n_new, max_len)(params, prompt)
+    if key is None:
+        key = jax.random.key(0)  # unused at temperature 0
+    return _dense_runner(
+        cfg, B, Tp, n_new, max_len, float(temperature), top_k
+    )(params, prompt, key)
 
 
 # --------------------------------------------------------------------------
@@ -350,10 +405,16 @@ def make_decode_step(cfg: TransformerConfig, mesh: Mesh):
 
 
 def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
-                  max_len: int | None = None):
-    """Jitted sharded greedy generation: (params, prompt (B, Tp)) ->
-    (B, n_new) tokens. Prefill + a lax.scan of decode steps inside ONE
-    shard_map program — zero host round trips between tokens.
+                  max_len: int | None = None, *,
+                  temperature: float = 0.0, top_k: int | None = None):
+    """Jitted sharded generation: ``gen(params, prompt (B, Tp)[, key])``
+    -> (B, n_new) tokens. Prefill + a lax.scan of decode steps inside
+    ONE shard_map program — zero host round trips between tokens.
+    Greedy by default; ``temperature > 0`` samples (optionally top-k)
+    and the returned callable takes the PRNG key as its third argument
+    (replicated across the mesh — every tp member draws the same token
+    from the identical post-psum logits; the dense and sharded
+    programs produce the same stream for the same key).
 
     The attention inside every layer of the training forward is
     replaced by cache reads; the tp psum of the training path is
@@ -367,8 +428,9 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
     _check_sharded_decode(cfg)
     if n_new < 1:
         raise ValueError(f"n_new must be >= 1, got {n_new}")
+    _check_sampling_params(temperature, top_k)
 
-    def local(params, prompt):
+    def local(params, prompt, key):
         B, Tp = prompt.shape
         L = max_len if max_len is not None else Tp + n_new
         if L < Tp + n_new:
@@ -390,7 +452,11 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
             params, prompt, cache, jnp.int32(0), cfg, prefill=True,
             kv_slice=kv_slice, tp_psum=True,
         )
-        tok = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+        row0 = jax.lax.axis_index("dp") * B
+        tok = _pick_token(
+            logits[:, -1], Tp - 1, key, temperature, top_k,
+            prompt.dtype, row0,
+        )
 
         def step(carry, pos):
             tok, cache = carry
@@ -398,7 +464,9 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
                 params, tok[:, None], cache, pos, cfg, prefill=False,
                 kv_slice=kv_slice, tp_psum=True,
             )
-            nxt = jnp.argmax(lg[:, 0], axis=-1).astype(tok.dtype)
+            nxt = _pick_token(
+                lg[:, 0], pos, key, temperature, top_k, tok.dtype, row0
+            )
             return (nxt, cache), tok
 
         # n_new - 1 decode forwards, as in the dense runner: the final
@@ -412,8 +480,16 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
     f = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(param_specs(cfg, mesh), P("dp", None)),
+        in_specs=(param_specs(cfg, mesh), P("dp", None), P()),
         out_specs=P("dp", None),
         check_vma=not _flash_interpreted(cfg.attn_impl),
     )
-    return jax.jit(f)
+    jitted = jax.jit(f)
+
+    def gen(params, prompt, key=None):
+        _check_sampling(temperature, top_k, key)
+        if key is None:
+            key = jax.random.key(0)  # unused at temperature 0
+        return jitted(params, prompt, key)
+
+    return gen

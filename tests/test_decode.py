@@ -227,3 +227,64 @@ def test_generate_rejects_n_new_zero():
     mesh = make_mesh((1, 4), ("dp", "tp"))
     with pytest.raises(ValueError, match="n_new must be >= 1"):
         make_generate(CFG, mesh, n_new=0)
+
+
+class TestSampledDecoding:
+    """temperature/top-k sampling shares the cached-decode machinery:
+    temperature 0 IS greedy; dense and sharded streams agree for the
+    same key; top-k truncation only emits top-k tokens."""
+
+    def test_temperature_zero_is_greedy(self):
+        params = init_params(CFG, seed=0)
+        prompt = _tokens(CFG, B=2, L=6)
+        a = generate_dense(params, prompt, 5, CFG)
+        b = generate_dense(params, prompt, 5, CFG, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_sampled_dense_matches_sharded_for_same_key(self):
+        cfg = dataclasses.replace(CFG, n_kv_heads=2)
+        mesh = make_mesh((2, 4), ("dp", "tp"))
+        params = init_params(cfg, seed=1)
+        prompt = _tokens(cfg, B=2, L=6, seed=2)
+        key = jax.random.key(7)
+        want = generate_dense(
+            params, prompt, 6, cfg, temperature=0.8, top_k=8, key=key
+        )
+        gen = make_generate(cfg, mesh, n_new=6, temperature=0.8, top_k=8)
+        got = gen(
+            shard_params(params, cfg, mesh),
+            jax.device_put(prompt, NamedSharding(mesh, P("dp", None))),
+            key,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # a different key gives a different stream (it is really sampling)
+        other = generate_dense(
+            params, prompt, 6, cfg, temperature=0.8, top_k=8,
+            key=jax.random.key(8),
+        )
+        assert not np.array_equal(np.asarray(want), np.asarray(other))
+
+    def test_top_k_one_is_greedy(self):
+        params = init_params(CFG, seed=3)
+        prompt = _tokens(CFG, B=1, L=5, seed=4)
+        greedy = generate_dense(params, prompt, 4, CFG)
+        k1 = generate_dense(
+            params, prompt, 4, CFG, temperature=1.5, top_k=1,
+            key=jax.random.key(0),
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+    def test_sampling_validation(self):
+        params = init_params(CFG, seed=0)
+        prompt = _tokens(CFG, B=1, L=4)
+        with pytest.raises(ValueError, match="needs a jax.random key"):
+            generate_dense(params, prompt, 2, CFG, temperature=1.0)
+        with pytest.raises(ValueError, match="only meaningful"):
+            generate_dense(
+                params, prompt, 2, CFG, key=jax.random.key(0)
+            )
+        with pytest.raises(ValueError, match="top_k must be"):
+            generate_dense(
+                params, prompt, 2, CFG, temperature=1.0, top_k=0,
+                key=jax.random.key(0),
+            )
