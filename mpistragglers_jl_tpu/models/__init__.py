@@ -14,6 +14,7 @@ _HOME = {
     "data_spec": "transformer",
     "init_cache": "decode",
     "cache_specs": "decode",
+    "decode_batch_axes": "decode",
     "shard_cache": "decode",
     "prefill_dense": "decode",
     "decode_step_dense": "decode",

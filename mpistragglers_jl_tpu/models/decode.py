@@ -53,7 +53,7 @@ from ..parallel.ring_attention import (
     _group_scores,
     resolve_attention_impl,
 )
-from .moe import moe_ffn_dense
+from .moe import moe_ffn_dense, moe_ffn_sharded
 from .transformer import (
     TransformerConfig,
     _kv_tp_sharded,
@@ -67,6 +67,7 @@ from .transformer import (
 __all__ = [
     "init_cache",
     "cache_specs",
+    "decode_batch_axes",
     "prefill_dense",
     "decode_step_dense",
     "generate_dense",
@@ -98,9 +99,17 @@ def init_cache(
     return [{"k": z, "v": z} for _ in range(cfg.n_layers)]
 
 
+def decode_batch_axes(cfg: TransformerConfig) -> tuple[str, ...]:
+    """Mesh axes the batch shards over at decode: MoE configs add
+    ``ep`` (every expert-parallel member routes distinct rows — the
+    GShard layout, matching the training path's ``batch_axes``)."""
+    return ("dp", "ep") if cfg.n_experts else ("dp",)
+
+
 def cache_specs(cfg: TransformerConfig) -> list[dict]:
-    """PartitionSpecs for the cache: batch over dp, heads over tp."""
-    s = P("dp", None, "tp", None)
+    """PartitionSpecs for the cache: batch over dp (and ep for MoE),
+    heads over tp."""
+    s = P(decode_batch_axes(cfg), None, "tp", None)
     return [{"k": s, "v": s} for _ in range(cfg.n_layers)]
 
 
@@ -157,7 +166,14 @@ def _incremental_layer(x, lp, cache_l, qpos, cfg, *, chunk_attn, kv_slice,
     x = x + attn_out
     h2 = _ln(x, lp["ln2_s"], lp["ln2_b"])
     if cfg.n_experts:
-        x = x + moe_ffn_dense(h2, lp, cfg.capacity_factor)[0]
+        if tp_psum:
+            # inside the mesh program: expert-parallel routing, exactly
+            # the training path's MoE branch (_forward_local) — experts
+            # over ep via all_to_all, hidden dims over tp
+            y, ybias, _ = moe_ffn_sharded(h2, lp, cfg.capacity_factor)
+            x = x + jax.lax.psum(y, "tp") + ybias
+        else:
+            x = x + moe_ffn_dense(h2, lp, cfg.capacity_factor)[0]
     else:
         y = _mlp(h2, lp)
         if tp_psum:
@@ -242,8 +258,9 @@ def _pick_token(logits, pos, key, temperature, top_k, dtype, row0=0):
     the given temperature, optionally truncated to the top-k logits.
 
     The per-draw key folds the global position AND the GLOBAL batch
-    row (``row0`` = this shard's batch offset, ``axis_index("dp") *
-    B_local`` under shard_map): a fixed key then yields one stream per
+    row (``row0`` = this shard's batch offset under shard_map, the
+    mixed-radix index over ``decode_batch_axes`` times B_local): a
+    fixed key then yields one stream per
     (row, position) regardless of how the batch is sharded — dense and
     dp-sharded programs sample identical tokens, and every tp member
     draws the same token from the identical post-psum logits."""
@@ -340,25 +357,30 @@ def generate_dense(params, prompt, n_new: int, cfg: TransformerConfig,
 
 
 # --------------------------------------------------------------------------
-# sharded (dp x tp mesh) API
+# sharded (dp [x ep] x tp mesh) API
 # --------------------------------------------------------------------------
 
 
-def _check_sharded_decode(cfg: TransformerConfig):
-    if cfg.n_experts:
-        raise NotImplementedError(
-            "sharded decode runs dense FFN layers only (expert routing "
-            "at decode composes with ep in a future rung); the dense "
-            "oracle (prefill_dense/decode_step_dense/generate_dense) "
-            "serves MoE configs"
+def _check_decode_mesh(cfg: TransformerConfig, mesh: Mesh):
+    """MoE decode composes expert parallelism: the mesh must carry an
+    ``ep`` axis (size 1 folds experts onto each member) alongside dp
+    and tp — same layout as the training path."""
+    need = {"dp", "tp"} | ({"ep"} if cfg.n_experts else set())
+    missing = need - set(mesh.axis_names)
+    if missing:
+        raise ValueError(
+            f"decode mesh is missing axes {sorted(missing)}; MoE "
+            "configs shard over (dp, ep, tp), dense over (dp, tp)"
         )
 
 
 def make_prefill(cfg: TransformerConfig, mesh: Mesh):
     """Jitted sharded prefill: (params, tokens (B, Tp), cache) ->
-    (last-position logits (B, V), cache). Batch over dp, heads over tp.
-    """
-    _check_sharded_decode(cfg)
+    (last-position logits (B, V), cache). Batch over dp (and ep for
+    MoE — expert routing runs sharded, all_to_all over ep, exactly as
+    in training), heads over tp."""
+    _check_decode_mesh(cfg, mesh)
+    bax = decode_batch_axes(cfg)
 
     def local(params, tokens, cache):
         _check_prefill_fits(tokens.shape[1], cache)
@@ -371,8 +393,8 @@ def make_prefill(cfg: TransformerConfig, mesh: Mesh):
     f = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(param_specs(cfg, mesh), P("dp", None), cache_specs(cfg)),
-        out_specs=(P("dp", None), cache_specs(cfg)),
+        in_specs=(param_specs(cfg, mesh), P(bax, None), cache_specs(cfg)),
+        out_specs=(P(bax, None), cache_specs(cfg)),
         check_vma=not _flash_interpreted(cfg.attn_impl),
     )
     return jax.jit(f)
@@ -383,7 +405,8 @@ def make_decode_step(cfg: TransformerConfig, mesh: Mesh):
     (logits (B, V), cache). Donates the cache for in-place HBM update.
     """
 
-    _check_sharded_decode(cfg)
+    _check_decode_mesh(cfg, mesh)
+    bax = decode_batch_axes(cfg)
 
     def local(params, token, cache, pos):
         logits, cache = _incremental_forward(
@@ -396,9 +419,9 @@ def make_decode_step(cfg: TransformerConfig, mesh: Mesh):
         local,
         mesh=mesh,
         in_specs=(
-            param_specs(cfg, mesh), P("dp"), cache_specs(cfg), P(),
+            param_specs(cfg, mesh), P(bax), cache_specs(cfg), P(),
         ),
-        out_specs=(P("dp", None), cache_specs(cfg)),
+        out_specs=(P(bax, None), cache_specs(cfg)),
         check_vma=not _flash_interpreted(cfg.attn_impl),
     )
     return jax.jit(f, donate_argnums=(2,))
@@ -425,7 +448,8 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
     sums cross tp via the psum below).
     """
 
-    _check_sharded_decode(cfg)
+    _check_decode_mesh(cfg, mesh)
+    bax = decode_batch_axes(cfg)
     if n_new < 1:
         raise ValueError(f"n_new must be >= 1, got {n_new}")
     _check_sampling_params(temperature, top_k)
@@ -452,7 +476,12 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
             params, prompt, cache, jnp.int32(0), cfg, prefill=True,
             kv_slice=kv_slice, tp_psum=True,
         )
-        row0 = jax.lax.axis_index("dp") * B
+        # global batch-row offset of this shard, derived from the one
+        # source of truth for the batch layout (dp-major, then ep)
+        row0 = jnp.int32(0)
+        for ax in decode_batch_axes(cfg):
+            row0 = row0 * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
+        row0 = row0 * B
         tok = _pick_token(
             logits[:, -1], Tp - 1, key, temperature, top_k,
             prompt.dtype, row0,
@@ -480,8 +509,8 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
     f = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(param_specs(cfg, mesh), P("dp", None), P()),
-        out_specs=P("dp", None),
+        in_specs=(param_specs(cfg, mesh), P(bax, None), P()),
+        out_specs=P(bax, None),
         check_vma=not _flash_interpreted(cfg.attn_impl),
     )
     jitted = jax.jit(f)
