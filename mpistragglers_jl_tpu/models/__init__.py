@@ -21,6 +21,7 @@ _HOME = {
     "generate_dense": "decode",
     "make_prefill": "decode",
     "make_decode_step": "decode",
+    "make_extend": "decode",
     "make_generate": "decode",
     "init_moe_layer": "moe",
     "moe_layer_specs": "moe",

@@ -74,6 +74,7 @@ __all__ = [
     "make_generate",
     "make_prefill",
     "make_decode_step",
+    "make_extend",
 ]
 
 _NEG = -1e30  # matches parallel/ring_attention.py
@@ -425,6 +426,63 @@ def make_decode_step(cfg: TransformerConfig, mesh: Mesh):
         check_vma=not _flash_interpreted(cfg.attn_impl),
     )
     return jax.jit(f, donate_argnums=(2,))
+
+
+def make_extend(cfg: TransformerConfig, mesh: Mesh):
+    """Jitted CHUNKED prefill step: (params, tokens (B, T), cache,
+    offset) -> (logits (B, T, V), cache) — processes a T-token chunk at
+    any global ``offset``, attending causally within the chunk and
+    fully to everything already cached below it. One compiled program
+    per chunk length serves a whole streaming prefill:
+
+    >>> extend = make_extend(cfg, mesh)
+    >>> for i in range(0, Tp, C):
+    ...     lg, cache = extend(params, prompt[:, i:i+C], cache, i)
+
+    The caller keeps ``offset + T <= max_len`` (dynamic offsets cannot
+    be trace-checked; out-of-range writes would clamp — see
+    :func:`decode_step_dense`); a chunk longer than the cache errors at
+    trace time. Equivalent position-for-position to one-shot
+    ``make_prefill`` (the
+    incremental forward is the training forward evaluated causally —
+    tests/test_decode.py pins the chunked == one-shot == dense-oracle
+    chain). The chunk attends through the masked cached-attention path
+    (offset 0 one-shot prefill keeps the flash chunk kernel); the
+    MoE capacity caveat of :func:`prefill_dense` applies per chunk.
+
+    The cache is deliberately NOT donated here: on the axon-tunneled
+    bench TPU the multi-token-chunk program with a donated cache
+    pytree dies with an opaque backend InvalidArgument at execution
+    (measured round 4 — the T=1 donated decode step and the undonated
+    T>1 program both run fine, so the aliasing of chunked
+    dynamic-update-slice outputs onto donated inputs is the trigger).
+    Chunked prefill runs once per prompt, so the extra cache copy is
+    noise next to the chunk compute."""
+
+    _check_decode_mesh(cfg, mesh)
+    bax = decode_batch_axes(cfg)
+
+    def local(params, tokens, cache, offset):
+        # the T-vs-cache half of the clamp guard is trace-time checkable
+        # (offset is dynamic: the caller owns offset + T <= max_len,
+        # as documented for decode_step_dense)
+        _check_prefill_fits(tokens.shape[1], cache)
+        logits, cache = _incremental_forward(
+            params, tokens, cache, offset, cfg, prefill=False,
+            kv_slice=make_kv_slice(cfg), tp_psum=True,
+        )
+        return logits, cache
+
+    f = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            param_specs(cfg, mesh), P(bax, None), cache_specs(cfg), P(),
+        ),
+        out_specs=(P(bax, None, None), cache_specs(cfg)),
+        check_vma=not _flash_interpreted(cfg.attn_impl),
+    )
+    return jax.jit(f)
 
 
 def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
