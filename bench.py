@@ -181,6 +181,18 @@ def bench_coded_gemm(m=8192, kdim=8192, ncols=8192, n=8, k=6, epochs=7):
     }
 
 
+def _try_rung(fn, **kw):
+    """Round-4 auxiliary rungs record a VISIBLE error instead of
+    zeroing out the whole contract on a transient tunnel failure (the
+    axon link can flake mid-session — docs/PERF.md drift notes). The
+    headline coded metric and the flagship transformer rung stay
+    loud-fail on purpose (VERDICT r2 item 1)."""
+    try:
+        return fn(**kw)
+    except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def driver_contract() -> dict:
     """The one-line JSON the driver records: the coded-GEMM headline
     plus every cross-cutting rung the PERF tables claim. Assembled HERE
@@ -204,8 +216,8 @@ def driver_contract() -> dict:
     from benchmarks.config4_lt_gemm import bench_rung
     from benchmarks.fused_chip_bench import bench_fused_chip
 
-    out["fused_rung"] = bench_fused_chip(epochs=8)
-    out["config4_rung"] = bench_rung()
+    out["fused_rung"] = _try_rung(bench_fused_chip, epochs=8)
+    out["config4_rung"] = _try_rung(bench_rung)
     return out
 
 
@@ -317,48 +329,64 @@ def _transformer_rungs():
             "loss_vs_oracle_rel_err",
         )
     }
-    lc32 = bench_transformer_train(
-        batch=1, seq=32768, steps=2, chains=2, oracle=False
-    )
-    tt["long_context_32k_rung"] = {
-        k: lc32[k]
-        for k in (
-            "value", "tokens_per_s", "model_tflops_per_s",
-            "mfu_vs_raw_matmul", "seq",
+    def rung32():
+        lc32 = bench_transformer_train(
+            batch=1, seq=32768, steps=2, chains=2, oracle=False
         )
-    }
-    gqa = bench_transformer_train(
-        batch=1, seq=16384, steps=3, chains=2, n_kv_heads=2
-    )
-    tt["gqa_long_context_rung"] = {
-        **{
-            k: gqa[k]
+        return {
+            k: lc32[k]
             for k in (
-                "value", "tokens_per_s", "params_m",
-                "loss_vs_oracle_rel_err",
+                "value", "tokens_per_s", "model_tflops_per_s",
+                "mfu_vs_raw_matmul", "seq",
             )
-        },
-        "n_kv_heads": 2,
-        "step_vs_mha": round(gqa["value"] / lc["value"], 3),
-    }
-    rm = bench_transformer_train(
-        batch=1, seq=16384, steps=3, chains=2, remat=True, oracle=False
-    )
-    tt["remat_rung"] = {
-        "value": rm["value"],
-        "tokens_per_s": rm["tokens_per_s"],
-        "step_vs_no_remat": round(rm["value"] / lc["value"], 3),
-    }
+        }
+
+    tt["long_context_32k_rung"] = _try_rung(rung32)
+
+    def rung_gqa():
+        gqa = bench_transformer_train(
+            batch=1, seq=16384, steps=3, chains=2, n_kv_heads=2
+        )
+        return {
+            **{
+                k: gqa[k]
+                for k in (
+                    "value", "tokens_per_s", "params_m",
+                    "loss_vs_oracle_rel_err",
+                )
+            },
+            "n_kv_heads": 2,
+            "step_vs_mha": round(gqa["value"] / lc["value"], 3),
+        }
+
+    tt["gqa_long_context_rung"] = _try_rung(rung_gqa)
+
+    def rung_remat():
+        rm = bench_transformer_train(
+            batch=1, seq=16384, steps=3, chains=2, remat=True,
+            oracle=False,
+        )
+        return {
+            "value": rm["value"],
+            "tokens_per_s": rm["tokens_per_s"],
+            "step_vs_no_remat": round(rm["value"] / lc["value"], 3),
+        }
+
+    tt["remat_rung"] = _try_rung(rung_remat)
     from benchmarks.transformer_train_bench import bench_decode
 
-    tt["decode_rung"] = bench_decode()
-    from benchmarks.moe_bench import bench_moe_train
+    tt["decode_rung"] = _try_rung(bench_decode)
 
-    moe = bench_moe_train(steps=3, chains=2, dense_baseline=False)
-    moe["routing_overhead_share"] = round(
-        (moe["value"] - tt["value"]) / moe["value"], 3
-    )
-    tt["moe_rung"] = moe
+    def rung_moe():
+        from benchmarks.moe_bench import bench_moe_train
+
+        moe = bench_moe_train(steps=3, chains=2, dense_baseline=False)
+        moe["routing_overhead_share"] = round(
+            (moe["value"] - tt["value"]) / moe["value"], 3
+        )
+        return moe
+
+    tt["moe_rung"] = _try_rung(rung_moe)
     return tt
 
 

@@ -64,6 +64,10 @@ def main(argv=None) -> None:
     ap.add_argument("--seq", type=int, default=256)
     ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="grouped-query attention: K/V head count "
+                    "(default MHA; e.g. 2 shrinks K/V projections and "
+                    "the ring/Ulysses K/V traffic by n_heads/kv_heads)")
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--steps", type=int, default=8)
     args = ap.parse_args(argv)
@@ -91,6 +95,7 @@ def main(argv=None) -> None:
         vocab=512,
         d_model=args.d_model,
         n_heads=heads,
+        n_kv_heads=args.kv_heads,
         n_layers=args.layers,
         d_ff=args.d_model * 4,
         attn="ulysses",

@@ -423,7 +423,10 @@ def make_decode_step(cfg: TransformerConfig, mesh: Mesh):
             param_specs(cfg, mesh), P(bax), cache_specs(cfg), P(),
         ),
         out_specs=(P(bax, None), cache_specs(cfg)),
-        check_vma=not _flash_interpreted(cfg.attn_impl),
+        # decode traces NO flash kernel (masked cached attention), so
+        # the interpreted-Pallas vma carve-out does not apply — keep
+        # shard_map's varying-axes checking on
+        check_vma=True,
     )
     return jax.jit(f, donate_argnums=(2,))
 
@@ -480,7 +483,7 @@ def make_extend(cfg: TransformerConfig, mesh: Mesh):
             param_specs(cfg, mesh), P(bax, None), cache_specs(cfg), P(),
         ),
         out_specs=(P(bax, None, None), cache_specs(cfg)),
-        check_vma=not _flash_interpreted(cfg.attn_impl),
+        check_vma=True,  # no flash kernel in the extend program
     )
     return jax.jit(f)
 
