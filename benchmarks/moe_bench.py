@@ -127,16 +127,24 @@ def bench_moe_train(
     T = batch * seq
     C = _capacity(T, E, capacity_factor)
 
+    C_half = _capacity(T, E, 0.5)
+
     @jax.jit
     def drops(params, toks):
         x = params["emb"][toks].reshape(T, d_model)
-        table, _, _, aux = switch_route_indices(
-            x, params["layers"][0]["wg"], C
-        )
+        wg = params["layers"][0]["wg"]
+        table, _, _, aux = switch_route_indices(x, wg, C)
         routed = (table < T).sum()
-        return 1.0 - routed / T, aux
+        # under-capacity probe: the SAME batch/router at cf=0.5 — a
+        # balanced router must then drop ~half its tokens, so this
+        # shows the measured drop machinery firing (a near-init router
+        # at the rung's generous cf legitimately reads 0.0 — round-4
+        # PERF note)
+        table_h, _, _, _ = switch_route_indices(x, wg, C_half)
+        routed_h = (table_h < T).sum()
+        return 1.0 - routed / T, 1.0 - routed_h / T, aux
 
-    drop_rate, aux0 = drops(params_m, inp_m)
+    drop_rate, drop_rate_cf_half, aux0 = drops(params_m, inp_m)
 
     out = {
         "metric": "moe-train-step",
@@ -148,6 +156,7 @@ def bench_moe_train(
         "capacity_factor": capacity_factor,
         "capacity_per_expert": C,
         "drop_rate": round(float(drop_rate), 4),
+        "drop_rate_at_cf_0.5": round(float(drop_rate_cf_half), 4),
         "aux_loss": round(float(aux0), 3),
         "loss_first": round(l0, 4),
         "loss_last": round(l1, 4),
