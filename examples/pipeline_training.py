@@ -4,7 +4,8 @@ Runs on a virtual 8-device CPU mesh out of the box (no TPU slice
 needed), exercising the full (dp, pp) program: one-forward-one-backward
 interleaving with O(pp) activation memory, the loss head folded into
 the last stage, expert layers inside their stage with the Switch aux
-loss riding the payload, and the analytic bubble fraction beside the
+loss riding the payload, and the bubble fraction — analytic AND
+measured from the executing schedule's per-tick trace — beside the
 loss curve.
 
 Run:
@@ -45,6 +46,7 @@ from mpistragglers_jl_tpu.parallel import make_mesh  # noqa: E402
 from mpistragglers_jl_tpu.parallel.pipeline import (  # noqa: E402
     bubble_fraction,
     make_pipeline_train_step,
+    measure_bubble,
     shard_params_pipeline,
 )
 
@@ -64,6 +66,13 @@ def main():
         f"1F1B bubble = {bubble_fraction(pp, n_micro):.2f} "
         f"(gpipe would be {bubble_fraction(pp, n_micro, 'gpipe'):.2f} "
         "each way)"
+    )
+    # MEASURED, not just analytic (round 4): the per-tick busy trace
+    # from the executing schedule integrates to exactly the formula
+    mb = measure_bubble(mesh, n_micro, "1f1b")
+    print(
+        f"measured 1F1B idle fraction = {mb['measured']:.4f} over "
+        f"{mb['ticks']} ticks (formula {mb['formula']:.4f})"
     )
     params = shard_params_pipeline(init_params(cfg, seed=0), cfg, mesh)
     step = make_pipeline_train_step(
