@@ -299,9 +299,21 @@ def _check_sampling(temperature, top_k, key) -> None:
         raise ValueError("temperature > 0 needs a jax.random key")
 
 
+def _eos_clamp(nxt, tok, done, eos_id):
+    """Static-shape EOS handling: once a row has emitted ``eos_id``
+    every later token is forced to it (the scan always runs n_new
+    steps — shapes never depend on content; callers strip the EOS tail
+    host-side). Returns (next_token, next_done)."""
+    if eos_id is None:
+        return nxt, done
+    done = jnp.logical_or(done, tok == eos_id)
+    return jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt), done
+
+
 @functools.lru_cache(maxsize=64)
 def _dense_runner(cfg: TransformerConfig, B: int, Tp: int, n_new: int,
-                  max_len: int, temperature: float, top_k: int | None):
+                  max_len: int, temperature: float, top_k: int | None,
+                  eos_id: int | None):
     """Shape-keyed jitted prefill+scan generation program (one compile
     per (cfg, shapes, sampling); the cache is built inside the jit, not
     baked in as a constant)."""
@@ -313,17 +325,19 @@ def _dense_runner(cfg: TransformerConfig, B: int, Tp: int, n_new: int,
         tok = _pick_token(
             logits[:, -1], Tp - 1, key, temperature, top_k, prompt.dtype
         )
+        done = jnp.zeros((B,), bool)
 
         def step(carry, pos):
-            tok, c = carry
+            tok, done, c = carry
             lg, c = decode_step_dense(params, tok, c, pos, cfg)
             nxt = _pick_token(lg, pos, key, temperature, top_k, tok.dtype)
-            return (nxt, c), tok
+            nxt, done = _eos_clamp(nxt, tok, done, eos_id)
+            return (nxt, done, c), tok
 
         # n_new - 1 decode forwards: the last emitted token is the final
         # carry, so no forward is spent computing a discarded successor
-        (tok, _), toks = jax.lax.scan(
-            step, (tok, c), Tp + jnp.arange(n_new - 1)
+        (tok, _, _), toks = jax.lax.scan(
+            step, (tok, done, c), Tp + jnp.arange(n_new - 1)
         )
         toks = jnp.concatenate([toks, tok[None]], axis=0)
         return toks.swapaxes(0, 1)  # (B, n_new)
@@ -334,11 +348,13 @@ def _dense_runner(cfg: TransformerConfig, B: int, Tp: int, n_new: int,
 def generate_dense(params, prompt, n_new: int, cfg: TransformerConfig,
                    max_len: int | None = None, *,
                    temperature: float = 0.0, top_k: int | None = None,
-                   key=None):
+                   key=None, eos_id: int | None = None):
     """Generation, dense single-program: prefill + lax.scan of decode
     steps under one jit (compiled once per shape, cached). Greedy by
     default; ``temperature > 0`` samples (optionally top-k-truncated)
-    with the given ``key``. Returns (B, n_new) tokens."""
+    with the given ``key``. ``eos_id``: rows that emit it keep emitting
+    it (static shapes; strip the tail host-side). Returns (B, n_new)
+    tokens."""
     if n_new < 1:
         raise ValueError(f"n_new must be >= 1, got {n_new}")
     _check_sampling(temperature, top_k, key)
@@ -353,7 +369,7 @@ def generate_dense(params, prompt, n_new: int, cfg: TransformerConfig,
     if key is None:
         key = jax.random.key(0)  # unused at temperature 0
     return _dense_runner(
-        cfg, B, Tp, n_new, max_len, float(temperature), top_k
+        cfg, B, Tp, n_new, max_len, float(temperature), top_k, eos_id
     )(params, prompt, key)
 
 
@@ -490,12 +506,15 @@ def make_extend(cfg: TransformerConfig, mesh: Mesh):
 
 def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
                   max_len: int | None = None, *,
-                  temperature: float = 0.0, top_k: int | None = None):
+                  temperature: float = 0.0, top_k: int | None = None,
+                  eos_id: int | None = None):
     """Jitted sharded generation: ``gen(params, prompt (B, Tp)[, key])``
     -> (B, n_new) tokens. Prefill + a lax.scan of decode steps inside
     ONE shard_map program — zero host round trips between tokens.
     Greedy by default; ``temperature > 0`` samples (optionally top-k)
-    and the returned callable takes the PRNG key as its third argument
+    and ``eos_id`` rows that finish keep emitting the EOS token
+    (static shapes; strip host-side). The returned callable takes the
+    PRNG key as its third argument
     (replicated across the mesh — every tp member draws the same token
     from the identical post-psum logits; the dense and sharded
     programs produce the same stream for the same key).
@@ -547,9 +566,12 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
             logits[:, -1], Tp - 1, key, temperature, top_k,
             prompt.dtype, row0,
         )
+        # all-False, derived from tok so it inherits tok's varying mesh
+        # axes (a plain zeros carry trips the scan's vma type check)
+        done = tok < jnp.asarray(0, tok.dtype)
 
         def step(carry, pos):
-            tok, cache = carry
+            tok, done, cache = carry
             lg, cache = _incremental_forward(
                 params, tok[:, None], cache, pos, cfg, prefill=False,
                 kv_slice=kv_slice, tp_psum=True,
@@ -557,12 +579,13 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
             nxt = _pick_token(
                 lg[:, 0], pos, key, temperature, top_k, tok.dtype, row0
             )
-            return (nxt, cache), tok
+            nxt, done = _eos_clamp(nxt, tok, done, eos_id)
+            return (nxt, done, cache), tok
 
         # n_new - 1 decode forwards, as in the dense runner: the final
         # token comes out of the carry, not a discarded extra forward
-        (tok, _), toks = jax.lax.scan(
-            step, (tok, cache), Tp + jnp.arange(n_new - 1)
+        (tok, _, _), toks = jax.lax.scan(
+            step, (tok, done, cache), Tp + jnp.arange(n_new - 1)
         )
         toks = jnp.concatenate([toks, tok[None]], axis=0)
         return toks.swapaxes(0, 1)

@@ -410,3 +410,31 @@ def test_chunked_prefill_matches_one_shot(hkv, chunk):
             np.asarray(lg), np.asarray(want[:, i:end]),
             atol=1e-4, rtol=1e-4, err_msg=f"chunk at {i}",
         )
+
+
+def test_eos_clamp_dense_and_sharded():
+    """Rows that emit eos_id keep emitting it for the rest of the
+    (static-shape) generation, dense and sharded alike; rows that never
+    hit it are untouched (compared against the eos-free stream)."""
+    params = init_params(CFG, seed=20)
+    prompt = _tokens(CFG, B=2, L=6, seed=21)
+    free = np.asarray(generate_dense(params, prompt, 8, CFG))
+    # pick the token row 0 emits at step 2 as the "EOS" id: from step 3
+    # on, row 0 must be clamped to it; a token row 1 never emits leaves
+    # row 1 identical to the free stream
+    eos = int(free[0, 2])
+    out = np.asarray(generate_dense(params, prompt, 8, CFG, eos_id=eos))
+    first = int(np.argmax(free[0] == eos))
+    assert np.all(out[0, first:] == eos)
+    np.testing.assert_array_equal(out[0, :first + 1], free[0, :first + 1])
+    for r in range(free.shape[0]):
+        if eos not in free[r]:
+            np.testing.assert_array_equal(out[r], free[r])
+    # sharded program, same clamp
+    mesh = make_mesh((2, 4), ("dp", "tp"))
+    gen = make_generate(CFG, mesh, n_new=8, eos_id=eos)
+    got = np.asarray(gen(
+        shard_params(params, CFG, mesh),
+        jax.device_put(prompt, NamedSharding(mesh, P("dp", None))),
+    ))
+    np.testing.assert_array_equal(got, out)
