@@ -25,6 +25,12 @@ Design (TPU-first):
   ``tp`` slots, slot ``t`` holding kv head ``t * kv_heads // tp`` —
   each device computes its own replica from the tp-replicated K/V
   projections, so the layout needs no extra collectives.
+* **Sliding windows are masked, not yet rolled.** With
+  ``attn_window=W`` the decode path masks the (q-W, q] band exactly
+  like training, but the cache stays ``max_len`` long and every step
+  still scores the full cache — an O(W) ring-buffer cache (the
+  window's memory/bandwidth prize at W << max_len) is the natural
+  next rung and changes only this module's cache layout.
 * **Greedy generation is one program.** ``make_generate`` runs prefill
   plus a ``lax.scan`` over decode steps *inside a single shard_map
   jit* — no host round trip per token; on the tunneled bench chip that
@@ -48,6 +54,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.ring_attention import (
+    _band_mask,
     _flash_interpreted,
     _group_pv,
     _group_scores,
@@ -121,16 +128,19 @@ def shard_cache(cache, cfg: TransformerConfig, mesh: Mesh):
     )
 
 
-def _cached_attention(q, kc, vc, qpos, scale):
+def _cached_attention(q, kc, vc, qpos, scale, window=None):
     """Grouped attention of the chunk's queries against the full cache.
 
     q: (B, T, H, D); kc/vc: (B, Lmax, Hkv, D) with positions
     ``arange(Lmax)``; validity is ``kpos <= qpos`` (cache entries past
-    the chunk are zeros AND masked; entries below the offset are real).
+    the chunk are zeros AND masked; entries below the offset are real),
+    intersected with the sliding-window band when ``window`` is set.
     """
     Lmax = kc.shape[1]
     s = _group_scores(q, kc, scale)  # (B, H, T, Lmax) f32
-    mask = jnp.arange(Lmax)[None, :] <= qpos[:, None]  # (T, Lmax)
+    # the one band predicate (parallel/ring_attention._band_mask): the
+    # serving path cannot silently diverge from the training oracle
+    mask = _band_mask(qpos, jnp.arange(Lmax), True, window)
     s = jnp.where(mask[None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     o = _group_pv(p, vc)  # (B, T, H, D) f32
@@ -160,7 +170,7 @@ def _incremental_layer(x, lp, cache_l, qpos, cfg, *, chunk_attn, kv_slice,
         # so the configured chunk kernel (flash on TPU) does the work
         o = chunk_attn(q, k, v)
     else:
-        o = _cached_attention(q, kc, vc, qpos, scale)
+        o = _cached_attention(q, kc, vc, qpos, scale, cfg.attn_window)
     attn_out = jnp.einsum("blhk,hkd->bld", o, lp["wo"])
     if tp_psum:
         attn_out = jax.lax.psum(attn_out, "tp")
@@ -196,7 +206,8 @@ def _incremental_forward(params, tokens, cache, offset, cfg,
     chunk_attn = None
     if prefill:
         chunk_attn = partial(
-            resolve_attention_impl(cfg.attn_impl), causal=True
+            resolve_attention_impl(cfg.attn_impl), causal=True,
+            window=cfg.attn_window,
         )
     x = params["emb"][tokens]
     new_cache = []

@@ -95,6 +95,12 @@ class TransformerConfig:
     # "flash" (fused Pallas kernel, ops/flash_attention.py) — applies to
     # the dense forward and to the local attention inside Ulysses
     attn_impl: str = "reference"
+    # sliding-window attention (Mistral-style): each position attends
+    # the previous `attn_window` positions only (None = full causal).
+    # Flows through every kernel — the reference oracle, the flash
+    # kernels (which SKIP blocks left of the band), ring, Ulysses —
+    # and the KV-cache decode path masks the same band.
+    attn_window: int | None = None
     # n_experts > 0 replaces every layer's dense MLP with a top-1-routed
     # MoE (models/moe.py) whose experts shard over an "ep" mesh axis
     n_experts: int = 0
@@ -132,6 +138,10 @@ class TransformerConfig:
             raise ValueError(
                 f"RoPE requires even head_dim, got "
                 f"{self.d_model // self.n_heads}"
+            )
+        if self.attn_window is not None and self.attn_window < 1:
+            raise ValueError(
+                f"attn_window must be >= 1, got {self.attn_window}"
             )
         if self.n_kv_heads is not None and (
             self.n_kv_heads < 1 or self.n_heads % self.n_kv_heads != 0
@@ -321,7 +331,10 @@ def make_kv_slice(cfg: TransformerConfig):
 
 def _local_attention(cfg: TransformerConfig):
     """The per-device (unsharded) attention kernel selected by config."""
-    return partial(resolve_attention_impl(cfg.attn_impl), causal=True)
+    return partial(
+        resolve_attention_impl(cfg.attn_impl), causal=True,
+        window=cfg.attn_window,
+    )
 
 
 def forward_dense(params: dict, tokens: jax.Array, cfg: TransformerConfig):
@@ -361,10 +374,14 @@ def _forward_local(params, tokens, cfg: TransformerConfig):
     Lc = tokens.shape[1]
     pos = jax.lax.axis_index("sp") * Lc + jnp.arange(Lc)
     if cfg.attn == "ring":
-        attn = partial(ring_self_attention, axis="sp", causal=True)
+        attn = partial(
+            ring_self_attention, axis="sp", causal=True,
+            window=cfg.attn_window,
+        )
     elif cfg.attn == "ulysses":
         attn = partial(
-            ulysses_attention, axis="sp", causal=True, impl=cfg.attn_impl
+            ulysses_attention, axis="sp", causal=True,
+            impl=cfg.attn_impl, window=cfg.attn_window,
         )
     else:
         raise ValueError(f"unknown sharded attention kind {cfg.attn!r}")

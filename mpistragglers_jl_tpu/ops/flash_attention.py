@@ -109,6 +109,37 @@ def _check_vmem(bq: int, bk: int, D: int, itemsize: int) -> None:
         )
 
 
+def _block_run(i, j, bq, bk, causal, window):
+    """Grid-level predication: does block (i, j) intersect the visible
+    band? Causal skips blocks entirely above the diagonal; a sliding
+    window additionally skips blocks entirely LEFT of the band
+    (min possible qpos - max possible kpos >= window). Returns a traced
+    bool (or True when nothing is masked)."""
+    run = True
+    if causal:
+        run = j * bk <= i * bq + bq - 1
+    if window is not None:
+        in_band = i * bq - (j * bk + bk - 1) < window
+        run = in_band if run is True else jnp.logical_and(run, in_band)
+    return run
+
+
+def _block_mask(i, j, bq, bk, causal, window):
+    """In-block (bq, bk) visibility mask for block (i, j), or None when
+    nothing is masked (mirrors parallel/ring_attention._band_mask)."""
+    if not causal and window is None:
+        return None
+    qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = None
+    if causal:
+        mask = kpos <= qpos
+    if window is not None:
+        band = qpos - kpos < window
+        mask = band if mask is None else jnp.logical_and(mask, band)
+    return mask
+
+
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying ``like``'s varying-mesh-axes, so the
     kernels are callable inside ``shard_map`` (e.g. as the per-device
@@ -125,7 +156,7 @@ def _sds(shape, dtype, like):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
-                *, scale, causal, bq, bk, nk):
+                *, scale, causal, window, bq, bk, nk):
     i, j = pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -134,9 +165,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
         m_sc[:] = jnp.full_like(m_sc, _NEG)
         l_sc[:] = jnp.zeros_like(l_sc)
 
-    # causal: skip blocks entirely above the diagonal (first key position
-    # of this block beyond the last query position of the q block)
-    run = (j * bk <= i * bq + bq - 1) if causal else True
+    # skip blocks outside the visible band (above the causal diagonal,
+    # or left of the sliding window)
+    run = _block_run(i, j, bq, bk, causal, window)
 
     @pl.when(run)
     def _update():
@@ -146,15 +177,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (bq, bk)
-        if causal:
-            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            mask = kpos <= qpos
+        mask = _block_mask(i, j, bq, bk, causal, window)
+        if mask is not None:
             s = jnp.where(mask, s, _NEG)
         m_prev = m_sc[:, :1]  # (bq, 1)
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if causal:
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m_prev - m_new)  # (bq, 1)
         l_sc[:] = jnp.broadcast_to(
@@ -174,7 +203,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
         lse_ref[0] = (m_sc[:, :1] + jnp.log(l)).astype(jnp.float32)
 
 
-def _fwd(q3, k3, v3, scale, causal, bq, bk, g, interpret):
+def _fwd(q3, k3, v3, scale, causal, window, bq, bk, g, interpret):
     """q3: (B*H, L, D); k3/v3: (B*Hkv, L, D) -> (o (B*H, L, D),
     lse (B*H, L, 1)). GQA costs nothing here: the grid runs over q
     heads and the K/V BlockSpec index maps divide the flattened
@@ -186,7 +215,8 @@ def _fwd(q3, k3, v3, scale, causal, bq, bk, g, interpret):
     Lk = k3.shape[1]
     nq, nk = Lq // bq, Lk // bk
     kern = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+        _fwd_kernel, scale=scale, causal=causal, window=window, bq=bq,
+        bk=bk, nk=nk,
     )
     return pl.pallas_call(
         kern,
@@ -223,14 +253,14 @@ def _fwd(q3, k3, v3, scale, causal, bq, bk, g, interpret):
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc, *, scale, causal, bq, bk, nk):
+                   acc, *, scale, causal, window, bq, bk, nk):
     i, j = pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
     def _init():
         acc[:] = jnp.zeros_like(acc)
 
-    run = (j * bk <= i * bq + bq - 1) if causal else True
+    run = _block_run(i, j, bq, bk, causal, window)
 
     @pl.when(run)
     def _update():
@@ -240,10 +270,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        if causal:
-            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG)
+        mask = _block_mask(i, j, bq, bk, causal, window)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG)
         p = jnp.exp(s - lse_ref[0])  # (bq, bk); masked rows -> 0
         dp = jax.lax.dot_general(
             do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
@@ -262,7 +291,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, bq, bk, nq):
+                    *, scale, causal, window, bq, bk, nq):
     j, i = pl.program_id(1), pl.program_id(2)  # k block major, q innermost
 
     @pl.when(i == 0)
@@ -270,7 +299,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = (j * bk <= i * bq + bq - 1) if causal else True
+    run = _block_run(i, j, bq, bk, causal, window)
 
     @pl.when(run)
     def _update():
@@ -280,10 +309,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        if causal:
-            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG)
+        mask = _block_mask(i, j, bq, bk, causal, window)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG)
         p = jnp.exp(s - lse_ref[0])  # (bq, bk)
         do = do_ref[0].astype(jnp.float32)
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -308,7 +336,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dq_ref, dkp_ref, dvp_ref, dq_acc,
-                      *, scale, causal, bq, bk, nk):
+                      *, scale, causal, window, bq, bk, nk):
     """Single-pass backward: one (i, j) sweep computes dq (accumulated
     over the inner j sweep in scratch) AND per-q-block dk/dv partials
     (reduced outside). The split kernels recompute s and dp twice —
@@ -321,7 +349,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    run = (j * bk <= i * bq + bq - 1) if causal else True
+    run = _block_run(i, j, bq, bk, causal, window)
 
     @pl.when(run)
     def _update():
@@ -331,10 +359,9 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        if causal:
-            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG)
+        mask = _block_mask(i, j, bq, bk, causal, window)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG)
         p = jnp.exp(s - lse_ref[0])  # (bq, bk)
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
@@ -357,10 +384,10 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ) * scale
         ).astype(dkp_ref.dtype)
 
-    if causal:
+    if causal or window is not None:
         @pl.when(jnp.logical_not(run))
         def _zero():
-            # skipped causal blocks still own their partial output block
+            # skipped band-exterior blocks still own their partial block
             dkp_ref[0, 0] = jnp.zeros_like(dkp_ref[0, 0])
             dvp_ref[0, 0] = jnp.zeros_like(dvp_ref[0, 0])
 
@@ -369,8 +396,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
 
 
-def _bwd_fused(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, g,
-               interpret):
+def _bwd_fused(q3, k3, v3, o3, lse, do3, scale, causal, window, bq, bk,
+               g, interpret):
     """Fused backward dispatch: dq + f32 dk/dv partials per q block,
     reduced by one XLA sum (and group-summed for GQA). Partial HBM is
     (BH, nq, Lk, D) f32 — the traffic that made this variant measure
@@ -385,8 +412,8 @@ def _bwd_fused(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, g,
     )
     dq, dkp, dvp = pl.pallas_call(
         functools.partial(
-            _bwd_fused_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
-            nk=nk,
+            _bwd_fused_kernel, scale=scale, causal=causal, window=window,
+            bq=bq, bk=bk, nk=nk,
         ),
         grid=(BH, nq, nk),
         in_specs=[
@@ -433,7 +460,8 @@ def _use_fused_bwd() -> bool:
     return False
 
 
-def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, g, interpret):
+def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, window, bq, bk, g,
+         interpret):
     BH, Lq, D = q3.shape
     Lk = k3.shape[1]
     nq, nk = Lq // bq, Lk // bk
@@ -444,7 +472,8 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, g, interpret):
 
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+            _bwd_dq_kernel, scale=scale, causal=causal, window=window,
+            bq=bq, bk=bk, nk=nk,
         ),
         grid=(BH, nq, nk),
         in_specs=[
@@ -471,7 +500,8 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, g, interpret):
     # finishes the job.
     dkq, dvq = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq
+            _bwd_dkv_kernel, scale=scale, causal=causal, window=window,
+            bq=bq, bk=bk, nq=nq,
         ),
         grid=(BH, nk, nq),
         in_specs=[
@@ -510,23 +540,28 @@ def _bwd(q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, g, interpret):
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash3(q3, k3, v3, scale, causal, bq, bk, g, fused_bwd, interpret):
-    o, _ = _fwd(q3, k3, v3, scale, causal, bq, bk, g, interpret)
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
+)
+def _flash3(q3, k3, v3, scale, causal, window, bq, bk, g, fused_bwd,
+            interpret):
+    o, _ = _fwd(q3, k3, v3, scale, causal, window, bq, bk, g, interpret)
     return o
 
 
-def _flash3_fwd(q3, k3, v3, scale, causal, bq, bk, g, fused_bwd,
+def _flash3_fwd(q3, k3, v3, scale, causal, window, bq, bk, g, fused_bwd,
                 interpret):
-    o, lse = _fwd(q3, k3, v3, scale, causal, bq, bk, g, interpret)
+    o, lse = _fwd(q3, k3, v3, scale, causal, window, bq, bk, g, interpret)
     return o, (q3, k3, v3, o, lse)
 
 
-def _flash3_bwd(scale, causal, bq, bk, g, fused_bwd, interpret, res, do3):
+def _flash3_bwd(scale, causal, window, bq, bk, g, fused_bwd, interpret,
+                res, do3):
     q3, k3, v3, o3, lse = res
     impl = _bwd_fused if fused_bwd else _bwd
     return impl(
-        q3, k3, v3, o3, lse, do3, scale, causal, bq, bk, g, interpret
+        q3, k3, v3, o3, lse, do3, scale, causal, window, bq, bk, g,
+        interpret,
     )
 
 
@@ -540,6 +575,7 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: float | None = None,
+    window: int | None = None,
     block_q: int = 1024,
     block_k: int = 1024,
     bwd_impl: str = "auto",
@@ -598,9 +634,12 @@ def flash_attention(
     def to3(x, L, h):
         return x.transpose(0, 2, 1, 3).reshape(B * h, L, D)
 
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     o3 = _flash3(
         to3(q, Lq, H), to3(k, Lk, Hkv), to3(v, Lk, Hkv),
-        float(scale), bool(causal), bq, bk, g, fused_bwd,
+        float(scale), bool(causal),
+        None if window is None else int(window), bq, bk, g, fused_bwd,
         bool(interpret),
     )
     return o3.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)

@@ -90,7 +90,22 @@ def _group_pv(p, vc):
     return o5.reshape(B, Lq, Hq, vc.shape[-1])
 
 
-def _block_update(q, kc, vc, o, m, l, qpos, kpos, scale, causal):
+def _band_mask(qpos, kpos, causal, window):
+    """(Lq, Lk) visibility: causal (kpos <= qpos) intersected with a
+    sliding window of ``window`` positions (qpos - kpos < window) when
+    set — the Mistral-style attention band. Returns None when nothing
+    is masked."""
+    mask = None
+    if causal:
+        mask = kpos[None, :] <= qpos[:, None]
+    if window is not None:
+        band = qpos[:, None] - kpos[None, :] < window
+        mask = band if mask is None else jnp.logical_and(mask, band)
+    return mask
+
+
+def _block_update(q, kc, vc, o, m, l, qpos, kpos, scale, causal,
+                  window=None):
     """One online-softmax accumulation step against K/V block (kc, vc).
 
     q: (B, Lq, H, D); kc/vc: (B, Lk, Hkv, D) where Hkv divides H (GQA;
@@ -98,13 +113,13 @@ def _block_update(q, kc, vc, o, m, l, qpos, kpos, scale, causal):
     running max / normalizer.
     """
     s = _group_scores(q, kc, scale)
-    if causal:
-        mask = kpos[None, :] <= qpos[:, None]  # (Lq, Lk)
+    mask = _band_mask(qpos, kpos, causal, window)
+    if mask is not None:
         s = jnp.where(mask[None, None], s, _NEG)
     m_new = jnp.maximum(m, s.max(axis=-1))
     # rows with nothing visible yet keep m=_NEG; their p underflows to 0
     p = jnp.exp(s - m_new[..., None])
-    if causal:
+    if mask is not None:
         p = jnp.where(mask[None, None], p, 0.0)
     corr = jnp.exp(m - m_new)  # (B, H, Lq)
     l = l * corr + p.sum(axis=-1)
@@ -120,6 +135,7 @@ def ring_self_attention(
     axis: str = "sp",
     causal: bool = False,
     scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Exact attention over ring-sharded sequence; call inside shard_map.
 
@@ -147,7 +163,8 @@ def ring_self_attention(
 
     # step 0: the resident block, no communication
     o, m, l = _block_update(
-        q, k, v, o0, m0, l0, qpos, me * Lc + jnp.arange(Lc), scale, causal
+        q, k, v, o0, m0, l0, qpos, me * Lc + jnp.arange(Lc), scale,
+        causal, window,
     )
 
     def step(carry, i):
@@ -159,7 +176,7 @@ def ring_self_attention(
         src = (me - i) % n  # who originally owned the block we now hold
         kpos = src * Lc + jnp.arange(Lc)
         o, m, l = _block_update(
-            q, kc, vc, o, m, l, qpos, kpos, scale, causal
+            q, kc, vc, o, m, l, qpos, kpos, scale, causal, window
         )
         return (o, m, l, kc, vc), None
 
@@ -180,6 +197,7 @@ def ulysses_attention(
     causal: bool = False,
     scale: float | None = None,
     impl: str = "reference",
+    window: int | None = None,
 ) -> jax.Array:
     """All-to-all sequence parallelism; call inside shard_map.
 
@@ -222,7 +240,9 @@ def ulysses_attention(
         tiled=True,
     )
     qf, kf, vf = a2a(q), a2a(k), a2a(v)
-    of = resolve_attention_impl(impl)(qf, kf, vf, causal=causal, scale=scale)
+    of = resolve_attention_impl(impl)(
+        qf, kf, vf, causal=causal, scale=scale, window=window
+    )
     # inverse: split sequence back out, concat heads
     return jax.lax.all_to_all(
         of, axis_name=axis, split_axis=1, concat_axis=2, tiled=True
@@ -243,11 +263,13 @@ def resolve_attention_impl(impl: str):
     raise ValueError(f"unknown attention impl {impl!r}")
 
 
-def reference_attention(q, k, v, *, causal=False, scale=None):
+def reference_attention(q, k, v, *, causal=False, scale=None,
+                        window=None):
     """Plain full-materialization attention (the correctness oracle and
     the per-device kernel inside Ulysses). (B, L, H, D) layout; k/v may
     carry fewer (grouped) heads — GQA/MQA — expanded here by repeat,
-    the obviously-correct oracle form."""
+    the obviously-correct oracle form. ``window`` adds the sliding-
+    window band (qpos - kpos < window)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     g = q.shape[2] // k.shape[2]
@@ -257,9 +279,10 @@ def reference_attention(q, k, v, *, causal=False, scale=None):
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
-    if causal:
-        L, Lk = q.shape[1], k.shape[1]
-        mask = jnp.arange(Lk)[None, :] <= jnp.arange(L)[:, None]
+    mask = _band_mask(
+        jnp.arange(q.shape[1]), jnp.arange(k.shape[1]), causal, window
+    )
+    if mask is not None:
         s = jnp.where(mask[None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
@@ -298,15 +321,20 @@ def _flash_interpreted(impl) -> bool:
     return _use_interpret()
 
 
-def make_ring_attention(mesh: Mesh, *, axis: str = "sp", causal: bool = False):
+def make_ring_attention(mesh: Mesh, *, axis: str = "sp",
+                        causal: bool = False, window: int | None = None):
     """Jitted ring attention over global (B, L, H, D) arrays sequence-
     sharded along ``axis`` of ``mesh``."""
-    return _make_wrapped(ring_self_attention, mesh, axis, causal)
+    return _make_wrapped(
+        ring_self_attention, mesh, axis, causal, window=window
+    )
 
 
 def make_ulysses_attention(
     mesh: Mesh, *, axis: str = "sp", causal: bool = False,
-    impl: str = "reference",
+    impl: str = "reference", window: int | None = None,
 ):
     """Jitted Ulysses attention over global (B, L, H, D) arrays."""
-    return _make_wrapped(ulysses_attention, mesh, axis, causal, impl=impl)
+    return _make_wrapped(
+        ulysses_attention, mesh, axis, causal, impl=impl, window=window
+    )
