@@ -297,6 +297,8 @@ def _transformer_rungs():
     * remat_rung — 16k with per-layer jax.checkpoint (the measured
       FLOPs-for-HBM cost vs the 16k base rung);
     * decode_rung — 16k prefill + 128 greedy KV-cache tokens;
+    * window_decode_rung — sliding-window serving, O(W) ring cache vs
+      the masked max_len cache (same band, ~16x less decode traffic);
     * moe_rung — E=4 Switch experts at the flagship shape (routing
       overhead computed against THIS session's flagship step).
 
@@ -387,9 +389,13 @@ def _transformer_rungs():
         }
 
     tt["remat_rung"] = _try_rung(rung_remat)
-    from benchmarks.transformer_train_bench import bench_decode
+    from benchmarks.transformer_train_bench import (
+        bench_decode,
+        bench_window_decode,
+    )
 
     tt["decode_rung"] = _try_rung(bench_decode)
+    tt["window_decode_rung"] = _try_rung(bench_window_decode)
 
     def rung_moe():
         from benchmarks.moe_bench import bench_moe_train

@@ -1,0 +1,109 @@
+"""Gradient-coded transformer training: the pool trains the flagship
+model family.
+
+BASELINE config 5 lifted from logistic regression to the transformer
+(models/coded_train.py): the dataset splits into n chunks, worker i
+holds the s+1 cyclic chunks of Tandon-style gradient coding, and every
+training epoch is ONE ``asyncmap`` with ``nwait = n - s`` — the epoch
+returns as soon as any n-s workers arrive, yet the decoded update is
+the EXACT full-batch gradient. Two workers here are hard stragglers
+(injected, deterministic); the coded run never waits for them and still
+walks the bit-identical trajectory of bulk-synchronous SGD.
+
+Run:  python examples/coded_transformer_training.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpistragglers_jl_tpu import AsyncPool, waitall
+from mpistragglers_jl_tpu.models.coded_train import (
+    CodedGradTrainer,
+    transformer_chunk_loss,
+)
+from mpistragglers_jl_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+N_WORKERS, S = 6, 2
+STRAGGLE_S = 1.0  # workers 1 and 4 stall this long every epoch
+EPOCHS = 4
+LR = 0.1
+
+CFG = TransformerConfig(vocab=97, d_model=48, n_heads=4, n_layers=2,
+                        d_ff=96)
+ROWS, SEQ = 4, 16
+
+
+def chunk_fn(j):
+    rng = np.random.default_rng((42, j))
+    return jnp.asarray(rng.integers(0, CFG.vocab, (ROWS, SEQ + 1)),
+                       jnp.int32)
+
+
+def straggle(i, epoch):
+    return STRAGGLE_S if i in (1, 4) else 0.0
+
+
+def main():
+    loss_fn = transformer_chunk_loss(CFG)
+    params0 = init_params(CFG, seed=1)
+
+    tr = CodedGradTrainer(loss_fn, params0, chunk_fn, N_WORKERS, S,
+                          delay_fn=straggle)
+    print(f"transformer {CFG.d_model}d/{CFG.n_layers}L over "
+          f"{N_WORKERS} workers, s={S} hard stragglers of "
+          f"{STRAGGLE_S * 1e3:.0f} ms")
+
+    # --- coded epochs: never wait for the stragglers -------------------
+    pool = AsyncPool(N_WORKERS)
+    params = params0
+    t0 = time.perf_counter()
+    for e in range(EPOCHS):
+        params = tr.step(pool, params, lr=LR)
+    coded_s = (time.perf_counter() - t0) / EPOCHS
+    waitall(pool, tr.backend)
+    print(f"coded epochs (nwait={N_WORKERS - S}): "
+          f"{coded_s * 1e3:7.1f} ms/epoch, "
+          f"loss {tr.full_batch_loss(params0):.4f} -> "
+          f"{tr.full_batch_loss(params):.4f}")
+
+    # --- bulk-synchronous baseline: pays the stragglers every epoch ----
+    tr_sync = CodedGradTrainer(loss_fn, params0, chunk_fn, N_WORKERS, S,
+                               delay_fn=straggle)
+    pool_sync = AsyncPool(N_WORKERS)
+    psync = params0
+    t0 = time.perf_counter()
+    for e in range(EPOCHS):
+        psync = tr_sync.step(pool_sync, psync, lr=LR, nwait=N_WORKERS)
+    sync_s = (time.perf_counter() - t0) / EPOCHS
+    waitall(pool_sync, tr_sync.backend)
+    print(f"bulk-sync epochs (nwait={N_WORKERS}):  "
+          f"{sync_s * 1e3:7.1f} ms/epoch — {sync_s / coded_s:.1f}x slower")
+    print("(single shared device: re-tasked stragglers still consume "
+          "device time, so the win is the UNOVERLAPPED straggle; on a "
+          "real slice each worker owns a chip and the full stall "
+          "disappears)")
+
+    # --- exactness: both trajectories are the same full-batch SGD ------
+    fa = jax.flatten_util.ravel_pytree(params)[0]
+    fb = jax.flatten_util.ravel_pytree(psync)[0]
+    err = float(jnp.max(jnp.abs(fa - fb)))
+    print(f"max |coded - bulk-sync| over all params: {err:.2e}")
+    assert err < 1e-4, "gradient-code decode must be exact"
+    print("exact full-batch gradient from fastest "
+          f"{N_WORKERS - S}/{N_WORKERS}: ok")
+
+
+if __name__ == "__main__":
+    main()

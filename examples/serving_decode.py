@@ -48,8 +48,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from mpistragglers_jl_tpu.models import (
     TransformerConfig,
     generate_dense,
+    generate_ring_dense,
     init_params,
     make_generate,
+    make_ring_generate,
     make_train_step,
     shard_params,
 )
@@ -131,6 +133,33 @@ def main(argv=None) -> None:
     )
     assert np.array_equal(toks, want), "sharded generate != dense oracle"
     print("sharded generation == dense oracle: ok")
+
+    # --- int8 KV cache: half the cache bytes, same stream here --------
+    gen_q8 = make_generate(cfg, mesh, n_new=args.n_new, quantize_kv=True)
+    toks_q8 = np.asarray(gen_q8(sparams, prompt))
+    agree = float((toks_q8 == toks).mean())
+    assert agree > 0.9, f"int8 cache degraded greedy agreement: {agree}"
+    print(f"int8 KV cache: {agree * 100:.0f}% of greedy tokens agree "
+          "with the exact cache (absmax per position/head)")
+
+    # --- sliding window + O(W) ring cache -----------------------------
+    import dataclasses
+
+    W = max(8, args.prompt_len // 4)
+    cfg_w = dataclasses.replace(cfg, attn_window=W)
+    gen_ring = make_ring_generate(cfg_w, mesh, n_new=args.n_new)
+    toks_ring = np.asarray(gen_ring(sparams, prompt))
+    want_ring = np.asarray(
+        generate_ring_dense(
+            params_host, np.asarray(prompt), args.n_new, cfg_w
+        )
+    )
+    assert np.array_equal(toks_ring, want_ring), "ring != dense ring"
+    full_pos = args.prompt_len + args.n_new
+    print(
+        f"ring cache (attn_window={W}): holds {W} positions instead of "
+        f"{full_pos} — sharded == dense oracle: ok"
+    )
 
 
 if __name__ == "__main__":

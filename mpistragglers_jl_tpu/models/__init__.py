@@ -18,7 +18,13 @@ _HOME = {
     "shard_cache": "decode",
     "prefill_dense": "decode",
     "decode_step_dense": "decode",
+    "decode_step_ring_dense": "decode",
     "generate_dense": "decode",
+    "generate_ring_dense": "decode",
+    "init_ring_cache": "decode",
+    "make_ring_generate": "decode",
+    "CodedGradTrainer": "coded_train",
+    "transformer_chunk_loss": "coded_train",
     "make_prefill": "decode",
     "make_decode_step": "decode",
     "make_extend": "decode",

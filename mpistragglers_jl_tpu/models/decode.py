@@ -25,12 +25,18 @@ Design (TPU-first):
   ``tp`` slots, slot ``t`` holding kv head ``t * kv_heads // tp`` —
   each device computes its own replica from the tp-replicated K/V
   projections, so the layout needs no extra collectives.
-* **Sliding windows are masked, not yet rolled.** With
-  ``attn_window=W`` the decode path masks the (q-W, q] band exactly
-  like training, but the cache stays ``max_len`` long and every step
-  still scores the full cache — an O(W) ring-buffer cache (the
-  window's memory/bandwidth prize at W << max_len) is the natural
-  next rung and changes only this module's cache layout.
+* **Sliding windows roll.** With ``attn_window=W`` the default path
+  masks the (q-W, q] band over a ``max_len`` cache exactly like
+  training; the *ring* path (``generate_ring_dense`` /
+  ``make_ring_generate``) keeps an O(W) circular cache instead:
+  position ``p`` writes slot ``p % W``, and slot ``s`` at decode
+  position ``pos`` holds position ``kpos(s) = pos - ((pos - s) mod
+  W)`` — valid iff ``kpos >= 0``, which makes the window+causal mask
+  *and* the warmup masking of unwritten slots the same one predicate.
+  RoPE is applied at write time with absolute positions, so rotation
+  survives the permuted storage order (dot products are relative).
+  Decode reads W cache positions per step regardless of how long the
+  stream runs — the window's memory/bandwidth prize at W << max_len.
 * **Greedy generation is one program.** ``make_generate`` runs prefill
   plus a ``lax.scan`` over decode steps *inside a single shard_map
   jit* — no host round trip per token; on the tunneled bench chip that
@@ -77,14 +83,87 @@ __all__ = [
     "decode_batch_axes",
     "prefill_dense",
     "decode_step_dense",
+    "decode_step_ring_dense",
     "generate_dense",
+    "generate_ring_dense",
+    "init_ring_cache",
     "make_generate",
+    "make_ring_generate",
     "make_prefill",
     "make_decode_step",
     "make_extend",
 ]
 
 _NEG = -1e30  # matches parallel/ring_attention.py
+
+
+# --------------------------------------------------------------------------
+# int8 KV-cache quantization (serving-time choice, orthogonal to layout)
+# --------------------------------------------------------------------------
+
+
+def _kv_quantize(x):
+    """Per-(batch, position, head) absmax int8 quantization over the
+    head_dim axis: ``x ~= x_i8 * s[..., None]``. The scale axis choice
+    matters: per-position scales ride the cache (tiny — no D axis) and
+    dequantization folds into the attention einsums as a rank-1 scale
+    on scores (K) and probabilities (V), so the cache is read as int8
+    bytes and no dequantized copy is ever materialized at full size."""
+    xf = x.astype(jnp.float32)
+    s = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+    s = jnp.maximum(s, 1e-8)  # all-zero rows (unwritten slots)
+    return jnp.round(xf / s[..., None]).astype(jnp.int8), s
+
+
+def _is_quantized(cache_l: dict) -> bool:
+    return "k_s" in cache_l
+
+
+def _expand_kv_scale(s, Hq):
+    """(B, L, Hkv) per-position scales -> (B, Hq, 1, L) broadcastable
+    against (B, Hq, Lq, L) scores/probs, repeating each kv head's scale
+    over its GQA group (same grouping as ``_group_scores``)."""
+    g = Hq // s.shape[2]
+    if g > 1:
+        s = jnp.repeat(s, g, axis=2)
+    return s.transpose(0, 2, 1)[:, :, None, :]
+
+
+def _cache_write(cache_l: dict, k, v, off) -> dict:
+    """Write a chunk's K/V at position-axis offset ``off``, quantizing
+    when the cache is int8 (detected from the layout, so every caller
+    — masked, ring, chunked — shares one write path)."""
+    upd = partial(jax.lax.dynamic_update_slice_in_dim, start_index=off,
+                  axis=1)
+    if not _is_quantized(cache_l):
+        return {"k": upd(cache_l["k"], update=k),
+                "v": upd(cache_l["v"], update=v)}
+    kq, ks = _kv_quantize(k)
+    vq, vs = _kv_quantize(v)
+    return {
+        "k": upd(cache_l["k"], update=kq),
+        "v": upd(cache_l["v"], update=vq),
+        "k_s": upd(cache_l["k_s"], update=ks),
+        "v_s": upd(cache_l["v_s"], update=vs),
+    }
+
+
+def _cache_scores(q, cache_l: dict, scale):
+    """Grouped scores against the cache, dequantizing via the rank-1
+    score correction when int8."""
+    kc = cache_l["k"]
+    if not _is_quantized(cache_l):
+        return _group_scores(q, kc, scale)
+    s = _group_scores(q, kc.astype(q.dtype), scale)
+    return s * _expand_kv_scale(cache_l["k_s"], q.shape[2])
+
+
+def _cache_pv(p, cache_l: dict):
+    """Grouped probs x V against the cache; int8 V dequantizes by
+    folding the per-position scale into the probabilities."""
+    if _is_quantized(cache_l):
+        p = p * _expand_kv_scale(cache_l["v_s"], p.shape[1])
+    return _group_pv(p, cache_l["v"])
 
 
 def _cache_heads_global(cfg: TransformerConfig, mesh: Mesh | None) -> int:
@@ -96,15 +175,31 @@ def _cache_heads_global(cfg: TransformerConfig, mesh: Mesh | None) -> int:
     return cfg.kv_heads if _kv_tp_sharded(cfg, mesh) else tp
 
 
+def _zero_cache_layer(B, L, H, Dh, dtype, quantize_kv):
+    z = jnp.zeros((B, L, H, Dh), jnp.int8 if quantize_kv else dtype)
+    layer = {"k": z, "v": z}
+    if quantize_kv:
+        zs = jnp.zeros((B, L, H), jnp.float32)
+        layer["k_s"], layer["v_s"] = zs, zs
+    return layer
+
+
 def init_cache(
     cfg: TransformerConfig, batch: int, max_len: int,
-    mesh: Mesh | None = None,
+    mesh: Mesh | None = None, *, quantize_kv: bool = False,
 ) -> list[dict]:
     """Zeroed per-layer KV cache (host pytree; ``shard_cache`` places
-    it). Layout: layers -> {"k","v"} of (B, max_len, cache_heads, Dh)."""
+    it). Layout: layers -> {"k","v"} of (B, max_len, cache_heads, Dh);
+    ``quantize_kv=True`` stores int8 K/V plus per-(batch, position,
+    head) f32 scales ``{"k_s","v_s"}`` — half the bytes of a bf16
+    cache, dequantized inside the attention einsums (never at full
+    size)."""
     H = _cache_heads_global(cfg, mesh)
-    z = jnp.zeros((batch, max_len, H, cfg.head_dim), cfg.dtype)
-    return [{"k": z, "v": z} for _ in range(cfg.n_layers)]
+    return [
+        _zero_cache_layer(batch, max_len, H, cfg.head_dim, cfg.dtype,
+                          quantize_kv)
+        for _ in range(cfg.n_layers)
+    ]
 
 
 def decode_batch_axes(cfg: TransformerConfig) -> tuple[str, ...]:
@@ -114,46 +209,75 @@ def decode_batch_axes(cfg: TransformerConfig) -> tuple[str, ...]:
     return ("dp", "ep") if cfg.n_experts else ("dp",)
 
 
-def cache_specs(cfg: TransformerConfig) -> list[dict]:
+def cache_specs(cfg: TransformerConfig, *,
+                quantize_kv: bool = False) -> list[dict]:
     """PartitionSpecs for the cache: batch over dp (and ep for MoE),
-    heads over tp."""
+    heads over tp; int8 scales shard exactly like their K/V."""
     s = P(decode_batch_axes(cfg), None, "tp", None)
-    return [{"k": s, "v": s} for _ in range(cfg.n_layers)]
+    layer = {"k": s, "v": s}
+    if quantize_kv:
+        ss = P(decode_batch_axes(cfg), None, "tp")
+        layer["k_s"], layer["v_s"] = ss, ss
+    return [dict(layer) for _ in range(cfg.n_layers)]
 
 
 def shard_cache(cache, cfg: TransformerConfig, mesh: Mesh):
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        cache, cache_specs(cfg),
+        cache, cache_specs(cfg, quantize_kv=_is_quantized(cache[0])),
     )
 
 
-def _cached_attention(q, kc, vc, qpos, scale, window=None):
+def _cached_attention(q, cache_l, qpos, scale, window=None):
     """Grouped attention of the chunk's queries against the full cache.
 
-    q: (B, T, H, D); kc/vc: (B, Lmax, Hkv, D) with positions
+    q: (B, T, H, D); the cache holds (B, Lmax, Hkv, D) at positions
     ``arange(Lmax)``; validity is ``kpos <= qpos`` (cache entries past
     the chunk are zeros AND masked; entries below the offset are real),
     intersected with the sliding-window band when ``window`` is set.
     """
-    Lmax = kc.shape[1]
-    s = _group_scores(q, kc, scale)  # (B, H, T, Lmax) f32
+    Lmax = cache_l["k"].shape[1]
+    s = _cache_scores(q, cache_l, scale)  # (B, H, T, Lmax) f32
     # the one band predicate (parallel/ring_attention._band_mask): the
     # serving path cannot silently diverge from the training oracle
     mask = _band_mask(qpos, jnp.arange(Lmax), True, window)
     s = jnp.where(mask[None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
-    o = _group_pv(p, vc)  # (B, T, H, D) f32
+    o = _cache_pv(p, cache_l)  # (B, T, H, D) f32
+    return o.astype(q.dtype)
+
+
+def _ring_cached_attention(q, cache_l, pos, scale):
+    """Single-query attention against an O(W) ring cache.
+
+    q: (B, 1, H, D); the cache holds (B, W, Hkv, D) where slot ``s``
+    holds the K/V of position ``kpos(s) = pos - ((pos - s) mod W)``
+    (the module docstring's invariant, established by the prefill
+    gather and maintained by the per-step slot write). ``kpos >= 0`` is
+    the whole mask: it is simultaneously the causal bound (every stored
+    position is <= pos by construction), the sliding-window bound
+    (every stored position is > pos - W), and the warmup guard for
+    slots no position has reached yet.
+    """
+    W = cache_l["k"].shape[1]
+    s = _cache_scores(q, cache_l, scale)  # (B, H, 1, W) f32
+    kpos = pos - jnp.mod(pos - jnp.arange(W), W)
+    s = jnp.where((kpos >= 0)[None, None, None, :], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    o = _cache_pv(p, cache_l)  # (B, 1, H, D) f32
     return o.astype(q.dtype)
 
 
 def _incremental_layer(x, lp, cache_l, qpos, cfg, *, chunk_attn, kv_slice,
-                       tp_psum):
+                       tp_psum, ring=False):
     """One layer of the incremental forward: write the chunk's K/V into
     the cache at ``qpos`` positions, attend, MLP. Returns (x, cache_l).
     ``tp_psum=True`` combines the head-shard out-projection and the
     d_ff-shard down-projection over the ``tp`` axis, exactly like the
-    training path (models/transformer.py ``_forward_local``)."""
+    training path (models/transformer.py ``_forward_local``).
+    ``ring=True`` treats the cache as the O(W) circular window buffer
+    (single-token chunks only): the write lands at slot ``pos % W`` and
+    attention runs through :func:`_ring_cached_attention`."""
     h = _ln(x, lp["ln1_s"], lp["ln1_b"])
     q = jnp.einsum("bld,dhk->blhk", h, lp["wq"])
     k = jnp.einsum("bld,dhk->blhk", h, lp["wk"])
@@ -162,15 +286,19 @@ def _incremental_layer(x, lp, cache_l, qpos, cfg, *, chunk_attn, kv_slice,
         k, v = kv_slice(k), kv_slice(v)
     q, k = _rope(q, qpos), _rope(k, qpos)
     off = qpos[0]
-    kc = jax.lax.dynamic_update_slice_in_dim(cache_l["k"], k, off, axis=1)
-    vc = jax.lax.dynamic_update_slice_in_dim(cache_l["v"], v, off, axis=1)
+    if ring:
+        off = jnp.mod(off, cache_l["k"].shape[1])
+    cache_l = _cache_write(cache_l, k, v, off)
     scale = cfg.head_dim ** -0.5
     if chunk_attn is not None:
         # prefill at offset 0: attention lives entirely inside the chunk,
-        # so the configured chunk kernel (flash on TPU) does the work
+        # so the configured chunk kernel (flash on TPU) does the work on
+        # the exact (unquantized) chunk K/V — only the cache quantizes
         o = chunk_attn(q, k, v)
+    elif ring:
+        o = _ring_cached_attention(q, cache_l, qpos[0], scale)
     else:
-        o = _cached_attention(q, kc, vc, qpos, scale, cfg.attn_window)
+        o = _cached_attention(q, cache_l, qpos, scale, cfg.attn_window)
     attn_out = jnp.einsum("blhk,hkd->bld", o, lp["wo"])
     if tp_psum:
         attn_out = jax.lax.psum(attn_out, "tp")
@@ -190,18 +318,25 @@ def _incremental_layer(x, lp, cache_l, qpos, cfg, *, chunk_attn, kv_slice,
         if tp_psum:
             y = jax.lax.psum(y, "tp")
         x = x + y + lp["b2"]
-    return x, {"k": kc, "v": vc}
+    return x, cache_l
 
 
 def _incremental_forward(params, tokens, cache, offset, cfg,
-                         *, prefill, kv_slice=None, tp_psum=False):
+                         *, prefill, kv_slice=None, tp_psum=False,
+                         ring=False):
     """Chunk forward at global ``offset``; returns (logits, cache).
 
     ``prefill=True`` (static) means offset is known to be 0 and chunk
     attention uses the configured kernel; otherwise attention runs
-    against the cache.
+    against the cache — the ``max_len`` positional cache by default,
+    the O(W) ring buffer when ``ring=True``.
     """
     T = tokens.shape[1]
+    if ring and (T != 1 or prefill):
+        raise ValueError(
+            "ring cache reads are decode-only (T == 1): prefill runs "
+            "positionally, then _ring_from_cache gathers the window"
+        )
     qpos = offset + jnp.arange(T)
     chunk_attn = None
     if prefill:
@@ -215,6 +350,7 @@ def _incremental_forward(params, tokens, cache, offset, cfg,
         x, cache_l = _incremental_layer(
             x, lp, cache_l, qpos, cfg,
             chunk_attn=chunk_attn, kv_slice=kv_slice, tp_psum=tp_psum,
+            ring=ring,
         )
         new_cache.append(cache_l)
     x = _ln(x, params["lnf_s"], params["lnf_b"])
@@ -260,6 +396,70 @@ def decode_step_dense(params, token, cache, pos, cfg: TransformerConfig):
     writes clamp, they do not error). Returns (logits (B, V), cache)."""
     logits, cache = _incremental_forward(
         params, token[:, None], cache, pos, cfg, prefill=False
+    )
+    return logits[:, 0], cache
+
+
+# --------------------------------------------------------------------------
+# O(W) ring cache for sliding-window serving
+# --------------------------------------------------------------------------
+
+
+def _check_ring_cfg(cfg: TransformerConfig) -> int:
+    if cfg.attn_window is None:
+        raise ValueError(
+            "the ring cache is the sliding-window cache: set "
+            "TransformerConfig(attn_window=W) to use it (full-attention "
+            "configs need every position — use the max_len cache)"
+        )
+    return cfg.attn_window
+
+
+def init_ring_cache(
+    cfg: TransformerConfig, batch: int, mesh: Mesh | None = None, *,
+    quantize_kv: bool = False,
+) -> list[dict]:
+    """Zeroed per-layer ring cache: layers -> {"k","v"} of
+    (B, attn_window, cache_heads, Dh). Sharding specs are
+    :func:`cache_specs` (the layouts coincide; only the length axis'
+    meaning differs — slots, not positions)."""
+    W = _check_ring_cfg(cfg)
+    H = _cache_heads_global(cfg, mesh)
+    return [
+        _zero_cache_layer(batch, W, H, cfg.head_dim, cfg.dtype,
+                          quantize_kv)
+        for _ in range(cfg.n_layers)
+    ]
+
+
+def _ring_from_cache(cache_l: dict, Tp: int, W: int) -> dict:
+    """Gather a positional cache holding positions [0, Tp) into the ring
+    layout: slot ``s`` <- the latest prompt position congruent to ``s``
+    (mod W); slots no position has reached (Tp < W) stay zero — the
+    ``kpos >= 0`` read mask of :func:`_ring_cached_attention` already
+    treats them as unwritten. Every cache leaf (int8 scales included)
+    shares the position axis, so one gather covers the layout."""
+    s = jnp.arange(W)
+    p = (Tp - 1) - jnp.mod((Tp - 1) - s, W)
+    valid = p >= 0
+
+    def gather(a):
+        g = jnp.take(a, jnp.maximum(p, 0), axis=1)
+        return jnp.where(valid.reshape((1, W) + (1,) * (a.ndim - 2)), g, 0)
+
+    return {kk: gather(a) for kk, a in cache_l.items()}
+
+
+def decode_step_ring_dense(params, token, cache, pos,
+                           cfg: TransformerConfig):
+    """One decode step against the O(W) ring cache: ``token`` (B,) at
+    global position ``pos``. Returns (logits (B, V), cache). Unlike
+    :func:`decode_step_dense` there is no max_len to overflow — the
+    stream may run indefinitely; the model simply never sees past the
+    window."""
+    _check_ring_cfg(cfg)
+    logits, cache = _incremental_forward(
+        params, token[:, None], cache, pos, cfg, prefill=False, ring=True
     )
     return logits[:, 0], cache
 
@@ -324,15 +524,24 @@ def _eos_clamp(nxt, tok, done, eos_id):
 @functools.lru_cache(maxsize=64)
 def _dense_runner(cfg: TransformerConfig, B: int, Tp: int, n_new: int,
                   max_len: int, temperature: float, top_k: int | None,
-                  eos_id: int | None):
+                  eos_id: int | None, quantize_kv: bool,
+                  ring: bool = False):
     """Shape-keyed jitted prefill+scan generation program (one compile
     per (cfg, shapes, sampling); the cache is built inside the jit, not
-    baked in as a constant)."""
+    baked in as a constant). ``ring=True`` is the O(W) sliding-window
+    variant: prefill fills a Tp-length transient positional cache
+    (freed after the gather), the last-W K/V gathers into ring slots,
+    and the decode scan carries W positions per layer (``max_len`` is
+    ignored — the ring has no horizon)."""
+    W = _check_ring_cfg(cfg) if ring else None
 
     @jax.jit
     def run(params, prompt, key):
-        c = init_cache(cfg, B, max_len)
+        c = init_cache(cfg, B, Tp if ring else max_len,
+                       quantize_kv=quantize_kv)
         logits, c = prefill_dense(params, prompt, c, cfg)
+        if ring:
+            c = [_ring_from_cache(cl, Tp, W) for cl in c]
         tok = _pick_token(
             logits[:, -1], Tp - 1, key, temperature, top_k, prompt.dtype
         )
@@ -340,8 +549,13 @@ def _dense_runner(cfg: TransformerConfig, B: int, Tp: int, n_new: int,
 
         def step(carry, pos):
             tok, done, c = carry
-            lg, c = decode_step_dense(params, tok, c, pos, cfg)
-            nxt = _pick_token(lg, pos, key, temperature, top_k, tok.dtype)
+            lg, c = _incremental_forward(
+                params, tok[:, None], c, pos, cfg, prefill=False,
+                ring=ring,
+            )
+            nxt = _pick_token(
+                lg[:, 0], pos, key, temperature, top_k, tok.dtype
+            )
             nxt, done = _eos_clamp(nxt, tok, done, eos_id)
             return (nxt, done, c), tok
 
@@ -359,7 +573,8 @@ def _dense_runner(cfg: TransformerConfig, B: int, Tp: int, n_new: int,
 def generate_dense(params, prompt, n_new: int, cfg: TransformerConfig,
                    max_len: int | None = None, *,
                    temperature: float = 0.0, top_k: int | None = None,
-                   key=None, eos_id: int | None = None):
+                   key=None, eos_id: int | None = None,
+                   quantize_kv: bool = False):
     """Generation, dense single-program: prefill + lax.scan of decode
     steps under one jit (compiled once per shape, cached). Greedy by
     default; ``temperature > 0`` samples (optionally top-k-truncated)
@@ -380,7 +595,32 @@ def generate_dense(params, prompt, n_new: int, cfg: TransformerConfig,
     if key is None:
         key = jax.random.key(0)  # unused at temperature 0
     return _dense_runner(
-        cfg, B, Tp, n_new, max_len, float(temperature), top_k, eos_id
+        cfg, B, Tp, n_new, max_len, float(temperature), top_k, eos_id,
+        quantize_kv,
+    )(params, prompt, key)
+
+
+def generate_ring_dense(params, prompt, n_new: int,
+                        cfg: TransformerConfig, *,
+                        temperature: float = 0.0, top_k: int | None = None,
+                        key=None, eos_id: int | None = None,
+                        quantize_kv: bool = False):
+    """Sliding-window generation over the O(W) ring cache, dense
+    single-program. Token-for-token equal to :func:`generate_dense` on
+    a window config (both attend exactly the (pos-W, pos] band; only
+    storage differs) while the decode scan carries W cache positions
+    per layer instead of ``Tp + n_new`` — memory AND per-step cache
+    bandwidth are O(W). Returns (B, n_new) tokens."""
+    if n_new < 1:
+        raise ValueError(f"n_new must be >= 1, got {n_new}")
+    _check_ring_cfg(cfg)
+    _check_sampling(temperature, top_k, key)
+    B, Tp = prompt.shape
+    if key is None:
+        key = jax.random.key(0)  # unused at temperature 0
+    return _dense_runner(
+        cfg, B, Tp, n_new, 0, float(temperature), top_k, eos_id,
+        quantize_kv, ring=True,
     )(params, prompt, key)
 
 
@@ -402,13 +642,16 @@ def _check_decode_mesh(cfg: TransformerConfig, mesh: Mesh):
         )
 
 
-def make_prefill(cfg: TransformerConfig, mesh: Mesh):
+def make_prefill(cfg: TransformerConfig, mesh: Mesh, *,
+                 quantize_kv: bool = False):
     """Jitted sharded prefill: (params, tokens (B, Tp), cache) ->
     (last-position logits (B, V), cache). Batch over dp (and ep for
     MoE — expert routing runs sharded, all_to_all over ep, exactly as
-    in training), heads over tp."""
+    in training), heads over tp. ``quantize_kv`` must match the cache
+    layout (init_cache's flag)."""
     _check_decode_mesh(cfg, mesh)
     bax = decode_batch_axes(cfg)
+    cspecs = cache_specs(cfg, quantize_kv=quantize_kv)
 
     def local(params, tokens, cache):
         _check_prefill_fits(tokens.shape[1], cache)
@@ -421,20 +664,22 @@ def make_prefill(cfg: TransformerConfig, mesh: Mesh):
     f = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(param_specs(cfg, mesh), P(bax, None), cache_specs(cfg)),
-        out_specs=(P(bax, None), cache_specs(cfg)),
+        in_specs=(param_specs(cfg, mesh), P(bax, None), cspecs),
+        out_specs=(P(bax, None), cspecs),
         check_vma=not _flash_interpreted(cfg.attn_impl),
     )
     return jax.jit(f)
 
 
-def make_decode_step(cfg: TransformerConfig, mesh: Mesh):
+def make_decode_step(cfg: TransformerConfig, mesh: Mesh, *,
+                     quantize_kv: bool = False):
     """Jitted sharded decode step: (params, token (B,), cache, pos) ->
     (logits (B, V), cache). Donates the cache for in-place HBM update.
     """
 
     _check_decode_mesh(cfg, mesh)
     bax = decode_batch_axes(cfg)
+    cspecs = cache_specs(cfg, quantize_kv=quantize_kv)
 
     def local(params, token, cache, pos):
         logits, cache = _incremental_forward(
@@ -447,9 +692,9 @@ def make_decode_step(cfg: TransformerConfig, mesh: Mesh):
         local,
         mesh=mesh,
         in_specs=(
-            param_specs(cfg, mesh), P(bax), cache_specs(cfg), P(),
+            param_specs(cfg, mesh), P(bax), cspecs, P(),
         ),
-        out_specs=(P(bax, None), cache_specs(cfg)),
+        out_specs=(P(bax, None), cspecs),
         # decode traces NO flash kernel (masked cached attention), so
         # the interpreted-Pallas vma carve-out does not apply — keep
         # shard_map's varying-axes checking on
@@ -458,7 +703,8 @@ def make_decode_step(cfg: TransformerConfig, mesh: Mesh):
     return jax.jit(f, donate_argnums=(2,))
 
 
-def make_extend(cfg: TransformerConfig, mesh: Mesh):
+def make_extend(cfg: TransformerConfig, mesh: Mesh, *,
+                quantize_kv: bool = False):
     """Jitted CHUNKED prefill step: (params, tokens (B, T), cache,
     offset) -> (logits (B, T, V), cache) — processes a T-token chunk at
     any global ``offset``, attending causally within the chunk and
@@ -491,6 +737,7 @@ def make_extend(cfg: TransformerConfig, mesh: Mesh):
 
     _check_decode_mesh(cfg, mesh)
     bax = decode_batch_axes(cfg)
+    cspecs = cache_specs(cfg, quantize_kv=quantize_kv)
 
     def local(params, tokens, cache, offset):
         # the T-vs-cache half of the clamp guard is trace-time checkable
@@ -507,9 +754,9 @@ def make_extend(cfg: TransformerConfig, mesh: Mesh):
         local,
         mesh=mesh,
         in_specs=(
-            param_specs(cfg, mesh), P(bax, None), cache_specs(cfg), P(),
+            param_specs(cfg, mesh), P(bax, None), cspecs, P(),
         ),
-        out_specs=(P(bax, None, None), cache_specs(cfg)),
+        out_specs=(P(bax, None, None), cspecs),
         check_vma=True,  # no flash kernel in the extend program
     )
     return jax.jit(f)
@@ -518,7 +765,8 @@ def make_extend(cfg: TransformerConfig, mesh: Mesh):
 def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
                   max_len: int | None = None, *,
                   temperature: float = 0.0, top_k: int | None = None,
-                  eos_id: int | None = None):
+                  eos_id: int | None = None, quantize_kv: bool = False,
+                  ring: bool = False):
     """Jitted sharded generation: ``gen(params, prompt (B, Tp)[, key])``
     -> (B, n_new) tokens. Prefill + a lax.scan of decode steps inside
     ONE shard_map program — zero host round trips between tokens.
@@ -537,9 +785,14 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
     ``_forward_local`` — see ``_incremental_layer`` (attention output
     enters the residual after the wo einsum, whose head-shard partial
     sums cross tp via the psum below).
+
+    ``ring=True`` (see :func:`make_ring_generate`) swaps the decode
+    scan's cache carry for the O(W) sliding-window ring; ``max_len``
+    is then ignored (the ring has no horizon).
     """
 
     _check_decode_mesh(cfg, mesh)
+    W = _check_ring_cfg(cfg) if ring else None
     bax = decode_batch_axes(cfg)
     if n_new < 1:
         raise ValueError(f"n_new must be >= 1, got {n_new}")
@@ -547,19 +800,20 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
 
     def local(params, prompt, key):
         B, Tp = prompt.shape
-        L = max_len if max_len is not None else Tp + n_new
-        if L < Tp + n_new:
-            raise ValueError(
-                f"max_len {L} < prompt {Tp} + n_new {n_new}: decode "
-                "positions would clamp into the last cache slot"
-            )
+        if ring:
+            L = Tp  # transient positional prefill cache, gathered below
+        else:
+            L = max_len if max_len is not None else Tp + n_new
+            if L < Tp + n_new:
+                raise ValueError(
+                    f"max_len {L} < prompt {Tp} + n_new {n_new}: decode "
+                    "positions would clamp into the last cache slot"
+                )
         Hc = _cache_heads_global(cfg, mesh)
         tp = mesh.shape["tp"]
         cache = [
-            {
-                "k": jnp.zeros((B, L, Hc // tp, cfg.head_dim), cfg.dtype),
-                "v": jnp.zeros((B, L, Hc // tp, cfg.head_dim), cfg.dtype),
-            }
+            _zero_cache_layer(B, L, Hc // tp, cfg.head_dim, cfg.dtype,
+                              quantize_kv)
             for _ in range(cfg.n_layers)
         ]
         kv_slice = make_kv_slice(cfg)
@@ -567,6 +821,8 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
             params, prompt, cache, jnp.int32(0), cfg, prefill=True,
             kv_slice=kv_slice, tp_psum=True,
         )
+        if ring:
+            cache = [_ring_from_cache(cl, Tp, W) for cl in cache]
         # global batch-row offset of this shard, derived from the one
         # source of truth for the batch layout (dp-major, then ep)
         row0 = jnp.int32(0)
@@ -585,7 +841,7 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
             tok, done, cache = carry
             lg, cache = _incremental_forward(
                 params, tok[:, None], cache, pos, cfg, prefill=False,
-                kv_slice=kv_slice, tp_psum=True,
+                kv_slice=kv_slice, tp_psum=True, ring=ring,
             )
             nxt = _pick_token(
                 lg[:, 0], pos, key, temperature, top_k, tok.dtype, row0
@@ -617,3 +873,24 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
         return jitted(params, prompt, key)
 
     return gen
+
+
+def make_ring_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int, *,
+                       temperature: float = 0.0, top_k: int | None = None,
+                       eos_id: int | None = None,
+                       quantize_kv: bool = False):
+    """Sharded sliding-window generation over the O(W) ring cache:
+    ``gen(params, prompt (B, Tp)[, key])`` -> (B, n_new) tokens.
+
+    The :func:`make_generate` program with the decode scan's cache carry
+    replaced by the ring (see the module docstring): prefill runs
+    positionally into a Tp-length transient (the chunk flash kernel
+    applies the window band), each layer's last-W K/V gathers into ring
+    slots, and every decode step writes slot ``pos % W`` and reads W
+    positions — per-token cache traffic and carried HBM are O(W)
+    however long the prompt or the stream. Sharding is unchanged:
+    batch over dp (and ep for MoE), cache heads over tp."""
+    return make_generate(
+        cfg, mesh, n_new, temperature=temperature, top_k=top_k,
+        eos_id=eos_id, quantize_kv=quantize_kv, ring=True,
+    )

@@ -74,6 +74,19 @@ def test_long_context_training_example():
     assert "sp=4" in out.stdout
 
 
+def test_coded_transformer_training_example():
+    out = _run_example(
+        "coded_transformer_training.py",
+        env_extra={"JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    # timing ratio is load-dependent (shared device) — the deterministic
+    # claims are that both loops ran and the trajectories are identical
+    assert "coded epochs (nwait=4)" in out.stdout
+    assert "bulk-sync epochs (nwait=6)" in out.stdout
+    assert "exact full-batch gradient from fastest 4/6: ok" in out.stdout
+
+
 def test_serving_decode_example():
     out = _run_example(
         "serving_decode.py",
@@ -88,3 +101,5 @@ def test_serving_decode_example():
     assert "mesh dp=2 tp=4" in out.stdout, out.stdout[-500:]
     assert "kv cache heads: 2 vs 8 MHA" in out.stdout
     assert "sharded generation == dense oracle: ok" in out.stdout
+    assert "int8 KV cache:" in out.stdout
+    assert "sharded == dense oracle: ok" in out.stdout  # ring section

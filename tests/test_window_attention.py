@@ -19,8 +19,14 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mpistragglers_jl_tpu.models.decode import (
+    _ring_from_cache,
     decode_step_dense,
+    decode_step_ring_dense,
+    generate_dense,
+    generate_ring_dense,
     init_cache,
+    init_ring_cache,
+    make_ring_generate,
     prefill_dense,
 )
 from mpistragglers_jl_tpu.models.transformer import (
@@ -164,6 +170,103 @@ def test_window_validation():
     q, k, v = _qkv(2, 2, L=8)
     with pytest.raises(ValueError, match="window must be"):
         flash_attention(q, k, v, causal=True, window=0)
+
+
+@pytest.mark.parametrize("Tp", [3, 12])
+def test_ring_decode_teacher_forced(Tp):
+    """The O(W) ring cache reproduces the windowed training forward
+    position-for-position, through multiple slot wraparounds (decode
+    runs to position 19 with W=5, so every slot is overwritten at least
+    once) and through the Tp < W warmup (Tp=3 leaves unwritten slots
+    that must self-mask)."""
+    cfg = CFG
+    L = 20
+    params = init_params(cfg, seed=3)
+    rng = np.random.default_rng(4)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab, (2, L)), jnp.int32)
+    want = forward_dense(params, toks, cfg)
+    cache = init_cache(cfg, 2, Tp)
+    lg, cache = prefill_dense(params, toks[:, :Tp], cache, cfg)
+    ring = [_ring_from_cache(cl, Tp, cfg.attn_window) for cl in cache]
+    for t in range(Tp, L):
+        lg, ring = decode_step_ring_dense(
+            params, toks[:, t], ring, jnp.int32(t), cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg), np.asarray(want[:, t]), atol=1e-4,
+            rtol=1e-4, err_msg=f"position {t}",
+        )
+
+
+@pytest.mark.parametrize("Tp", [3, 12])
+def test_ring_generate_matches_masked_generate(Tp):
+    """generate_ring_dense == generate_dense token-for-token on a
+    window config: same band, different storage. n_new=13 with W=5
+    wraps every slot."""
+    cfg = CFG
+    params = init_params(cfg, seed=5)
+    rng = np.random.default_rng(6)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, Tp)), jnp.int32)
+    want = generate_dense(params, prompt, 13, cfg)
+    got = generate_ring_dense(params, prompt, 13, cfg)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_ring_generate_sampled_matches_masked():
+    """Sampling draws from identical logits streams (same fold-in key
+    schedule), so the sampled token streams agree too."""
+    cfg = CFG
+    params = init_params(cfg, seed=7)
+    rng = np.random.default_rng(8)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, 6)), jnp.int32)
+    key = jax.random.key(9)
+    want = generate_dense(
+        params, prompt, 8, cfg, temperature=0.8, top_k=7, key=key
+    )
+    got = generate_ring_dense(
+        params, prompt, 8, cfg, temperature=0.8, top_k=7, key=key
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("shape", [(2, 2), (1, 4)])
+def test_sharded_ring_generate_matches_dense(shape):
+    """make_ring_generate over dp x tp == the dense ring generator —
+    including tp=4 > kv_heads=2, the replicated-groups cache layout."""
+    cfg = CFG
+    mesh = make_mesh(shape, ("dp", "tp"))
+    params = init_params(cfg, seed=10)
+    rng = np.random.default_rng(11)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, 7)), jnp.int32)
+    want = generate_ring_dense(params, prompt, 9, cfg)
+    gen = make_ring_generate(cfg, mesh, 9)
+    got = gen(
+        shard_params(params, cfg, mesh),
+        jax.device_put(prompt, NamedSharding(mesh, P("dp", None))),
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_ring_cache_is_O_window():
+    """The structural claim: ring leaves are (B, W, Hkv, Dh) however
+    long the stream — no max_len anywhere in the layout."""
+    cfg = CFG
+    ring = init_ring_cache(cfg, batch=3)
+    for layer in ring:
+        assert layer["k"].shape == (
+            3, cfg.attn_window, cfg.kv_heads, cfg.head_dim
+        )
+        assert layer["v"].shape == layer["k"].shape
+
+
+def test_ring_requires_window():
+    cfg = dataclasses.replace(CFG, attn_window=None)
+    with pytest.raises(ValueError, match="sliding-window"):
+        init_ring_cache(cfg, batch=1)
+    params = init_params(cfg, seed=0)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="sliding-window"):
+        generate_ring_dense(params, prompt, 2, cfg)
 
 
 @pytest.mark.parametrize("maker_kind", ["ring", "ulysses"])
