@@ -310,54 +310,46 @@ def bench_decode(
 
     rtt = _fence_rtt(dev)
 
+    # one timing discipline for every program here: call 0 is the
+    # compile, calls 1..chains are fence-RTT-subtracted, keep the min
+    compile_s = {}
+
+    def _time_best(name, run):
+        best = None
+        for i in range(chains + 1):
+            t0 = time.perf_counter()
+            run()
+            dt = time.perf_counter() - t0
+            if i == 0:
+                compile_s[name] = dt
+            else:
+                dt -= rtt
+                best = dt if best is None else min(best, dt)
+        return best
+
     # prefill alone (cache fill + last-position logits)
     prefill = make_prefill(cfg, mesh)
-    cache0 = shard_cache(
-        init_cache(cfg, batch, prompt_len + n_new, mesh), cfg, mesh
-    )
-    t0 = time.perf_counter()
-    lg, cache = prefill(params, prompt, cache0)
-    float(jnp.sum(lg.astype(jnp.float32)))
-    prefill_compile_s = time.perf_counter() - t0
-    best_p = None
-    for _ in range(chains):
+
+    def run_prefill():
         cache0 = shard_cache(
             init_cache(cfg, batch, prompt_len + n_new, mesh), cfg, mesh
         )
-        t0 = time.perf_counter()
         lg, _ = prefill(params, prompt, cache0)
         float(jnp.sum(lg.astype(jnp.float32)))
-        dt = time.perf_counter() - t0 - rtt
-        best_p = dt if best_p is None else min(best_p, dt)
 
-    # the full generation program (prefill + n_new cached steps)
+    best_p = _time_best("prefill", run_prefill)
+
+    # the full generation program (prefill + n_new cached steps);
+    # np.asarray token fetch IS the fence
     gen = make_generate(cfg, mesh, n_new=n_new)
-    t0 = time.perf_counter()
-    toks = gen(params, prompt)
-    np.asarray(toks)  # token fetch IS the fence
-    gen_compile_s = time.perf_counter() - t0
-    best_g = None
-    for _ in range(chains):
-        t0 = time.perf_counter()
-        toks = gen(params, prompt)
-        np.asarray(toks)
-        dt = time.perf_counter() - t0 - rtt
-        best_g = dt if best_g is None else min(best_g, dt)
+    best_g = _time_best("generate", lambda: np.asarray(gen(params, prompt)))
 
     # int8 KV cache: same generation program, half the cache bytes;
     # dequant folds into the attention einsums (models/decode.py)
     gen_q8 = make_generate(cfg, mesh, n_new=n_new, quantize_kv=True)
-    t0 = time.perf_counter()
-    toks = gen_q8(params, prompt)
-    np.asarray(toks)
-    q8_compile_s = time.perf_counter() - t0
-    best_q8 = None
-    for _ in range(chains):
-        t0 = time.perf_counter()
-        toks = gen_q8(params, prompt)
-        np.asarray(toks)
-        dt = time.perf_counter() - t0 - rtt
-        best_q8 = dt if best_q8 is None else min(best_q8, dt)
+    best_q8 = _time_best(
+        "generate_q8", lambda: np.asarray(gen_q8(params, prompt))
+    )
 
     # the generation program runs n_new - 1 cached decode forwards
     # (the first token comes out of prefill — models/decode.py scan)
@@ -387,9 +379,7 @@ def bench_decode(
         "kv_cache_mib_int8": round(cache_q8_mb, 1),
         "decode_ms_per_token_int8": round(decode_q8_s / n_dec * 1e3, 3),
         "int8_decode_speedup": round(decode_s / decode_q8_s, 2),
-        "compile_s": round(
-            prefill_compile_s + gen_compile_s + q8_compile_s, 1
-        ),
+        "compile_s": round(sum(compile_s.values()), 1),
         "fence_rtt_s": round(rtt, 4),
         "chains_min_of": chains,
     }

@@ -11,9 +11,11 @@ from .checkpoint import state_dict, load_state_dict, save, restore
 from .rs_gf256 import RSGF256
 from .straggle import AdaptiveNwait, PoolLatencyModel, WorkerStats
 from .coded_checkpoint import CodedCheckpoint, CheckpointCorrupt
+from .hedge import HedgedServer
 
 __all__ = [
     "faults",
+    "HedgedServer",
     "AdaptiveNwait",
     "PoolLatencyModel",
     "WorkerStats",

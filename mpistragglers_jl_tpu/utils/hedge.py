@@ -1,0 +1,190 @@
+"""Hedged requests: first-response-wins replicated dispatch.
+
+The serving-side dual of fastest-k training. The reference's pool
+primitive — dispatch to several workers, return at the first
+satisfactory arrival (``nwait=1``; src/MPIAsyncPools.jl:148-158 with
+the minimal quorum) — is exactly the classic tail-latency hedge
+("The Tail at Scale"): send the same request to ``hedge`` replicas and
+take whichever answers first, so one stalled replica costs nothing.
+
+:class:`HedgedServer` packages that on top of subset pools
+(``AsyncPool(ranks=[...])`` routing, pool.py): each request runs on its
+own 2-or-more-replica subset of one shared backend, so independent
+requests hedge over disjoint replicas concurrently. The pieces the
+pool already provides:
+
+* **first-wins** is ``asyncmap(nwait=1)`` — phase 3 returns at the
+  first fresh arrival;
+* **losers cost nothing** — the slower replica's result arrives later,
+  is harvested by the next phase-1 drain on that pool (stale, stored,
+  worker freed), and the server's busy map keeps the rank out of new
+  subsets until then;
+* **exactly-once bookkeeping** — ``fresh_indices`` distinguishes the
+  winner from the drained losers.
+
+The server never blocks on a loser: ``request`` blocks only for its own
+winner; ``drain`` (shutdown) is the one full barrier.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..backends.base import Backend, WorkerFailure
+from ..pool import AsyncPool, asyncmap, waitall
+
+__all__ = ["HedgedServer"]
+
+
+class HedgedServer:
+    """First-response-wins dispatch over rank subsets of one backend.
+
+    >>> srv = HedgedServer(backend)
+    >>> result, rank, latency = srv.request(payload, hedge=2)
+
+    ``request`` picks ``hedge`` idle replicas (round-robin over the
+    backend's ranks, skipping any still busy with a previous request's
+    losing dispatch), broadcasts the payload to all of them, and
+    returns the first arrival. Explicit ``replicas=[...]`` overrides
+    the choice (the caller owns disjointness then — a rank busy in
+    another subset raises from the backend's slot check).
+    """
+
+    def __init__(self, backend: Backend):
+        self.backend = backend
+        self._pools: dict[tuple[int, ...], AsyncPool] = {}
+        self._rr = 0  # round-robin cursor over backend ranks
+        self.history: list[tuple[int, float]] = []  # (winner rank, s)
+        # replicas whose LOSING dispatch failed: their error must not
+        # poison later requests (they already lost — nobody is waiting
+        # on the result), but the rank is out of rotation until the
+        # caller repairs it (backend.respawn + reset_dead)
+        self.failures: list[WorkerFailure] = []
+        self._dead: set[int] = set()
+
+    # -- busy/harvest bookkeeping ---------------------------------------
+
+    def _harvest(self) -> None:
+        """Non-blocking drain of every pool's outstanding losers (the
+        pool phase-1 discipline, run across pools): frees their ranks
+        for new subsets."""
+        from ..pool import _store  # package-internal by design
+
+        for pool in self._pools.values():
+            for i in np.flatnonzero(pool.active):
+                result = self.backend.test(
+                    pool.ranks[i], tag=int(pool.stags[i])
+                )
+                if result is None:
+                    continue
+                try:
+                    _store(pool, int(i), result, None)
+                except WorkerFailure as e:
+                    # a LOSER died: its request was already served, so
+                    # no caller is owed this error — record it, bench
+                    # the rank, keep serving
+                    self.failures.append(e)
+                    self._dead.add(int(pool.ranks[i]))
+                pool.active[int(i)] = False
+
+    def _busy_ranks(self) -> set[int]:
+        busy: set[int] = set()
+        for pool in self._pools.values():
+            busy.update(
+                int(pool.ranks[j]) for j in np.flatnonzero(pool.active)
+            )
+        return busy
+
+    def _pick(self, hedge: int, timeout: float | None) -> list[int]:
+        """Up to ``hedge`` idle ranks, round-robin. Best-effort width:
+        when losers from earlier requests still hold ranks, the hedge
+        NARROWS rather than fails (a thinner hedge is a latency risk;
+        a refused request is an outage). Zero idle ranks blocks on the
+        harvest loop — bounded by ``timeout`` when given."""
+        import time as _time
+
+        n = self.backend.n_workers
+        deadline = (
+            None if timeout is None else _time.perf_counter() + timeout
+        )
+        while True:
+            busy = self._busy_ranks() | self._dead
+            picked: list[int] = []
+            for d in range(n):
+                r = (self._rr + d) % n
+                if r not in busy:
+                    picked.append(r)
+                    if len(picked) == hedge:
+                        break
+            if picked:
+                self._rr = (picked[-1] + 1) % n
+                return picked
+            if deadline is not None and _time.perf_counter() > deadline:
+                raise RuntimeError(
+                    f"no idle replica within {timeout} s (all {n} busy "
+                    "with losing dispatches); add replicas or drain()"
+                )
+            _time.sleep(1e-3)
+            self._harvest()
+
+    # -- the request path -----------------------------------------------
+
+    def request(
+        self,
+        payload,
+        *,
+        hedge: int = 2,
+        replicas: Sequence[int] | None = None,
+        timeout: float | None = None,
+    ):
+        """Dispatch ``payload`` to up to ``hedge`` idle replicas (the
+        width narrows when losers still hold ranks — see ``_pick``);
+        return ``(result, winner_rank, winner_latency_s)`` of the first
+        arrival. The losing replicas keep computing and are recycled
+        opportunistically — no request ever waits for them."""
+        if hedge < 1:
+            raise ValueError(f"hedge must be >= 1, got {hedge}")
+        self._harvest()
+        ranks = (
+            list(int(r) for r in replicas) if replicas is not None
+            else self._pick(hedge, timeout)
+        )
+        key = tuple(sorted(ranks))
+        pool = self._pools.get(key)
+        if pool is None:
+            pool = AsyncPool(list(key))
+            self._pools[key] = pool
+        asyncmap(pool, payload, self.backend, nwait=1, timeout=timeout)
+        fresh = pool.fresh_indices()
+        # >1 fresh iff several replicas answered within the same poll
+        # tick; the measured-latency argmin is then the honest winner
+        i = int(fresh[np.argmin(pool.latency[fresh])])
+        winner = (pool.results[i], int(pool.ranks[i]),
+                  float(pool.latency[i]))
+        self.history.append(winner[1:])
+        return winner
+
+    def reset_dead(self, rank: int) -> None:
+        """Return a repaired replica (e.g. after ``backend.respawn``)
+        to the rotation."""
+        self._dead.discard(int(rank))
+        for pool in self._pools.values():
+            if rank in pool.ranks:
+                pool.reset_worker(pool._idx_of_rank[int(rank)])
+
+    def drain(self) -> None:
+        """Shutdown barrier: wait for every outstanding loser so the
+        backend can be closed (or reused) cleanly. A loser dying during
+        the drain is recorded like any other loser death, not raised —
+        drain is cleanup, not a request."""
+        for pool in self._pools.values():
+            while pool.active.any():
+                try:
+                    waitall(pool, self.backend)
+                except WorkerFailure as e:
+                    # _store already freed the failed slot, so the
+                    # retry drains only the remaining workers
+                    self.failures.append(e)
+                    self._dead.add(int(e.worker))

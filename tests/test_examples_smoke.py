@@ -87,6 +87,16 @@ def test_coded_transformer_training_example():
     assert "exact full-batch gradient from fastest 4/6: ok" in out.stdout
 
 
+def test_hedged_serving_example():
+    out = _run_example(
+        "hedged_serving.py", env_extra={"JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    # the example asserts internally that no hedged request paid a
+    # stall while single-assignment did; this line prints only then
+    assert "the tail is gone" in out.stdout
+
+
 def test_serving_decode_example():
     out = _run_example(
         "serving_decode.py",

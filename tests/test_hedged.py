@@ -1,0 +1,164 @@
+"""Hedged requests (utils/hedge.py): first-response-wins over subset
+pools of one shared backend — the serving-side dual of fastest-k.
+
+Deterministic delay schedules make every claim checkable: the winner is
+the fast replica, a stalled loser's rank stays out of new subsets until
+its late result is harvested, and the measured request latency tracks
+the fast replica's injected delay, not the straggler's.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu.backends.local import LocalBackend
+from mpistragglers_jl_tpu.utils import HedgedServer
+
+N = 4
+SLOW, FAST = 0.25, 0.01
+
+
+def _work(i, payload, epoch):
+    # echo enough to identify (replica, payload) pairs
+    return np.asarray([i, int(payload[0]), epoch], dtype=np.int64)
+
+
+def _mk_backend(slow_ranks=(0,)):
+    def delay(i, epoch):
+        return SLOW if i in slow_ranks else FAST
+
+    return LocalBackend(_work, N, delay_fn=delay)
+
+
+def test_winner_is_fast_replica_and_latency_tracks_it():
+    backend = _mk_backend(slow_ranks=(0,))
+    srv = HedgedServer(backend)
+    t0 = time.perf_counter()
+    result, rank, lat = srv.request(
+        np.asarray([7], np.int64), replicas=[0, 1]
+    )
+    wall = time.perf_counter() - t0
+    assert rank == 1  # the fast one
+    assert result[0] == 1 and result[1] == 7
+    assert lat < SLOW / 2  # paid the fast delay, not the stall
+    assert wall < SLOW  # the request never waited for the straggler
+    srv.drain()
+    backend.shutdown()
+
+
+def test_loser_rank_excluded_until_harvested():
+    backend = _mk_backend(slow_ranks=(0,))
+    srv = HedgedServer(backend)
+    srv.request(np.asarray([1], np.int64), replicas=[0, 1])
+    # rank 0 is still grinding its losing dispatch
+    assert srv._busy_ranks() == {0}
+    _, rank2, _ = srv.request(np.asarray([2], np.int64), hedge=2)
+    assert rank2 in {2, 3}  # subset avoided the busy rank
+    # after the stall elapses, harvest frees rank 0 for new subsets
+    time.sleep(SLOW + 0.05)
+    srv._harvest()
+    assert 0 not in srv._busy_ranks()
+    srv.drain()
+    backend.shutdown()
+
+
+def test_round_robin_spreads_load():
+    backend = _mk_backend(slow_ranks=())
+    srv = HedgedServer(backend)
+    seen = set()
+    for q in range(4):
+        _, rank, _ = srv.request(np.asarray([q], np.int64), hedge=2)
+        seen.add(rank)
+        srv.drain()  # settle both replicas between requests
+    assert len(seen) >= 2  # the cursor rotated subsets
+    backend.shutdown()
+
+
+def test_hedge_narrows_when_losers_hold_ranks():
+    """Best-effort width: with rank 0 still grinding a losing dispatch,
+    a hedge=4 request degrades to the 3 idle replicas instead of
+    refusing (a thinner hedge is a latency risk; a refused request is
+    an outage)."""
+    backend = _mk_backend(slow_ranks=(0,))
+    srv = HedgedServer(backend)
+    srv.request(np.asarray([1], np.int64), replicas=[0, 1], timeout=5.0)
+    assert srv._busy_ranks() == {0}
+    _, rank, _ = srv.request(np.asarray([2], np.int64), hedge=4)
+    assert rank in {1, 2, 3}
+    assert any(len(k) == 3 for k in srv._pools)  # the narrowed subset
+    srv.drain()
+    backend.shutdown()
+
+
+def test_hedge_one_is_plain_dispatch():
+    backend = _mk_backend(slow_ranks=())
+    srv = HedgedServer(backend)
+    _, rank, _ = srv.request(np.asarray([3], np.int64), hedge=1)
+    assert rank in range(N)
+    srv.drain()
+    backend.shutdown()
+
+
+def test_validation():
+    backend = _mk_backend()
+    srv = HedgedServer(backend)
+    with pytest.raises(ValueError, match="hedge"):
+        srv.request(np.asarray([1], np.int64), hedge=0)
+    backend.shutdown()
+
+
+def test_dead_loser_does_not_poison_later_requests():
+    """A replica that dies AFTER losing its hedge must not raise into
+    an unrelated later request: its request was already served, so the
+    failure is recorded, the rank benched, and serving continues."""
+
+    def work(i, payload, epoch):
+        if i == 0:
+            time.sleep(FAST * 3)  # lose first, then die
+            raise RuntimeError("replica 0 exploded after losing")
+        return _work(i, payload, epoch)
+
+    backend = LocalBackend(work, N)
+    srv = HedgedServer(backend)
+    _, rank1, _ = srv.request(
+        np.asarray([1], np.int64), replicas=[0, 1], timeout=5.0
+    )
+    assert rank1 == 1
+    time.sleep(FAST * 4)  # let the loser finish dying
+    _, rank2, _ = srv.request(np.asarray([2], np.int64), hedge=2)
+    assert rank2 != 0
+    assert len(srv.failures) == 1
+    assert srv.failures[0].worker == 0
+    assert 0 in srv._dead
+    # benched: later picks never include the dead rank
+    for q in range(3, 6):
+        _, rank, _ = srv.request(np.asarray([q], np.int64), hedge=2)
+        assert rank != 0
+    srv.drain()
+    # repair hook returns it to rotation
+    srv.reset_dead(0)
+    assert 0 not in srv._dead
+    backend.shutdown()
+
+
+def test_tail_latency_win_under_random_stalls():
+    """The Tail-at-Scale claim, deterministically: replica r stalls on
+    requests where (q + r) % 4 == 0, so single-assignment eats a stall
+    every 4th request while hedge=2 (consecutive ranks never both
+    stall) never does."""
+
+    def delay(i, epoch):
+        return SLOW if (epoch + i) % 4 == 0 else FAST
+
+    backend = LocalBackend(_work, N, delay_fn=delay)
+    srv = HedgedServer(backend)
+    hedged = []
+    for q in range(8):
+        t0 = time.perf_counter()
+        srv.request(np.asarray([q], np.int64), hedge=2)
+        hedged.append(time.perf_counter() - t0)
+        srv.drain()  # isolate per-request timing
+    assert max(hedged) < SLOW, hedged  # no request paid a stall
+    srv.drain()
+    backend.shutdown()
