@@ -298,7 +298,10 @@ def _transformer_rungs():
       FLOPs-for-HBM cost vs the 16k base rung);
     * decode_rung — 16k prefill + 128 greedy KV-cache tokens;
     * window_decode_rung — sliding-window serving, O(W) ring cache vs
-      the masked max_len cache (same band, ~16x less decode traffic);
+      the masked max_len cache (same band, 16x less cache memory;
+      decode cost via slope methodology);
+    * spec_decode_rung — n-gram-draft speculative decode vs plain
+      greedy, identical output stream (tokens/forward + wall ratio);
     * moe_rung — E=4 Switch experts at the flagship shape (routing
       overhead computed against THIS session's flagship step).
 
@@ -391,11 +394,13 @@ def _transformer_rungs():
     tt["remat_rung"] = _try_rung(rung_remat)
     from benchmarks.transformer_train_bench import (
         bench_decode,
+        bench_spec_decode,
         bench_window_decode,
     )
 
     tt["decode_rung"] = _try_rung(bench_decode)
     tt["window_decode_rung"] = _try_rung(bench_window_decode)
+    tt["spec_decode_rung"] = _try_rung(bench_spec_decode)
 
     def rung_moe():
         from benchmarks.moe_bench import bench_moe_train

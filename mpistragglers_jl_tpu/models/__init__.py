@@ -25,6 +25,7 @@ _HOME = {
     "make_ring_generate": "decode",
     "CodedGradTrainer": "coded_train",
     "transformer_chunk_loss": "coded_train",
+    "generate_speculative_dense": "speculative",
     "make_prefill": "decode",
     "make_decode_step": "decode",
     "make_extend": "decode",

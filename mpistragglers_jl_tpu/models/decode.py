@@ -107,8 +107,11 @@ def _kv_quantize(x):
     head_dim axis: ``x ~= x_i8 * s[..., None]``. The scale axis choice
     matters: per-position scales ride the cache (tiny — no D axis) and
     dequantization folds into the attention einsums as a rank-1 scale
-    on scores (K) and probabilities (V), so the cache is read as int8
-    bytes and no dequantized copy is ever materialized at full size."""
+    on scores (K) and probabilities (V), so no dequantized copy is
+    *required* at full size. Measured reality (docs/PERF.md): XLA
+    materializes one anyway before the dot, so on the current
+    toolchain this is a MEMORY feature (half the cache bytes), not a
+    latency feature."""
     xf = x.astype(jnp.float32)
     s = jnp.max(jnp.abs(xf), axis=-1) / 127.0
     s = jnp.maximum(s, 1e-8)  # all-zero rows (unwritten slots)

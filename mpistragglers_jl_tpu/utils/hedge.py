@@ -106,6 +106,13 @@ class HedgedServer:
         import time as _time
 
         n = self.backend.n_workers
+        if not set(range(n)) - self._dead:
+            # dead ranks never come back on their own — waiting on the
+            # harvest loop would hang forever; name the actual problem
+            raise RuntimeError(
+                f"all {n} replicas are dead ({sorted(self._dead)}); "
+                "repair them (backend.respawn + reset_dead)"
+            )
         deadline = (
             None if timeout is None else _time.perf_counter() + timeout
         )

@@ -142,6 +142,17 @@ def test_dead_loser_does_not_poison_later_requests():
     backend.shutdown()
 
 
+def test_all_dead_raises_immediately():
+    """Every rank benched -> an immediate, accurate error (the harvest
+    loop can never revive dead ranks, so waiting would hang)."""
+    backend = _mk_backend(slow_ranks=())
+    srv = HedgedServer(backend)
+    srv._dead = {0, 1, 2, 3}
+    with pytest.raises(RuntimeError, match="dead"):
+        srv.request(np.asarray([1], np.int64), hedge=2)
+    backend.shutdown()
+
+
 def test_tail_latency_win_under_random_stalls():
     """The Tail-at-Scale claim, deterministically: replica r stalls on
     requests where (q + r) % 4 == 0, so single-assignment eats a stall

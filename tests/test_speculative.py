@@ -1,0 +1,108 @@
+"""Speculative decoding (models/speculative.py): the output IS the
+greedy stream — speculation only changes how many forwards it takes.
+
+Exact equality with ``generate_dense`` is the load-bearing contract
+(accept-iff-argmax-matches + correction token = greedy by induction;
+the cache-consistency argument is the module docstring). Acceptance
+(forwards saved) varies with stream predictability and is asserted
+only where it is structurally guaranteed.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu.models.decode import generate_dense
+from mpistragglers_jl_tpu.models.speculative import (
+    _bigram_draft,
+    generate_speculative_dense,
+)
+from mpistragglers_jl_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+CFG = TransformerConfig(
+    vocab=61, d_model=48, n_heads=4, n_layers=2, d_ff=96
+)
+
+
+def _prompt(L, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, CFG.vocab, (1, L)), jnp.int32)
+
+
+@pytest.mark.parametrize("k", [1, 3, 4, 8])
+@pytest.mark.parametrize("Tp,n_new", [(8, 17), (3, 5), (12, 30)])
+def test_speculative_equals_greedy(Tp, n_new, k):
+    params = init_params(CFG, seed=1)
+    prompt = _prompt(Tp, seed=Tp * 31 + k)
+    want = generate_dense(params, prompt, n_new, CFG)
+    got, iters = generate_speculative_dense(
+        params, prompt, n_new, CFG, k=k
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert 0 < iters <= n_new - 1 or (n_new == 1 and iters == 0)
+
+
+def test_repetitive_prompt_equals_greedy_and_accepts():
+    """A strongly periodic prompt: lookup drafting must still be exact,
+    and untrained greedy streams loop, so some drafts accept — fewer
+    verify forwards than tokens."""
+    params = init_params(CFG, seed=2)
+    base = _prompt(6, seed=9)
+    prompt = jnp.tile(base, (1, 4))  # period-6 repetition, Tp=24
+    n_new = 24
+    want = generate_dense(params, prompt, n_new, CFG)
+    got, iters = generate_speculative_dense(
+        params, prompt, n_new, CFG, k=4
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert iters < n_new - 1, (
+        f"no draft ever accepted on a periodic stream ({iters} forwards "
+        f"for {n_new} tokens)"
+    )
+
+
+def test_n_new_one_needs_no_decode_forward():
+    params = init_params(CFG, seed=3)
+    prompt = _prompt(5, seed=4)
+    want = generate_dense(params, prompt, 1, CFG)
+    got, iters = generate_speculative_dense(params, prompt, 1, CFG)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert iters == 0  # prefill's argmax is the whole answer
+
+
+def test_bigram_draft_lookup_semantics():
+    """Draft = the continuation of the most recent earlier occurrence
+    of the current bigram; fallback repeats the last token."""
+    buf = jnp.asarray([5, 7, 1, 2, 3, 5, 7, 9, 0, 0], jnp.int32)
+    # cursor=7 (known through index 6): current bigram (buf[5], buf[6])
+    # = (5, 7); its only EARLIER occurrence is p=0 (p=5 is the current
+    # bigram itself, excluded): continuation after it is [1, 2, 3]
+    dr = _bigram_draft(buf, jnp.int32(7), 3)
+    np.testing.assert_array_equal(np.asarray(dr), [1, 2, 3])
+    # no earlier occurrence: repeat last token
+    buf2 = jnp.asarray([1, 2, 3, 4, 5, 0, 0, 0], jnp.int32)
+    dr2 = _bigram_draft(buf2, jnp.int32(5), 3)
+    np.testing.assert_array_equal(np.asarray(dr2), [5, 5, 5])
+
+
+def test_validation():
+    params = init_params(CFG, seed=0)
+    with pytest.raises(ValueError, match="B=1"):
+        generate_speculative_dense(
+            params, jnp.zeros((2, 4), jnp.int32), 4, CFG
+        )
+    with pytest.raises(ValueError, match="prompt >= 2"):
+        generate_speculative_dense(
+            params, jnp.zeros((1, 1), jnp.int32), 4, CFG
+        )
+    with pytest.raises(ValueError, match="n_new"):
+        generate_speculative_dense(
+            params, jnp.zeros((1, 4), jnp.int32), 0, CFG
+        )
+    with pytest.raises(ValueError, match="draft length"):
+        generate_speculative_dense(
+            params, jnp.zeros((1, 4), jnp.int32), 4, CFG, k=0
+        )
