@@ -375,6 +375,18 @@ def bench_decode(
 
     decode_ms, best_g = slope_ms(False)
     decode_q8_ms, _ = slope_ms(True)
+    # third variant: the experimental Pallas int8 decode kernel (off by
+    # default — measured slower so far; keep the record honest)
+    from mpistragglers_jl_tpu.models.decode import use_decode_kernel
+
+    use_decode_kernel(True)
+    try:
+        decode_q8k_ms, _ = slope_ms(True)
+    except Exception as e:  # never let the experiment kill the rung
+        decode_q8k_ms = None
+        print(f"int8 kernel variant failed: {e!r}", flush=True)
+    finally:
+        use_decode_kernel(False)
 
     Hkv = cfg.kv_heads
     cache_mb = (
@@ -399,6 +411,9 @@ def bench_decode(
         "kv_cache_mib_int8": round(cache_q8_mb, 1),
         "decode_ms_per_token_int8": round(decode_q8_ms, 3),
         "int8_decode_speedup": round(decode_ms / decode_q8_ms, 2),
+        "decode_ms_per_token_int8_kernel": (
+            round(decode_q8k_ms, 3) if decode_q8k_ms else None
+        ),
         "decode_slope_steps": slope_steps,
         "compile_s": round(compile_s, 1),
         "fence_rtt_s": round(rtt, 4),

@@ -26,6 +26,7 @@ _HOME = {
     "CodedGradTrainer": "coded_train",
     "transformer_chunk_loss": "coded_train",
     "generate_speculative_dense": "speculative",
+    "make_speculative_dense": "speculative",
     "make_prefill": "decode",
     "make_decode_step": "decode",
     "make_extend": "decode",

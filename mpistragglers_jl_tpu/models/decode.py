@@ -96,6 +96,27 @@ __all__ = [
 
 _NEG = -1e30  # matches parallel/ring_attention.py
 
+# The Pallas int8 decode-attention kernel (ops/decode_attention.py) is
+# OPT-IN: correct everywhere (tests/test_decode_attention.py) but so
+# far measured SLOWER than the einsum dequant path on the bench chip
+# (0.6-0.8x across three kernel layouts — docs/PERF.md records each
+# attempt); the einsum path stays the default until a layout wins.
+_USE_DECODE_KERNEL = False
+
+
+def use_decode_kernel(enabled: bool) -> None:
+    """Route quantized T=1 cached attention through the Pallas kernel
+    (experimental; see the note above). Set it BEFORE building/first-
+    calling a generation program for a given shape — compiled programs
+    (``make_*`` closures, the lru-cached dense runners) bake the
+    routing in at trace time."""
+    global _USE_DECODE_KERNEL
+    _USE_DECODE_KERNEL = bool(enabled)
+
+
+def _decode_kernel_enabled() -> bool:
+    return _USE_DECODE_KERNEL
+
 
 # --------------------------------------------------------------------------
 # int8 KV-cache quantization (serving-time choice, orthogonal to layout)
@@ -238,7 +259,33 @@ def _cached_attention(q, cache_l, qpos, scale, window=None):
     ``arange(Lmax)``; validity is ``kpos <= qpos`` (cache entries past
     the chunk are zeros AND masked; entries below the offset are real),
     intersected with the sliding-window band when ``window`` is set.
+
+    int8 caches at T == 1 take the Pallas decode kernel
+    (ops/decode_attention.py): it dequantizes in VMEM, so HBM reads
+    really are the int8 bytes — the einsum form's ``.astype`` is
+    materialized by XLA and gives half the bytes back (docs/PERF.md).
     """
+    Hq, Hkv_c = q.shape[2], cache_l["k"].shape[2]
+    if (
+        _decode_kernel_enabled()
+        and _is_quantized(cache_l)
+        and q.shape[1] == 1
+        and q.shape[-1] % 128 == 0
+        and Hq % Hkv_c == 0
+        and Hq // Hkv_c <= 8
+    ):
+        from ..ops.decode_attention import (
+            DEFAULT_BLOCK_K,
+            _pick_block_128,
+            quantized_decode_attention,
+        )
+
+        if _pick_block_128(
+            cache_l["k"].shape[1], DEFAULT_BLOCK_K, Hkv_c, q.shape[-1]
+        ) is not None:
+            return quantized_decode_attention(
+                q, cache_l, qpos[0], scale, window
+            )
     Lmax = cache_l["k"].shape[1]
     s = _cache_scores(q, cache_l, scale)  # (B, H, T, Lmax) f32
     # the one band predicate (parallel/ring_attention._band_mask): the
@@ -812,6 +859,13 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
                     f"max_len {L} < prompt {Tp} + n_new {n_new}: decode "
                     "positions would clamp into the last cache slot"
                 )
+            if quantize_kv and _decode_kernel_enabled() and L > 2048:
+                # round up so the int8 decode KERNEL always has a big
+                # lane-aligned block divisor (extra slots are masked).
+                # Gated on the kernel toggle: the einsum path needs no
+                # alignment, and the extra masked positions would skew
+                # its memory/time against the bf16 baseline
+                L = -(-L // 2048) * 2048
         Hc = _cache_heads_global(cfg, mesh)
         tp = mesh.shape["tp"]
         cache = [

@@ -1,0 +1,224 @@
+"""Pallas TPU decode attention over the int8 KV cache.
+
+Why this kernel exists (measured, docs/PERF.md "int8 KV cache"): the
+einsum-form dequantization — int8 cache ``.astype(bf16)`` feeding the
+attention dots — is *expressed* as a fused rank-1 correction, but XLA
+materializes the converted operand in HBM, so the int8 cache read half
+the bytes and then paid them back with interest (0.70x vs the bf16
+cache). The fix is the standard Pallas move: stream the int8 blocks
+through VMEM and dequantize in registers, so HBM traffic really is the
+int8 bytes plus scales.
+
+Layout lesson (both dead ends measured on the chip, docs/PERF.md):
+a head-major kernel layout needs a transpose of the whole cache —
+XLA materializes it per layer per step and the win drowns (0.82x);
+slicing one head's D-chunk per grid row from the native layout makes
+every DMA a strided 128-lane gather (0.53x). The kernel therefore
+reads the cache EXACTLY as it is laid out — contiguous
+``(bk, Hkv*D)`` blocks of the native ``(B, L, Hkv, D)`` cache — and
+handles the GQA grouping *inside* the kernel with a static loop over
+kv heads (static row/lane slices, one MXU dot per head group):
+
+* grid ``(B, k_blocks)``, k innermost-sequential — for B=1 at 16k
+  that is a handful of grid steps per layer, not hundreds;
+* the q heads ride the sublane axis, each GQA group zero-padded to
+  the 8-row tile (``(Hkv * 8, D)`` total); padding rows compute
+  garbage that is sliced off at the end, never normalized;
+* per-(position, head) f32 scales arrive in their native
+  ``(B, L, Hkv)`` layout too (whole-trailing-dim blocks are
+  tile-legal) — NOTHING is transposed or copied outside the kernel;
+* validity is ``kpos <= pos`` (plus the sliding band when ``window``
+  is set) with ``pos`` delivered through SMEM — one compiled kernel
+  serves every decode step; blocks entirely outside the visible range
+  are predicated off grid-level.
+
+Inference-only: no VJP (the cache is never differentiated through).
+Interpret mode on non-TPU backends keeps the path testable on the CI
+mesh, same as the flash kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _sds, _use_interpret
+
+_NEG = -1e30
+_LANE = 128
+_SUB = 8  # TPU sublane tile: each GQA group pads to this many q rows
+
+__all__ = ["quantized_decode_attention"]
+
+
+# Scoped-VMEM budget per (block row x kv head), CALIBRATED on the
+# bench chip: Mosaic's stack allocation for this kernel measured
+# ~1435 B/(row*head) at D=128 (bk=5632, Hkv=2 hit 16.16 MiB against
+# the 16 MiB scoped limit) — double-buffered int8 K/V plus the f32
+# score/probability intermediates and allocator slack.
+_VMEM_PER_ROW_HEAD = 11.3  # bytes per (row, head, D/128 lane group)
+_VMEM_CAP = 12 * 2 ** 20
+# default k-block budget; the models/decode.py routing gate imports
+# THIS constant so the two call sites cannot drift
+DEFAULT_BLOCK_K = 8192
+
+
+def _pick_block_128(L: int, block: int, Hkv: int = 2,
+                    D: int = 128) -> int | None:
+    """Largest lane-aligned block (multiple of 128) <= ``block``
+    dividing L whose calibrated working set fits scoped VMEM. Lengths
+    with no such divisor fall back to the whole dimension in one block
+    (block == dim is always tile-legal) when IT fits; otherwise None —
+    the caller keeps the einsum path."""
+    cap = int(_VMEM_CAP / (Hkv * D * _VMEM_PER_ROW_HEAD))
+    b = min(block, L, max(cap, 128))
+    b -= b % 128
+    while b >= 128:
+        if L % b == 0:
+            return b
+        b -= 128
+    if L <= max(cap, 128):  # whole-dim fallback
+        return L
+    return None
+
+
+def _kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+            acc, m_sc, l_sc, *, scale, window, bk, nk, Hkv, D):
+    j = pl.program_id(1)
+    pos = pos_ref[0]
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    run = j * bk <= pos  # any position of this block visible?
+    if window is not None:
+        run = jnp.logical_and(run, pos - (j * bk + bk - 1) < window)
+
+    @pl.when(run)
+    def _update():
+        kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        mask = kpos <= pos
+        if window is not None:
+            mask = jnp.logical_and(mask, pos - kpos < window)
+        kblk = k_ref[0]  # (bk, Hkv*D) int8, one contiguous DMA
+        vblk = v_ref[0]
+        ksb = ks_ref[0].astype(jnp.float32)  # (bk, Hkv)
+        vsb = vs_ref[0].astype(jnp.float32)
+        # static loop over kv heads: static row/lane slices, one MXU
+        # dot per GQA group — the grouping costs index math, not DMA
+        for h in range(Hkv):
+            rows = slice(h * _SUB, (h + 1) * _SUB)
+            q = q_ref[0][rows]  # (SUB, D): g live rows + padding
+            kb = kblk[:, h * D:(h + 1) * D].astype(q.dtype)
+            s = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # (SUB, bk)
+            s = s * ksb[:, h][None, :]
+            s = jnp.where(mask, s, _NEG)
+            m_prev = m_sc[rows, :1]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            p = jnp.where(mask, p, 0.0)
+            corr = jnp.exp(m_prev - m_new)
+            l_sc[rows] = jnp.broadcast_to(
+                l_sc[rows, :1] * corr + p.sum(axis=-1, keepdims=True),
+                (_SUB, _LANE),
+            )
+            vb = vblk[:, h * D:(h + 1) * D].astype(jnp.float32)
+            pv = p * vsb[:, h][None, :]
+            acc[rows] = acc[rows] * corr + jax.lax.dot_general(
+                pv, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_sc[rows] = jnp.broadcast_to(m_new, (_SUB, _LANE))
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_sc[:, :1], 1e-20)
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+
+
+def quantized_decode_attention(
+    q, cache_l: dict, pos, scale, window=None, *,
+    block_k: int = DEFAULT_BLOCK_K, interpret: bool | None = None,
+):
+    """Single-query grouped attention against an int8 cache layer.
+
+    q: (B, 1, H, D); ``cache_l``: {"k","v"} int8 (B, L, Hkv, D) +
+    {"k_s","v_s"} f32 (B, L, Hkv); ``pos``: scalar current position
+    (cache entries with kpos <= pos are valid). Returns (B, 1, H, D)
+    in q's dtype — numerically the online-softmax evaluation of the
+    same masked attention ``models/decode.py::_cached_attention``
+    computes in einsum form (pinned by tests/test_decode_attention.py).
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    B, T, H, D = q.shape
+    if T != 1:
+        raise ValueError(f"decode kernel is single-query, got T={T}")
+    kc, vc = cache_l["k"], cache_l["v"]
+    ks, vs = cache_l["k_s"], cache_l["v_s"]
+    L, Hkv = kc.shape[1], kc.shape[2]
+    g = H // Hkv
+    bk = _pick_block_128(L, block_k, Hkv, D)
+    if bk is None:
+        raise ValueError(
+            f"cache length {L} has no multiple-of-128 divisor <= "
+            f"{block_k} and is too long for a whole-dimension block; "
+            "size the cache (prompt + n_new) to a multiple of 128, or "
+            "use the einsum path"
+        )
+    nk = L // bk
+    if g > _SUB:
+        raise ValueError(
+            f"GQA group {g} exceeds the kernel's {_SUB}-row group tile"
+        )
+
+    # (B, 1, H, D) -> (B, Hkv*SUB, D): each kv head's g q-rows padded
+    # to the 8-row tile (tiny — no cache-sized copies anywhere here)
+    q3 = q.reshape(B, Hkv, g, D)
+    if g < _SUB:
+        q3 = jnp.pad(q3, ((0, 0), (0, 0), (0, _SUB - g), (0, 0)))
+    q3 = q3.reshape(B, Hkv * _SUB, D)
+    rows = Hkv * _SUB
+    kf = kc.reshape(B, L, Hkv * D)  # free: (Hkv, D) tail is contiguous
+    vf = vc.reshape(B, L, Hkv * D)
+    pos1 = jnp.asarray(pos, jnp.int32).reshape(1)
+
+    kern = functools.partial(
+        _kernel, scale=scale, window=window, bk=bk, nk=nk, Hkv=Hkv, D=D
+    )
+    o3 = pl.pallas_call(
+        kern,
+        grid=(B, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, rows, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, Hkv * D), lambda b, j: (b, j, 0)),
+            # whole-trailing-dim blocks are tile-legal at any Hkv
+            pl.BlockSpec((1, bk, Hkv), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, Hkv * D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, Hkv), lambda b, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, rows, D), lambda b, j: (b, 0, 0)),
+        out_shape=_sds((B, rows, D), q.dtype, q),
+        scratch_shapes=[
+            pltpu.VMEM((rows, D), jnp.float32),
+            pltpu.VMEM((rows, _LANE), jnp.float32),
+            pltpu.VMEM((rows, _LANE), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(pos1, q3, kf, ks, vf, vs)
+    # (B, Hkv*SUB, D) -> drop each group's padding rows -> (B, 1, H, D)
+    return o3.reshape(B, Hkv, _SUB, D)[:, :, :g].reshape(B, 1, H, D)

@@ -1,0 +1,138 @@
+"""Pallas int8 decode-attention kernel (ops/decode_attention.py):
+online-softmax single-query attention with in-VMEM dequantization,
+pinned against the einsum-form oracle (models/decode.py
+``_cache_scores``/``_cache_pv`` composition) on identical quantized
+caches. Shapes use head_dim 128 — the kernel's lane-width gate — so
+the same configs the flagship serves are what the CI mesh tests
+(interpret mode off-TPU, like the flash kernels).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu.models.decode import (
+    _cache_pv,
+    _cache_scores,
+    _band_mask,
+    _NEG,
+    _kv_quantize,
+    generate_dense,
+    init_cache,
+    prefill_dense,
+)
+from mpistragglers_jl_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+from mpistragglers_jl_tpu.ops.decode_attention import (
+    quantized_decode_attention,
+)
+
+
+def _quant_cache(B, L, Hkv, D, seed=0):
+    rng = np.random.default_rng(seed)
+    k = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+    kq, ks = _kv_quantize(k)
+    vq, vs = _kv_quantize(v)
+    return {"k": kq, "k_s": ks, "v": vq, "v_s": vs}
+
+
+def _oracle(q, cache_l, pos, scale, window=None):
+    """The einsum-form masked attention (the path the kernel replaces)."""
+    L = cache_l["k"].shape[1]
+    s = _cache_scores(q, cache_l, scale)
+    mask = _band_mask(pos[None], jnp.arange(L), True, window)
+    s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return _cache_pv(p, cache_l).astype(q.dtype)
+
+
+@pytest.mark.parametrize("Hq,Hkv", [(8, 2), (4, 4), (8, 1)])
+@pytest.mark.parametrize("pos", [0, 7, 200, 255])
+def test_kernel_matches_einsum_oracle(Hq, Hkv, pos):
+    B, L, D = 2, 256, 128
+    cache = _quant_cache(B, L, Hkv, D, seed=pos)
+    rng = np.random.default_rng(99)
+    q = jnp.asarray(rng.standard_normal((B, 1, Hq, D)), jnp.float32)
+    scale = D ** -0.5
+    want = _oracle(q, cache, jnp.int32(pos), scale)
+    got = quantized_decode_attention(
+        q, cache, jnp.int32(pos), scale, block_k=128, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("W", [5, 64, 1000])
+def test_kernel_window_band(W):
+    B, L, Hq, Hkv, D = 1, 256, 4, 2, 128
+    cache = _quant_cache(B, L, Hkv, D, seed=W)
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((B, 1, Hq, D)), jnp.float32)
+    scale = D ** -0.5
+    pos = jnp.int32(200)
+    want = _oracle(q, cache, pos, scale, window=W)
+    got = quantized_decode_attention(
+        q, cache, pos, scale, window=W, block_k=128, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_kernel_block_predication_excludes_future():
+    """Blocks wholly past pos (and entries past pos inside a block)
+    must not leak: poison the future with huge values."""
+    B, L, Hq, Hkv, D = 1, 128, 4, 2, 128
+    cache = _quant_cache(B, L, Hkv, D, seed=1)
+    poisoned = dict(cache)
+    poisoned["k_s"] = cache["k_s"].at[:, 40:].set(1e9)
+    poisoned["v_s"] = cache["v_s"].at[:, 40:].set(1e9)
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((B, 1, Hq, D)), jnp.float32)
+    scale = D ** -0.5
+    clean = quantized_decode_attention(
+        q, cache, jnp.int32(39), scale, block_k=128, interpret=True
+    )
+    dirty = quantized_decode_attention(
+        q, poisoned, jnp.int32(39), scale, block_k=128, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(clean), np.asarray(dirty))
+
+
+def test_kernel_rides_generation_at_head_dim_128():
+    """End-to-end: with the kernel toggled on, a D=128 config's
+    quantized greedy generation routes decode steps through it and
+    matches the exact-cache stream, dense path."""
+    from mpistragglers_jl_tpu.models.decode import use_decode_kernel
+
+    cfg = TransformerConfig(
+        vocab=97, d_model=256, n_heads=2, n_kv_heads=1, n_layers=2,
+        d_ff=256,
+    )
+    assert cfg.head_dim == 128
+    params = init_params(cfg, seed=7)
+    rng = np.random.default_rng(8)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, 6)), jnp.int32)
+    want = generate_dense(params, prompt, 7, cfg)
+    use_decode_kernel(True)
+    try:
+        got = generate_dense(params, prompt, 7, cfg, quantize_kv=True)
+    finally:
+        use_decode_kernel(False)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_kernel_validation():
+    cache = _quant_cache(1, 64, 2, 128)
+    q = jnp.zeros((1, 2, 4, 128), jnp.float32)
+    with pytest.raises(ValueError, match="single-query"):
+        quantized_decode_attention(
+            q, cache, jnp.int32(0), 1.0, interpret=True
+        )
