@@ -737,13 +737,27 @@ def test_worker_rejects_rogue_coordinator():
         conn, _ = srv.accept()
         conn.settimeout(10)
         try:
-            hello = conn.recv(HDR.size, socket.MSG_WAITALL)
+            def recv_exact(n):
+                # NOT recv(MSG_WAITALL): under load it has been seen
+                # returning short on a socket with a timeout, leaking
+                # the response's tail into the post-handshake read and
+                # failing the no-data assertion below for the wrong
+                # reason
+                buf = b""
+                while len(buf) < n:
+                    chunk = conn.recv(n - len(buf))
+                    if not chunk:
+                        break
+                    buf += chunk
+                return buf
+
+            hello = recv_exact(HDR.size)
             saw["hello"] = HDR.unpack(hello)
             # issue a perfectly-formed 16-byte challenge like a real
             # coordinator would
             conn.sendall(HDR.pack(16, 0, 0, 0, 2) + b"C" * 16)
             # the worker answers mac(32) + its challenge W(16)
-            resp = conn.recv(HDR.size + 48, socket.MSG_WAITALL)
+            resp = recv_exact(HDR.size + 48)
             saw["resp_len"] = HDR.unpack(resp[: HDR.size])[0]
             # ...but we don't know the token: send a garbage proof
             conn.sendall(HDR.pack(32, 0, 0, 0, 2) + b"X" * 32)
