@@ -40,10 +40,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .decode import _incremental_forward, init_cache, prefill_dense
+from .decode import (
+    _check_decode_mesh,
+    _incremental_forward,
+    init_cache,
+    prefill_dense,
+)
 from .transformer import TransformerConfig
 
-__all__ = ["generate_speculative_dense", "make_speculative_dense"]
+__all__ = [
+    "generate_speculative_dense",
+    "make_speculative_dense",
+    "make_speculative",
+]
 
 
 def _bigram_draft(buf, cursor, k: int):
@@ -64,57 +73,75 @@ def _bigram_draft(buf, cursor, k: int):
     return jnp.where(has, dr, buf[cursor - 1])
 
 
+def _spec_loop(prefill, step, cache, prompt, Tp: int, n_new: int,
+               k: int):
+    """THE draft/verify loop — the exact-greedy acceptance contract
+    lives here once, shared by the dense and sharded programs.
+
+    ``prefill(prompt, cache) -> (logits (1, Tp, V), cache)``;
+    ``step(chunk (1, k+1), cache, offset) -> (logits, cache)``.
+    Returns the packed ``(n_new + 1,)`` array: tokens + the verify-
+    forward count in the last slot (one array = one D2H fetch — two
+    separate fetches cost two tunnel round trips, the difference
+    between a measured win and a measured loss on the bench chip)."""
+    if prompt.shape[1] != Tp:
+        raise ValueError(
+            f"program compiled for Tp={Tp}, got prompt of "
+            f"{prompt.shape[1]} tokens: positions past the prompt "
+            "would attend unwritten zero K/V and diverge silently"
+        )
+    Lbuf = Tp + n_new + k + 1  # slack: the last verify may overrun
+    logits, cache = prefill(prompt, cache)
+    first = jnp.argmax(logits[0, -1]).astype(prompt.dtype)
+    buf = jnp.zeros((Lbuf,), prompt.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, prompt[0], (0,))
+    buf = buf.at[Tp].set(first)
+
+    def cond(state):
+        _, cursor, _, _ = state
+        return cursor < Tp + n_new
+
+    def body(state):
+        buf, cursor, cache, iters = state
+        draft = _bigram_draft(buf, cursor, k)  # (k,)
+        chunk = jnp.concatenate(
+            [jax.lax.dynamic_slice(buf, (cursor - 1,), (1,)), draft]
+        )[None]  # (1, k+1) at positions cursor-1 .. cursor+k-1
+        lg, cache = step(chunk, cache, cursor - 1)
+        greedy = jnp.argmax(lg[0], axis=-1).astype(buf.dtype)  # (k+1,)
+        # greedy[i] is the model's token for position cursor+i given
+        # the exact prefix; accept drafts while they match it
+        acc = jnp.cumprod((greedy[:k] == draft).astype(jnp.int32))
+        m = jnp.sum(acc, dtype=jnp.int32)  # accepted drafts, 0..k
+        draft_ext = jnp.concatenate([draft, draft[-1:]])
+        # emit[i<m] = draft[i] (== greedy[i]); emit[m] = greedy[m]
+        # (the correction); entries past m are dead — overwritten
+        # by later iterations before any read
+        emit = jnp.where(jnp.arange(k + 1) < m, draft_ext, greedy)
+        buf = jax.lax.dynamic_update_slice(buf, emit, (cursor,))
+        return buf, cursor + m + 1, cache, iters + 1
+
+    buf, cursor, _, iters = jax.lax.while_loop(
+        cond, body, (buf, jnp.int32(Tp + 1), cache, jnp.int32(0))
+    )
+    return jnp.concatenate(
+        [buf[Tp:Tp + n_new], iters.astype(buf.dtype)[None]]
+    )
+
+
 @functools.lru_cache(maxsize=64)
 def _spec_runner(cfg: TransformerConfig, Tp: int, n_new: int, k: int):
-    Lbuf = Tp + n_new + k + 1  # slack: the last verify may overrun
+    Lbuf = Tp + n_new + k + 1
 
     @jax.jit
     def run(params, prompt):
         cache = init_cache(cfg, 1, Lbuf)
-        logits, cache = prefill_dense(params, prompt, cache, cfg)
-        first = jnp.argmax(logits[0, -1]).astype(prompt.dtype)
-        buf = jnp.zeros((Lbuf,), prompt.dtype)
-        buf = jax.lax.dynamic_update_slice(buf, prompt[0], (0,))
-        buf = buf.at[Tp].set(first)
-
-        def cond(state):
-            _, cursor, _, _ = state
-            return cursor < Tp + n_new
-
-        def body(state):
-            buf, cursor, cache, iters = state
-            draft = _bigram_draft(buf, cursor, k)  # (k,)
-            chunk = jnp.concatenate(
-                [jax.lax.dynamic_slice(buf, (cursor - 1,), (1,)), draft]
-            )[None]  # (1, k+1) at positions cursor-1 .. cursor+k-1
-            lg, cache = _incremental_forward(
-                params, chunk, cache, cursor - 1, cfg, prefill=False
-            )
-            greedy = jnp.argmax(lg[0], axis=-1).astype(buf.dtype)  # (k+1,)
-            # greedy[i] is the model's token for position cursor+i given
-            # the exact prefix; accept drafts while they match it
-            acc = jnp.cumprod(
-                (greedy[:k] == draft).astype(jnp.int32)
-            )
-            m = jnp.sum(acc, dtype=jnp.int32)  # accepted drafts, 0..k
-            draft_ext = jnp.concatenate([draft, draft[-1:]])
-            # emit[i<m] = draft[i] (== greedy[i]); emit[m] = greedy[m]
-            # (the correction); entries past m are dead — overwritten
-            # by later iterations before any read
-            emit = jnp.where(jnp.arange(k + 1) < m, draft_ext, greedy)
-            buf = jax.lax.dynamic_update_slice(buf, emit, (cursor,))
-            return buf, cursor + m + 1, cache, iters + 1
-
-        buf, cursor, _, iters = jax.lax.while_loop(
-            cond, body, (buf, jnp.int32(Tp + 1), cache, jnp.int32(0))
-        )
-        # ONE output array (tokens + the forward count in the last
-        # slot): the caller fetches it in a single D2H transfer — two
-        # separate fetches cost two tunnel round trips on the bench
-        # chip, which at these decode times is the difference between
-        # a measured win and a measured loss
-        return jnp.concatenate(
-            [buf[Tp:Tp + n_new], iters.astype(buf.dtype)[None]]
+        return _spec_loop(
+            lambda pr, c: prefill_dense(params, pr, c, cfg),
+            lambda ch, c, off: _incremental_forward(
+                params, ch, c, off, cfg, prefill=False
+            ),
+            cache, prompt, Tp, n_new, k,
         )
 
     return run
@@ -164,3 +191,79 @@ def generate_speculative_dense(
         _spec_runner(cfg, Tp, n_new, int(k))(params, prompt)
     )
     return packed[None, :n_new], int(packed[n_new])
+
+
+def make_speculative(cfg: TransformerConfig, mesh, Tp: int, n_new: int,
+                     *, k: int = 4):
+    """Sharded speculative generation over a (dp=1, tp) mesh:
+    ``run(params, prompt (1, Tp)) -> (n_new + 1,)`` packed tokens +
+    forward count, same contract as :func:`make_speculative_dense`.
+
+    The draft/verify while_loop (``_spec_loop`` — shared with the
+    dense program, so the exact-greedy acceptance logic lives once)
+    runs inside ONE shard_map jit: every tp member computes identical
+    post-psum logits, hence the identical argmax, draft, and
+    acceptance — the speculation control flow replicates for free,
+    exactly like greedy ``make_generate``'s token picks. Per-stream
+    (B=1): speculation is a latency optimization for one sequence;
+    shard extra streams over dp by running one program per stream.
+    Dense configs only: the MoE all_to_all marks the loop carries
+    varying over ep, which the replicated-control-flow scheme cannot
+    express — MoE serving uses :func:`~.decode.make_generate`."""
+    from jax.sharding import PartitionSpec as P
+
+    from .decode import (
+        _cache_heads_global,
+        _zero_cache_layer,
+        make_kv_slice,
+    )
+    from .transformer import param_specs
+
+    _check_decode_mesh(cfg, mesh)
+    if cfg.n_experts:
+        raise ValueError(
+            "sharded speculative decoding supports dense configs only "
+            "(MoE expert-parallel carries cannot replicate across the "
+            "speculation loop); serve MoE with make_generate"
+        )
+    if int(mesh.shape["dp"]) != 1:
+        raise ValueError(
+            "speculative decode is per-stream: use dp=1 (run one "
+            "program per stream for batch serving)"
+        )
+    if Tp < 2 or n_new < 1 or k < 1:
+        raise ValueError(f"need Tp >= 2, n_new >= 1, k >= 1; got "
+                         f"{(Tp, n_new, k)}")
+    Lbuf = Tp + n_new + k + 1
+
+    def local(params, prompt):
+        kv_slice = make_kv_slice(cfg)
+        Hc = _cache_heads_global(cfg, mesh)
+        tp = mesh.shape["tp"]
+        cache = [
+            _zero_cache_layer(1, Lbuf, Hc // tp, cfg.head_dim,
+                              cfg.dtype, False)
+            for _ in range(cfg.n_layers)
+        ]
+        return _spec_loop(
+            lambda pr, c: _incremental_forward(
+                params, pr, c, jnp.int32(0), cfg, prefill=True,
+                kv_slice=kv_slice, tp_psum=True,
+            ),
+            lambda ch, c, off: _incremental_forward(
+                params, ch, c, off, cfg, prefill=False,
+                kv_slice=kv_slice, tp_psum=True,
+            ),
+            cache, prompt, Tp, n_new, k,
+        )
+
+    # prompt replicated (dp=1 enforced above): every member runs the
+    # identical control flow on identical post-psum logits, so the
+    # packed output is unvarying on every mesh axis
+    f = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs(cfg, mesh), P()),
+        out_specs=P(),
+    )
+    return jax.jit(f)
