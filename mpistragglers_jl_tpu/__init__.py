@@ -25,7 +25,45 @@ __all__ = [
     "WorkerFailure",
 ]
 
-__version__ = "0.1.0"
+def _version() -> str:
+    # pyproject.toml is the single source of truth. Prefer reading it
+    # directly when running from a source tree (an older installed
+    # wheel's metadata must not shadow the tree); fall back to dist
+    # metadata for installed packages, where pyproject isn't shipped.
+    import os
+
+    pyproject = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "pyproject.toml"
+    )
+    try:
+        import tomllib
+
+        with open(pyproject, "rb") as f:
+            return tomllib.load(f)["project"]["version"]
+    except Exception:
+        # py3.10 has no tomllib: a plain parse of the version line keeps
+        # the source tree authoritative there too (an installed wheel's
+        # metadata must never shadow the tree)
+        import re
+
+        try:
+            with open(pyproject, encoding="utf-8") as f:
+                m = re.search(
+                    r'^version\s*=\s*"([^"]+)"', f.read(), re.MULTILINE
+                )
+            if m:
+                return m.group(1)
+        except OSError:
+            pass
+    try:
+        from importlib.metadata import version as dist_version
+
+        return dist_version("mpistragglers_jl_tpu")
+    except Exception:  # pragma: no cover - source tree, py<3.11
+        return "0+unknown"
+
+
+__version__ = _version()
 
 
 def __getattr__(name):
