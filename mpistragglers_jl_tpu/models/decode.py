@@ -87,6 +87,7 @@ __all__ = [
     "generate_dense",
     "generate_ring_dense",
     "init_ring_cache",
+    "ring_from_cache",
     "make_generate",
     "make_ring_generate",
     "make_prefill",
@@ -106,16 +107,52 @@ _USE_DECODE_KERNEL = False
 
 def use_decode_kernel(enabled: bool) -> None:
     """Route quantized T=1 cached attention through the Pallas kernel
-    (experimental; see the note above). Set it BEFORE building/first-
-    calling a generation program for a given shape — compiled programs
-    (``make_*`` closures, the lru-cached dense runners) bake the
-    routing in at trace time."""
+    (experimental; see the note above). The flag is part of the dense
+    runners' cache key, so toggling always takes effect on the next
+    dense ``generate_*`` call — already-compiled programs for the other
+    setting stay cached and are reused on a toggle back. ``make_*``
+    closures snapshot the flag at *make* time (routing and shard_map's
+    vma setting must agree); rebuild them to change routing."""
     global _USE_DECODE_KERNEL
     _USE_DECODE_KERNEL = bool(enabled)
 
 
 def _decode_kernel_enabled() -> bool:
     return _USE_DECODE_KERNEL
+
+
+def _kernel_possible(cfg, quantize_kv: bool,
+                     use_kernel: bool | None = None) -> bool:
+    """Could a program for ``cfg`` route T=1 cached attention through
+    the int8 kernel? The shard-invariant part of ``_cached_attention``'s
+    guard (toggle, quantized cache, lane-aligned head_dim); the
+    remaining conditions (GQA ratio, block divisor) depend on per-shard
+    shapes and stay trace-time. Used both to keep the flag out of cache
+    keys where it is inert and to scope the vma carve-out."""
+    if use_kernel is None:
+        use_kernel = _USE_DECODE_KERNEL
+    return bool(
+        quantize_kv and use_kernel and cfg.head_dim % 128 == 0
+    )
+
+
+def _decode_kernel_interpreted(
+    cfg, quantize_kv: bool, use_kernel: bool | None = None
+) -> bool:
+    """True iff a quantized decode program for ``cfg`` could trace the
+    int8 Pallas kernel via the Pallas *interpreter* (non-TPU mesh) —
+    shard_map's varying-axes checking must be off for it, the same
+    carve-out ``_flash_interpreted`` gives the flash kernels.
+    ``use_kernel`` is the make-time snapshot of the toggle; defaults to
+    the live flag. A slight over-approximation is safe only in one
+    direction: claiming "kernel" for a kernel-free program silently
+    loses vma checking, so the cfg-static guard conditions are all
+    applied here."""
+    if not _kernel_possible(cfg, quantize_kv, use_kernel):
+        return False
+    from ..ops.flash_attention import _use_interpret
+
+    return _use_interpret()
 
 
 # --------------------------------------------------------------------------
@@ -252,7 +289,8 @@ def shard_cache(cache, cfg: TransformerConfig, mesh: Mesh):
     )
 
 
-def _cached_attention(q, cache_l, qpos, scale, window=None):
+def _cached_attention(q, cache_l, qpos, scale, window=None,
+                      use_kernel=None):
     """Grouped attention of the chunk's queries against the full cache.
 
     q: (B, T, H, D); the cache holds (B, Lmax, Hkv, D) at positions
@@ -264,10 +302,16 @@ def _cached_attention(q, cache_l, qpos, scale, window=None):
     (ops/decode_attention.py): it dequantizes in VMEM, so HBM reads
     really are the int8 bytes — the einsum form's ``.astype`` is
     materialized by XLA and gives half the bytes back (docs/PERF.md).
+    ``use_kernel`` pins the routing decision (callers that also pick a
+    vma setting from it must pass their snapshot — routing read from
+    the live global at trace time could disagree); None reads the
+    global toggle.
     """
+    if use_kernel is None:
+        use_kernel = _decode_kernel_enabled()
     Hq, Hkv_c = q.shape[2], cache_l["k"].shape[2]
     if (
-        _decode_kernel_enabled()
+        use_kernel
         and _is_quantized(cache_l)
         and q.shape[1] == 1
         and q.shape[-1] % 128 == 0
@@ -319,7 +363,7 @@ def _ring_cached_attention(q, cache_l, pos, scale):
 
 
 def _incremental_layer(x, lp, cache_l, qpos, cfg, *, chunk_attn, kv_slice,
-                       tp_psum, ring=False):
+                       tp_psum, ring=False, decode_kernel=None):
     """One layer of the incremental forward: write the chunk's K/V into
     the cache at ``qpos`` positions, attend, MLP. Returns (x, cache_l).
     ``tp_psum=True`` combines the head-shard out-projection and the
@@ -348,7 +392,8 @@ def _incremental_layer(x, lp, cache_l, qpos, cfg, *, chunk_attn, kv_slice,
     elif ring:
         o = _ring_cached_attention(q, cache_l, qpos[0], scale)
     else:
-        o = _cached_attention(q, cache_l, qpos, scale, cfg.attn_window)
+        o = _cached_attention(q, cache_l, qpos, scale, cfg.attn_window,
+                              use_kernel=decode_kernel)
     attn_out = jnp.einsum("blhk,hkd->bld", o, lp["wo"])
     if tp_psum:
         attn_out = jax.lax.psum(attn_out, "tp")
@@ -373,13 +418,15 @@ def _incremental_layer(x, lp, cache_l, qpos, cfg, *, chunk_attn, kv_slice,
 
 def _incremental_forward(params, tokens, cache, offset, cfg,
                          *, prefill, kv_slice=None, tp_psum=False,
-                         ring=False):
+                         ring=False, decode_kernel=None):
     """Chunk forward at global ``offset``; returns (logits, cache).
 
     ``prefill=True`` (static) means offset is known to be 0 and chunk
     attention uses the configured kernel; otherwise attention runs
     against the cache — the ``max_len`` positional cache by default,
-    the O(W) ring buffer when ``ring=True``.
+    the O(W) ring buffer when ``ring=True``. ``decode_kernel`` is the
+    caller's make-time snapshot of the int8-kernel toggle (None: read
+    the live global at trace time).
     """
     T = tokens.shape[1]
     if ring and (T != 1 or prefill):
@@ -400,7 +447,7 @@ def _incremental_forward(params, tokens, cache, offset, cfg,
         x, cache_l = _incremental_layer(
             x, lp, cache_l, qpos, cfg,
             chunk_attn=chunk_attn, kv_slice=kv_slice, tp_psum=tp_psum,
-            ring=ring,
+            ring=ring, decode_kernel=decode_kernel,
         )
         new_cache.append(cache_l)
     x = _ln(x, params["lnf_s"], params["lnf_b"])
@@ -500,6 +547,26 @@ def _ring_from_cache(cache_l: dict, Tp: int, W: int) -> dict:
     return {kk: gather(a) for kk, a in cache_l.items()}
 
 
+def ring_from_cache(cache, Tp: int, cfg: TransformerConfig) -> list[dict]:
+    """Public positional-prefill -> ring handoff: convert a full cache
+    holding prompt positions ``[0, Tp)`` (from :func:`prefill_dense`
+    over an :func:`init_cache` arena) into the O(W) ring layout that
+    :func:`decode_step_ring_dense` consumes. The source cache must
+    actually hold every prompt position — prefilling directly into a
+    W-slot ring arena would need wrapped writes the positional prefill
+    does not do (:func:`_check_prefill_fits` rejects that at trace
+    time); prefill long prompts into a Tp-length positional cache, then
+    hand off here."""
+    W = _check_ring_cfg(cfg)
+    if not cache or jax.tree.leaves(cache[0])[0].shape[1] < Tp:
+        have = jax.tree.leaves(cache[0])[0].shape[1] if cache else 0
+        raise ValueError(
+            f"source cache holds {have} positions < prompt {Tp}; the "
+            "ring gather needs every prompt position present"
+        )
+    return [_ring_from_cache(cl, Tp, W) for cl in cache]
+
+
 def decode_step_ring_dense(params, token, cache, pos,
                            cfg: TransformerConfig):
     """One decode step against the O(W) ring cache: ``token`` (B,) at
@@ -575,7 +642,7 @@ def _eos_clamp(nxt, tok, done, eos_id):
 def _dense_runner(cfg: TransformerConfig, B: int, Tp: int, n_new: int,
                   max_len: int, temperature: float, top_k: int | None,
                   eos_id: int | None, quantize_kv: bool,
-                  ring: bool = False):
+                  ring: bool = False, use_kernel: bool = False):
     """Shape-keyed jitted prefill+scan generation program (one compile
     per (cfg, shapes, sampling); the cache is built inside the jit, not
     baked in as a constant). ``ring=True`` is the O(W) sliding-window
@@ -601,7 +668,7 @@ def _dense_runner(cfg: TransformerConfig, B: int, Tp: int, n_new: int,
             tok, done, c = carry
             lg, c = _incremental_forward(
                 params, tok[:, None], c, pos, cfg, prefill=False,
-                ring=ring,
+                ring=ring, decode_kernel=use_kernel,
             )
             nxt = _pick_token(
                 lg[:, 0], pos, key, temperature, top_k, tok.dtype
@@ -647,6 +714,7 @@ def generate_dense(params, prompt, n_new: int, cfg: TransformerConfig,
     return _dense_runner(
         cfg, B, Tp, n_new, max_len, float(temperature), top_k, eos_id,
         quantize_kv,
+        use_kernel=_kernel_possible(cfg, quantize_kv),
     )(params, prompt, key)
 
 
@@ -670,7 +738,7 @@ def generate_ring_dense(params, prompt, n_new: int,
         key = jax.random.key(0)  # unused at temperature 0
     return _dense_runner(
         cfg, B, Tp, n_new, 0, float(temperature), top_k, eos_id,
-        quantize_kv, ring=True,
+        quantize_kv, ring=True, use_kernel=False,  # ring never routes it
     )(params, prompt, key)
 
 
@@ -730,11 +798,16 @@ def make_decode_step(cfg: TransformerConfig, mesh: Mesh, *,
     _check_decode_mesh(cfg, mesh)
     bax = decode_batch_axes(cfg)
     cspecs = cache_specs(cfg, quantize_kv=quantize_kv)
+    # snapshot the kernel toggle NOW: routing (traced at first call)
+    # and check_vma (fixed here) must come from the same reading, or a
+    # toggle between make and first call splits them
+    use_kernel = _decode_kernel_enabled()
 
     def local(params, token, cache, pos):
         logits, cache = _incremental_forward(
             params, token[:, None], cache, pos, cfg, prefill=False,
             kv_slice=make_kv_slice(cfg), tp_psum=True,
+            decode_kernel=use_kernel,
         )
         return logits[:, 0], cache
 
@@ -745,10 +818,10 @@ def make_decode_step(cfg: TransformerConfig, mesh: Mesh, *,
             param_specs(cfg, mesh), P(bax), cspecs, P(),
         ),
         out_specs=(P(bax, None), cspecs),
-        # decode traces NO flash kernel (masked cached attention), so
-        # the interpreted-Pallas vma carve-out does not apply — keep
-        # shard_map's varying-axes checking on
-        check_vma=True,
+        # decode traces no FLASH kernel, but with quantize_kv + the
+        # kernel toggle it traces the int8 decode kernel — which needs
+        # the same interpreted-Pallas vma carve-out
+        check_vma=not _decode_kernel_interpreted(cfg, quantize_kv, use_kernel),
     )
     return jax.jit(f, donate_argnums=(2,))
 
@@ -788,6 +861,7 @@ def make_extend(cfg: TransformerConfig, mesh: Mesh, *,
     _check_decode_mesh(cfg, mesh)
     bax = decode_batch_axes(cfg)
     cspecs = cache_specs(cfg, quantize_kv=quantize_kv)
+    use_kernel = _decode_kernel_enabled()  # same snapshot discipline
 
     def local(params, tokens, cache, offset):
         # the T-vs-cache half of the clamp guard is trace-time checkable
@@ -797,6 +871,7 @@ def make_extend(cfg: TransformerConfig, mesh: Mesh, *,
         logits, cache = _incremental_forward(
             params, tokens, cache, offset, cfg, prefill=False,
             kv_slice=make_kv_slice(cfg), tp_psum=True,
+            decode_kernel=use_kernel,
         )
         return logits, cache
 
@@ -807,7 +882,10 @@ def make_extend(cfg: TransformerConfig, mesh: Mesh, *,
             param_specs(cfg, mesh), P(bax, None), cspecs, P(),
         ),
         out_specs=(P(bax, None, None), cspecs),
-        check_vma=True,  # no flash kernel in the extend program
+        # extend is chunked (T > 1) on every real path, but a T == 1
+        # chunk with quantize_kv + the kernel toggle traces the int8
+        # decode kernel like a decode step — same vma carve-out
+        check_vma=not _decode_kernel_interpreted(cfg, quantize_kv, use_kernel),
     )
     return jax.jit(f)
 
@@ -847,6 +925,7 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
     if n_new < 1:
         raise ValueError(f"n_new must be >= 1, got {n_new}")
     _check_sampling_params(temperature, top_k)
+    use_kernel = _decode_kernel_enabled()  # make-time snapshot
 
     def local(params, prompt, key):
         B, Tp = prompt.shape
@@ -859,7 +938,7 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
                     f"max_len {L} < prompt {Tp} + n_new {n_new}: decode "
                     "positions would clamp into the last cache slot"
                 )
-            if quantize_kv and _decode_kernel_enabled() and L > 2048:
+            if quantize_kv and use_kernel and L > 2048:
                 # round up so the int8 decode KERNEL always has a big
                 # lane-aligned block divisor (extra slots are masked).
                 # Gated on the kernel toggle: the einsum path needs no
@@ -899,6 +978,7 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
             lg, cache = _incremental_forward(
                 params, tok[:, None], cache, pos, cfg, prefill=False,
                 kv_slice=kv_slice, tp_psum=True, ring=ring,
+                decode_kernel=use_kernel,
             )
             nxt = _pick_token(
                 lg[:, 0], pos, key, temperature, top_k, tok.dtype, row0
@@ -919,7 +999,13 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
         mesh=mesh,
         in_specs=(param_specs(cfg, mesh), P(bax, None), P()),
         out_specs=P(bax, None),
-        check_vma=not _flash_interpreted(cfg.attn_impl),
+        # the generate program can trace BOTH interpreted Pallas
+        # kernels: flash in the prefill chunk, the int8 decode kernel
+        # in the scan steps — either needs the vma carve-out
+        check_vma=not (
+            _flash_interpreted(cfg.attn_impl)
+            or _decode_kernel_interpreted(cfg, quantize_kv, use_kernel)
+        ),
     )
     jitted = jax.jit(f)
 

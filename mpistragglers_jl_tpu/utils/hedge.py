@@ -56,7 +56,9 @@ class HedgedServer:
         self.backend = backend
         self._pools: dict[tuple[int, ...], AsyncPool] = {}
         self._rr = 0  # round-robin cursor over backend ranks
-        self.history: list[tuple[int, float]] = []  # (winner rank, s)
+        # (winner rank, latency s, dispatched hedge width) per request
+        self.history: list[tuple[int, float, int]] = []
+        self.last_hedge_width: int = 0
         # replicas whose LOSING dispatch failed: their error must not
         # poison later requests (they already lost — nobody is waiting
         # on the result), but the rank is out of rotation until the
@@ -97,12 +99,14 @@ class HedgedServer:
             )
         return busy
 
-    def _pick(self, hedge: int, timeout: float | None) -> list[int]:
+    def _pick(self, hedge: int, deadline: float | None) -> list[int]:
         """Up to ``hedge`` idle ranks, round-robin. Best-effort width:
         when losers from earlier requests still hold ranks, the hedge
         NARROWS rather than fails (a thinner hedge is a latency risk;
         a refused request is an outage). Zero idle ranks blocks on the
-        harvest loop — bounded by ``timeout`` when given."""
+        harvest loop — bounded by ``deadline`` (an absolute
+        ``perf_counter`` time: the caller's single request budget, NOT
+        a fresh window) when given."""
         import time as _time
 
         n = self.backend.n_workers
@@ -113,9 +117,6 @@ class HedgedServer:
                 f"all {n} replicas are dead ({sorted(self._dead)}); "
                 "repair them (backend.respawn + reset_dead)"
             )
-        deadline = (
-            None if timeout is None else _time.perf_counter() + timeout
-        )
         while True:
             busy = self._busy_ranks() | self._dead
             picked: list[int] = []
@@ -130,8 +131,9 @@ class HedgedServer:
                 return picked
             if deadline is not None and _time.perf_counter() > deadline:
                 raise RuntimeError(
-                    f"no idle replica within {timeout} s (all {n} busy "
-                    "with losing dispatches); add replicas or drain()"
+                    f"no idle replica within the request budget (all "
+                    f"{n} busy with losing dispatches); add replicas "
+                    "or drain()"
                 )
             _time.sleep(1e-3)
             self._harvest()
@@ -150,27 +152,44 @@ class HedgedServer:
         width narrows when losers still hold ranks — see ``_pick``);
         return ``(result, winner_rank, winner_latency_s)`` of the first
         arrival. The losing replicas keep computing and are recycled
-        opportunistically — no request ever waits for them."""
+        opportunistically — no request ever waits for them.
+
+        ``timeout`` is ONE wall-clock budget for the whole request:
+        waiting for an idle replica and waiting for the winner share
+        the same deadline (not a fresh window each). The width actually
+        dispatched is observable per call as ``last_hedge_width`` and
+        in ``history`` — a narrowed hedge is a latency risk the caller
+        may want to react to."""
+        import time as _time
+
         if hedge < 1:
             raise ValueError(f"hedge must be >= 1, got {hedge}")
+        deadline = (
+            None if timeout is None else _time.perf_counter() + timeout
+        )
         self._harvest()
         ranks = (
             list(int(r) for r in replicas) if replicas is not None
-            else self._pick(hedge, timeout)
+            else self._pick(hedge, deadline)
         )
+        self.last_hedge_width = len(ranks)
         key = tuple(sorted(ranks))
         pool = self._pools.get(key)
         if pool is None:
             pool = AsyncPool(list(key))
             self._pools[key] = pool
-        asyncmap(pool, payload, self.backend, nwait=1, timeout=timeout)
+        remaining = (
+            None if deadline is None
+            else max(deadline - _time.perf_counter(), 1e-9)
+        )
+        asyncmap(pool, payload, self.backend, nwait=1, timeout=remaining)
         fresh = pool.fresh_indices()
         # >1 fresh iff several replicas answered within the same poll
         # tick; the measured-latency argmin is then the honest winner
         i = int(fresh[np.argmin(pool.latency[fresh])])
         winner = (pool.results[i], int(pool.ranks[i]),
                   float(pool.latency[i]))
-        self.history.append(winner[1:])
+        self.history.append(winner[1:] + (len(ranks),))
         return winner
 
     def reset_dead(self, rank: int) -> None:

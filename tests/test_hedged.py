@@ -173,3 +173,53 @@ def test_tail_latency_win_under_random_stalls():
     assert max(hedged) < SLOW, hedged  # no request paid a stall
     srv.drain()
     backend.shutdown()
+
+
+def test_single_deadline_not_double_timeout():
+    """One request budget covers pick + wait (ADVICE r4: the caller's
+    timeout used to apply twice — idle-rank wait AND asyncmap — for a
+    worst case near 2x). The regression-sensitive shape: every rank is
+    busy losing for most of the budget, frees in time for the pick, and
+    the dispatched request then stalls — the asyncmap leg must get only
+    the REMAINING budget (~budget-SLOW), not a fresh full window."""
+    backend = _mk_backend(slow_ranks=(0, 1, 2, 3))  # everyone stalls
+    srv = HedgedServer(backend)
+    # occupy every rank with a losing dispatch (give up immediately;
+    # the workers grind on for SLOW seconds)
+    for r in range(N):
+        with pytest.raises(TimeoutError):
+            srv.request(
+                np.asarray([r], np.int64), replicas=[r], timeout=0.01
+            )
+    budget = SLOW + 0.12  # pick frees at ~SLOW; ~0.12 s remains
+    t0 = time.perf_counter()
+    with pytest.raises((RuntimeError, TimeoutError),
+                       match="request budget|did not respond"):
+        srv.request(np.asarray([9], np.int64), hedge=2, timeout=budget)
+    wall = time.perf_counter() - t0
+    # buggy double-application: asyncmap gets a fresh `budget` window
+    # after the ~SLOW pick wait -> wall ~= SLOW + budget ~= 0.62 s.
+    # fixed: wall ~= budget. Assert well below the buggy wall.
+    assert wall < budget + 0.5 * SLOW, (
+        f"request consumed {wall:.3f}s against a {budget:.2f}s budget "
+        "— the deadline was applied more than once"
+    )
+    time.sleep(SLOW + 0.05)
+    srv.drain()
+    backend.shutdown()
+
+
+def test_hedge_width_is_observable():
+    """A narrowed hedge is surfaced (ADVICE r4): width lands in
+    last_hedge_width and in the history tuple."""
+    backend = _mk_backend(slow_ranks=(0,))
+    srv = HedgedServer(backend)
+    srv.request(np.asarray([1], np.int64), hedge=2, replicas=[0, 1])
+    assert srv.last_hedge_width == 2
+    # rank 0 still busy losing; ask for width 4 -> narrows to 3
+    _, rank, _ = srv.request(np.asarray([2], np.int64), hedge=4)
+    assert srv.last_hedge_width == 3
+    assert srv.history[-1][2] == 3
+    time.sleep(SLOW + 0.05)
+    srv.drain()
+    backend.shutdown()
