@@ -402,6 +402,17 @@ def _transformer_rungs():
     tt["window_decode_rung"] = _try_rung(bench_window_decode)
     tt["spec_decode_rung"] = _try_rung(bench_spec_decode)
 
+    def rung_serving():
+        # import inside the thunk: an import-time failure is recorded
+        # as this rung's error, not a loss of every transformer rung
+        from benchmarks.serving_bench import bench_serving
+
+        return bench_serving()
+
+    # round-5: continuous-batching scheduler — aggregate decode
+    # throughput at S concurrent requests vs S=1 (VERDICT r4 next-#1)
+    tt["serving_rung"] = _try_rung(rung_serving)
+
     def rung_moe():
         from benchmarks.moe_bench import bench_moe_train
 

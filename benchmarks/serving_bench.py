@@ -1,0 +1,122 @@
+"""Continuous-batching serving throughput: aggregate decode tokens/s
+at S concurrent requests vs S=1 (VERDICT r4 next-#1).
+
+The economics being priced: a B=1 decode step is weight-read-bound —
+every step streams the full parameter bytes from HBM to emit ONE token
+(docs/PERF.md round 4), so every cache-side win is capped. The
+scheduler's batched step streams the same weights once for S tokens;
+until KV-cache reads (S x W window rows) rival the weight bytes,
+aggregate throughput scales near-linearly with S. This rung measures
+that scaling on the real chip through the actual scheduler tick
+(admission excluded — steady-state decode is the claim; admission cost
+is bounded per tick by one prefill chunk and measured separately).
+
+Methodology: each tick is one device scan of ``n_inner`` steps for all
+S slots plus one host fetch of the (S, n_inner) token block — on the
+tunneled bench chip that fetch is a ~120 ms fixed round trip
+(BASELINE.md), so the measured fence RTT is subtracted per tick, the
+same correction every decode rung applies (transformer_train_bench).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+__all__ = ["bench_serving"]
+
+
+def bench_serving(
+    *,
+    slot_counts: tuple[int, ...] = (1, 4, 8),
+    prompt_len: int = 512,
+    window: int = 1024,
+    n_inner: int = 64,
+    ticks: int = 6,
+    chains: int = 3,
+    d_model: int = 1024,
+    n_layers: int = 8,
+    n_heads: int = 8,
+    n_kv_heads: int | None = 2,
+    d_ff: int = 4096,
+    vocab: int = 32768,
+) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.transformer_train_bench import _fence_rtt, _timed
+    from mpistragglers_jl_tpu.models.serving import ServingScheduler
+    from mpistragglers_jl_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab=vocab, d_model=d_model, n_heads=n_heads,
+        n_kv_heads=n_kv_heads, n_layers=n_layers, d_ff=d_ff,
+        attn="ulysses", attn_impl="flash", dtype=jnp.bfloat16,
+        attn_window=window,
+    )
+    params = init_params(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    rtt = _fence_rtt(jax.devices()[0])
+
+    rungs = {}
+    compile_s = 0.0
+    for S in slot_counts:
+        sched = ServingScheduler(
+            params, cfg, slots=S, n_inner=n_inner,
+            prompt_chunk=prompt_len, max_prompt=prompt_len,
+        )
+        for _ in range(S):
+            # budget sized so no request retires mid-measurement: every
+            # tick decodes all S rows (steady state, no admission)
+            sched.submit(
+                rng.integers(0, vocab, prompt_len, dtype=np.int32),
+                max_new=n_inner * (ticks + 2) * (chains + 2),
+            )
+        t0 = time.perf_counter()
+        sched.step()  # admit all S + first decode tick (compiles)
+        compile_s += time.perf_counter() - t0
+        best = None
+        for _ in range(chains):
+            dt = _timed(lambda: [sched.step() for _ in range(ticks)])
+            dt -= rtt * ticks  # one (S, n_inner) token fetch per tick
+            best = dt if best is None else min(best, dt)
+        tokens = S * n_inner * ticks
+        per_tok_ms = best / tokens * 1e3
+        rungs[f"S{S}"] = {
+            "aggregate_tokens_per_s": round(tokens / best, 1),
+            "ms_per_token_aggregate": round(per_tok_ms, 4),
+            "ms_per_step": round(best / (n_inner * ticks) * 1e3, 3),
+        }
+
+    base_n = 1 if 1 in slot_counts else min(slot_counts)
+    base = rungs[f"S{base_n}"]["aggregate_tokens_per_s"]
+    for S in slot_counts:
+        r = rungs[f"S{S}"]
+        r[f"vs_S{base_n}"] = round(
+            r["aggregate_tokens_per_s"] / base, 2
+        )
+    return {
+        "metric": "serving-continuous-batching",
+        "prompt_len": prompt_len,
+        "attn_window": window,
+        "n_inner": n_inner,
+        "ticks": ticks,
+        "chains_min_of": chains,
+        "fence_rtt_s": round(rtt, 4),
+        "compile_s": round(compile_s, 1),
+        **rungs,
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(bench_serving()))

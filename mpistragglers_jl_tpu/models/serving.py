@@ -1,0 +1,629 @@
+"""Continuous batching: a multi-request serving scheduler.
+
+The reference is transport-only (src/MPIAsyncPools.jl:1-226 — no model,
+no serving); this is north-star serving scope (VERDICT r4 next-#1),
+converting the round-4 serving inventory (ring cache, GQA decode, int8
+KV, speculative/hedged) from single-request features into aggregate
+throughput. At B=1 a decode step is weight-read-bound — the HBM traffic
+is the parameters, amortized over one token (docs/PERF.md). Batching S
+concurrent requests into one step amortizes the same weight reads over
+S tokens; until the KV-cache reads dominate, aggregate tokens/s scales
+near-linearly with S. That economics is the whole point of this module.
+
+Design (TPU-first):
+
+* **Fixed slots, static shapes.** The scheduler owns ``S`` serving
+  slots. Per-layer state is ONE batched O(W) ring cache
+  ``(S, W, kv_heads, head_dim)`` — the ring layout
+  (models/decode.py) makes every slot a fixed-size arena regardless of
+  how long its request runs, so slot reuse is a row overwrite, never a
+  reallocation, and one compiled program serves every scheduler tick.
+* **Per-row positions.** Unlike ``decode_step_ring_dense`` (one scalar
+  position for the whole batch), every slot decodes at its own global
+  position: RoPE angles, ring-slot writes, and the ``kpos >= 0``
+  validity mask are all computed per row (``_rope_rows``,
+  ``_ring_write_rows``, ``_ring_attention_rows``). The masks make slot
+  reuse safe: a freshly admitted row's unwritten slots have
+  ``kpos < 0`` and self-mask, so the previous occupant's K/V are
+  unreachable even before they are overwritten.
+* **Inner scan, host ticks.** Each scheduler tick runs ``n_inner``
+  decode steps for all S slots inside one ``lax.scan`` program — one
+  host round trip per ``S x n_inner`` tokens (on the tunneled bench
+  chip a round trip costs ~120 ms; per-token host control would bury
+  the batching win).
+* **Chunked prefill interleaved with decode.** Admission does not
+  stall in-flight requests behind a long prompt: each tick advances
+  every admitting request by ONE C-token prefill chunk (through the
+  masked cached-attention path, exactly ``make_extend``'s semantics)
+  and then runs the decode scan. A request's prefill lands in a
+  transient positional cache; on the last chunk the final-W window
+  gathers into its slot's ring rows (``ring_from_cache`` math with a
+  traced length) and the first token comes from the last chunk's
+  logits. Decode stall per tick is bounded by one chunk, not one
+  prompt.
+* **EOS retirement + slot reuse.** Rows that emit ``eos_id`` keep
+  emitting it on-device (static shapes; ``_eos_clamp``); the host
+  strips the tail, retires the request (EOS or its ``max_new`` budget),
+  and hands the slot to the next queued request.
+
+Greedy decoding per row equals the single-request oracle
+(:func:`~.decode.generate_ring_dense`) token-for-token — the batched
+per-row step is the same math evaluated at S independent (row,
+position) points; tests/test_serving.py pins every admitted request
+against its oracle stream, including staggered admissions and reuse.
+
+``make_serving_scan(cfg, mesh=...)`` is the sharded variant of the
+decode tick (slots over ``dp``, heads over ``tp``, the training path's
+psum placement) — the multi-chip serving program the driver dryrun
+compiles and checks against the dense tick.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+from typing import Any, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .decode import (
+    _NEG,
+    _cache_pv,
+    _cache_scores,
+    _check_ring_cfg,
+    _eos_clamp,
+    _incremental_forward,
+    _is_quantized,
+    _kv_quantize,
+    _ring_from_cache,
+)
+from .transformer import (
+    TransformerConfig,
+    _ln,
+    _mlp,
+    make_kv_slice,
+    param_specs,
+)
+
+__all__ = [
+    "Request",
+    "ServingScheduler",
+    "make_serving_scan",
+    "serving_decode_step_dense",
+]
+
+
+def _fresh_cache(cfg: TransformerConfig, B: int, L: int,
+                 quantize_kv: bool = False) -> list[dict]:
+    """Zeroed positional/ring cache with DISTINCT buffers per leaf.
+    decode.py's ``_zero_cache_layer`` aliases one zeros array for k and
+    v (fine undonated); the serving programs donate their caches, and
+    donating the same buffer twice is an XLA execution error."""
+    shape = (B, L, cfg.kv_heads, cfg.head_dim)
+    kvdt = jnp.int8 if quantize_kv else cfg.dtype
+
+    def layer():
+        out = {"k": jnp.zeros(shape, kvdt), "v": jnp.zeros(shape, kvdt)}
+        if quantize_kv:
+            out["k_s"] = jnp.zeros(shape[:3], jnp.float32)
+            out["v_s"] = jnp.zeros(shape[:3], jnp.float32)
+        return out
+
+    return [layer() for _ in range(cfg.n_layers)]
+
+
+# --------------------------------------------------------------------------
+# per-row primitives (each slot at its own global position)
+# --------------------------------------------------------------------------
+
+
+def _rope_rows(x, pos):
+    """Rotary embedding for single-token rows: x (S, 1, H, D), pos (S,)
+    global positions — the per-row counterpart of transformer._rope
+    (which shares one position vector across the batch)."""
+    Dh = x.shape[-1]
+    half = Dh // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]  # (S, half)
+    cos = jnp.cos(ang)[:, None, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, None, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+
+
+def _ring_write_rows(cache_l: dict, k, v, slot):
+    """Write each row's single-token K/V at its own ring slot:
+    k, v (S, 1, Hkv, D), slot (S,) — a per-row scatter on the slot
+    axis (decode.py's ``_cache_write`` writes one shared offset)."""
+    rows = jnp.arange(k.shape[0])
+
+    def put(c, u):
+        return c.at[rows, slot].set(u[:, 0].astype(c.dtype))
+
+    if not _is_quantized(cache_l):
+        return {"k": put(cache_l["k"], k), "v": put(cache_l["v"], v)}
+    kq, ks = _kv_quantize(k)
+    vq, vs = _kv_quantize(v)
+    return {
+        "k": put(cache_l["k"], kq),
+        "v": put(cache_l["v"], vq),
+        "k_s": put(cache_l["k_s"], ks),
+        "v_s": put(cache_l["v_s"], vs),
+    }
+
+
+def _ring_attention_rows(q, cache_l, pos, scale):
+    """Single-query ring attention with a per-row position: the same
+    ``kpos(s) = pos - ((pos - s) mod W), valid iff kpos >= 0`` invariant
+    as decode.py's ``_ring_cached_attention``, evaluated rowwise. The
+    mask is simultaneously causal bound, sliding-window bound, warmup
+    guard, AND slot-reuse guard (a reused slot's stale rows sit at
+    kpos < 0 for the new occupant until overwritten)."""
+    W = cache_l["k"].shape[1]
+    s = _cache_scores(q, cache_l, scale)  # (S, H, 1, W) f32
+    kpos = pos[:, None] - jnp.mod(
+        pos[:, None] - jnp.arange(W)[None, :], W
+    )  # (S, W)
+    s = jnp.where((kpos >= 0)[:, None, None, :], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    o = _cache_pv(p, cache_l)
+    return o.astype(q.dtype)
+
+
+def _serving_layer(x, lp, cache_l, pos, cfg, *, kv_slice=None,
+                   tp_psum=False):
+    """One layer of the per-row serving step (the dense-FFN half of
+    decode.py's ``_incremental_layer`` with per-row positions)."""
+    h = _ln(x, lp["ln1_s"], lp["ln1_b"])
+    q = jnp.einsum("bld,dhk->blhk", h, lp["wq"])
+    k = jnp.einsum("bld,dhk->blhk", h, lp["wk"])
+    v = jnp.einsum("bld,dhk->blhk", h, lp["wv"])
+    if kv_slice is not None:
+        k, v = kv_slice(k), kv_slice(v)
+    q, k = _rope_rows(q, pos), _rope_rows(k, pos)
+    W = cache_l["k"].shape[1]
+    cache_l = _ring_write_rows(cache_l, k, v, jnp.mod(pos, W))
+    o = _ring_attention_rows(q, cache_l, pos, cfg.head_dim ** -0.5)
+    attn_out = jnp.einsum("blhk,hkd->bld", o, lp["wo"])
+    if tp_psum:
+        attn_out = jax.lax.psum(attn_out, "tp")
+    x = x + attn_out
+    h2 = _ln(x, lp["ln2_s"], lp["ln2_b"])
+    y = _mlp(h2, lp)
+    if tp_psum:
+        y = jax.lax.psum(y, "tp")
+    return x + y + lp["b2"], cache_l
+
+
+def _serving_forward(params, tok, pos, caches, cfg, *, kv_slice=None,
+                     tp_psum=False):
+    """(tok (S,), pos (S,), caches) -> (logits (S, V), caches)."""
+    x = params["emb"][tok[:, None]]  # (S, 1, d)
+    new = []
+    for lp, cl in zip(params["layers"], caches):
+        x, cl = _serving_layer(x, lp, cl, pos, cfg, kv_slice=kv_slice,
+                               tp_psum=tp_psum)
+        new.append(cl)
+    x = _ln(x, params["lnf_s"], params["lnf_b"])
+    logits = jnp.einsum("bld,vd->blv", x, params["emb"])
+    return logits[:, 0], new
+
+
+def serving_decode_step_dense(params, tok, pos, caches,
+                              cfg: TransformerConfig):
+    """One batched serving decode step, dense: every slot at its own
+    position. Returns (logits (S, V), caches). The single-position
+    sibling is :func:`~.decode.decode_step_ring_dense`."""
+    _check_ring_cfg(cfg)
+    return _serving_forward(params, tok, pos, caches, cfg)
+
+
+def _scan_body(params, tok, pos, done, caches, cfg, eos_id, n_inner,
+               *, kv_slice=None, tp_psum=False):
+    """``n_inner`` greedy decode steps for all S slots under one scan.
+    Returns (tok, pos, done, caches, toks (S, n_inner))."""
+
+    def step(carry, _):
+        tok, pos, done, caches = carry
+        lg, caches = _serving_forward(
+            params, tok, pos, caches, cfg, kv_slice=kv_slice,
+            tp_psum=tp_psum,
+        )
+        nxt = jnp.argmax(lg, axis=-1).astype(tok.dtype)
+        nxt, done = _eos_clamp(nxt, tok, done, eos_id)
+        return (nxt, pos + 1, done, caches), nxt
+
+    (tok, pos, done, caches), toks = jax.lax.scan(
+        step, (tok, pos, done, caches), None, length=n_inner
+    )
+    return tok, pos, done, caches, toks.swapaxes(0, 1)
+
+
+@functools.lru_cache(maxsize=32)
+def _serving_scan_dense(cfg: TransformerConfig, n_inner: int,
+                        eos_id: int | None):
+    """Jitted dense tick: (params, tok, pos, done, caches) ->
+    (tok, pos, done, caches, toks). Caches donated — the tick updates
+    the arena in place in HBM."""
+
+    @functools.partial(jax.jit, donate_argnums=(4,))
+    def run(params, tok, pos, done, caches):
+        return _scan_body(params, tok, pos, done, caches, cfg, eos_id,
+                          n_inner)
+
+    return run
+
+
+def make_serving_scan(cfg: TransformerConfig, mesh: Mesh, n_inner: int,
+                      *, eos_id: int | None = None,
+                      quantize_kv: bool = False):
+    """Sharded serving tick: slots over ``dp``, heads over ``tp``
+    (psum placement of the training path — the serving counterpart of
+    :func:`~.decode.make_decode_step` with per-row positions).
+    Returns ``f(params, tok, pos, done, caches)`` jitted over ``mesh``
+    with the caches donated. ``quantize_kv=True`` serves an int8 ring
+    cache (scale leaves shard like their K/V; the per-row write/score
+    paths detect the layout)."""
+    _check_ring_cfg(cfg)
+    if cfg.n_experts:
+        raise ValueError(
+            "serving scheduler covers dense-FFN configs; MoE decode "
+            "routes per chunk (models/decode.py prefill caveat) and is "
+            "served via make_generate"
+        )
+    if cfg.kv_heads % mesh.shape["tp"] != 0:
+        raise ValueError(
+            f"kv_heads {cfg.kv_heads} must divide tp "
+            f"{mesh.shape['tp']} for the sharded serving tick; for "
+            "GQA with wider tp (replicated-group cache layout) serve "
+            "via make_ring_generate, or narrow tp"
+        )
+    cspec = P("dp", None, "tp", None)
+    layer_spec = {"k": cspec, "v": cspec}
+    if quantize_kv:
+        sspec = P("dp", None, "tp")
+        layer_spec["k_s"], layer_spec["v_s"] = sspec, sspec
+    cspecs = [dict(layer_spec) for _ in range(cfg.n_layers)]
+
+    def local(params, tok, pos, done, caches):
+        return _scan_body(
+            params, tok, pos, done, caches, cfg, eos_id, n_inner,
+            kv_slice=make_kv_slice(cfg), tp_psum=True,
+        )
+
+    f = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs(cfg, mesh), P("dp"), P("dp"), P("dp"),
+                  cspecs),
+        out_specs=(P("dp"), P("dp"), P("dp"), cspecs,
+                   P("dp", None)),
+        # the serving step is pure einsum/scatter — no Pallas kernel on
+        # any path (per-row attention never routes the int8 kernel), so
+        # varying-axes checking stays on
+        check_vma=True,
+    )
+    return jax.jit(f, donate_argnums=(4,))
+
+
+# --------------------------------------------------------------------------
+# admission programs (chunked prefill -> ring window -> slot)
+# --------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _extend_chunk_dense(cfg: TransformerConfig, C: int, Lmax: int):
+    """One C-token prefill chunk into a (1, Lmax) transient positional
+    cache at dynamic ``offset`` (make_extend semantics, dense B=1).
+    Cache donated: chunks stream through one arena."""
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def run(params, chunk, cache, offset):
+        logits, cache = _incremental_forward(
+            params, chunk, cache, offset, cfg, prefill=False
+        )
+        return logits, cache
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _finish_admit_dense(cfg: TransformerConfig, Lmax: int):
+    """Gather the last-W window of a filled positional cache into ring
+    rows + pick the first token: (cache, last_logits (1, C, V),
+    true_len, last_off) -> (tok0 (), ring leaves (1, W, ...))."""
+    W = _check_ring_cfg(cfg)
+
+    @jax.jit
+    def run(cache, last_logits, true_len, last_off):
+        ring = [_ring_from_cache(cl, true_len, W) for cl in cache]
+        lg = jnp.take(last_logits[0], true_len - 1 - last_off, axis=0)
+        tok0 = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return tok0, ring
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _place_dense(cfg: TransformerConfig):
+    """Install an admitted request into slot ``s``: ring rows into the
+    batched cache, first token + start position into the row state.
+    Everything donated — admission is an in-place row write."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 2, 3, 4))
+    def run(caches, ring, tok, pos, done, s, tok0, pos0):
+        caches = [
+            {kk: c[kk].at[s].set(r[kk][0].astype(c[kk].dtype))
+             for kk in c}
+            for c, r in zip(caches, ring)
+        ]
+        return (caches, tok.at[s].set(tok0), pos.at[s].set(pos0),
+                done.at[s].set(False))
+
+    return run
+
+
+# --------------------------------------------------------------------------
+# the scheduler
+# --------------------------------------------------------------------------
+
+
+class Request:
+    """One generation request: ``prompt`` (1D int tokens) in,
+    ``tokens`` (the generated ids, EOS kept if emitted) out.
+    ``finished`` flips at retirement; ``reason`` is ``"eos"`` or
+    ``"length"``."""
+
+    _next_id = 0
+
+    def __init__(self, prompt, max_new: int):
+        self.id = Request._next_id
+        Request._next_id += 1
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        self.max_new = int(max_new)
+        self.tokens: list[int] = []
+        self.finished = False
+        self.reason: str | None = None
+        # filled by the scheduler: admission tick and retirement tick,
+        # the observability hooks the tests and bench read
+        self.admitted_tick: int | None = None
+        self.retired_tick: int | None = None
+        # incremental EOS-scan state (scheduler-internal): index of the
+        # first EOS if found, and how many tokens were already scanned
+        self._eos_at: int | None = None
+        self._scanned = 0
+
+
+class _Admitting:
+    """Per-slot chunked-prefill state machine: the transient positional
+    cache plus the chunk cursor."""
+
+    def __init__(self, req: Request, cache, padded, n_chunks: int):
+        self.req = req
+        self.cache = cache
+        self.padded = padded  # (1, n_chunks * C) int32
+        self.n_chunks = n_chunks
+        self.next_chunk = 0
+        self.last_logits = None
+
+
+class ServingScheduler:
+    """Continuous-batching scheduler over ``slots`` fixed serving
+    slots (dense single-device programs; the sharded tick is
+    :func:`make_serving_scan`).
+
+    >>> sched = ServingScheduler(params, cfg, slots=8, eos_id=2)
+    >>> r = sched.submit(prompt, max_new=64)   # any time, any order
+    >>> sched.run()                            # or step() per tick
+    >>> r.tokens                               # greedy == oracle
+
+    Each ``step()`` tick: (1) advance every admitting request by one
+    prefill chunk, installing finished ones into their slot; (2) admit
+    queued requests into free slots; (3) run ``n_inner`` decode steps
+    for all slots in one device program; (4) harvest tokens, retire
+    rows that emitted EOS or exhausted their budget, free their slots.
+    Greedy only (temperature sampling belongs to ``generate_*``).
+
+    ``prompt_chunk`` bounds the decode stall a long prompt can inject
+    into in-flight requests (one chunk per tick); ``max_prompt`` sizes
+    the transient prefill arena (one compile for all prompt lengths).
+    """
+
+    def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
+                 n_inner: int = 8, eos_id: int | None = None,
+                 prompt_chunk: int = 256, max_prompt: int = 2048,
+                 quantize_kv: bool = False):
+        W = _check_ring_cfg(cfg)
+        if cfg.n_experts:
+            raise ValueError(
+                "serving scheduler covers dense-FFN configs (MoE: see "
+                "make_serving_scan's error note)"
+            )
+        if slots < 1 or n_inner < 1:
+            raise ValueError("slots and n_inner must be >= 1")
+        if prompt_chunk > max_prompt:
+            raise ValueError("prompt_chunk must be <= max_prompt")
+        self.params = params
+        self.cfg = cfg
+        self.S = int(slots)
+        self.W = W
+        self.n_inner = int(n_inner)
+        self.eos_id = eos_id
+        self.C = int(prompt_chunk)
+        self.Lmax = int(max_prompt)
+        self.quantize_kv = bool(quantize_kv)
+        self._queue: deque[Request] = deque()
+        self._slot_req: list[Request | None] = [None] * self.S
+        self._admitting: dict[int, _Admitting] = {}  # slot -> state
+        self.tick_count = 0
+        # device-resident row state + batched ring cache arena
+        self._tok = jnp.zeros((self.S,), jnp.int32)
+        self._pos = jnp.zeros((self.S,), jnp.int32)
+        self._done = jnp.ones((self.S,), bool)  # idle rows stay done
+        self._caches = _fresh_cache(cfg, self.S, W, self.quantize_kv)
+        self._scan = _serving_scan_dense(cfg, self.n_inner, eos_id)
+        self._extend = _extend_chunk_dense(cfg, self.C, self.Lmax)
+        self._finish = _finish_admit_dense(cfg, self.Lmax)
+        self._place = _place_dense(cfg)
+
+    # -- public API -----------------------------------------------------
+
+    def submit(self, prompt, max_new: int) -> Request:
+        """Queue a request; returns the live :class:`Request` whose
+        ``tokens``/``finished`` the caller watches. Admission happens
+        inside subsequent ticks — requests may arrive while others are
+        mid-decode (the "straggling request" case)."""
+        req = Request(prompt, max_new)
+        if req.prompt.size > self.Lmax:
+            raise ValueError(
+                f"prompt of {req.prompt.size} tokens exceeds max_prompt "
+                f"{self.Lmax}; raise max_prompt (one-time recompile)"
+            )
+        self._queue.append(req)
+        return req
+
+    @property
+    def active(self) -> int:
+        """Slots currently decoding or admitting."""
+        return sum(r is not None for r in self._slot_req)
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue)
+
+    def step(self) -> list[Request]:
+        """One scheduler tick; returns the requests retired in it
+        (including any that retire at admission — max_new == 1 or a
+        first-token EOS)."""
+        self.tick_count += 1
+        retired: list[Request] = []
+        self._advance_admissions(retired)
+        self._admit_from_queue(retired)
+        decoding = [
+            s for s, r in enumerate(self._slot_req)
+            if r is not None and s not in self._admitting
+        ]
+        if decoding:
+            (self._tok, self._pos, self._done, self._caches,
+             toks) = self._scan(self.params, self._tok, self._pos,
+                                self._done, self._caches)
+            host = np.asarray(toks)  # (S, n_inner) one fetch per tick
+            for s in decoding:
+                req = self._slot_req[s]
+                req.tokens.extend(int(t) for t in host[s])
+                if self._retire_if_due(req):
+                    self._free_slot(s)
+                    retired.append(req)
+        return retired
+
+    def run(self, max_ticks: int = 10_000) -> None:
+        """Tick until every queued and in-flight request retires."""
+        for _ in range(max_ticks):
+            if not self._queue and self.active == 0:
+                return
+            self.step()
+        raise RuntimeError(
+            f"not drained after {max_ticks} ticks: {self.pending} "
+            f"queued, {self.active} active"
+        )
+
+    # -- admission ------------------------------------------------------
+
+    def _admit_from_queue(self, retired: list[Request]) -> None:
+        free = [s for s, r in enumerate(self._slot_req) if r is None]
+        while self._queue and free:
+            s = free.pop(0)
+            req = self._queue.popleft()
+            Tp = req.prompt.size
+            n_chunks = -(-Tp // self.C)
+            padded = np.zeros((1, n_chunks * self.C), np.int32)
+            padded[0, :Tp] = req.prompt
+            cache = _fresh_cache(self.cfg, 1, self.Lmax,
+                                 self.quantize_kv)
+            self._slot_req[s] = req
+            self._admitting[s] = _Admitting(
+                req, cache, jnp.asarray(padded), n_chunks
+            )
+            req.admitted_tick = self.tick_count
+            # first chunk runs this very tick (short prompts admit in
+            # one tick and decode from the next)
+            self._advance_admission(s, retired)
+
+    def _advance_admissions(self, retired: list[Request]) -> None:
+        for s in list(self._admitting):
+            self._advance_admission(s, retired)
+
+    def _advance_admission(self, s: int,
+                           retired: list[Request]) -> None:
+        st = self._admitting[s]
+        i = st.next_chunk
+        chunk = jax.lax.dynamic_slice_in_dim(
+            st.padded, i * self.C, self.C, axis=1
+        )
+        st.last_logits, st.cache = self._extend(
+            self.params, chunk, st.cache, jnp.int32(i * self.C)
+        )
+        st.next_chunk += 1
+        if st.next_chunk < st.n_chunks:
+            return
+        Tp = st.req.prompt.size
+        tok0, ring = self._finish(
+            st.cache, st.last_logits, jnp.int32(Tp),
+            jnp.int32((st.n_chunks - 1) * self.C),
+        )
+        (self._caches, self._tok, self._pos,
+         self._done) = self._place(
+            self._caches, ring, self._tok, self._pos, self._done,
+            jnp.int32(s), tok0, jnp.int32(Tp),
+        )
+        st.req.tokens.append(int(tok0))
+        del self._admitting[s]
+        if self._retire_if_due(st.req):  # max_new == 1 or prompt EOS
+            self._free_slot(s)
+            retired.append(st.req)
+
+    # -- retirement -----------------------------------------------------
+
+    def _retire_if_due(self, req: Request) -> bool:
+        cut = None
+        if self.eos_id is not None and req._eos_at is None:
+            # scan only this tick's new tokens (a long-lived request
+            # must not pay a full-history scan per tick)
+            try:
+                req._eos_at = req.tokens.index(
+                    self.eos_id, req._scanned
+                )
+            except ValueError:
+                pass
+            req._scanned = len(req.tokens)
+        if req._eos_at is not None:
+            cut = req._eos_at + 1
+            if cut <= req.max_new:
+                req.reason = "eos"
+            else:
+                cut = None
+        if cut is None and len(req.tokens) >= req.max_new:
+            cut = req.max_new
+            req.reason = "length"
+        if cut is None:
+            return False
+        del req.tokens[cut:]
+        req.finished = True
+        req.retired_tick = self.tick_count
+        return True
+
+    def _free_slot(self, s: int) -> None:
+        self._slot_req[s] = None
+        # the row keeps decoding garbage until reused — done=True makes
+        # it emit EOS-clamped tokens nobody reads; admission resets it
+        self._done = self._done.at[s].set(True)
