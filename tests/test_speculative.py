@@ -166,3 +166,68 @@ def test_validation():
         generate_speculative_dense(
             params, jnp.zeros((1, 4), jnp.int32), 4, CFG, k=0
         )
+
+
+@pytest.mark.parametrize("k", [1, 4])
+@pytest.mark.parametrize("Tp,n_new", [(8, 17), (3, 5)])
+def test_model_draft_equals_greedy(Tp, n_new, k):
+    """Truncated-layer model draft behind the same verify loop: the
+    stream is still EXACTLY greedy (any draft is correct; only
+    acceptance varies)."""
+    params = init_params(CFG, seed=1)
+    prompt = _prompt(Tp, seed=Tp * 13 + k)
+    want = generate_dense(params, prompt, n_new, CFG)
+    got, iters = generate_speculative_dense(
+        params, prompt, n_new, CFG, k=k, draft_layers=1
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert 0 < iters <= n_new - 1 or (n_new == 1 and iters == 0)
+
+
+def test_sharded_model_draft_matches_dense():
+    from mpistragglers_jl_tpu.models.speculative import make_speculative
+    from mpistragglers_jl_tpu.models.transformer import shard_params
+    from mpistragglers_jl_tpu.parallel import make_mesh
+
+    mesh = make_mesh((1, 4), ("dp", "tp"))
+    params = init_params(CFG, seed=4)
+    prompt = _prompt(8, seed=45)
+    want, want_iters = generate_speculative_dense(
+        params, prompt, 12, CFG, k=3, draft_layers=1
+    )
+    run = make_speculative(CFG, mesh, 8, 12, k=3, draft_layers=1)
+    packed = np.asarray(run(shard_params(params, CFG, mesh), prompt))
+    np.testing.assert_array_equal(packed[None, :12], np.asarray(want))
+    assert int(packed[12]) == want_iters
+
+
+def test_draft_layers_validation():
+    params = init_params(CFG, seed=1)
+    for bad in (0, CFG.n_layers, -1):
+        with pytest.raises(ValueError, match="draft_layers"):
+            generate_speculative_dense(
+                params, _prompt(4), 5, CFG, draft_layers=bad
+            )
+
+
+def test_model_draft_perfect_acceptance_when_truncation_exact():
+    """Alignment guard for the model drafter: zero the top layer's
+    residual contributions (wo, w2, b2) so the 1-layer truncation
+    computes EXACTLY the full model's logits — every draft must then
+    be accepted and the forward count collapses to ceil((n_new-1)/(k+1)).
+    An off-by-one anywhere in the draft cache positions would break
+    this immediately."""
+    import jax.numpy as jnp
+
+    params = init_params(CFG, seed=1)
+    lp = params["layers"][1]
+    for kk in ("wo", "w2", "b2"):
+        lp[kk] = jnp.zeros_like(lp[kk])
+    n_new, k = 40, 4
+    prompt = _prompt(8, seed=3)
+    want = generate_dense(params, prompt, n_new, CFG)
+    got, fwd = generate_speculative_dense(
+        params, prompt, n_new, CFG, k=k, draft_layers=1
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert fwd == -(-(n_new - 1) // (k + 1)), fwd
