@@ -125,7 +125,21 @@ def bench_fused_chip(epochs: int = EPOCHS) -> dict:
     fg.shutdown()
     cg.backend.shutdown()
 
+    # the library's own measured auto-selection (VERDICT r4 item 4):
+    # on one device the paths sit inside the noise band, so
+    # select_coded_gemm probes THIS session and the rung records the
+    # decision it made
+    from mpistragglers_jl_tpu.parallel import select_coded_gemm
+
+    sel = select_coded_gemm(
+        A, mesh, K, B, n_workers=N, dtype=np.float32, batch=True,
+        batch_arrival="enqueue",
+    )
+    selection = sel.selection
+    sel.shutdown()
+
     return {
+        "auto_selection": selection,
         "metric": "fused-pool-mesh-real-chip",
         "shape": f"(n={N},k={K}) coded {M}x{D} @ {D}x{NCOLS} f32",
         "device": str(dev),

@@ -119,7 +119,14 @@ def _route(x2d: jax.Array, wg: jax.Array):
     """The router core shared by both routing forms: top-1 expert,
     cumsum slot (in token order), gate probability, Switch aux loss.
     Returns ``(expert (T,), slot (T,), gate (T,) f32, aux)``."""
-    logits = x2d.astype(jnp.float32) @ wg.astype(jnp.float32)  # (T, E)
+    # f32 ACCUMULATION without materializing an f32 copy of the whole
+    # (T, D) activation (the astype form wrote+read 2x64 MB per layer
+    # for a 4-column matmul — the single largest routing cost measured
+    # in benchmarks/moe_route_attrib.py)
+    logits = jnp.einsum(
+        "td,de->te", x2d, wg.astype(x2d.dtype),
+        preferred_element_type=jnp.float32,
+    )  # (T, E) f32
     probs = jax.nn.softmax(logits, axis=-1)
     expert = jnp.argmax(probs, axis=-1)  # (T,)
     gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
@@ -152,6 +159,13 @@ def switch_route_indices(x2d: jax.Array, wg: jax.Array, capacity: int):
     chosen expert; ``gate`` (T,) f32 router probabilities of the chosen
     expert; ``aux`` the Switch load-balance loss.
     """
+    table, expert, _, gate, aux = _route_tables(x2d, wg, capacity)
+    return table, expert, gate, aux
+
+
+def _route_tables(x2d: jax.Array, wg: jax.Array, capacity: int):
+    """:func:`switch_route_indices` plus the per-token ``slot`` — the
+    inverse seating map the gather-form backward passes need."""
     T = x2d.shape[0]
     E = wg.shape[1]
     expert, slot, gate, aux = _route(x2d, wg)
@@ -159,23 +173,85 @@ def switch_route_indices(x2d: jax.Array, wg: jax.Array, capacity: int):
     table = jnp.full((E, capacity), T, jnp.int32).at[expert, slot].set(
         jnp.arange(T, dtype=jnp.int32), mode="drop"
     )
-    return table, expert, gate, aux
+    return table, expert, slot, gate, aux
 
 
-def _gather_dispatch(x2d, table):
-    """(T, D) tokens -> (E, C, D) expert slots; empty slots are zeros
-    (the sentinel row T gathers the zero pad)."""
-    x_pad = jnp.concatenate(
-        [x2d, jnp.zeros((1, x2d.shape[1]), x2d.dtype)], axis=0
-    )
-    return x_pad[table]
+# Dispatch and combine are the SAME bijection between kept tokens and
+# their (expert, slot) seats, applied in opposite directions — so both
+# directions of both ops are GATHERS. Left to autodiff, the transpose
+# of each gather is a scatter-add, and TPU scatter-adds (plus the
+# sentinel row's duplicate indices) measured as the dominant routing
+# cost in the r4 rung (benchmarks/moe_route_attrib.py); the custom
+# VJPs below express each backward as the inverse gather instead,
+# eliminating every (T-or-EC, D)-scale scatter from the layer.
+
+
+def _int_zero(a):
+    """float0 cotangent for an integer primal (custom_vjp contract)."""
+    return np.zeros(a.shape, dtype=jax.dtypes.float0)
+
+
+def _seat_gather(x2d, table):
+    T = x2d.shape[0]
+    safe = jnp.minimum(table, T - 1)
+    return x2d[safe] * (table < T)[..., None].astype(x2d.dtype)
+
+
+def _token_gather(w_ecd, expert, slot):
+    E, C, D = w_ecd.shape
+    kept = (slot < C)[:, None].astype(w_ecd.dtype)
+    idx = expert * C + jnp.minimum(slot, C - 1)
+    return w_ecd.reshape(E * C, D)[idx] * kept
+
+
+@jax.custom_vjp
+def _gather_dispatch(x2d, table, expert, slot):
+    """(T, D) tokens -> (E, C, D) expert slots; empty slots are zeros.
+    ``expert``/``slot`` ((T,), from :func:`_route`) are the inverse
+    seating map driving the gather-form backward."""
+    return _seat_gather(x2d, table)
+
+
+def _gather_dispatch_fwd(x2d, table, expert, slot):
+    return _seat_gather(x2d, table), (table, expert, slot)
+
+
+def _gather_dispatch_bwd(res, g):
+    table, expert, slot = res
+    return (_token_gather(g, expert, slot), _int_zero(table),
+            _int_zero(expert), _int_zero(slot))
+
+
+_gather_dispatch.defvjp(_gather_dispatch_fwd, _gather_dispatch_bwd)
+
+
+@jax.custom_vjp
+def _combine_per_token(w_ecd, table, expert, slot):
+    """(E, C, D) weighted slots -> (T, D): each token reads its own
+    seat (dropped tokens read zero). Equal to the scatter-add combine
+    because the seating is a bijection; both directions — like both
+    directions of :func:`_gather_dispatch` — are gathers."""
+    return _token_gather(w_ecd, expert, slot)
+
+
+def _combine_per_token_fwd(w_ecd, table, expert, slot):
+    return _token_gather(w_ecd, expert, slot), (table, expert, slot)
+
+
+def _combine_per_token_bwd(res, g):
+    table, expert, slot = res
+    # dw[e, c] = dy[token seated at (e, c)], zero for empty seats —
+    # exactly the dispatch gather applied to the cotangent
+    return (_seat_gather(g, table), _int_zero(table),
+            _int_zero(expert), _int_zero(slot))
+
+
+_combine_per_token.defvjp(_combine_per_token_fwd, _combine_per_token_bwd)
 
 
 def _scatter_combine(weighted, table, T):
-    """(E, C, D) weighted expert outputs -> (T, D) by scatter-add at
-    the table's token indices; empty slots land on the discarded
-    sentinel row, dropped tokens receive zero (the caller's residual
-    carries them)."""
+    """Scatter-add oracle for :func:`_combine_per_token` (kept for the
+    equivalence test; the hot paths use the gather form)."""
     E, C, D = weighted.shape
     y = jnp.zeros((T + 1, D), weighted.dtype)
     y = y.at[table.reshape(-1)].add(weighted.reshape(E * C, D))
@@ -204,12 +280,14 @@ def moe_ffn_dense(x: jax.Array, mp: dict, capacity_factor: float):
     T = B * L
     C = _capacity(T, E, capacity_factor)
     x2d = x.reshape(T, D)
-    table, _, gate, aux = switch_route_indices(x2d, mp["wg"], C)
-    xe = _gather_dispatch(x2d, table)
+    table, expert, slot, gate, aux = _route_tables(x2d, mp["wg"], C)
+    xe = _gather_dispatch(x2d, table, expert, slot)
     ye = _expert_ffn(xe, mp) + mp["be2"][:, None, :]
-    gate_pad = jnp.concatenate([gate, jnp.zeros((1,), gate.dtype)])
-    g = gate_pad[table].astype(x.dtype)  # (E, C); empty slots 0
-    y = _scatter_combine(ye * g[..., None], table, T)
+    # per-token combine (gather form); the gate multiply stays outside
+    # the custom-vjp op so the router gradient flows through it
+    yt = _combine_per_token(ye, table, expert, slot)
+    kg = jnp.where(slot < C, gate, 0.0).astype(x.dtype)  # dropped -> 0
+    y = yt * kg[:, None]
     return y.reshape(B, L, D), aux
 
 
@@ -240,8 +318,8 @@ def moe_ffn_sharded(x: jax.Array, mp: dict, capacity_factor: float,
     # router: wg is replicated; logits over ALL E experts. Gather-form
     # dispatch (see switch_route_indices) — the (E, C, D) slot tensor
     # the all_to_all ships is built by a gather, not a T x E*C matmul.
-    table, expert, gate, aux = switch_route_indices(x2d, mp["wg"], C)
-    xe = _gather_dispatch(x2d, table)
+    table, expert, slot, gate, aux = _route_tables(x2d, mp["wg"], C)
+    xe = _gather_dispatch(x2d, table, expert, slot)
     # (E, C, D) -> ship expert-group j to ep member j; receive my
     # E_local experts' slots from every member: (E_local, ep*C, D)
     xe = jax.lax.all_to_all(
@@ -252,16 +330,13 @@ def moe_ffn_sharded(x: jax.Array, mp: dict, capacity_factor: float,
     ye = jax.lax.all_to_all(
         ye, ep_axis, split_axis=1, concat_axis=0, tiled=True
     )  # (E, C, D), tp-partial
-    gate_pad = jnp.concatenate([gate, jnp.zeros((1,), gate.dtype)])
-    g = gate_pad[table].astype(x.dtype)  # (E, C); empty slots 0
-    y = _scatter_combine(ye * g[..., None], table, T)
+    yt = _combine_per_token(ye, table, expert, slot)  # (T, D) tp-partial
+    kg = jnp.where(slot < C, gate, 0.0).astype(x.dtype)  # dropped -> 0
+    y = yt * kg[:, None]
     # be2 is replicated over tp, so it must bypass the caller's tp psum.
-    # It is a rank-1 per-token quantity: kept-gate[t] * be2[expert[t]] —
-    # O(T*D) (one small scatter for the kept mask + one row gather),
-    # NOT an (E, C, D) broadcast + second full scatter (review r4).
+    # It is a rank-1 per-token quantity: kept-gate[t] * be2[expert[t]]
+    # (one row gather — review r4; the kept mask is just slot < C now).
     be2 = jax.lax.all_gather(mp["be2"], ep_axis, axis=0, tiled=True)
-    kept = jnp.zeros((T + 1,), bool).at[table.reshape(-1)].set(True)[:T]
-    kg = jnp.where(kept, gate, 0.0).astype(x.dtype)  # (T,)
     ybias = kg[:, None] * be2[expert]
     return y.reshape(B, L, D), ybias.reshape(B, L, D), aux
 

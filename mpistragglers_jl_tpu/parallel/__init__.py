@@ -4,6 +4,7 @@ _HOME = {
     "MeshMatDotGemm": "mesh_gemm",
     "PoolMeshCodedGemm": "fused",
     "PoolMeshMatDotGemm": "fused",
+    "select_coded_gemm": "fused",
     "distributed_mds_decode": "collectives",
     "masked_psum_scatter_combine": "collectives",
     "ring_allgather": "collectives",

@@ -51,7 +51,7 @@ from ..ops.matdot import MatDotCode, MatDotWeightCache, _matdot_worker
 from ..pool import AsyncPool, asyncmap
 from .collectives import masked_psum_scatter_combine, mds_decode_weights
 
-__all__ = ["PoolMeshCodedGemm", "PoolMeshMatDotGemm"]
+__all__ = ["PoolMeshCodedGemm", "PoolMeshMatDotGemm", "select_coded_gemm"]
 
 
 def _mesh_axis_devices(mesh: Mesh, axis: str) -> list[jax.Device]:
@@ -465,3 +465,102 @@ class PoolMeshMatDotGemm:
 
     def shutdown(self) -> None:
         self.backend.shutdown()
+
+
+class _UnfusedCodedGemm:
+    """Adapter giving :class:`~..ops.coded_gemm.CodedGemm` (the
+    device-0 gather+solve decode) the fused ``epoch()`` surface so
+    :func:`select_coded_gemm` can drive either winner identically."""
+
+    fused = False
+
+    def __init__(self, cg):
+        self.gemm = cg
+        self.backend = cg.backend
+        self.k = cg.code.k
+
+    def epoch(self, pool: AsyncPool, B, *, nwait=None, epoch=None):
+        asyncmap(pool, B, self.backend,
+                 nwait=self.gemm.nwait if nwait is None else nwait,
+                 epoch=epoch)
+        return self.gemm.result_device(pool)
+
+    def full(self, decoded) -> np.ndarray:
+        return np.asarray(decoded)
+
+    def shutdown(self) -> None:
+        self.backend.shutdown()
+
+
+def select_coded_gemm(
+    A: np.ndarray,
+    mesh: Mesh,
+    k: int,
+    B_probe,
+    *,
+    n_workers: int | None = None,
+    probe_epochs: int = 3,
+    chains: int = 2,
+    **kw,
+):
+    """Measured fused-vs-unfused selection (VERDICT r4 item 4).
+
+    On a multi-device mesh the fused path's structural win (no k-shard
+    gather onto one device, decode riding ICI) is decisive; on ONE
+    device the two paths differ only by dispatch economics that sit
+    inside the session's noise band (measured 0.95-1.10x across rounds
+    — docs/PERF.md). So instead of hardcoding a loser, probe both on
+    THIS session's link: alternating timed chains of ``probe_epochs``
+    epochs (the fused-bench discipline — alternation because the
+    tunnel drifts minute-to-minute by more than the difference),
+    keep the winner, shut the loser down. The decision and both
+    measurements ride on ``winner.selection``:
+
+    >>> g = select_coded_gemm(A, mesh, k, B_probe)
+    >>> g.selection          # {"picked": ..., "fused_ms": ..., ...}
+    >>> decoded = g.epoch(pool, B)
+
+    ``**kw`` (``batch``, ``batch_arrival``, ``precision``, ``parity``,
+    ``dtype``) is forwarded to both candidates.
+    """
+    import time
+
+    from ..ops.coded_gemm import CodedGemm
+    from ..pool import waitall
+
+    devices = _mesh_axis_devices(mesh, kw.pop("axis", "w"))
+    n = int(n_workers) if n_workers is not None else len(devices)
+    fused = PoolMeshCodedGemm(A, mesh, k, n_workers=n, **kw)
+    dev_map = [devices[i * len(devices) // n] for i in range(n)]
+    unfused = _UnfusedCodedGemm(CodedGemm(A, n, k, devices=dev_map, **kw))
+
+    fence = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+    times = {True: None, False: None}
+    pools = {True: AsyncPool(n), False: AsyncPool(n)}
+    for g, is_fused in ((fused, True), (unfused, False)):  # warmup
+        out = g.epoch(pools[is_fused], B_probe)
+        float(fence(out))
+        waitall(pools[is_fused], g.backend)
+    for _ in range(chains):
+        for g, is_fused in ((fused, True), (unfused, False)):
+            pool = pools[is_fused]
+            t0 = time.perf_counter()
+            for _ in range(probe_epochs):
+                out = g.epoch(pool, B_probe)
+                waitall(pool, g.backend)
+            float(fence(out))
+            dt = (time.perf_counter() - t0) / probe_epochs
+            prev = times[is_fused]
+            times[is_fused] = dt if prev is None else min(prev, dt)
+    pick_fused = times[True] <= times[False]
+    winner, loser = (fused, unfused) if pick_fused else (unfused, fused)
+    loser.shutdown()
+    winner.selection = {
+        "picked": "fused" if pick_fused else "unfused",
+        "fused_ms": round(times[True] * 1e3, 2),
+        "unfused_ms": round(times[False] * 1e3, 2),
+        "probe_epochs": probe_epochs,
+        "chains": chains,
+        "mesh_devices": len(devices),
+    }
+    return winner

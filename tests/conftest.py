@@ -24,3 +24,16 @@ jax.config.update("jax_platforms", "cpu")
 # tests are Float64 throughout, SURVEY §7 "the hard parts"); TPU-path
 # tests pin float32 explicitly so this only affects CPU-mesh runs
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: the suite's wall-clock is dominated
+# by a flat ~1-3 s/test tail of small jit compiles (measured r5 —
+# durations show no outliers above 9 s in the default tier, yet it
+# spends 12+ min on one core). Caching compiled executables across runs
+# turns every repeat run (local dev loops, the driver's green check,
+# CI with a cached directory) into mostly cache hits. Correctness is
+# unaffected: the cache key covers program, backend, and flags.
+_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+)
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)

@@ -979,6 +979,7 @@ def test_native_wait_any_duplicate_index_two_tags():
         backend.shutdown()
 
 
+@pytest.mark.slow
 def test_on_dead_straggle_spawned_workers():
     """on_dead="straggle": a crashed spawned worker becomes an infinite
     straggler — fastest-k epochs keep making progress with NO error

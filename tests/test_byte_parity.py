@@ -61,6 +61,7 @@ def _make_backend(kind, work_fn, n):
         pytest.skip(f"native transport unavailable: {e}")
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kind", ["local", "process", "native"])
 def test_mixed_dtype_payloads_bit_identical(kind):
     """float64 + int64 payloads land bit-exactly in one recvbuf; the

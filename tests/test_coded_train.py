@@ -64,6 +64,7 @@ def _direct_full_batch_sgd(params, lr, epochs):
     return params
 
 
+@pytest.mark.slow
 def test_straggler_trajectory_matches_bulk_sync():
     """3 coded epochs with two injected hard stragglers == 3 direct
     full-batch SGD epochs, leaf for leaf. THE exactness claim."""
@@ -83,6 +84,7 @@ def test_straggler_trajectory_matches_bulk_sync():
     )
 
 
+@pytest.mark.slow
 def test_fit_loss_decreases_and_drains():
     tr = _make(delay_fn=_slow_two)
     params, hist = tr.fit(epochs=4, lr=0.1)
@@ -93,6 +95,7 @@ def test_fit_loss_decreases_and_drains():
     assert hist2[-1] < hist[0]
 
 
+@pytest.mark.slow
 def test_optax_path_runs_and_learns():
     optax = pytest.importorskip("optax")
     tr = _make(tx=optax.adamw(3e-3))
@@ -112,6 +115,7 @@ def test_lr_tx_exclusive():
         tr2.step(pool, params, lr=0.1)  # both
 
 
+@pytest.mark.slow
 def test_bulk_sync_nwait_n_equals_coded():
     """nwait=n (no straggler tolerance used) decodes identically —
     the code is exact for ANY >= n-s arrival set."""

@@ -46,6 +46,7 @@ def _tokens(cfg, B=2, L=12, seed=0):
     return jnp.asarray(rng.integers(0, cfg.vocab, (B, L)), jnp.int32)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("hkv", [None, 2, 1])
 def test_teacher_forced_decode_matches_dense_forward(hkv):
     """Prefill the first half, decode the second half teacher-forced;
@@ -98,6 +99,7 @@ def test_prefill_flash_matches_reference_prefill():
         ((1, 8), 1),  # MQA at tp=8
     ],
 )
+@pytest.mark.slow
 def test_sharded_prefill_and_decode_match_dense(shape, hkv):
     cfg = dataclasses.replace(CFG, n_kv_heads=hkv)
     mesh = make_mesh(shape, ("dp", "tp"))
@@ -144,6 +146,7 @@ def test_sharded_generate_matches_dense_generate(hkv):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.slow
 def test_generate_dense_is_greedy_self_consistent():
     """Feeding generated tokens back through the training forward
     reproduces the same greedy choices (the cache is not drifting)."""
@@ -158,6 +161,7 @@ def test_generate_dense_is_greedy_self_consistent():
     np.testing.assert_array_equal(np.asarray(pred), np.asarray(out))
 
 
+@pytest.mark.slow
 def test_moe_decode_dense_oracle():
     cfg = dataclasses.replace(
         CFG, n_experts=2, d_model=32, n_heads=4, n_kv_heads=2, d_ff=64
@@ -190,6 +194,7 @@ def test_moe_decode_mesh_validation():
     ((2, 2, 2), ("dp", "ep", "tp")),
     ((1, 2, 4), ("dp", "ep", "tp")),
 ])
+@pytest.mark.slow
 def test_moe_sharded_decode_matches_dense(shape, axes):
     """Expert-parallel decode (round 4): routing runs sharded with the
     all_to_all over ep inside the incremental forward, exactly like the
@@ -357,6 +362,7 @@ class TestSampledDecoding:
             )
 
 
+@pytest.mark.slow
 def test_moe_sharded_sampled_generate_matches_dense():
     """The ep-aware global-row sampling offset: a fixed key must give
     the SAME sampled stream dense and on a (dp, ep, tp) mesh (pins the
@@ -412,6 +418,7 @@ def test_chunked_prefill_matches_one_shot(hkv, chunk):
         )
 
 
+@pytest.mark.slow
 def test_eos_clamp_dense_and_sharded():
     """Rows that emit eos_id keep emitting it for the rest of the
     (static-shape) generation, dense and sharded alike; rows that never

@@ -106,6 +106,7 @@ def test_kernel_block_predication_excludes_future():
     np.testing.assert_array_equal(np.asarray(clean), np.asarray(dirty))
 
 
+@pytest.mark.slow
 def test_kernel_rides_generation_at_head_dim_128():
     """End-to-end: with the kernel toggled on, a D=128 config's
     quantized greedy generation routes decode steps through it and

@@ -38,6 +38,7 @@ def test_straggler_aware_training_converges(tmp_path):
     assert (tmp_path / "training_trace.json").exists()  # Perfetto artifact
 
 
+@pytest.mark.slow
 def test_rateless_gemm_example():
     out = _run_example(
         "rateless_gemm.py", env_extra={"JAX_PLATFORMS": "cpu"}
@@ -47,6 +48,7 @@ def test_rateless_gemm_example():
     assert "re-tasks contributed fresh information" in out.stdout
 
 
+@pytest.mark.slow
 def test_pipeline_training_example():
     out = _run_example(
         "pipeline_training.py", timeout=420,
@@ -57,6 +59,7 @@ def test_pipeline_training_example():
     assert "1F1B bubble" in out.stdout
 
 
+@pytest.mark.slow
 def test_long_context_training_example():
     out = _run_example(
         "long_context_training.py", "--steps", "4",
@@ -74,6 +77,7 @@ def test_long_context_training_example():
     assert "sp=4" in out.stdout
 
 
+@pytest.mark.slow
 def test_coded_transformer_training_example():
     out = _run_example(
         "coded_transformer_training.py",
@@ -97,6 +101,7 @@ def test_hedged_serving_example():
     assert "the tail is gone" in out.stdout
 
 
+@pytest.mark.slow
 def test_serving_decode_example():
     out = _run_example(
         "serving_decode.py",

@@ -212,6 +212,7 @@ def test_kv_tp_misaligned_rejected():
         ((2, 2, 2), "ulysses", 1),
     ],
 )
+@pytest.mark.slow
 def test_sharded_gqa_grads_match_dense(shape, attn, hkv):
     cfg = dataclasses.replace(
         CFG, n_heads=8, d_model=64, n_kv_heads=hkv, attn=attn

@@ -10,6 +10,8 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -23,6 +25,7 @@ def _run_launcher(nranks, script, *extra, timeout=120):
     )
 
 
+@pytest.mark.slow
 def test_spmd_example_end_to_end():
     """The shipped example runs to completion under the launcher: the
     coordinator's 10-epoch nwait=1 loop over launcher-started workers."""
@@ -34,6 +37,7 @@ def test_spmd_example_end_to_end():
     assert proc.stdout.count("epoch ") == 10
 
 
+@pytest.mark.slow
 def test_failed_rank_fails_the_launch(tmp_path):
     """mpiexec semantics: any rank exiting non-zero fails the job."""
     script = tmp_path / "boom.py"
@@ -91,6 +95,7 @@ def test_parse_hostfile_mpiexec_style(tmp_path):
     ]
 
 
+@pytest.mark.slow
 def test_multihost_two_process_groups(tmp_path):
     """The VERDICT r2 'one command' bar: --hosts with a faked ssh
     models two hosts as two local process groups with separate tmpdirs
@@ -129,6 +134,7 @@ def test_multihost_two_process_groups(tmp_path):
     assert (tmp_path / "hosts" / "hostB").is_dir()
 
 
+@pytest.mark.slow
 def test_multihost_remote_rank_failure_propagates(tmp_path):
     """A non-zero exit inside the REMOTE span fails the launch (ssh
     span runner exits with the span's worst code, mpiexec-style)."""
@@ -196,6 +202,7 @@ def test_remote_cmd_keeps_secret_off_argv():
     assert any("MSGT_ADDRESS" in part for part in cmd)
 
 
+@pytest.mark.slow
 def test_multihost_spmd_example_single_host():
     """The one-liner example (examples/multihost_spmd.py) also runs
     single-host under the launcher — same script, no --hosts."""
@@ -207,6 +214,7 @@ def test_multihost_spmd_example_single_host():
     assert "done: workers=2" in proc.stdout
 
 
+@pytest.mark.slow
 def test_span_watchdog_reaps_on_stdin_eof(tmp_path):
     """The remote-side guarantee: when the launch channel (stdin pipe)
     EOFs — launcher death or abort — the span runner kills its rank
@@ -351,6 +359,7 @@ def test_remote_span_broken_pipe_fails_clean(monkeypatch, capsys):
     assert "span on 'deadhost' failed before start" in err
 
 
+@pytest.mark.slow
 def test_remote_span_dying_after_token_aborts_promptly(tmp_path):
     """The sibling of the broken-pipe case: the ssh process consumes the
     auth token, THEN crashes. The job must abort with the span's code

@@ -80,6 +80,7 @@ def test_switch_route_capacity_drops_overflow():
         ((1, 2, 2, 2), ("dp", "sp", "tp", "ep")),
     ],
 )
+@pytest.mark.slow
 def test_moe_sharded_forward_matches_dense(shape, axes):
     mesh = make_mesh(shape, axes)
     params = init_params(CFG, seed=1)
@@ -93,6 +94,7 @@ def test_moe_sharded_forward_matches_dense(shape, axes):
     )
 
 
+@pytest.mark.slow
 def test_moe_sharded_grads_match_dense():
     mesh = make_mesh((2, 1, 1, 2), ("dp", "sp", "tp", "ep"))
     params = init_params(CFG, seed=4)
@@ -190,11 +192,12 @@ def test_gather_dispatch_equals_onehot_einsum():
     import numpy as np
 
     from mpistragglers_jl_tpu.models.moe import (
+        _combine_per_token,
         _expert_ffn,
         _gather_dispatch,
+        _route_tables,
         _scatter_combine,
         switch_route,
-        switch_route_indices,
     )
 
     rng = np.random.default_rng(0)
@@ -215,17 +218,80 @@ def test_gather_dispatch_equals_onehot_einsum():
     y_a = jnp.einsum("ecd,tec->td", ye_a, combine)
     dropped = np.asarray(dispatch.sum(axis=(1, 2)) == 0)
     assert dropped.any(), "pick a tighter capacity: no drops exercised"
-    # gather path
-    table, _, gate, aux_b = switch_route_indices(x2d, wg, C)
-    xe_b = _gather_dispatch(x2d, table)
+    # gather path (per-token combine, the hot form)
+    table, expert, slot, gate, aux_b = _route_tables(x2d, wg, C)
+    xe_b = _gather_dispatch(x2d, table, expert, slot)
     np.testing.assert_allclose(np.asarray(xe_a), np.asarray(xe_b), atol=1e-6)
     ye_b = _expert_ffn(xe_b, mp) + mp["be2"][:, None, :]
-    gate_pad = jnp.concatenate([gate, jnp.zeros((1,), gate.dtype)])
-    g = gate_pad[table]
-    y_b = _scatter_combine(ye_b * g[..., None], table, T)
+    kg = jnp.where(slot < C, gate, 0.0)
+    y_b = _combine_per_token(ye_b, table, expert, slot) * kg[:, None]
     np.testing.assert_allclose(
         np.asarray(y_a), np.asarray(y_b), atol=1e-5, rtol=1e-5
     )
     np.testing.assert_allclose(float(aux_a), float(aux_b), rtol=1e-6)
     # dropped tokens produce exactly zero in both
     assert np.all(np.abs(np.asarray(y_b))[dropped] < 1e-7)
+    # the scatter-add oracle agrees with the per-token combine too
+    gate_pad = jnp.concatenate([gate, jnp.zeros((1,), gate.dtype)])
+    g = gate_pad[table]
+    y_c = _scatter_combine(ye_b * g[..., None], table, T)
+    np.testing.assert_allclose(
+        np.asarray(y_b), np.asarray(y_c), atol=1e-6
+    )
+
+
+def test_gather_form_gradients_match_onehot_oracle():
+    """The custom VJPs (gather-form backward for dispatch AND combine)
+    must produce the one-hot einsum formulation's gradients exactly —
+    d/dx, d/d(expert weights), d/d(router) all compared, drops
+    included."""
+    import numpy as np
+
+    from mpistragglers_jl_tpu.models.moe import (
+        _combine_per_token,
+        _expert_ffn,
+        _gather_dispatch,
+        _route,
+        _route_tables,
+        switch_route,
+    )
+
+    rng = np.random.default_rng(7)
+    T, D, E, F = 48, 12, 4, 24
+    C = 6  # force drops
+    x2d = jnp.asarray(rng.standard_normal((T, D)), jnp.float32)
+    mp = {
+        "wg": jnp.asarray(rng.standard_normal((D, E)), jnp.float32),
+        "we1": jnp.asarray(rng.standard_normal((E, D, F)) * 0.1,
+                           jnp.float32),
+        "be1": jnp.zeros((E, F), jnp.float32),
+        "we2": jnp.asarray(rng.standard_normal((E, F, D)) * 0.1,
+                           jnp.float32),
+        "be2": jnp.asarray(rng.standard_normal((E, D)) * 0.1,
+                           jnp.float32),
+    }
+
+    def loss_onehot(x2d, mp):
+        dispatch, combine, _ = switch_route(x2d, mp["wg"], C)
+        xe = jnp.einsum("td,tec->ecd", x2d, dispatch)
+        ye = _expert_ffn(xe, mp) + mp["be2"][:, None, :]
+        y = jnp.einsum("ecd,tec->td", ye, combine)
+        return jnp.sum(y ** 2)
+
+    def loss_gather(x2d, mp):
+        table, expert, slot, gate, _ = _route_tables(x2d, mp["wg"], C)
+        xe = _gather_dispatch(x2d, table, expert, slot)
+        ye = _expert_ffn(xe, mp) + mp["be2"][:, None, :]
+        kg = jnp.where(slot < C, gate, 0.0).astype(x2d.dtype)
+        y = _combine_per_token(ye, table, expert, slot) * kg[:, None]
+        return jnp.sum(y ** 2)
+
+    la, ga = jax.value_and_grad(loss_onehot, argnums=(0, 1))(x2d, mp)
+    lb, gb = jax.value_and_grad(loss_gather, argnums=(0, 1))(x2d, mp)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+    flat_a = jax.tree.leaves(ga)
+    flat_b = jax.tree.leaves(gb)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4
+        )

@@ -54,6 +54,7 @@ def _start_cli_worker(rank: int, address: str) -> subprocess.Popen:
     )
 
 
+@pytest.mark.slow
 def test_tcp_cli_workers_jitted_sgd_with_kill_and_reaccept():
     from examples.multihost_jax_worker import DIM, reference_grad
 

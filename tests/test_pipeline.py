@@ -88,6 +88,7 @@ def _dense_loss(params, toks, tgts, cfg):
     return -jnp.take_along_axis(logp, tgts[..., None], axis=-1).mean()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("shape", [(2, 4), (1, 4), (4, 2)])
 def test_pipeline_loss_and_grads_match_dense(shape):
     mesh = make_mesh(shape, ("dp", "pp"))
@@ -122,6 +123,7 @@ def test_pipeline_loss_and_grads_match_dense(shape):
         )
 
 
+@pytest.mark.slow
 def test_pipeline_train_step_reduces_loss_and_stays_sharded():
     mesh = make_mesh((2, 4), ("dp", "pp"))
     params = shard_params_pipeline(init_params(CFG, seed=2), CFG, mesh)
@@ -183,6 +185,7 @@ def _grads_1f1b(cfg, mesh, params, toks, tgts, n_microbatch):
     return grad_fn(sp, place(toks), place(tgts))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("shape", [(2, 4), (1, 4), (4, 2)])
 def test_1f1b_loss_and_grads_match_dense(shape):
     """The interleaved fwd/bwd schedule computes the same loss AND the
@@ -206,6 +209,7 @@ def test_1f1b_loss_and_grads_match_dense(shape):
         )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("pp", [2, 4])
 def test_1f1b_moe_pipeline_loss_decreases(pp):
     """MoE stages are pipeline-legal under 1F1B (VERDICT round 1 item 4:
@@ -258,6 +262,7 @@ def test_bubble_fraction_metric():
         bubble_fraction(4, 4, "pipedream")
 
 
+@pytest.mark.slow
 def test_gpipe_schedule_train_step_reduces_loss():
     """The fill/drain schedule's full train step stays wired (the 1F1B
     default must not orphan it)."""
@@ -290,6 +295,7 @@ def _undo_devmajor(a):
     ((4, 2), 2, 2),
     ((2, 2), 4, 4),   # deep interleave, two waves
 ])
+@pytest.mark.slow
 def test_circular_loss_and_grads_match_dense(shape, v, n_micro):
     """The interleaved virtual-stage schedule (device-major chunks,
     payload-riding stage counters, seamless wave injection) computes the
@@ -341,6 +347,7 @@ def test_circular_loss_and_grads_match_dense(shape, v, n_micro):
         )
 
 
+@pytest.mark.slow
 def test_circular_train_step_reduces_loss():
     cfg = TransformerConfig(
         vocab=61, d_model=32, n_heads=4, n_layers=8, d_ff=64
@@ -439,6 +446,7 @@ def test_measured_bubble_circular_implementation_overhead(pp, M, v):
     assert (r["busy"].sum(axis=1) == v * M).all()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("schedule", ["1f1b", "gpipe", "circular"])
 def test_optax_pipeline_train_step_adamw(schedule):
     """AdamW over the pipeline schedules (VERDICT r3 missing #3): loss
@@ -483,6 +491,7 @@ def test_optax_pipeline_train_step_adamw(schedule):
     assert losses[-1] < losses[0] - 0.02, losses
 
 
+@pytest.mark.slow
 def test_optax_pipeline_1f1b_matches_gpipe_trajectory():
     """1F1B computes grads in its own scan (no autodiff-through-scan);
     driving the SAME AdamW from both must give the same loss curve."""

@@ -68,6 +68,7 @@ def test_ring_output_stays_sequence_sharded():
     assert spec == P(None, "sp", None, None) or spec[1] == "sp"
 
 
+@pytest.mark.slow
 def test_ring_gradients_match_dense():
     # differentiability: the scan/ppermute program must backprop — the
     # requirement for using ring attention inside a train step

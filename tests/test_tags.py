@@ -45,6 +45,7 @@ def _make_backend(kind, work_fn, n):
         pytest.skip(f"native transport unavailable: {e}")
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kind", ["local", "process", "native"])
 def test_two_pools_one_backend_no_crosstalk(kind):
     """Pool A (tag 1, slow work) and pool B (tag 2, fast work) share one
@@ -130,6 +131,7 @@ def test_wait_any_mixed_tags_local():
         backend.shutdown()
 
 
+@pytest.mark.slow
 def test_control_data_split_native():
     """The kmap2 convention, library-grade: a data pool (tag 0) and a
     low-rate control pool (tag 1) multiplex one native transport; a
@@ -163,6 +165,7 @@ def test_control_data_split_native():
         backend.shutdown()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kind", ["local", "process", "native"])
 def test_subset_pools_with_tags(kind):
     """Rank-subset routing (pool index i -> ranks[i], reference

@@ -85,6 +85,7 @@ def _hw_env():
     return env
 
 
+@pytest.mark.slow
 def test_flash_attention_mosaic_compiles_on_tpu():
     res = subprocess.run(
         [sys.executable, "-c", _CHILD],

@@ -132,6 +132,7 @@ def test_resume_matches_uninterrupted_training(tmp_path):
     assert pool3.epoch == 10  # epoch numbering continued, not restarted
 
 
+@pytest.mark.slow
 def test_1f1b_pipeline_resume_matches_uninterrupted(tmp_path):
     """Checkpoint/resume composes with the 1F1B pipeline train step:
     save mid-training, restore into a fresh step function, and the

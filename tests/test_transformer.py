@@ -113,6 +113,7 @@ def test_train_step_reduces_loss_and_stays_sharded():
     assert "tp" in tuple(wq_spec)
 
 
+@pytest.mark.slow
 def test_sharded_grads_match_dense_grads():
     mesh = make_mesh((2, 2, 2), ("dp", "sp", "tp"))
     params = init_params(CFG, seed=4)
@@ -176,6 +177,7 @@ def test_long_context_memory_scaling_shape():
     )
 
 
+@pytest.mark.slow
 def test_remat_grads_match_unremated():
     """cfg.remat trades FLOPs for activation memory; it must not change
     the math: loss matches exactly and gradients agree to float
@@ -231,6 +233,7 @@ def test_remat_grads_match_unremated():
             np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_optax_train_step_adamw():
     """make_optax_train_step drives any optax optimizer through the
     sharded loss: AdamW reduces the loss, opt_state stays sharded like

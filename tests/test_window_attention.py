@@ -172,6 +172,7 @@ def test_window_validation():
         flash_attention(q, k, v, causal=True, window=0)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("Tp", [3, 12])
 def test_ring_decode_teacher_forced(Tp):
     """The O(W) ring cache reproduces the windowed training forward
