@@ -51,6 +51,12 @@ Greedy decoding per row equals the single-request oracle
 per-row step is the same math evaluated at S independent (row,
 position) points; tests/test_serving.py pins every admitted request
 against its oracle stream, including staggered admissions and reuse.
+One precision caveat: "same math" means same at exact f32 — at the
+TPU's DEFAULT matmul precision (bf16 MXU passes) the batched and
+single-request program shapes round differently and greedy argmax
+TIES can flip between them (set
+``jax.config.update("jax_default_matmul_precision", "highest")`` for
+cross-shape exactness; examples/continuous_batching.py demonstrates).
 
 ``make_serving_scan(cfg, mesh=...)`` is the sharded variant of the
 decode tick (slots over ``dp``, heads over ``tp``, the training path's

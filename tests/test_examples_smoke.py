@@ -118,3 +118,14 @@ def test_serving_decode_example():
     assert "sharded generation == dense oracle: ok" in out.stdout
     assert "int8 KV cache:" in out.stdout
     assert "sharded == dense oracle: ok" in out.stdout  # ring section
+
+
+@pytest.mark.slow
+def test_continuous_batching_example():
+    out = _run_example(
+        "continuous_batching.py",
+        env_extra={"JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "all 10 streams == their single-request oracles" in out.stdout
+    assert "wave 2:" in out.stdout  # straggling admissions exercised
