@@ -189,6 +189,7 @@ def test_transport_dead_peer_is_sticky():
 # ------------------------------------------------------------------- pool
 
 
+@pytest.mark.slow
 def test_full_gather_and_epoch_echo():
     n = 3
     backend = NativeProcessBackend(_echo, n)
@@ -214,6 +215,7 @@ def test_full_gather_and_epoch_echo():
             proc.is_alive()
 
 
+@pytest.mark.slow
 def test_fastest_k_skips_straggler():
     n = 3
     backend = NativeProcessBackend(_echo, n, delay_fn=StragglerDelay(2))
@@ -232,6 +234,7 @@ def test_fastest_k_skips_straggler():
         backend.shutdown()
 
 
+@pytest.mark.slow
 def test_remote_exception_carries_traceback():
     n = 3
     backend = NativeProcessBackend(_fail_worker1_epoch2, n)
@@ -252,6 +255,7 @@ def test_remote_exception_carries_traceback():
         backend.shutdown()
 
 
+@pytest.mark.slow
 def test_tcp_transport_pool_roundtrip():
     """The multi-host path: same pool, TCP loopback instead of a Unix
     socket (port 0 -> ephemeral, resolved via backend.address)."""
@@ -297,6 +301,7 @@ def _spawn_cli_worker(address, rank):
     )
 
 
+@pytest.mark.slow
 def test_external_workers_over_cli():
     """spawn=False + `python -m mpistragglers_jl_tpu.worker`: the
     multi-host deployment model (coordinator binds TCP, workers join
@@ -322,6 +327,7 @@ def test_external_workers_over_cli():
             p.wait(timeout=10)
 
 
+@pytest.mark.slow
 def test_direct_dispatch_snapshots_despite_mutation():
     """Direct Backend-API use (no begin_epoch): every dispatch must
     snapshot the payload at call time — in-place mutation between two
@@ -340,6 +346,7 @@ def test_direct_dispatch_snapshots_despite_mutation():
         backend.shutdown()
 
 
+@pytest.mark.slow
 def test_direct_dispatch_after_asyncmap_snapshots_mutation():
     """The cache armed inside asyncmap must be disarmed when it returns:
     a manual dispatch at the SAME epoch with a mutated buffer sees the
@@ -409,6 +416,7 @@ def test_undeserializable_payload_ships_error_not_dead_worker():
         backend.shutdown()
 
 
+@pytest.mark.slow
 def test_asyncmap_timeout_over_native_transport():
     from mpistragglers_jl_tpu import DeadWorkerError
 
@@ -506,6 +514,7 @@ def test_parse_ranks():
         parse_ranks("1,1")
 
 
+@pytest.mark.slow
 def test_cli_serves_multiple_ranks_one_command():
     """One `-m ...worker --ranks 0-1` process serves both ranks (the
     one-command-per-host deployment shape)."""
@@ -541,6 +550,7 @@ def test_cli_serves_multiple_ranks_one_command():
         proc.wait(timeout=15)
 
 
+@pytest.mark.slow
 def test_respawn_recovers_crashed_rank():
     """Elastic recovery: a crashed rank is replaced in place and the
     pool keeps the same index space (new capability over the reference,
@@ -571,6 +581,7 @@ def test_respawn_recovers_crashed_rank():
         backend.shutdown()
 
 
+@pytest.mark.slow
 def test_use_after_shutdown_raises_not_segfaults():
     backend = NativeProcessBackend(_echo, 2)
     pool = AsyncPool(2)
@@ -585,6 +596,7 @@ def test_use_after_shutdown_raises_not_segfaults():
     backend.shutdown()  # idempotent
 
 
+@pytest.mark.slow
 def test_dead_worker_fails_fast_not_hangs():
     n = 3
     backend = NativeProcessBackend(_exit_worker2, n)
@@ -783,6 +795,7 @@ def test_worker_rejects_rogue_coordinator():
     assert saw.get("post", b"") == b""  # no data ever followed
 
 
+@pytest.mark.slow
 def test_spawned_backend_auto_auth_end_to_end():
     """spawn=True generates a per-backend secret automatically; the
     spawned workers inherit it and the pool works unchanged."""

@@ -86,6 +86,7 @@ def test_mixed_dtype_payloads_bit_identical(kind):
         backend.shutdown()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kind", ["local", "process", "native"])
 def test_structured_records_roundtrip(kind):
     """A structured-dtype recvbuf (the reference's 'anything isbits')

@@ -91,6 +91,7 @@ def test_coded_transformer_training_example():
     assert "exact full-batch gradient from fastest 4/6: ok" in out.stdout
 
 
+@pytest.mark.slow
 def test_hedged_serving_example():
     out = _run_example(
         "hedged_serving.py", env_extra={"JAX_PLATFORMS": "cpu"},

@@ -240,6 +240,7 @@ def test_gather_dispatch_equals_onehot_einsum():
     )
 
 
+@pytest.mark.slow
 def test_gather_form_gradients_match_onehot_oracle():
     """The custom VJPs (gather-form backward for dispatch AND combine)
     must produce the one-hot einsum formulation's gradients exactly —

@@ -55,6 +55,7 @@ class StragglerDelay:
         return self.slow if i == self.straggler else self.fast
 
 
+@pytest.mark.slow
 def test_full_gather_and_epoch_echo():
     n = 3
     backend = ProcessBackend(_echo, n)
@@ -80,6 +81,7 @@ def test_full_gather_and_epoch_echo():
             proc.is_alive()
 
 
+@pytest.mark.slow
 def test_fastest_k_skips_straggler_process():
     n = 3
     backend = ProcessBackend(_echo, n, delay_fn=StragglerDelay(2))
@@ -100,6 +102,7 @@ def test_fastest_k_skips_straggler_process():
         backend.shutdown()
 
 
+@pytest.mark.slow
 def test_remote_exception_carries_traceback():
     n = 3
     backend = ProcessBackend(_fail_worker1_epoch2, n)
@@ -127,6 +130,7 @@ def _exit_on_negative(i, payload, epoch):
     return np.array([float(i + 1), float(payload[0]), float(epoch)])
 
 
+@pytest.mark.slow
 def test_respawn_recovers_crashed_rank():
     """Elastic recovery on the pipe backend: dead rank replaced in place
     (the reference's dead ranks are permanent — SURVEY §5)."""
@@ -153,6 +157,7 @@ def test_respawn_recovers_crashed_rank():
         backend.shutdown()
 
 
+@pytest.mark.slow
 def test_dead_worker_process_fails_fast_not_hangs():
     # a crashed rank hangs the reference's Waitall! forever (SURVEY §5);
     # here the EOF on its pipe surfaces as WorkerFailure at harvest

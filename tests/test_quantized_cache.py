@@ -58,6 +58,7 @@ def test_quantize_roundtrip_bound():
     assert float(jnp.max(err - s[..., None] / 2)) <= 1e-6
 
 
+@pytest.mark.slow
 def test_teacher_forced_quantized_error_bounded():
     """int8 teacher-forced decode tracks the exact forward: logit error
     small against the logit scale (int8 absmax keeps ~2 decimal digits

@@ -53,6 +53,7 @@ def test_witness_code_property():
     assert code.peelable(rest + gen1)
 
 
+@pytest.mark.slow
 def test_static_window_cannot_decode_with_straggler():
     """The fixed-window workload under a permanent straggler never
     becomes decodable: its re-tasks recompute the same shard, so the
@@ -71,6 +72,7 @@ def test_static_window_cannot_decode_with_straggler():
         lt.backend.shutdown()
 
 
+@pytest.mark.slow
 def test_rateless_decodes_past_permanent_straggler():
     """Same code, same seed, same straggler: rounds 2+ draw
     generation-1 shards from the live workers and the epoch decodes

@@ -72,6 +72,7 @@ def test_kmap1_full_gather_each_chunk_from_its_worker():
         backend.shutdown()
 
 
+@pytest.mark.slow
 def test_kmap1_under_real_processes():
     """Same scenario executed as the reference actually runs it — real
     OS processes (runtests.jl:17 spawns ranks via mpiexec)."""

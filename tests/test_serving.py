@@ -181,6 +181,7 @@ def test_request_validation():
         ServingScheduler(init_params(moe, seed=1), moe, slots=1)
 
 
+@pytest.mark.slow
 def test_sharded_serving_scan_matches_dense():
     """The dp x tp serving tick (the driver-dryrun leg) reproduces the
     dense per-row step exactly on the virtual mesh."""
