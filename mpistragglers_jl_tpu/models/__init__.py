@@ -27,6 +27,12 @@ _HOME = {
     "transformer_chunk_loss": "coded_train",
     "generate_speculative_dense": "speculative",
     "make_speculative_dense": "speculative",
+    "make_speculative": "speculative",
+    "ring_from_cache": "decode",
+    "Request": "serving",
+    "ServingScheduler": "serving",
+    "make_serving_scan": "serving",
+    "serving_decode_step_dense": "serving",
     "make_prefill": "decode",
     "make_decode_step": "decode",
     "make_extend": "decode",
@@ -39,7 +45,27 @@ _HOME = {
     "moe_ffn_sharded": "moe",
 }
 
-__all__ = list(_HOME)
+__all__ = list(_HOME) + ["clear_cached_programs"]
+
+
+def clear_cached_programs() -> None:
+    """Drop every lru-cached jitted program factory in the models
+    package (dense generation runners, speculative runners, serving
+    tick/admission programs). Compiled programs can pin device buffers;
+    long-running hosts that sweep many shapes (benchmarks, services)
+    call this between phases to release HBM. One public chokepoint so
+    callers cannot silently miss a newly added cache."""
+    from . import decode, serving, speculative
+
+    for cache in (
+        decode._dense_runner,
+        speculative._spec_runner,
+        serving._serving_scan_dense,
+        serving._extend_chunk_dense,
+        serving._finish_admit_dense,
+        serving._place_dense,
+    ):
+        cache.cache_clear()
 
 
 def __getattr__(name):
