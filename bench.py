@@ -444,8 +444,13 @@ def _transformer_rungs():
     def rung_moe():
         from benchmarks.moe_bench import bench_moe_train
 
-        moe = bench_moe_train(steps=3, chains=2, dense_baseline=False)
-        moe["routing_overhead_share"] = round(
+        # dense_baseline=True: the routing share MUST compare steps
+        # measured in the same minutes — borrowing the flagship step
+        # from the top of the contract re-imports the chip-rate drift
+        # the r5 MFU fix removed (a full-contract validation run read
+        # 0.208 against the early flagship vs 0.128 same-session)
+        moe = bench_moe_train(steps=3, chains=2, dense_baseline=True)
+        moe["share_vs_contract_flagship"] = round(
             (moe["value"] - tt["value"]) / moe["value"], 3
         )
         return moe
