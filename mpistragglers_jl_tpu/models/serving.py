@@ -283,13 +283,19 @@ def make_serving_scan(cfg: TransformerConfig, mesh: Mesh, n_inner: int,
             "routes per chunk (models/decode.py prefill caveat) and is "
             "served via make_generate"
         )
-    if cfg.kv_heads % mesh.shape["tp"] != 0:
+    tp = int(mesh.shape["tp"])
+    if cfg.kv_heads % tp != 0 and tp % cfg.kv_heads != 0:
         raise ValueError(
-            f"kv_heads {cfg.kv_heads} must divide tp "
-            f"{mesh.shape['tp']} for the sharded serving tick; for "
-            "GQA with wider tp (replicated-group cache layout) serve "
-            "via make_ring_generate, or narrow tp"
+            f"kv_heads {cfg.kv_heads} and tp {tp} must nest (one "
+            "divide the other) for the sharded serving tick's cache "
+            "layout"
         )
+    # kv_heads < tp uses decode.py's replicated-groups layout: the
+    # cache's global head axis has `tp` slots, slot t holding kv head
+    # t*kv_heads//tp (each device computes its slot locally from the
+    # tp-replicated K/V projections via make_kv_slice — no extra
+    # collectives). Callers size the cache head axis with
+    # `_cache_heads_global(cfg, mesh)` exactly like make_ring_generate.
     cspec = P("dp", None, "tp", None)
     layer_spec = {"k": cspec, "v": cspec}
     if quantize_kv:

@@ -281,3 +281,43 @@ def test_sharded_serving_scan_quantized():
         dpos = dpos + 1
     got = scan(PARAMS, tok, pos, done, [dict(c) for c in caches])
     np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(dtok))
+
+
+def test_sharded_serving_scan_gqa_wider_tp():
+    """kv_heads < tp: the replicated-groups cache layout (global head
+    axis = tp slots, slot t holding kv head t*kv/tp) reproduces the
+    dense per-row step — the same layout make_ring_generate uses."""
+    from mpistragglers_jl_tpu.models.decode import _cache_heads_global
+
+    S, n_inner = 4, 2
+    mesh = make_mesh((1, 4), ("dp", "tp"))
+    assert CFG.kv_heads == 2 and mesh.shape["tp"] == 4
+    scan = make_serving_scan(CFG, mesh, n_inner)
+    Hc = _cache_heads_global(CFG, mesh)
+    assert Hc == 4  # tp slots
+    tok = jnp.asarray(RNG.integers(1, CFG.vocab, S), jnp.int32)
+    pos = jnp.asarray([5, 8, 3, 7], jnp.int32)
+    done = jnp.zeros((S,), bool)
+    W = CFG.attn_window
+    key = jax.random.key(9)
+    caches_dense, caches_rep = [], []
+    ks = jax.random.split(key, 2 * CFG.n_layers)
+    head_map = jnp.arange(Hc) * CFG.kv_heads // Hc  # slot -> kv head
+    for i in range(CFG.n_layers):
+        kf = jax.random.normal(
+            ks[2 * i], (S, W, CFG.kv_heads, CFG.head_dim), CFG.dtype
+        ) * 0.1
+        vf = jax.random.normal(
+            ks[2 * i + 1], (S, W, CFG.kv_heads, CFG.head_dim), CFG.dtype
+        ) * 0.1
+        caches_dense.append({"k": kf, "v": vf})
+        caches_rep.append({
+            "k": kf[:, :, head_map], "v": vf[:, :, head_map],
+        })
+    dtok, dpos, dc = tok, pos, caches_dense
+    for _ in range(n_inner):
+        lg, dc = serving_decode_step_dense(PARAMS, dtok, dpos, dc, CFG)
+        dtok = jnp.argmax(lg, axis=-1).astype(tok.dtype)
+        dpos = dpos + 1
+    got = scan(PARAMS, tok, pos, done, caches_rep)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(dtok))
