@@ -81,10 +81,12 @@ from .decode import (
     _cache_pv,
     _cache_scores,
     _check_ring_cfg,
+    _check_sampling_params,
     _eos_clamp,
     _incremental_forward,
     _is_quantized,
     _kv_quantize,
+    _pick_token,
     _ring_from_cache,
 )
 from .transformer import (
@@ -230,9 +232,29 @@ def serving_decode_step_dense(params, tok, pos, caches,
     return _serving_forward(params, tok, pos, caches, cfg)
 
 
+def _pick_rows(lg, pos, keys, temperature, top_k, dtype):
+    """Per-row token choice: greedy at temperature 0 (static), else
+    per-row keyed sampling — each row evaluated as row 0 of its own
+    B=1 stream THROUGH ``decode._pick_token`` itself (vmapped), so the
+    fold/truncation discipline has one source of truth and a slot's
+    sampled stream equals ``generate_ring_dense(..., key=key_row)``
+    for the same request key by construction."""
+    if temperature == 0.0:
+        return jnp.argmax(lg, axis=-1).astype(dtype)
+    return jax.vmap(
+        lambda k, p, ll: _pick_token(
+            ll[None], p, k, temperature, top_k, dtype
+        )[0]
+    )(keys, pos, lg)
+
+
 def _scan_body(params, tok, pos, done, caches, cfg, eos_id, n_inner,
-               *, kv_slice=None, tp_psum=False):
-    """``n_inner`` greedy decode steps for all S slots under one scan.
+               keys, *, temperature=0.0, top_k=None,
+               kv_slice=None, tp_psum=False):
+    """``n_inner`` decode steps for all S slots under one scan (greedy,
+    or per-row keyed sampling when ``temperature > 0``; ``keys`` is
+    required — a silent shared-default key would couple every
+    scheduler's streams).
     Returns (tok, pos, done, caches, toks (S, n_inner))."""
 
     def step(carry, _):
@@ -241,7 +263,7 @@ def _scan_body(params, tok, pos, done, caches, cfg, eos_id, n_inner,
             params, tok, pos, caches, cfg, kv_slice=kv_slice,
             tp_psum=tp_psum,
         )
-        nxt = jnp.argmax(lg, axis=-1).astype(tok.dtype)
+        nxt = _pick_rows(lg, pos, keys, temperature, top_k, tok.dtype)
         nxt, done = _eos_clamp(nxt, tok, done, eos_id)
         return (nxt, pos + 1, done, caches), nxt
 
@@ -253,30 +275,36 @@ def _scan_body(params, tok, pos, done, caches, cfg, eos_id, n_inner,
 
 @functools.lru_cache(maxsize=32)
 def _serving_scan_dense(cfg: TransformerConfig, n_inner: int,
-                        eos_id: int | None):
-    """Jitted dense tick: (params, tok, pos, done, caches) ->
+                        eos_id: int | None, temperature: float = 0.0,
+                        top_k: int | None = None):
+    """Jitted dense tick: (params, tok, pos, done, caches, keys) ->
     (tok, pos, done, caches, toks). Caches donated — the tick updates
     the arena in place in HBM."""
 
     @functools.partial(jax.jit, donate_argnums=(4,))
-    def run(params, tok, pos, done, caches):
+    def run(params, tok, pos, done, caches, keys):
         return _scan_body(params, tok, pos, done, caches, cfg, eos_id,
-                          n_inner)
+                          n_inner, keys, temperature=temperature,
+                          top_k=top_k)
 
     return run
 
 
 def make_serving_scan(cfg: TransformerConfig, mesh: Mesh, n_inner: int,
                       *, eos_id: int | None = None,
-                      quantize_kv: bool = False):
+                      quantize_kv: bool = False,
+                      temperature: float = 0.0,
+                      top_k: int | None = None):
     """Sharded serving tick: slots over ``dp``, heads over ``tp``
     (psum placement of the training path — the serving counterpart of
     :func:`~.decode.make_decode_step` with per-row positions).
-    Returns ``f(params, tok, pos, done, caches)`` jitted over ``mesh``
-    with the caches donated. ``quantize_kv=True`` serves an int8 ring
+    Returns ``f(params, tok, pos, done, caches, keys)`` jitted over
+    ``mesh`` with the caches donated (``keys``: per-slot PRNG keys,
+    used only at ``temperature > 0``). ``quantize_kv=True`` serves an int8 ring
     cache (scale leaves shard like their K/V; the per-row write/score
     paths detect the layout)."""
     _check_ring_cfg(cfg)
+    _check_sampling_params(temperature, top_k)
     if cfg.n_experts:
         raise ValueError(
             "serving scheduler covers dense-FFN configs; MoE decode "
@@ -303,9 +331,10 @@ def make_serving_scan(cfg: TransformerConfig, mesh: Mesh, n_inner: int,
         layer_spec["k_s"], layer_spec["v_s"] = sspec, sspec
     cspecs = [dict(layer_spec) for _ in range(cfg.n_layers)]
 
-    def local(params, tok, pos, done, caches):
+    def local(params, tok, pos, done, caches, keys):
         return _scan_body(
             params, tok, pos, done, caches, cfg, eos_id, n_inner,
+            keys, temperature=temperature, top_k=top_k,
             kv_slice=make_kv_slice(cfg), tp_psum=True,
         )
 
@@ -313,7 +342,7 @@ def make_serving_scan(cfg: TransformerConfig, mesh: Mesh, n_inner: int,
         local,
         mesh=mesh,
         in_specs=(param_specs(cfg, mesh), P("dp"), P("dp"), P("dp"),
-                  cspecs),
+                  cspecs, P("dp")),
         out_specs=(P("dp"), P("dp"), P("dp"), cspecs,
                    P("dp", None)),
         # the serving step is pure einsum/scatter — no Pallas kernel on
@@ -346,17 +375,24 @@ def _extend_chunk_dense(cfg: TransformerConfig, C: int, Lmax: int):
 
 
 @functools.lru_cache(maxsize=32)
-def _finish_admit_dense(cfg: TransformerConfig, Lmax: int):
+def _finish_admit_dense(cfg: TransformerConfig, Lmax: int,
+                        temperature: float = 0.0,
+                        top_k: int | None = None):
     """Gather the last-W window of a filled positional cache into ring
-    rows + pick the first token: (cache, last_logits (1, C, V),
-    true_len, last_off) -> (tok0 (), ring leaves (1, W, ...))."""
+    rows + pick the first token (greedy, or sampled with the request's
+    key at the prompt's last position — decode.py's fold discipline):
+    (cache, last_logits (1, C, V), true_len, last_off, key) ->
+    (tok0 (), ring leaves (1, W, ...))."""
     W = _check_ring_cfg(cfg)
 
     @jax.jit
-    def run(cache, last_logits, true_len, last_off):
+    def run(cache, last_logits, true_len, last_off, key):
         ring = [_ring_from_cache(cl, true_len, W) for cl in cache]
         lg = jnp.take(last_logits[0], true_len - 1 - last_off, axis=0)
-        tok0 = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        tok0 = _pick_rows(
+            lg[None], (true_len - 1)[None], key[None], temperature,
+            top_k, jnp.int32,
+        )[0]
         return tok0, ring
 
     return run
@@ -369,14 +405,14 @@ def _place_dense(cfg: TransformerConfig):
     Everything donated — admission is an in-place row write."""
 
     @functools.partial(jax.jit, donate_argnums=(0, 2, 3, 4))
-    def run(caches, ring, tok, pos, done, s, tok0, pos0):
+    def run(caches, ring, tok, pos, done, keys, s, tok0, pos0, key):
         caches = [
             {kk: c[kk].at[s].set(r[kk][0].astype(c[kk].dtype))
              for kk in c}
             for c, r in zip(caches, ring)
         ]
         return (caches, tok.at[s].set(tok0), pos.at[s].set(pos0),
-                done.at[s].set(False))
+                done.at[s].set(False), keys.at[s].set(key))
 
     return run
 
@@ -394,9 +430,11 @@ class Request:
 
     _next_id = 0
 
-    def __init__(self, prompt, max_new: int):
+    def __init__(self, prompt, max_new: int, key=None):
         self.id = Request._next_id
         Request._next_id += 1
+        # per-request PRNG key (sampling schedulers); None -> id-derived
+        self.key = key
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("empty prompt")
@@ -444,7 +482,11 @@ class ServingScheduler:
     queued requests into free slots; (3) run ``n_inner`` decode steps
     for all slots in one device program; (4) harvest tokens, retire
     rows that emitted EOS or exhausted their budget, free their slots.
-    Greedy only (temperature sampling belongs to ``generate_*``).
+    Greedy by default; ``temperature > 0`` (optionally ``top_k``)
+    samples each slot with its request's own key (``submit(...,
+    key=...)``; id-derived when omitted) — a sampled stream equals
+    ``generate_ring_dense(..., key=request_key)`` exactly, like the
+    greedy==oracle contract.
 
     ``prompt_chunk`` bounds the decode stall a long prompt can inject
     into in-flight requests (one chunk per tick); ``max_prompt`` sizes
@@ -454,8 +496,10 @@ class ServingScheduler:
     def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
                  n_inner: int = 8, eos_id: int | None = None,
                  prompt_chunk: int = 256, max_prompt: int = 2048,
-                 quantize_kv: bool = False):
+                 quantize_kv: bool = False, temperature: float = 0.0,
+                 top_k: int | None = None):
         W = _check_ring_cfg(cfg)
+        _check_sampling_params(temperature, top_k)
         if cfg.n_experts:
             raise ValueError(
                 "serving scheduler covers dense-FFN configs (MoE: see "
@@ -479,23 +523,40 @@ class ServingScheduler:
         self._admitting: dict[int, _Admitting] = {}  # slot -> state
         self.tick_count = 0
         # device-resident row state + batched ring cache arena
+        self.temperature = float(temperature)
+        self.top_k = top_k
         self._tok = jnp.zeros((self.S,), jnp.int32)
         self._pos = jnp.zeros((self.S,), jnp.int32)
         self._done = jnp.ones((self.S,), bool)  # idle rows stay done
+        self._keys = jax.random.split(jax.random.key(0), self.S)
         self._caches = _fresh_cache(cfg, self.S, W, self.quantize_kv)
-        self._scan = _serving_scan_dense(cfg, self.n_inner, eos_id)
+        self._scan = _serving_scan_dense(
+            cfg, self.n_inner, eos_id, self.temperature, top_k
+        )
         self._extend = _extend_chunk_dense(cfg, self.C, self.Lmax)
-        self._finish = _finish_admit_dense(cfg, self.Lmax)
+        self._finish = _finish_admit_dense(
+            cfg, self.Lmax, self.temperature, top_k
+        )
         self._place = _place_dense(cfg)
 
     # -- public API -----------------------------------------------------
 
-    def submit(self, prompt, max_new: int) -> Request:
+    def submit(self, prompt, max_new: int, key=None) -> Request:
         """Queue a request; returns the live :class:`Request` whose
         ``tokens``/``finished`` the caller watches. Admission happens
         inside subsequent ticks — requests may arrive while others are
-        mid-decode (the "straggling request" case)."""
-        req = Request(prompt, max_new)
+        mid-decode (the "straggling request" case). ``key``: the
+        request's PRNG key when the scheduler samples
+        (``temperature > 0``); defaults to a request-id-derived key.
+        A sampled stream equals ``generate_ring_dense(..., key=key)``
+        for the same key (tests pin it)."""
+        if key is not None and self.temperature == 0.0:
+            raise ValueError(
+                "submit(key=...) on a greedy scheduler: the key would "
+                "be silently unused — construct the scheduler with "
+                "temperature > 0 (generate_* raises the same way)"
+            )
+        req = Request(prompt, max_new, key=key)
         if req.prompt.size > self.Lmax:
             raise ValueError(
                 f"prompt of {req.prompt.size} tokens exceeds max_prompt "
@@ -528,7 +589,7 @@ class ServingScheduler:
         if decoding:
             (self._tok, self._pos, self._done, self._caches,
              toks) = self._scan(self.params, self._tok, self._pos,
-                                self._done, self._caches)
+                                self._done, self._caches, self._keys)
             host = np.asarray(toks)  # (S, n_inner) one fetch per tick
             for s in decoding:
                 req = self._slot_req[s]
@@ -589,14 +650,16 @@ class ServingScheduler:
         if st.next_chunk < st.n_chunks:
             return
         Tp = st.req.prompt.size
+        rkey = (st.req.key if st.req.key is not None
+                else jax.random.key(st.req.id + 1))
         tok0, ring = self._finish(
             st.cache, st.last_logits, jnp.int32(Tp),
-            jnp.int32((st.n_chunks - 1) * self.C),
+            jnp.int32((st.n_chunks - 1) * self.C), rkey,
         )
-        (self._caches, self._tok, self._pos,
-         self._done) = self._place(
+        (self._caches, self._tok, self._pos, self._done,
+         self._keys) = self._place(
             self._caches, ring, self._tok, self._pos, self._done,
-            jnp.int32(s), tok0, jnp.int32(Tp),
+            self._keys, jnp.int32(s), tok0, jnp.int32(Tp), rkey,
         )
         st.req.tokens.append(int(tok0))
         del self._admitting[s]

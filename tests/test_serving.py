@@ -211,8 +211,9 @@ def test_sharded_serving_scan_matches_dense():
         dpos = dpos + 1
         want.append(dtok)
     want = jnp.stack(want, axis=1)
+    keys = jax.random.split(jax.random.key(0), S)
     got = scan(PARAMS, tok, pos, done,
-               [dict(c) for c in caches])  # donated: pass copies
+               [dict(c) for c in caches], keys)  # donated: pass copies
     np.testing.assert_array_equal(np.asarray(got[4]), np.asarray(want))
     np.testing.assert_array_equal(np.asarray(got[0]),
                                   np.asarray(want[:, -1]))
@@ -279,7 +280,8 @@ def test_sharded_serving_scan_quantized():
         lg, dc = serving_decode_step_dense(PARAMS, dtok, dpos, dc, CFG)
         dtok = jnp.argmax(lg, axis=-1).astype(tok.dtype)
         dpos = dpos + 1
-    got = scan(PARAMS, tok, pos, done, [dict(c) for c in caches])
+    keys = jax.random.split(jax.random.key(0), S)
+    got = scan(PARAMS, tok, pos, done, [dict(c) for c in caches], keys)
     np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(dtok))
 
 
@@ -319,5 +321,55 @@ def test_sharded_serving_scan_gqa_wider_tp():
         lg, dc = serving_decode_step_dense(PARAMS, dtok, dpos, dc, CFG)
         dtok = jnp.argmax(lg, axis=-1).astype(tok.dtype)
         dpos = dpos + 1
-    got = scan(PARAMS, tok, pos, done, caches_rep)
+    keys = jax.random.split(jax.random.key(0), S)
+    got = scan(PARAMS, tok, pos, done, caches_rep, keys)
     np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(dtok))
+
+
+def test_sampled_serving_matches_sampled_oracle():
+    """temperature/top-k serving: each request's sampled stream equals
+    ``generate_ring_dense`` with the SAME key (the per-row pick uses
+    decode.py's exact (key, pos, row 0) fold discipline), through
+    admission order, retirement, and slot reuse."""
+    temp, tk = 0.8, 7
+    sched = ServingScheduler(PARAMS, CFG, slots=2, n_inner=3,
+                             prompt_chunk=8, max_prompt=32,
+                             temperature=temp, top_k=tk)
+    pairs = []
+    for i, (plen, n) in enumerate([(5, 9), (11, 6), (3, 12), (8, 7)]):
+        p = _prompt(plen)
+        key = jax.random.key(100 + i)
+        pairs.append((sched.submit(p, n, key=key), p, n, key))
+    sched.run()
+    for r, p, n, key in pairs:
+        want = generate_ring_dense(
+            PARAMS, jnp.asarray(p)[None], n, CFG,
+            temperature=temp, top_k=tk, key=key,
+        )
+        assert r.tokens == [int(t) for t in np.asarray(want)[0]], (
+            f"request {r.id}"
+        )
+
+
+def test_sampled_serving_default_keys_differ_per_request():
+    """Without explicit keys, two identical prompts sample DIFFERENT
+    streams (id-derived keys) — no accidental stream coupling."""
+    sched = ServingScheduler(PARAMS, CFG, slots=2, n_inner=3,
+                             prompt_chunk=8, max_prompt=32,
+                             temperature=1.0)
+    p = _prompt(6)
+    r1 = sched.submit(p, 12)
+    r2 = sched.submit(p, 12)
+    sched.run()
+    assert r1.tokens != r2.tokens
+
+
+def test_sampling_validation():
+    with pytest.raises(ValueError, match="temperature"):
+        ServingScheduler(PARAMS, CFG, slots=1, temperature=-0.5)
+    with pytest.raises(ValueError, match="top_k"):
+        ServingScheduler(PARAMS, CFG, slots=1, temperature=1.0, top_k=0)
+    sched = ServingScheduler(PARAMS, CFG, slots=1, prompt_chunk=8,
+                             max_prompt=16)
+    with pytest.raises(ValueError, match="greedy scheduler"):
+        sched.submit(_prompt(3), 4, key=jax.random.key(1))
