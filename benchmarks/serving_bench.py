@@ -68,10 +68,18 @@ def bench_serving(
 
     rungs = {}
     compile_s = 0.0
-    for S in slot_counts:
+    # int8 sub-rung at the largest S: at B=1 the int8 cache LOSES
+    # (weight-read-bound, docs/PERF.md) — but at S slots the cache
+    # reads are S x W rows while the weight read stays constant, so
+    # batching is where quantization's byte model has real leverage;
+    # measure it rather than extrapolate
+    variants = [(S, False) for S in slot_counts]
+    variants.append((max(slot_counts), True))
+    for S, q8 in variants:
         sched = ServingScheduler(
             params, cfg, slots=S, n_inner=n_inner,
             prompt_chunk=prompt_len, max_prompt=prompt_len,
+            quantize_kv=q8,
         )
         for _ in range(S):
             # budget sized so no request retires mid-measurement: every
@@ -90,7 +98,7 @@ def bench_serving(
             best = dt if best is None else min(best, dt)
         tokens = S * n_inner * ticks
         per_tok_ms = best / tokens * 1e3
-        rungs[f"S{S}"] = {
+        rungs[f"S{S}" + ("_int8" if q8 else "")] = {
             "aggregate_tokens_per_s": round(tokens / best, 1),
             "ms_per_token_aggregate": round(per_tok_ms, 4),
             "ms_per_step": round(best / (n_inner * ticks) * 1e3, 3),
@@ -103,6 +111,11 @@ def bench_serving(
         r[f"vs_S{base_n}"] = round(
             r["aggregate_tokens_per_s"] / base, 2
         )
+    Smax = max(slot_counts)
+    rungs[f"S{Smax}_int8"]["vs_bf16"] = round(
+        rungs[f"S{Smax}_int8"]["aggregate_tokens_per_s"]
+        / rungs[f"S{Smax}"]["aggregate_tokens_per_s"], 2
+    )
     return {
         "metric": "serving-continuous-batching",
         "prompt_len": prompt_len,
