@@ -373,3 +373,27 @@ def test_sampling_validation():
                              max_prompt=16)
     with pytest.raises(ValueError, match="greedy scheduler"):
         sched.submit(_prompt(3), 4, key=jax.random.key(1))
+
+
+def test_clear_cached_programs_drops_all_model_caches():
+    """models.clear_cached_programs is the one chokepoint for dropping
+    lru-cached jitted program factories (bench uses it between rung
+    blocks to release HBM) — it must clear every registered cache."""
+    from mpistragglers_jl_tpu.models import clear_cached_programs
+    from mpistragglers_jl_tpu.models import decode, serving, speculative
+
+    sched = ServingScheduler(PARAMS, CFG, slots=1, n_inner=1,
+                             prompt_chunk=4, max_prompt=8)
+    r = sched.submit(_prompt(3), 2)
+    sched.run()
+    assert r.finished
+    generate_ring_dense(PARAMS, jnp.asarray(_prompt(3))[None], 2, CFG)
+    caches = (
+        decode._dense_runner, speculative._spec_runner,
+        serving._serving_scan_dense, serving._extend_chunk_dense,
+        serving._finish_admit_dense, serving._place_dense,
+    )
+    assert any(c.cache_info().currsize > 0 for c in caches)
+    clear_cached_programs()
+    for c in caches:
+        assert c.cache_info().currsize == 0, c
