@@ -1,4 +1,5 @@
-"""Benchmark entry point: prints ONE JSON line.
+"""Benchmark entry point: full detail on stdout, then ONE COMPACT
+JSON line last.
 
 Headline metric (BASELINE config 3, the north-star workload): (n=8, k=6)
 MDS-coded GEMM at 8192x8192 through the async pool, ``nwait=6`` — the
@@ -8,8 +9,25 @@ baseline (the closest stand-in on this machine for the reference's
 CPU/MPI execution; the reference itself publishes no numbers —
 SURVEY §6).
 
-Other BASELINE configs are runnable individually from ``benchmarks/``;
-this file stays the driver's one-line contract.
+Driver contract (repaired after BENCH_r04/r05 — benchmarks/README.md
+documents the format):
+
+* the LAST stdout line is a compact summary (headline + one scalar per
+  rung nested under ``"rungs"``), kept well under the driver's ~2000-
+  char tail capture — r04 recorded ``parsed: null`` because the full
+  nested contract outgrew the tail and the tail held only the line's
+  torso. The full detail still prints, as earlier stdout lines.
+* ``driver_contract`` runs against an ELAPSED BUDGET
+  (``BENCH_BUDGET_S``, default 780 s — inside the driver's 870 s
+  timeout with margin for interpreter startup and the final print):
+  every rung declares a cost estimate and is skipped, visibly, when
+  the remaining budget cannot cover it — r05 recorded ``rc: 124`` with
+  ZERO output because the contract ran open-loop into the timeout.
+* compiles land in the same persistent XLA cache the test suite uses
+  (tests/.jax_cache, tests/conftest.py mechanism), so a warm driver
+  run spends its budget measuring, not compiling.
+
+Other BASELINE configs are runnable individually from ``benchmarks/``.
 
 Usage: python bench.py [coded|uncoded]
 """
@@ -17,6 +35,7 @@ Usage: python bench.py [coded|uncoded]
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -26,6 +45,21 @@ from benchmarks.transformer_train_bench import (
     _timed,
     bench_transformer_train,
 )
+
+
+def _wire_compile_cache() -> None:
+    """Point XLA's persistent compilation cache at the suite's
+    directory (tests/conftest.py:29-39 — the one mechanism, shared so
+    driver runs and test runs warm each other). Compile-bound first
+    runs are exactly how BENCH_r05 spent 870 s producing nothing."""
+    import jax
+
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests",
+        ".jax_cache",
+    )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 
 
 def bench_coded_gemm(m=8192, kdim=8192, ncols=8192, n=8, k=6, epochs=7):
@@ -181,12 +215,73 @@ def bench_coded_gemm(m=8192, kdim=8192, ncols=8192, n=8, k=6, epochs=7):
     }
 
 
-def _try_rung(fn, **kw):
+# Monotonic deadline for the current driver_contract run (None =
+# unbudgeted, e.g. the standalone CLI paths). _try_rung consults it so
+# the guard reaches every sub-rung without threading a parameter
+# through _transformer_rungs.
+_DEADLINE: float | None = None
+
+# Rung cost estimates are written for the dev chip. The driver can land
+# on a machine orders of magnitude slower (a CPU-only box compiles and
+# runs the same programs — BENCH_r05's rc 124 was the chip-sized
+# contract started open-loop on exactly such a box), so driver_contract
+# measures a raw-matmul rate up front and scales every estimate by
+# REF_RATE / measured. On the chip the factor clamps to 1 and nothing
+# changes; on a slow box the scaled estimates make the budget guard
+# skip chip-sized rungs instead of discovering the truth at rc 124.
+_REF_RATE = 5e12  # conservative f32 rate the chip estimates assume
+_EST_SCALE = 1.0
+
+
+def _budget_left() -> float | None:
+    return None if _DEADLINE is None else _DEADLINE - time.perf_counter()
+
+
+def _probe_raw_rate() -> float:
+    """Sustained f32 matmul rate (FLOP/s) of whatever device the driver
+    landed on: best of 3 fenced chains of 8 chained 1024^3 jitted
+    matmuls — cheap everywhere (~2 GFLOP per call), and the one number
+    that separates the dev chip from a CPU-only driver box. CHAINED on
+    purpose: on the tunneled chip a single fenced call is dominated by
+    the axon enqueue/fence RTT (the same reason decode_kernel_attrib's
+    `timed` chains its calls), which would understate the chip and
+    inflate the scale factor on the very machine the estimates are
+    written for."""
+    import jax
+    import jax.numpy as jnp
+
+    a = jnp.asarray(
+        np.random.default_rng(7).standard_normal((1024, 1024)),
+        jnp.float32,
+    )
+    mm = jax.jit(lambda u, v: u @ v)
+    reps = 8
+    c = mm(a, a)
+    c.block_until_ready()  # compile outside the clock
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        c = a
+        for _ in range(reps):
+            c = mm(a, c)  # dependent chain: enqueue all, fence once
+        c.block_until_ready()
+        dt = (time.perf_counter() - t0) / reps
+        best = dt if best is None else min(best, dt)
+    return 2.0 * 1024**3 / max(best, 1e-9)
+
+
+def _try_rung(fn, est: float = 60.0, **kw):
     """Round-4 auxiliary rungs record a VISIBLE error instead of
     zeroing out the whole contract on a transient tunnel failure (the
     axon link can flake mid-session — docs/PERF.md drift notes). The
     headline coded metric and the flagship transformer rung stay
     loud-fail on purpose (VERDICT r2 item 1).
+
+    ``est`` is the rung's rough chip cost in seconds: under a driver
+    budget (see :func:`driver_contract`) a rung whose estimate no
+    longer fits the remaining time is SKIPPED with a visible record —
+    a partial contract that prints beats a complete one that times out
+    at rc 124 (BENCH_r05).
 
     Each rung is followed by a GC pass: the contract now spans enough
     rungs (decode caches, serving slot arenas, MoE params, spec
@@ -195,6 +290,12 @@ def _try_rung(fn, **kw):
     that accumulation."""
     import gc
 
+    est = est * _EST_SCALE  # chip estimate -> this machine (see above)
+    left = _budget_left()
+    if left is not None and left < est:
+        return {
+            "skipped": f"budget: {left:.0f}s left < {est:.0f}s estimate"
+        }
     try:
         return fn(**kw)
     except Exception as e:  # noqa: BLE001 — recorded, not swallowed
@@ -220,33 +321,249 @@ def _release_device_memory():
     gc.collect()
 
 
-def driver_contract() -> dict:
-    """The one-line JSON the driver records: the coded-GEMM headline
-    plus every cross-cutting rung the PERF tables claim. Assembled HERE
-    — not inside :func:`bench_coded_gemm` — so parameterized CLI
-    reruns of the coded metric (benchmarks/config3_mds_gemm.py) do not
-    pay for, or mislabel, unrelated benchmarks."""
-    out = bench_coded_gemm()
-    out["adaptive_nwait"] = bench_adaptive_nwait()
-    # round-3 flagship rung: the REAL train step (shard_map + Ulysses +
-    # Pallas flash attention under Mosaic) on this chip. Not wrapped in
-    # try/except on purpose: if the non-interpret flash path stops
-    # compiling, the whole bench fails loudly (VERDICT r2 item 1).
-    out["transformer_train"] = _transformer_rungs()
-    _release_device_memory()
-    # systematic-LT overhead rung (VERDICT r2 item 4): real pool path,
-    # one permanent straggler, systematic vs classic stream
-    out["rateless_overhead"] = bench_rateless_overhead()
-    # round-4 contract widening (VERDICT r3 weak #5): the fused
-    # pool↔mesh epoch on the real chip (alternated-chain vs the unfused
-    # device-0 gather) and the scaled config-4 chained LT epoch —
-    # previously PERF-prose-only, now regression-guarded
-    from benchmarks.config4_lt_gemm import bench_rung
-    from benchmarks.fused_chip_bench import bench_fused_chip
+def driver_contract(budget_s: float | None = None) -> dict:
+    """The JSON the driver records: the coded-GEMM headline plus every
+    cross-cutting rung the PERF tables claim. Assembled HERE — not
+    inside :func:`bench_coded_gemm` — so parameterized CLI reruns of
+    the coded metric (benchmarks/config3_mds_gemm.py) do not pay for,
+    or mislabel, unrelated benchmarks.
 
-    out["fused_rung"] = _try_rung(bench_fused_chip, epochs=8)
-    out["config4_rung"] = _try_rung(bench_rung)
-    return out
+    Runs against an elapsed budget (``BENCH_BUDGET_S`` env, default
+    780 s), with three machine-adaptive layers so the contract ALWAYS
+    prints before the driver's timeout — BENCH_r04/r05's failure modes
+    are each answered structurally:
+
+    * every rung estimate is scaled by a measured raw-matmul probe
+      (``_EST_SCALE``), so chip-sized rungs skip visibly on a slow box
+      instead of running open-loop into the timeout (rc 124);
+    * the headline climbs a measured SIZE LADDER (1024^3 first — it
+      lands on any machine — then 2048/4096/8192 while the projection
+      from the last measured size fits the remaining budget), so
+      "value" is a real coded-GEMM measurement everywhere and the full
+      config-3 cube still runs wherever it affords;
+    * a deadline WATCHDOG thread prints the contract-so-far and exits 0
+      if the budget somehow elapses mid-rung — the last line is valid
+      JSON even when an estimate lies."""
+    global _DEADLINE, _EST_SCALE
+    import threading
+
+    _wire_compile_cache()
+    if budget_s is None:
+        budget_s = float(os.environ.get("BENCH_BUDGET_S", "780"))
+    t0 = time.perf_counter()
+    _DEADLINE = (t0 + budget_s) if budget_s > 0 else None
+    out: dict = {}
+    done = threading.Event()
+
+    def _watchdog():
+        while not done.is_set():
+            deadline = _DEADLINE  # one read: the finally can None it
+            if deadline is None:
+                break
+            left = deadline - time.perf_counter()
+            if left <= 0:
+                break
+            done.wait(min(left, 5.0))
+        if done.is_set():
+            return
+        # deadline elapsed mid-rung: flush what exists as BOTH contract
+        # lines and exit 0 — a partial contract that parses beats rc
+        # 124. The main thread is still mutating `out`, so the snapshot
+        # (and the dumps over it) can race; retry once, then fall back
+        # to a minimal line — something parseable ALWAYS prints.
+        try:
+            for _ in range(2):
+                try:
+                    snap = dict(out)
+                    snap["elapsed_s"] = round(
+                        time.perf_counter() - t0, 1
+                    )
+                    snap["budget_s"] = budget_s
+                    snap["watchdog"] = (
+                        "deadline elapsed mid-rung; partial contract"
+                    )
+                    lines = (json.dumps(snap, default=str),
+                             _contract_line(snap))
+                    break
+                except Exception:  # noqa: BLE001 — mid-copy mutation,
+                    continue  # un-dumpable value: fall to the minimal
+                    # line rather than exiting with NOTHING printed
+            else:
+                fb = json.dumps({
+                    "metric": None, "value": None,
+                    "watchdog": "deadline elapsed; snapshot raced",
+                })
+                lines = (fb, fb)
+            print(lines[0])
+            print(lines[1])
+            sys.stdout.flush()
+        finally:
+            os._exit(0)
+
+    if _DEADLINE is not None:
+        threading.Thread(target=_watchdog, daemon=True).start()
+    try:
+        rate = _probe_raw_rate()
+        _EST_SCALE = max(1.0, _REF_RATE / rate)
+        out["machine_calibration"] = {
+            "raw_matmul_gflops": round(rate / 1e9, 1),
+            "est_scale": round(_EST_SCALE, 1),
+        }
+        # headline: never budget-skipped, loud-fail (it IS the
+        # contract) — but SIZED by measurement. Each ladder step is a
+        # complete config-3 bench at that cube; the next step runs only
+        # while its projection (measured last step x8 for the cube,
+        # x1.5 margin) leaves the aux-rung reserve intact. The largest
+        # completed cube is the headline ("metric" carries the size).
+        aux_reserve = 0.35 * budget_s
+        last_total = None
+        for cube in (1024, 2048, 4096, 8192):
+            if last_total is not None:
+                left = _budget_left()
+                proj = last_total * 8 * 1.5
+                if left is not None and left - aux_reserve < proj:
+                    out["headline_ladder_stop"] = (
+                        f"{cube}^3 projected {proj:.0f}s vs "
+                        f"{left:.0f}s left ({aux_reserve:.0f}s reserved)"
+                    )
+                    break
+            t_step = time.perf_counter()
+            if last_total is None:
+                # 1024^3 stays loud-fail: with no smaller measurement
+                # banked there is nothing honest to print without it
+                out.update(
+                    bench_coded_gemm(m=cube, kdim=cube, ncols=cube)
+                )
+            else:
+                # the ladder projects TIME only — a cube the budget
+                # affords can still exceed RAM/HBM. A failed climb must
+                # not destroy the measured smaller-cube headline.
+                try:
+                    out.update(
+                        bench_coded_gemm(m=cube, kdim=cube, ncols=cube)
+                    )
+                except Exception as e:  # noqa: BLE001 — recorded
+                    out["headline_ladder_stop"] = (
+                        f"{cube}^3 failed: {type(e).__name__}: {e}"
+                    )
+                    break
+            last_total = time.perf_counter() - t_step
+            out["headline_cube"] = cube
+        out["adaptive_nwait"] = _try_rung(bench_adaptive_nwait, est=15)
+        # round-3 flagship rung block: the REAL train step (shard_map +
+        # Ulysses + Pallas flash attention under Mosaic) on this chip.
+        # The flagship stays loud-fail (VERDICT r2 item 1: if the
+        # non-interpret flash path stops compiling the bench must
+        # fail), but under budget pressure it skips VISIBLY — sub-rungs
+        # inside gate themselves through _try_rung estimates.
+        left = _budget_left()
+        if left is not None and left < 150 * _EST_SCALE:
+            out["transformer_train"] = {
+                "skipped": f"budget: {left:.0f}s left < "
+                           f"{150 * _EST_SCALE:.0f}s estimate"
+            }
+        else:
+            # publish the dict BEFORE it fills: the watchdog snapshot
+            # must see completed sub-rungs even mid-block
+            out["transformer_train"] = tt = {}
+            _transformer_rungs(into=tt)
+        _release_device_memory()
+        # systematic-LT overhead rung (VERDICT r2 item 4): real pool
+        # path, one permanent straggler, systematic vs classic stream
+        out["rateless_overhead"] = _try_rung(
+            bench_rateless_overhead, est=60
+        )
+        # round-4 contract widening (VERDICT r3 weak #5): the fused
+        # pool↔mesh epoch on the real chip (alternated-chain vs the
+        # unfused device-0 gather) and the scaled config-4 chained LT
+        # epoch — previously PERF-prose-only, now regression-guarded
+        from benchmarks.config4_lt_gemm import bench_rung
+        from benchmarks.fused_chip_bench import bench_fused_chip
+
+        out["fused_rung"] = _try_rung(bench_fused_chip, est=45, epochs=8)
+        out["config4_rung"] = _try_rung(bench_rung, est=120)
+        out["elapsed_s"] = round(time.perf_counter() - t0, 1)
+        out["budget_s"] = budget_s
+        return out
+    finally:
+        done.set()
+        _DEADLINE = None
+        _EST_SCALE = 1.0
+
+
+def _rung_summary(d, *keys):
+    """One scalar per rung for the compact contract line: the first of
+    ``keys`` present, or the rung's skip/error marker."""
+    if not isinstance(d, dict):
+        return None
+    if "error" in d:
+        return "error"
+    if "skipped" in d:
+        return "skipped"
+    for k in keys:
+        v = d.get(k)
+        if isinstance(v, (int, float, str)):
+            return v
+    return None
+
+
+def _contract_line(out: dict) -> str:
+    """The driver-facing LAST line: headline + one scalar per rung.
+    The full detail prints separately; this line must survive a ~2000-
+    char tail capture intact (BENCH_r04's ``parsed: null`` was the full
+    contract outgrowing the tail), so it is capped hard: if the rung
+    digest somehow overflows, the rungs drop before the headline does."""
+    tt = out.get("transformer_train") or {}
+    if not isinstance(tt, dict):
+        tt = {}
+    # a skipped/errored parent block marks every nested digest with its
+    # own state rather than a null that reads like a lost measurement
+    tt_mark = tt if ("skipped" in tt or "error" in tt) else None
+    decode = tt_mark or tt.get("decode_rung")
+    serving = tt_mark or tt.get("serving_rung")
+    serving = serving if isinstance(serving, dict) else {}
+    s_mark = (
+        serving if ("skipped" in serving or "error" in serving) else None
+    )
+    rungs = {
+        "adaptive_speedup": _rung_summary(
+            out.get("adaptive_nwait"), "speedup"),
+        "train_s_per_step": _rung_summary(tt, "value"),
+        "train_mfu": _rung_summary(tt, "mfu_vs_raw_matmul"),
+        "decode_ms_per_token": _rung_summary(
+            decode, "decode_ms_per_token"),
+        "decode_int8_vs_bf16": _rung_summary(
+            decode, "int8_decode_speedup"),
+        "serving_S8_tok_s": _rung_summary(
+            serving.get("S8", s_mark), "aggregate_tokens_per_s"),
+        "serving_int8_vs_bf16": _rung_summary(
+            serving.get("S8_int8", s_mark), "vs_bf16"),
+        "rateless_overhead": _rung_summary(
+            (out.get("rateless_overhead") or {}).get(
+                "systematic", out.get("rateless_overhead"))
+            if isinstance(out.get("rateless_overhead"), dict) else None,
+            "overhead"),
+        "fused_ms": _rung_summary(out.get("fused_rung"), "fused_ms",
+                                  "per_epoch_ms", "value"),
+        "config4": _rung_summary(out.get("config4_rung"), "value",
+                                 "per_epoch_s"),
+    }
+    line = {
+        "metric": out.get("metric"),
+        "value": out.get("value"),
+        "unit": out.get("unit"),
+        "vs_baseline": out.get("vs_baseline"),
+        "mfu_vs_raw_matmul": out.get("mfu_vs_raw_matmul"),
+        "elapsed_s": out.get("elapsed_s"),
+        "rungs": rungs,
+    }
+    # default=str: a stray numpy scalar in a rung digest must degrade
+    # to a string, not throw away the whole driver line
+    s = json.dumps(line, default=str)
+    if len(s) > 1800:  # belt-and-braces: headline survives regardless
+        line["rungs"] = {"dropped": "line cap"}
+        s = json.dumps(line, default=str)
+    return s
 
 
 def bench_rateless_overhead(m=2048, ncols=256, n=8, k=8, seeds=(0, 1, 2)):
@@ -312,7 +629,7 @@ def bench_rateless_overhead(m=2048, ncols=256, n=8, k=8, seeds=(0, 1, 2)):
     return out
 
 
-def _transformer_rungs():
+def _transformer_rungs(into: dict | None = None):
     """Flagship train-step metric + the model-family rungs the PERF
     headline tables claim (VERDICT r3 weak #5: anything not in this
     JSON has no regression guard at judge time):
@@ -335,8 +652,41 @@ def _transformer_rungs():
 
     Per-rung step counts stay small on purpose: the tunnel can degrade
     mid-session and the driver has a global timeout (docs/PERF.md).
+    Rung ORDER is claim priority: the budget guard (_try_rung) skips
+    from wherever the money runs out, so the serving/decode rungs —
+    the int8-KV and continuous-batching claims under active scrutiny —
+    run before the auxiliary training shapes.
+
+    ``into`` (driver_contract passes its live ``out["transformer_train"]``
+    dict) is populated rung-by-rung, so the deadline watchdog's snapshot
+    sees every COMPLETED sub-rung — measurements must not vanish because
+    the block as a whole was still in flight when the budget elapsed.
     """
-    tt = bench_transformer_train()
+    tt = into if into is not None else {}
+    tt.update(bench_transformer_train())
+
+    from benchmarks.transformer_train_bench import (
+        bench_decode,
+        bench_spec_decode,
+        bench_window_decode,
+    )
+
+    tt["decode_rung"] = _try_rung(bench_decode, est=100)
+    tt["window_decode_rung"] = _try_rung(bench_window_decode, est=80)
+
+    def rung_serving():
+        # import inside the thunk: an import-time failure is recorded
+        # as this rung's error, not a loss of every transformer rung
+        from benchmarks.serving_bench import bench_serving
+
+        return bench_serving()
+
+    # round-5: continuous-batching scheduler — aggregate decode
+    # throughput at S concurrent requests vs S=1 (VERDICT r4 next-#1);
+    # round-6 adds the int8 kernel-vs-einsum sub-rungs at S=8 (the
+    # batched decode path's driver-verifiable claim)
+    tt["serving_rung"] = _try_rung(rung_serving, est=120)
+    tt["spec_decode_rung"] = _try_rung(bench_spec_decode, est=60)
 
     def rung_470m():
         big = bench_transformer_train(
@@ -354,12 +704,14 @@ def _transformer_rungs():
             )
         }
 
-    tt["large_model_rung"] = _try_rung(rung_470m)
+    tt["large_model_rung"] = _try_rung(rung_470m, est=60)
     # lc is a ratio dependency of the gqa/remat rungs below: if it
-    # fails, their thunks KeyError inside their own _try_rung and are
-    # recorded as error dicts — nothing zeroes the contract
+    # fails (or is budget-skipped), their thunks KeyError inside their
+    # own _try_rung and are recorded as error dicts — nothing zeroes
+    # the contract
     lc = _try_rung(
-        bench_transformer_train, batch=1, seq=16384, steps=3, chains=2
+        bench_transformer_train, est=60, batch=1, seq=16384, steps=3,
+        chains=2,
     )
     tt["long_context_rung"] = (
         lc
@@ -388,7 +740,7 @@ def _transformer_rungs():
             )
         }
 
-    tt["long_context_32k_rung"] = _try_rung(rung32)
+    tt["long_context_32k_rung"] = _try_rung(rung32, est=70)
 
     def rung_gqa():
         gqa = bench_transformer_train(
@@ -406,7 +758,7 @@ def _transformer_rungs():
             "step_vs_mha": round(gqa["value"] / lc["value"], 3),
         }
 
-    tt["gqa_long_context_rung"] = _try_rung(rung_gqa)
+    tt["gqa_long_context_rung"] = _try_rung(rung_gqa, est=60)
 
     def rung_remat():
         rm = bench_transformer_train(
@@ -419,27 +771,7 @@ def _transformer_rungs():
             "step_vs_no_remat": round(rm["value"] / lc["value"], 3),
         }
 
-    tt["remat_rung"] = _try_rung(rung_remat)
-    from benchmarks.transformer_train_bench import (
-        bench_decode,
-        bench_spec_decode,
-        bench_window_decode,
-    )
-
-    tt["decode_rung"] = _try_rung(bench_decode)
-    tt["window_decode_rung"] = _try_rung(bench_window_decode)
-    tt["spec_decode_rung"] = _try_rung(bench_spec_decode)
-
-    def rung_serving():
-        # import inside the thunk: an import-time failure is recorded
-        # as this rung's error, not a loss of every transformer rung
-        from benchmarks.serving_bench import bench_serving
-
-        return bench_serving()
-
-    # round-5: continuous-batching scheduler — aggregate decode
-    # throughput at S concurrent requests vs S=1 (VERDICT r4 next-#1)
-    tt["serving_rung"] = _try_rung(rung_serving)
+    tt["remat_rung"] = _try_rung(rung_remat, est=50)
 
     def rung_moe():
         from benchmarks.moe_bench import bench_moe_train
@@ -455,7 +787,7 @@ def _transformer_rungs():
         )
         return moe
 
-    tt["moe_rung"] = _try_rung(rung_moe)
+    tt["moe_rung"] = _try_rung(rung_moe, est=60)
     return tt
 
 
@@ -646,7 +978,12 @@ def bench_uncoded_gemm(m=4096, k=4096, n=4096, n_workers=4, epochs=40):
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "coded"
     if which == "coded":
-        print(json.dumps(driver_contract()))
+        full = driver_contract()
+        # full detail first (greppable, NOT the driver's line) …
+        print(json.dumps(full, default=str))
+        sys.stdout.flush()
+        # … then the compact contract as the LAST stdout line
+        print(_contract_line(full))
     elif which == "uncoded":
         print(json.dumps(bench_uncoded_gemm()))
     elif which == "transformer":

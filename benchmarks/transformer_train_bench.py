@@ -381,9 +381,13 @@ def bench_decode(
         return per * 1e3, totals[n1]
 
     decode_ms, best_g = slope_ms(False)
+    # B=1 int8 under the AUTO default routes the einsum dequant path
+    # (below KERNEL_MIN_BATCH — the scan boundary cost isn't amortized)
     decode_q8_ms, _ = slope_ms(True)
-    # third variant: the experimental Pallas int8 decode kernel (off by
-    # default — measured slower so far; keep the record honest)
+    # third variant: the Pallas int8 decode kernel FORCED at B=1 —
+    # kept measured so the boundary-cost attribution stays a number,
+    # not folklore (batched routing is where the kernel wins; see
+    # decode_kernel_attrib.py and the serving rung)
     from mpistragglers_jl_tpu.models.decode import use_decode_kernel
 
     use_decode_kernel(True)
@@ -393,7 +397,7 @@ def bench_decode(
         decode_q8k_ms = None
         print(f"int8 kernel variant failed: {e!r}", flush=True)
     finally:
-        use_decode_kernel(False)
+        use_decode_kernel(None)  # restore the batched-AUTO default
 
     Hkv = cfg.kv_heads
     cache_mb = (

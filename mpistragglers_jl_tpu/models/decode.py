@@ -56,6 +56,7 @@ import functools
 from functools import partial
 
 import jax
+from .. import _jax_compat  # noqa: F401  (installs older-JAX aliases)
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -97,47 +98,108 @@ __all__ = [
 
 _NEG = -1e30  # matches parallel/ring_attention.py
 
-# The Pallas int8 decode-attention kernel (ops/decode_attention.py) is
-# OPT-IN: correct everywhere (tests/test_decode_attention.py) but so
-# far measured SLOWER than the einsum dequant path on the bench chip
-# (0.6-0.8x across three kernel layouts — docs/PERF.md records each
-# attempt); the einsum path stays the default until a layout wins.
-_USE_DECODE_KERNEL = False
+# int8 decode-kernel routing (ops/decode_attention.py). Tri-state:
+#
+#   None  (default) — AUTO: route the kernel only for BATCHED decode
+#         (local batch >= KERNEL_MIN_BATCH). Measured (docs/PERF.md):
+#         standalone the kernel beats the bf16 einsum 1.22x at its DMA
+#         floor, but inside the generation scan each pallas_call pays a
+#         launch/carry-aliasing boundary cost of ~0.02-0.04 ms/layer.
+#         That cost is PER CALL, so batching divides it by the rows the
+#         call serves: at B=1 it swamps the byte win (0.70-0.91x), at
+#         B >= 4 the amortized boundary rides under the streaming win.
+#   True  — force the kernel at every batch (tests, attribution).
+#   False — force the einsum dequant path.
+_USE_DECODE_KERNEL: bool | None = None
+
+# The auto threshold: the r5 boundary attribution (~0.03 ms/call) over
+# the kernel's standalone margin (~0.012 ms at the 16k flagship shape)
+# crosses under 4 rows per call; serving runs S=8.
+KERNEL_MIN_BATCH = 4
+
+_UNSET = object()  # "no snapshot" sentinel for _kernel_possible
 
 
-def use_decode_kernel(enabled: bool) -> None:
-    """Route quantized T=1 cached attention through the Pallas kernel
-    (experimental; see the note above). The flag is part of the dense
-    runners' cache key, so toggling always takes effect on the next
-    dense ``generate_*`` call — already-compiled programs for the other
-    setting stay cached and are reused on a toggle back. ``make_*``
-    closures snapshot the flag at *make* time (routing and shard_map's
-    vma setting must agree); rebuild them to change routing."""
+def use_decode_kernel(enabled: bool | None) -> None:
+    """Set int8 decode-attention routing: ``True`` forces the Pallas
+    kernel, ``False`` forces the einsum dequant path, ``None`` restores
+    the batched AUTO default (kernel iff local batch >=
+    ``KERNEL_MIN_BATCH`` — see the module note). The flag is part of
+    the dense runners' cache key, so toggling always takes effect on
+    the next dense ``generate_*`` call — already-compiled programs for
+    the other setting stay cached and are reused on a toggle back.
+    ``make_*`` closures snapshot the flag at *make* time (routing and
+    shard_map's vma setting must agree); rebuild them to change
+    routing."""
     global _USE_DECODE_KERNEL
-    _USE_DECODE_KERNEL = bool(enabled)
+    _USE_DECODE_KERNEL = None if enabled is None else bool(enabled)
 
 
-def _decode_kernel_enabled() -> bool:
+def _decode_kernel_enabled() -> bool | None:
     return _USE_DECODE_KERNEL
 
 
-def _kernel_possible(cfg, quantize_kv: bool,
-                     use_kernel: bool | None = None) -> bool:
+def _route_kernel(use_kernel, B: int) -> bool:
+    """Resolve the tri-state toggle at a concrete (trace-time) local
+    batch. ``_UNSET`` reads the live global; an explicit ``None`` is a
+    caller's make-time AUTO snapshot and resolves WITHOUT re-reading
+    the global — routing and the snapshot-derived ``check_vma`` setting
+    must come from ONE reading (make_generate / make_serving_scan), or
+    a toggle flipped between make and first trace would bake a program
+    whose routing disagrees with its vma mode. AUTO routes the kernel
+    only when the call serves enough rows (``KERNEL_MIN_BATCH``) to
+    amortize the scan/custom_call boundary cost."""
+    if use_kernel is _UNSET:
+        use_kernel = _USE_DECODE_KERNEL
+    if use_kernel is None:
+        return B >= KERNEL_MIN_BATCH
+    return bool(use_kernel)
+
+
+def _kernel_viable(q, cache_l) -> bool:
+    """Trace-time shape gate shared by EVERY int8-kernel call site
+    (masked ``_cached_attention``, ring ``_ring_cached_attention``,
+    and serving's per-row ``_ring_attention_rows``): quantized cache,
+    single query, lane-aligned head_dim, a GQA group that fits the
+    kernel's 8 sublanes (ops/decode_attention._SUB), and a 128-multiple
+    block divisor for the cache length. One predicate so the routing
+    sites cannot drift from the kernel's actual constraints."""
+    if not _is_quantized(cache_l):
+        return False
+    Hq, Hkv = q.shape[2], cache_l["k"].shape[2]
+    if (
+        q.shape[1] != 1
+        or q.shape[-1] % 128 != 0
+        or Hq % Hkv != 0
+        or Hq // Hkv > 8
+    ):
+        return False
+    from ..ops.decode_attention import DEFAULT_BLOCK_K, _pick_block_128
+
+    return _pick_block_128(
+        cache_l["k"].shape[1], DEFAULT_BLOCK_K, Hkv, q.shape[-1]
+    ) is not None
+
+
+def _kernel_possible(cfg, quantize_kv: bool, use_kernel=_UNSET) -> bool:
     """Could a program for ``cfg`` route T=1 cached attention through
     the int8 kernel? The shard-invariant part of ``_cached_attention``'s
-    guard (toggle, quantized cache, lane-aligned head_dim); the
-    remaining conditions (GQA ratio, block divisor) depend on per-shard
-    shapes and stay trace-time. Used both to keep the flag out of cache
-    keys where it is inert and to scope the vma carve-out."""
-    if use_kernel is None:
+    guard (toggle not forced off, quantized cache, lane-aligned
+    head_dim); the remaining conditions (GQA ratio, block divisor,
+    batch threshold under auto) depend on per-shard shapes and stay
+    trace-time. Used both to keep the flag out of cache keys where it
+    is inert and to scope the vma carve-out. ``None`` (auto) counts as
+    possible — the batch is not known here."""
+    if use_kernel is _UNSET:
         use_kernel = _USE_DECODE_KERNEL
     return bool(
-        quantize_kv and use_kernel and cfg.head_dim % 128 == 0
+        quantize_kv and use_kernel is not False
+        and cfg.head_dim % 128 == 0
     )
 
 
 def _decode_kernel_interpreted(
-    cfg, quantize_kv: bool, use_kernel: bool | None = None
+    cfg, quantize_kv: bool, use_kernel=_UNSET
 ) -> bool:
     """True iff a quantized decode program for ``cfg`` could trace the
     int8 Pallas kernel via the Pallas *interpreter* (non-TPU mesh) —
@@ -147,7 +209,10 @@ def _decode_kernel_interpreted(
     the live flag. A slight over-approximation is safe only in one
     direction: claiming "kernel" for a kernel-free program silently
     loses vma checking, so the cfg-static guard conditions are all
-    applied here."""
+    applied here. Under the AUTO default the per-shard batch is not
+    known at make time, so auto counts as "kernel" — a small-batch
+    auto program on an interpreted mesh runs without vma checking (the
+    conservative direction is unreachable without the batch)."""
     if not _kernel_possible(cfg, quantize_kv, use_kernel):
         return False
     from ..ops.flash_attention import _use_interpret
@@ -290,7 +355,7 @@ def shard_cache(cache, cfg: TransformerConfig, mesh: Mesh):
 
 
 def _cached_attention(q, cache_l, qpos, scale, window=None,
-                      use_kernel=None):
+                      use_kernel=_UNSET):
     """Grouped attention of the chunk's queries against the full cache.
 
     q: (B, T, H, D); the cache holds (B, Lmax, Hkv, D) at positions
@@ -303,33 +368,20 @@ def _cached_attention(q, cache_l, qpos, scale, window=None,
     really are the int8 bytes — the einsum form's ``.astype`` is
     materialized by XLA and gives half the bytes back (docs/PERF.md).
     ``use_kernel`` pins the routing decision (callers that also pick a
-    vma setting from it must pass their snapshot — routing read from
-    the live global at trace time could disagree); None reads the
-    global toggle.
+    vma setting from it must pass their snapshot — even an AUTO
+    ``None`` snapshot resolves without re-reading the global, see
+    ``_route_kernel``); the ``_UNSET`` default reads the global toggle,
+    whose AUTO default routes the kernel only for batched calls (the
+    per-call scan boundary cost amortizes over the batch rows).
     """
-    if use_kernel is None:
-        use_kernel = _decode_kernel_enabled()
-    Hq, Hkv_c = q.shape[2], cache_l["k"].shape[2]
-    if (
-        use_kernel
-        and _is_quantized(cache_l)
-        and q.shape[1] == 1
-        and q.shape[-1] % 128 == 0
-        and Hq % Hkv_c == 0
-        and Hq // Hkv_c <= 8
+    if _route_kernel(use_kernel, q.shape[0]) and _kernel_viable(
+        q, cache_l
     ):
-        from ..ops.decode_attention import (
-            DEFAULT_BLOCK_K,
-            _pick_block_128,
-            quantized_decode_attention,
-        )
+        from ..ops.decode_attention import quantized_decode_attention
 
-        if _pick_block_128(
-            cache_l["k"].shape[1], DEFAULT_BLOCK_K, Hkv_c, q.shape[-1]
-        ) is not None:
-            return quantized_decode_attention(
-                q, cache_l, qpos[0], scale, window
-            )
+        return quantized_decode_attention(
+            q, cache_l, qpos[0], scale, window
+        )
     Lmax = cache_l["k"].shape[1]
     s = _cache_scores(q, cache_l, scale)  # (B, H, T, Lmax) f32
     # the one band predicate (parallel/ring_attention._band_mask): the
@@ -341,7 +393,7 @@ def _cached_attention(q, cache_l, qpos, scale, window=None,
     return o.astype(q.dtype)
 
 
-def _ring_cached_attention(q, cache_l, pos, scale):
+def _ring_cached_attention(q, cache_l, pos, scale, use_kernel=_UNSET):
     """Single-query attention against an O(W) ring cache.
 
     q: (B, 1, H, D); the cache holds (B, W, Hkv, D) where slot ``s``
@@ -352,8 +404,20 @@ def _ring_cached_attention(q, cache_l, pos, scale):
     position is <= pos by construction), the sliding-window bound
     (every stored position is > pos - W), and the warmup guard for
     slots no position has reached yet.
-    """
+
+    int8 ring caches route the same Pallas kernel as the masked path
+    when the routing gate says so (``ring=True`` mode evaluates the
+    identical ``kpos >= 0`` predicate in VMEM) — the window serving
+    scan gets the dequantize-in-registers win at batch."""
     W = cache_l["k"].shape[1]
+    if _route_kernel(use_kernel, q.shape[0]) and _kernel_viable(
+        q, cache_l
+    ):
+        from ..ops.decode_attention import quantized_decode_attention
+
+        return quantized_decode_attention(
+            q, cache_l, pos, scale, ring=True
+        )
     s = _cache_scores(q, cache_l, scale)  # (B, H, 1, W) f32
     kpos = pos - jnp.mod(pos - jnp.arange(W), W)
     s = jnp.where((kpos >= 0)[None, None, None, :], s, _NEG)
@@ -363,7 +427,7 @@ def _ring_cached_attention(q, cache_l, pos, scale):
 
 
 def _incremental_layer(x, lp, cache_l, qpos, cfg, *, chunk_attn, kv_slice,
-                       tp_psum, ring=False, decode_kernel=None):
+                       tp_psum, ring=False, decode_kernel=_UNSET):
     """One layer of the incremental forward: write the chunk's K/V into
     the cache at ``qpos`` positions, attend, MLP. Returns (x, cache_l).
     ``tp_psum=True`` combines the head-shard out-projection and the
@@ -390,7 +454,8 @@ def _incremental_layer(x, lp, cache_l, qpos, cfg, *, chunk_attn, kv_slice,
         # the exact (unquantized) chunk K/V — only the cache quantizes
         o = chunk_attn(q, k, v)
     elif ring:
-        o = _ring_cached_attention(q, cache_l, qpos[0], scale)
+        o = _ring_cached_attention(q, cache_l, qpos[0], scale,
+                                   use_kernel=decode_kernel)
     else:
         o = _cached_attention(q, cache_l, qpos, scale, cfg.attn_window,
                               use_kernel=decode_kernel)
@@ -418,15 +483,17 @@ def _incremental_layer(x, lp, cache_l, qpos, cfg, *, chunk_attn, kv_slice,
 
 def _incremental_forward(params, tokens, cache, offset, cfg,
                          *, prefill, kv_slice=None, tp_psum=False,
-                         ring=False, decode_kernel=None):
+                         ring=False, decode_kernel=_UNSET):
     """Chunk forward at global ``offset``; returns (logits, cache).
 
     ``prefill=True`` (static) means offset is known to be 0 and chunk
     attention uses the configured kernel; otherwise attention runs
     against the cache — the ``max_len`` positional cache by default,
     the O(W) ring buffer when ``ring=True``. ``decode_kernel`` is the
-    caller's make-time snapshot of the int8-kernel toggle (None: read
-    the live global at trace time).
+    caller's make-time snapshot of the int8-kernel toggle — a ``None``
+    snapshot pins AUTO without re-reading the global (``_route_kernel``)
+    — or ``_UNSET`` (the default) to read the live global at trace
+    time.
     """
     T = tokens.shape[1]
     if ring and (T != 1 or prefill):
@@ -470,6 +537,61 @@ def _check_prefill_fits(T: int, cache) -> None:
             f"chunk of {T} tokens does not fit the cache (max_len "
             f"{Lmax}); build the cache at least prompt+decode long"
         )
+
+
+def _aligned_quantized_prefill(params, prompt, cache, cfg, *,
+                               decode_kernel, kv_slice=None,
+                               tp_psum=False, chunk=512):
+    """Quantized-ring ORACLE prefill, in C-token chunks: every chunk
+    attends the ALREADY-QUANTIZED cache (``prefill=False``), which is
+    the only math the serving scheduler's chunked admission can ever
+    evaluate — raw K/V of earlier chunks are gone once written. Per-
+    position absmax quantization makes the chunk size invisible (a
+    position's scale never depends on its neighbours), so any C yields
+    the identical stream; C=512 keeps the materialized causal scores at
+    O(C * Tp) per layer instead of the O(Tp^2) a one-shot aligned call
+    would allocate — the flagship 16k prompt stays servable through
+    this path, not just test-scale oracles.
+
+    The shape-identical full chunks run under ONE ``lax.scan`` body
+    (their logits are discarded; only the cache carries), so trace and
+    compile cost stay flat in Tp — a python loop would retrace the
+    whole per-layer forward Tp/C times. At most two chunks trace
+    directly at the tail: the one whose logits the caller needs, plus
+    the ragged remainder when Tp % C != 0."""
+    B, Tp = prompt.shape
+    _check_prefill_fits(Tp, cache)
+    nfull, rem = divmod(Tp, chunk)
+    # fold all full chunks whose logits nobody reads into the scan
+    nscan = nfull - (1 if rem == 0 else 0)
+    off0 = 0
+    if nscan >= 2:
+        chunks = (
+            prompt[:, :nscan * chunk]
+            .reshape(B, nscan, chunk)
+            .swapaxes(0, 1)
+        )
+        offs = jnp.arange(nscan, dtype=jnp.int32) * chunk
+
+        def body(cache, xs):
+            ch, off = xs
+            _, cache = _incremental_forward(
+                params, ch, cache, off, cfg, prefill=False,
+                kv_slice=kv_slice, tp_psum=tp_psum,
+                decode_kernel=decode_kernel,
+            )
+            return cache, None
+
+        cache, _ = jax.lax.scan(body, cache, (chunks, offs))
+        off0 = nscan * chunk
+    logits = None
+    for off in range(off0, Tp, chunk):
+        logits, cache = _incremental_forward(
+            params, prompt[:, off:off + chunk], cache, jnp.int32(off),
+            cfg, prefill=False, kv_slice=kv_slice, tp_psum=tp_psum,
+            decode_kernel=decode_kernel,
+        )
+    return logits, cache
 
 
 def prefill_dense(params, tokens, cache, cfg: TransformerConfig):
@@ -649,14 +771,32 @@ def _dense_runner(cfg: TransformerConfig, B: int, Tp: int, n_new: int,
     variant: prefill fills a Tp-length transient positional cache
     (freed after the gather), the last-W K/V gathers into ring slots,
     and the decode scan carries W positions per layer (``max_len`` is
-    ignored — the ring has no horizon)."""
+    ignored — the ring has no horizon).
+
+    Quantized RING prefill attends through the masked cached-attention
+    path (``prefill=False`` at offset 0) instead of the exact chunk
+    kernel: the serving scheduler's chunked admission can only ever
+    attend the already-quantized cache (raw K/V of earlier chunks are
+    gone once written), and per-position quantization makes one
+    whole-prompt "chunk" here IDENTICAL to the scheduler's C-token
+    chunks — so ``generate_ring_dense(quantize_kv=True)`` is the
+    scheduler's stream as an IDENTITY, not a coincidence
+    (tests/test_serving.py pins it). The masked (non-ring) generator
+    keeps the exact-prefill property docs/PERF.md documents; the
+    aligned prefill runs CHUNKED (``_aligned_quantized_prefill``), so
+    its score memory is O(C * Tp) and long prompts stay servable."""
     W = _check_ring_cfg(cfg) if ring else None
 
     @jax.jit
     def run(params, prompt, key):
         c = init_cache(cfg, B, Tp if ring else max_len,
                        quantize_kv=quantize_kv)
-        logits, c = prefill_dense(params, prompt, c, cfg)
+        if ring and quantize_kv:
+            logits, c = _aligned_quantized_prefill(
+                params, prompt, c, cfg, decode_kernel=use_kernel,
+            )
+        else:
+            logits, c = prefill_dense(params, prompt, c, cfg)
         if ring:
             c = [_ring_from_cache(cl, Tp, W) for cl in c]
         tok = _pick_token(
@@ -714,7 +854,8 @@ def generate_dense(params, prompt, n_new: int, cfg: TransformerConfig,
     return _dense_runner(
         cfg, B, Tp, n_new, max_len, float(temperature), top_k, eos_id,
         quantize_kv,
-        use_kernel=_kernel_possible(cfg, quantize_kv),
+        use_kernel=_kernel_possible(cfg, quantize_kv)
+        and _route_kernel(_UNSET, B),
     )(params, prompt, key)
 
 
@@ -728,7 +869,14 @@ def generate_ring_dense(params, prompt, n_new: int,
     a window config (both attend exactly the (pos-W, pos] band; only
     storage differs) while the decode scan carries W cache positions
     per layer instead of ``Tp + n_new`` — memory AND per-step cache
-    bandwidth are O(W). Returns (B, n_new) tokens."""
+    bandwidth are O(W). Returns (B, n_new) tokens.
+
+    With ``quantize_kv=True`` this is THE serving oracle: prefill
+    attends the already-quantized cache exactly like the scheduler's
+    chunked admission (see :func:`_dense_runner`), so a scheduler slot
+    reproduces this stream as an identity; the masked generator keeps
+    exact prefill, so the two quantized generators may differ at
+    prefill-adjacent tokens (tests pin each contract separately)."""
     if n_new < 1:
         raise ValueError(f"n_new must be >= 1, got {n_new}")
     _check_ring_cfg(cfg)
@@ -738,7 +886,11 @@ def generate_ring_dense(params, prompt, n_new: int,
         key = jax.random.key(0)  # unused at temperature 0
     return _dense_runner(
         cfg, B, Tp, n_new, 0, float(temperature), top_k, eos_id,
-        quantize_kv, ring=True, use_kernel=False,  # ring never routes it
+        quantize_kv, ring=True,
+        # the ring kernel (ops/decode_attention ring=True) routes under
+        # the same gate as the masked path
+        use_kernel=_kernel_possible(cfg, quantize_kv)
+        and _route_kernel(_UNSET, B),
     )(params, prompt, key)
 
 
@@ -929,6 +1081,13 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
 
     def local(params, prompt, key):
         B, Tp = prompt.shape
+        # resolve the tri-state snapshot at THIS shard's batch (auto
+        # routes the kernel only when the call serves enough rows to
+        # amortize the scan boundary cost — see _route_kernel)
+        routed = (
+            _kernel_possible(cfg, quantize_kv, use_kernel)
+            and _route_kernel(use_kernel, B)
+        )
         if ring:
             L = Tp  # transient positional prefill cache, gathered below
         else:
@@ -938,12 +1097,12 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
                     f"max_len {L} < prompt {Tp} + n_new {n_new}: decode "
                     "positions would clamp into the last cache slot"
                 )
-            if quantize_kv and use_kernel and L > 2048:
+            if quantize_kv and routed and L > 2048:
                 # round up so the int8 decode KERNEL always has a big
                 # lane-aligned block divisor (extra slots are masked).
-                # Gated on the kernel toggle: the einsum path needs no
-                # alignment, and the extra masked positions would skew
-                # its memory/time against the bf16 baseline
+                # Gated on the resolved routing: the einsum path needs
+                # no alignment, and the extra masked positions would
+                # skew its memory/time against the bf16 baseline
                 L = -(-L // 2048) * 2048
         Hc = _cache_heads_global(cfg, mesh)
         tp = mesh.shape["tp"]
@@ -953,10 +1112,19 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
             for _ in range(cfg.n_layers)
         ]
         kv_slice = make_kv_slice(cfg)
-        logits, cache = _incremental_forward(
-            params, prompt, cache, jnp.int32(0), cfg, prefill=True,
-            kv_slice=kv_slice, tp_psum=True,
-        )
+        if ring and quantize_kv:
+            # oracle alignment, same as _dense_runner: quantized ring
+            # prefill attends the already-quantized cache — the only
+            # math the scheduler's chunked admission can evaluate
+            logits, cache = _aligned_quantized_prefill(
+                params, prompt, cache, cfg, decode_kernel=routed,
+                kv_slice=kv_slice, tp_psum=True,
+            )
+        else:
+            logits, cache = _incremental_forward(
+                params, prompt, cache, jnp.int32(0), cfg, prefill=True,
+                kv_slice=kv_slice, tp_psum=True,
+            )
         if ring:
             cache = [_ring_from_cache(cl, Tp, W) for cl in cache]
         # global batch-row offset of this shard, derived from the one
@@ -978,7 +1146,7 @@ def make_generate(cfg: TransformerConfig, mesh: Mesh, n_new: int,
             lg, cache = _incremental_forward(
                 params, tok[:, None], cache, pos, cfg, prefill=False,
                 kv_slice=kv_slice, tp_psum=True, ring=ring,
-                decode_kernel=use_kernel,
+                decode_kernel=routed,
             )
             nxt = _pick_token(
                 lg[:, 0], pos, key, temperature, top_k, tok.dtype, row0

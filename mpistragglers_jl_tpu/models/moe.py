@@ -42,6 +42,7 @@ path and the single-chip execution mode.
 from __future__ import annotations
 
 import jax
+from .. import _jax_compat  # noqa: F401  (installs older-JAX aliases)
 import jax.numpy as jnp
 import numpy as np
 
@@ -67,7 +68,14 @@ def init_moe_layer(rng: np.random.Generator, d_model: int, d_ff: int,
         rng.standard_normal(s) / np.sqrt(s[-2]), dtype
     )
     return {
-        "wg": jnp.asarray(rng.standard_normal((D, E)) * 0.02, dtype),
+        # the router stays f32 at ANY model dtype: wg is only (D, E) —
+        # E columns of weights, bytes that round to zero next to the
+        # expert FFNs — while routing decisions (argmax over logits,
+        # gate magnitudes, the load-balance loss) are exactly the
+        # quantities bf16 rounding perturbs first. tests/test_moe.py
+        # pins bf16-activation routing against the f32 router.
+        "wg": jnp.asarray(rng.standard_normal((D, E)) * 0.02,
+                          jnp.float32),
         "we1": sd(E, D, F),
         "be1": jnp.zeros((E, F), dtype),
         # float(): np.float64 scalars promote f32 params under x64
@@ -122,9 +130,13 @@ def _route(x2d: jax.Array, wg: jax.Array):
     # f32 ACCUMULATION without materializing an f32 copy of the whole
     # (T, D) activation (the astype form wrote+read 2x64 MB per layer
     # for a 4-column matmul — the single largest routing cost measured
-    # in benchmarks/moe_route_attrib.py)
+    # in benchmarks/moe_route_attrib.py). The router WEIGHT is not
+    # downcast to the activation dtype: wg stays f32 (it is only
+    # (D, E)) and the mixed-precision dot accumulates in f32 via
+    # preferred_element_type — bf16 rounding touches the activations
+    # once (they already are bf16), never the router's parameters.
     logits = jnp.einsum(
-        "td,de->te", x2d, wg.astype(x2d.dtype),
+        "td,de->te", x2d, wg,
         preferred_element_type=jnp.float32,
     )  # (T, E) f32
     probs = jax.nn.softmax(logits, axis=-1)

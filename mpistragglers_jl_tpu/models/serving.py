@@ -73,6 +73,7 @@ from typing import Any, Sequence
 import numpy as np
 
 import jax
+from .. import _jax_compat  # noqa: F401  (installs older-JAX aliases)
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -82,12 +83,18 @@ from .decode import (
     _cache_scores,
     _check_ring_cfg,
     _check_sampling_params,
+    _decode_kernel_enabled,
+    _decode_kernel_interpreted,
+    _UNSET,
     _eos_clamp,
     _incremental_forward,
     _is_quantized,
+    _kernel_possible,
+    _kernel_viable,
     _kv_quantize,
     _pick_token,
     _ring_from_cache,
+    _route_kernel,
 )
 from .transformer import (
     TransformerConfig,
@@ -166,14 +173,29 @@ def _ring_write_rows(cache_l: dict, k, v, slot):
     }
 
 
-def _ring_attention_rows(q, cache_l, pos, scale):
+def _ring_attention_rows(q, cache_l, pos, scale, use_kernel=False):
     """Single-query ring attention with a per-row position: the same
     ``kpos(s) = pos - ((pos - s) mod W), valid iff kpos >= 0`` invariant
     as decode.py's ``_ring_cached_attention``, evaluated rowwise. The
     mask is simultaneously causal bound, sliding-window bound, warmup
     guard, AND slot-reuse guard (a reused slot's stale rows sit at
-    kpos < 0 for the new occupant until overwritten)."""
+    kpos < 0 for the new occupant until overwritten).
+
+    ``use_kernel=True`` routes int8 caches through the Pallas decode
+    kernel's ring mode (per-row positions ride SMEM): ONE kernel call
+    serves all S slots, so the scan/custom_call boundary cost that
+    sinks the kernel at B=1 is paid once per S tokens — the batched
+    regime is where int8 finally converts its byte win into time
+    (docs/PERF.md). Default False: this function is also the dense
+    ORACLE step (``serving_decode_step_dense``), which stays einsum so
+    kernel-vs-einsum parity is testable against it."""
     W = cache_l["k"].shape[1]
+    if use_kernel and _kernel_viable(q, cache_l):
+        from ..ops.decode_attention import quantized_decode_attention
+
+        return quantized_decode_attention(
+            q, cache_l, pos, scale, ring=True
+        )
     s = _cache_scores(q, cache_l, scale)  # (S, H, 1, W) f32
     kpos = pos[:, None] - jnp.mod(
         pos[:, None] - jnp.arange(W)[None, :], W
@@ -185,7 +207,7 @@ def _ring_attention_rows(q, cache_l, pos, scale):
 
 
 def _serving_layer(x, lp, cache_l, pos, cfg, *, kv_slice=None,
-                   tp_psum=False):
+                   tp_psum=False, use_kernel=False):
     """One layer of the per-row serving step (the dense-FFN half of
     decode.py's ``_incremental_layer`` with per-row positions)."""
     h = _ln(x, lp["ln1_s"], lp["ln1_b"])
@@ -197,7 +219,8 @@ def _serving_layer(x, lp, cache_l, pos, cfg, *, kv_slice=None,
     q, k = _rope_rows(q, pos), _rope_rows(k, pos)
     W = cache_l["k"].shape[1]
     cache_l = _ring_write_rows(cache_l, k, v, jnp.mod(pos, W))
-    o = _ring_attention_rows(q, cache_l, pos, cfg.head_dim ** -0.5)
+    o = _ring_attention_rows(q, cache_l, pos, cfg.head_dim ** -0.5,
+                             use_kernel=use_kernel)
     attn_out = jnp.einsum("blhk,hkd->bld", o, lp["wo"])
     if tp_psum:
         attn_out = jax.lax.psum(attn_out, "tp")
@@ -210,13 +233,13 @@ def _serving_layer(x, lp, cache_l, pos, cfg, *, kv_slice=None,
 
 
 def _serving_forward(params, tok, pos, caches, cfg, *, kv_slice=None,
-                     tp_psum=False):
+                     tp_psum=False, use_kernel=False):
     """(tok (S,), pos (S,), caches) -> (logits (S, V), caches)."""
     x = params["emb"][tok[:, None]]  # (S, 1, d)
     new = []
     for lp, cl in zip(params["layers"], caches):
         x, cl = _serving_layer(x, lp, cl, pos, cfg, kv_slice=kv_slice,
-                               tp_psum=tp_psum)
+                               tp_psum=tp_psum, use_kernel=use_kernel)
         new.append(cl)
     x = _ln(x, params["lnf_s"], params["lnf_b"])
     logits = jnp.einsum("bld,vd->blv", x, params["emb"])
@@ -227,7 +250,9 @@ def serving_decode_step_dense(params, tok, pos, caches,
                               cfg: TransformerConfig):
     """One batched serving decode step, dense: every slot at its own
     position. Returns (logits (S, V), caches). The single-position
-    sibling is :func:`~.decode.decode_step_ring_dense`."""
+    sibling is :func:`~.decode.decode_step_ring_dense`. Always the
+    einsum path — this is the reference step the kernelized tick is
+    pinned against."""
     _check_ring_cfg(cfg)
     return _serving_forward(params, tok, pos, caches, cfg)
 
@@ -250,7 +275,7 @@ def _pick_rows(lg, pos, keys, temperature, top_k, dtype):
 
 def _scan_body(params, tok, pos, done, caches, cfg, eos_id, n_inner,
                keys, *, temperature=0.0, top_k=None,
-               kv_slice=None, tp_psum=False):
+               kv_slice=None, tp_psum=False, use_kernel=False):
     """``n_inner`` decode steps for all S slots under one scan (greedy,
     or per-row keyed sampling when ``temperature > 0``; ``keys`` is
     required — a silent shared-default key would couple every
@@ -261,7 +286,7 @@ def _scan_body(params, tok, pos, done, caches, cfg, eos_id, n_inner,
         tok, pos, done, caches = carry
         lg, caches = _serving_forward(
             params, tok, pos, caches, cfg, kv_slice=kv_slice,
-            tp_psum=tp_psum,
+            tp_psum=tp_psum, use_kernel=use_kernel,
         )
         nxt = _pick_rows(lg, pos, keys, temperature, top_k, tok.dtype)
         nxt, done = _eos_clamp(nxt, tok, done, eos_id)
@@ -276,16 +301,19 @@ def _scan_body(params, tok, pos, done, caches, cfg, eos_id, n_inner,
 @functools.lru_cache(maxsize=32)
 def _serving_scan_dense(cfg: TransformerConfig, n_inner: int,
                         eos_id: int | None, temperature: float = 0.0,
-                        top_k: int | None = None):
+                        top_k: int | None = None,
+                        use_kernel: bool = False):
     """Jitted dense tick: (params, tok, pos, done, caches, keys) ->
     (tok, pos, done, caches, toks). Caches donated — the tick updates
-    the arena in place in HBM."""
+    the arena in place in HBM. ``use_kernel`` is the scheduler's
+    RESOLVED int8-kernel routing (part of the cache key, so toggling
+    the global routes on the next scheduler construction)."""
 
     @functools.partial(jax.jit, donate_argnums=(4,))
     def run(params, tok, pos, done, caches, keys):
         return _scan_body(params, tok, pos, done, caches, cfg, eos_id,
                           n_inner, keys, temperature=temperature,
-                          top_k=top_k)
+                          top_k=top_k, use_kernel=use_kernel)
 
     return run
 
@@ -330,12 +358,23 @@ def make_serving_scan(cfg: TransformerConfig, mesh: Mesh, n_inner: int,
         sspec = P("dp", None, "tp")
         layer_spec["k_s"], layer_spec["v_s"] = sspec, sspec
     cspecs = [dict(layer_spec) for _ in range(cfg.n_layers)]
+    # make-time snapshot of the int8-kernel toggle (decode.py's
+    # discipline: routing and check_vma must come from one reading)
+    use_kernel = _decode_kernel_enabled()
 
     def local(params, tok, pos, done, caches, keys):
+        # resolve at this shard's slot count: one ring-kernel call per
+        # layer serves every local slot, so the auto gate compares the
+        # per-call boundary cost against S_local amortizing rows
+        routed = (
+            _kernel_possible(cfg, quantize_kv, use_kernel)
+            and _route_kernel(use_kernel, tok.shape[0])
+        )
         return _scan_body(
             params, tok, pos, done, caches, cfg, eos_id, n_inner,
             keys, temperature=temperature, top_k=top_k,
             kv_slice=make_kv_slice(cfg), tp_psum=True,
+            use_kernel=routed,
         )
 
     f = jax.shard_map(
@@ -345,10 +384,12 @@ def make_serving_scan(cfg: TransformerConfig, mesh: Mesh, n_inner: int,
                   cspecs, P("dp")),
         out_specs=(P("dp"), P("dp"), P("dp"), cspecs,
                    P("dp", None)),
-        # the serving step is pure einsum/scatter — no Pallas kernel on
-        # any path (per-row attention never routes the int8 kernel), so
-        # varying-axes checking stays on
-        check_vma=True,
+        # quantize_kv + the kernel toggle routes the int8 ring kernel
+        # inside the tick — interpreted Pallas needs the same vma
+        # carve-out as decode.py's make_decode_step; einsum-only
+        # programs keep varying-axes checking on
+        check_vma=not _decode_kernel_interpreted(cfg, quantize_kv,
+                                                 use_kernel),
     )
     return jax.jit(f, donate_argnums=(4,))
 
@@ -530,8 +571,17 @@ class ServingScheduler:
         self._done = jnp.ones((self.S,), bool)  # idle rows stay done
         self._keys = jax.random.split(jax.random.key(0), self.S)
         self._caches = _fresh_cache(cfg, self.S, W, self.quantize_kv)
+        # int8 Pallas kernel routing, resolved at construction against
+        # THIS scheduler's slot count (decode.py's auto gate: the tick
+        # batches all S slots into one kernel call per layer, which is
+        # what amortizes the scan boundary cost the B=1 path cannot)
+        self.use_kernel = (
+            _kernel_possible(cfg, self.quantize_kv)
+            and _route_kernel(_UNSET, self.S)
+        )
         self._scan = _serving_scan_dense(
-            cfg, self.n_inner, eos_id, self.temperature, top_k
+            cfg, self.n_inner, eos_id, self.temperature, top_k,
+            self.use_kernel,
         )
         self._extend = _extend_chunk_dense(cfg, self.C, self.Lmax)
         self._finish = _finish_admit_dense(

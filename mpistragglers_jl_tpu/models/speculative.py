@@ -52,6 +52,7 @@ import dataclasses
 import functools
 
 import jax
+from .. import _jax_compat  # noqa: F401  (installs older-JAX aliases)
 import jax.numpy as jnp
 import numpy as np
 

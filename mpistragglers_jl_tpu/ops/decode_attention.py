@@ -19,18 +19,28 @@ reads the cache EXACTLY as it is laid out — contiguous
 handles the GQA grouping *inside* the kernel with a static loop over
 kv heads (static row/lane slices, one MXU dot per head group):
 
-* grid ``(B, k_blocks)``, k innermost-sequential — for B=1 at 16k
-  that is a handful of grid steps per layer, not hundreds;
+* grid ``(B, k_blocks)``, k innermost-sequential — batch rows are
+  independent ("parallel"), and within a row Mosaic double-buffers the
+  sequential k-blocks: block j+1's int8 K/V DMA overlaps block j's
+  dots, so the stream never stalls on HBM;
 * the q heads ride the sublane axis, each GQA group zero-padded to
   the 8-row tile (``(Hkv * 8, D)`` total); padding rows compute
   garbage that is sliced off at the end, never normalized;
 * per-(position, head) f32 scales arrive in their native
   ``(B, L, Hkv)`` layout too (whole-trailing-dim blocks are
   tile-legal) — NOTHING is transposed or copied outside the kernel;
-* validity is ``kpos <= pos`` (plus the sliding band when ``window``
-  is set) with ``pos`` delivered through SMEM — one compiled kernel
-  serves every decode step; blocks entirely outside the visible range
-  are predicated off grid-level.
+* positions are PER ROW: ``pos`` may be a scalar (every row at the
+  same step — the ``generate_*`` scan) or a ``(B,)`` vector (every
+  serving slot at its own global position — the continuous-batching
+  scheduler). Either way it rides SMEM and one compiled kernel serves
+  every decode step; blocks entirely outside a row's visible range are
+  predicated off grid-level.
+* two cache layouts share the kernel: the POSITIONAL cache (slot s
+  holds position s; validity ``kpos <= pos`` plus the sliding band
+  when ``window`` is set) and the O(W) RING cache (``ring=True``:
+  slot s holds ``kpos(s) = pos - ((pos - s) mod W)``, valid iff
+  ``kpos >= 0`` — which reduces to ``s <= pos or pos >= W``, the same
+  one-predicate mask models/decode.py's ring reads use).
 
 Inference-only: no VJP (the cache is never differentiated through).
 Interpret mode on non-TPU backends keeps the path testable on the CI
@@ -46,7 +56,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import _sds, _use_interpret
+from .flash_attention import _CompilerParams, _sds, _use_interpret
 
 _NEG = -1e30
 _LANE = 128
@@ -87,9 +97,10 @@ def _pick_block_128(L: int, block: int, Hkv: int = 2,
 
 
 def _kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
-            acc, m_sc, l_sc, *, scale, window, bk, nk, Hkv, D):
+            acc, m_sc, l_sc, *, scale, window, bk, nk, Hkv, D, ring):
+    b = pl.program_id(0)
     j = pl.program_id(1)
-    pos = pos_ref[0]
+    pos = pos_ref[b]  # this row's global decode position
 
     @pl.when(j == 0)
     def _init():
@@ -97,16 +108,25 @@ def _kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
         m_sc[:] = jnp.full_like(m_sc, _NEG)
         l_sc[:] = jnp.zeros_like(l_sc)
 
-    run = j * bk <= pos  # any position of this block visible?
-    if window is not None:
+    # any slot of this block visible? Positional: the causal frontier
+    # (plus the band's lower edge). Ring: slots [0, min(pos, W-1)] are
+    # valid, so the same frontier predicate covers warmup, and once
+    # pos >= W every block runs (j*bk <= W - bk < W <= pos).
+    run = j * bk <= pos
+    if window is not None and not ring:
         run = jnp.logical_and(run, pos - (j * bk + bk - 1) < window)
 
     @pl.when(run)
     def _update():
         kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
-        mask = kpos <= pos
-        if window is not None:
-            mask = jnp.logical_and(mask, pos - kpos < window)
+        if ring:
+            # slot s holds position pos - ((pos - s) mod W); kpos >= 0
+            # iff s <= pos or pos >= W (W == bk * nk, the whole cache)
+            mask = jnp.logical_or(kpos <= pos, pos >= bk * nk)
+        else:
+            mask = kpos <= pos
+            if window is not None:
+                mask = jnp.logical_and(mask, pos - kpos < window)
         kblk = k_ref[0]  # (bk, Hkv*D) int8, one contiguous DMA
         vblk = v_ref[0]
         ksb = ks_ref[0].astype(jnp.float32)  # (bk, Hkv)
@@ -147,20 +167,33 @@ def _kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
 
 
 def quantized_decode_attention(
-    q, cache_l: dict, pos, scale, window=None, *,
+    q, cache_l: dict, pos, scale, window=None, *, ring: bool = False,
     block_k: int = DEFAULT_BLOCK_K, interpret: bool | None = None,
 ):
     """Single-query grouped attention against an int8 cache layer.
 
     q: (B, 1, H, D); ``cache_l``: {"k","v"} int8 (B, L, Hkv, D) +
-    {"k_s","v_s"} f32 (B, L, Hkv); ``pos``: scalar current position
-    (cache entries with kpos <= pos are valid). Returns (B, 1, H, D)
-    in q's dtype — numerically the online-softmax evaluation of the
-    same masked attention ``models/decode.py::_cached_attention``
-    computes in einsum form (pinned by tests/test_decode_attention.py).
+    {"k_s","v_s"} f32 (B, L, Hkv); ``pos``: scalar current position,
+    or a ``(B,)`` vector of PER-ROW positions (the serving scheduler's
+    slots each decode at their own step). Returns (B, 1, H, D) in q's
+    dtype — numerically the online-softmax evaluation of the same
+    masked attention ``models/decode.py::_cached_attention`` computes
+    in einsum form (pinned by tests/test_decode_attention.py).
+
+    ``ring=True`` reads the O(W) ring layout instead (L == W; slot s
+    holds ``kpos(s) = pos - ((pos - s) mod W)``): validity is the one
+    ``kpos >= 0`` predicate of ``_ring_cached_attention`` /
+    ``_ring_attention_rows``, so the batched serving tick and the ring
+    generate scan route the exact same kernel. ``window`` must be None
+    in ring mode — the ring IS the window.
     """
     if interpret is None:
         interpret = _use_interpret()
+    if ring and window is not None:
+        raise ValueError(
+            "ring mode encodes the window in the cache layout; pass "
+            "window=None (the ring length IS the window)"
+        )
     B, T, H, D = q.shape
     if T != 1:
         raise ValueError(f"decode kernel is single-query, got T={T}")
@@ -191,10 +224,14 @@ def quantized_decode_attention(
     rows = Hkv * _SUB
     kf = kc.reshape(B, L, Hkv * D)  # free: (Hkv, D) tail is contiguous
     vf = vc.reshape(B, L, Hkv * D)
-    pos1 = jnp.asarray(pos, jnp.int32).reshape(1)
+    # scalar pos broadcasts to every row; a (B,) vector rides as-is
+    posv = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32).reshape(-1), (B,)
+    )
 
     kern = functools.partial(
-        _kernel, scale=scale, window=window, bk=bk, nk=nk, Hkv=Hkv, D=D
+        _kernel, scale=scale, window=window, bk=bk, nk=nk, Hkv=Hkv,
+        D=D, ring=ring,
     )
     o3 = pl.pallas_call(
         kern,
@@ -215,10 +252,10 @@ def quantized_decode_attention(
             pltpu.VMEM((rows, _LANE), jnp.float32),
             pltpu.VMEM((rows, _LANE), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(pos1, q3, kf, ks, vf, vs)
+    )(posv, q3, kf, ks, vf, vs)
     # (B, Hkv*SUB, D) -> drop each group's padding rows -> (B, 1, H, D)
     return o3.reshape(B, Hkv, _SUB, D)[:, :, :g].reshape(B, 1, H, D)

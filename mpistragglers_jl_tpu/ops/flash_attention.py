@@ -33,12 +33,20 @@ from __future__ import annotations
 import functools
 
 import jax
+from .. import _jax_compat  # noqa: F401  (installs older-JAX aliases)
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30  # matches parallel/ring_attention.py: large-negative mask
 _LANE = 128  # TPU lane width; m/l scratch is broadcast across lanes
+
+# pltpu.CompilerParams is the current spelling; older toolchains (the
+# CPU-only CI image lags the chip host) ship it as TPUCompilerParams —
+# same kwargs, so the kernels stay loadable on both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
 
 
 def _grid_params():
@@ -48,7 +56,7 @@ def _grid_params():
     and must run in order. Without this annotation Mosaic assumes every
     grid axis is sequential — measured 20% slower on the round-3 chip
     (docs/PERF.md)."""
-    return pltpu.CompilerParams(
+    return _CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary")
     )
 
@@ -143,8 +151,11 @@ def _block_mask(i, j, bq, bk, causal, window):
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying ``like``'s varying-mesh-axes, so the
     kernels are callable inside ``shard_map`` (e.g. as the per-device
-    attention of Ulysses) where outputs must declare their vma."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    attention of Ulysses) where outputs must declare their vma.
+    Toolchains without ``jax.typeof`` have no vma tracking either, so
+    the plain struct is the correct degradation there."""
+    typeof = getattr(jax, "typeof", None)
+    vma = getattr(typeof(like), "vma", None) if typeof else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

@@ -39,6 +39,7 @@ permanently dead chip means reforming the mesh, which is the
 from __future__ import annotations
 
 import jax
+from .. import _jax_compat  # noqa: F401  (installs older-JAX aliases)
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -520,17 +521,22 @@ def select_coded_gemm(
     >>> g.selection          # {"picked": ..., "fused_ms": ..., ...}
     >>> decoded = g.epoch(pool, B)
 
-    ``**kw`` (``batch``, ``batch_arrival``, ``precision``, ``parity``,
-    ``dtype``) is forwarded to both candidates.
+    ``**kw`` (``axis``, ``batch``, ``batch_arrival``, ``precision``,
+    ``parity``, ``dtype``) is forwarded to both candidates.
     """
     import time
 
     from ..ops.coded_gemm import CodedGemm
     from ..pool import waitall
 
-    devices = _mesh_axis_devices(mesh, kw.pop("axis", "w"))
+    # pop-and-forward: the axis names BOTH the probe's device order and
+    # the fused candidate's mesh axis (dropping it here crashed every
+    # non-default-axis mesh inside PoolMeshCodedGemm — regression-
+    # pinned in tests/test_fused.py)
+    axis = kw.pop("axis", "w")
+    devices = _mesh_axis_devices(mesh, axis)
     n = int(n_workers) if n_workers is not None else len(devices)
-    fused = PoolMeshCodedGemm(A, mesh, k, n_workers=n, **kw)
+    fused = PoolMeshCodedGemm(A, mesh, k, n_workers=n, axis=axis, **kw)
     dev_map = [devices[i * len(devices) // n] for i in range(n)]
     unfused = _UnfusedCodedGemm(CodedGemm(A, n, k, devices=dev_map, **kw))
 

@@ -86,6 +86,104 @@ def test_kernel_window_band(W):
     )
 
 
+def _ring_oracle(q, cache_l, pos, scale):
+    """The einsum-form ring attention (``_ring_cached_attention`` /
+    ``_ring_attention_rows`` math): slot s holds position
+    ``pos - ((pos - s) mod W)``, valid iff that position is >= 0.
+    ``pos`` may be scalar or (B,) per-row."""
+    W = cache_l["k"].shape[1]
+    B = q.shape[0]
+    posv = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32).reshape(-1), (B,)
+    )
+    s = _cache_scores(q, cache_l, scale)  # (B, H, 1, W)
+    kpos = posv[:, None] - jnp.mod(
+        posv[:, None] - jnp.arange(W)[None, :], W
+    )
+    s = jnp.where((kpos >= 0)[:, None, None, :], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return _cache_pv(p, cache_l).astype(q.dtype)
+
+
+@pytest.mark.parametrize("B", [1, 4, 8])
+@pytest.mark.parametrize("Hq,Hkv", [(8, 2), (4, 4), (8, 1)])
+def test_batched_kernel_per_row_positions_match_oracle(B, Hq, Hkv):
+    """The batched grid with a (B,) position vector — every row at its
+    own decode step, the serving scheduler's shape — matches the
+    einsum oracle row-for-row."""
+    L, D = 256, 128
+    cache = _quant_cache(B, L, Hkv, D, seed=10 * B + Hkv)
+    rng = np.random.default_rng(100 + B)
+    q = jnp.asarray(rng.standard_normal((B, 1, Hq, D)), jnp.float32)
+    scale = D ** -0.5
+    pos = jnp.asarray(rng.integers(0, L, B), jnp.int32)
+    want = jnp.concatenate([
+        _oracle(
+            q[i:i + 1],
+            {kk: vv[i:i + 1] for kk, vv in cache.items()},
+            pos[i], scale,
+        )
+        for i in range(B)
+    ])
+    got = quantized_decode_attention(
+        q, cache, pos, scale, block_k=128, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("B", [1, 4, 8])
+@pytest.mark.parametrize("W", [128, 256])
+@pytest.mark.parametrize("pos", [37, 129, 1000])
+def test_ring_kernel_matches_ring_einsum(B, W, pos):
+    """ring=True reads the O(W) ring layout: warmup (pos < W, stale
+    slots masked), first wrap, and deep-stream positions all match the
+    einsum ring reference."""
+    Hq, Hkv, D = 8, 2, 128
+    cache = _quant_cache(B, W, Hkv, D, seed=W + pos)
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((B, 1, Hq, D)), jnp.float32)
+    scale = D ** -0.5
+    want = _ring_oracle(q, cache, jnp.int32(pos), scale)
+    got = quantized_decode_attention(
+        q, cache, jnp.int32(pos), scale, ring=True, block_k=128,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("Hq,Hkv", [(8, 2), (8, 1), (4, 4)])
+def test_ring_kernel_per_row_positions(Hq, Hkv):
+    """Per-row positions in ring mode — the serving tick's exact call:
+    rows simultaneously in warmup, at the wrap boundary, and deep."""
+    B, W, D = 4, 256, 128
+    cache = _quant_cache(B, W, Hkv, D, seed=Hq)
+    rng = np.random.default_rng(8)
+    q = jnp.asarray(rng.standard_normal((B, 1, Hq, D)), jnp.float32)
+    scale = D ** -0.5
+    pos = jnp.asarray([3, 255, 256, 1000], jnp.int32)
+    want = _ring_oracle(q, cache, pos, scale)
+    got = quantized_decode_attention(
+        q, cache, pos, scale, ring=True, block_k=128, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_ring_rejects_window():
+    cache = _quant_cache(1, 128, 2, 128)
+    q = jnp.zeros((1, 1, 4, 128), jnp.float32)
+    with pytest.raises(ValueError, match="ring"):
+        quantized_decode_attention(
+            q, cache, jnp.int32(0), 1.0, window=64, ring=True,
+            interpret=True,
+        )
+
+
 def test_kernel_block_predication_excludes_future():
     """Blocks wholly past pos (and entries past pos inside a block)
     must not leak: poison the future with huge values."""
@@ -126,7 +224,7 @@ def test_kernel_rides_generation_at_head_dim_128():
     try:
         got = generate_dense(params, prompt, 7, cfg, quantize_kv=True)
     finally:
-        use_decode_kernel(False)
+        use_decode_kernel(None)  # restore the batched-AUTO default
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 

@@ -279,3 +279,29 @@ def test_select_coded_gemm_probes_and_picks(mesh):
     np.testing.assert_allclose(C[: A.shape[0]], A @ B, atol=1e-3)
     waitall(pool, g.backend)
     g.shutdown()
+
+
+def test_select_coded_gemm_forwards_nondefault_axis():
+    """Regression (r5 review): ``select_coded_gemm`` popped ``axis``
+    for its device lookup but never forwarded it to the fused
+    candidate, so any mesh axis not named 'w' crashed inside
+    PoolMeshCodedGemm. A 'pool'-named axis must probe, pick, and
+    decode exactly like the default."""
+    from mpistragglers_jl_tpu.parallel import select_coded_gemm
+
+    mesh = make_mesh(N, ("pool",))
+    rng = np.random.default_rng(6)
+    A = rng.standard_normal((K * 8, 16)).astype(np.float32)
+    B = rng.standard_normal((16, 10)).astype(np.float32)
+    g = select_coded_gemm(A, mesh, K, B, probe_epochs=1, chains=1,
+                          axis="pool", dtype=np.float32)
+    try:
+        assert g.selection["picked"] in ("fused", "unfused")
+        pool = AsyncPool(N)
+        decoded = g.epoch(pool, B)
+        np.testing.assert_allclose(
+            g.full(decoded)[: A.shape[0]], A @ B, atol=1e-3
+        )
+        waitall(pool, g.backend)
+    finally:
+        g.shutdown()

@@ -60,6 +60,31 @@ def test_switch_route_shapes_and_mass():
     assert float(aux) >= 1.0 - 1e-6  # >= 1, == 1 at perfect balance
 
 
+def test_router_stays_f32_and_bf16_routing_matches():
+    """The router weight is never downcast (r5 review item): at a bf16
+    model dtype ``wg`` inits f32 — it is only (D, E), bytes that round
+    to zero next to the expert FFNs — and routing from bf16 activations
+    through the mixed-precision dot (f32 accumulation via
+    preferred_element_type) reproduces the f32 router's decisions:
+    identical argmax/slots, gates to bf16-input tolerance."""
+    from mpistragglers_jl_tpu.models.moe import _route, init_moe_layer
+
+    rng = np.random.default_rng(21)
+    lp = init_moe_layer(rng, d_model=64, d_ff=128, n_experts=4,
+                        n_layers=2, dtype=jnp.bfloat16)
+    assert lp["wg"].dtype == jnp.float32  # not downcast at init
+    assert lp["we1"].dtype == jnp.bfloat16  # experts do follow dtype
+    x = jnp.asarray(rng.standard_normal((256, 64)), jnp.float32)
+    e32, s32, g32, aux32 = _route(x, lp["wg"])
+    eb, sb, gb, auxb = _route(x.astype(jnp.bfloat16), lp["wg"])
+    np.testing.assert_array_equal(np.asarray(eb), np.asarray(e32))
+    np.testing.assert_array_equal(np.asarray(sb), np.asarray(s32))
+    np.testing.assert_allclose(
+        np.asarray(gb), np.asarray(g32), atol=2e-2
+    )
+    np.testing.assert_allclose(float(auxb), float(aux32), atol=2e-2)
+
+
 def test_switch_route_capacity_drops_overflow():
     # all tokens to one expert, capacity 3 -> exactly 3 survive
     x = jnp.ones((10, 4), jnp.float32)

@@ -18,6 +18,7 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mpistragglers_jl_tpu.models.decode import (
+    _aligned_quantized_prefill,
     _kv_quantize,
     decode_step_dense,
     generate_dense,
@@ -143,6 +144,40 @@ def test_ring_quantized_matches_masked_quantized():
     np.testing.assert_array_equal(np.asarray(got_sh), np.asarray(want))
 
 
+def test_aligned_prefill_scan_matches_one_shot():
+    """The quantized ring oracle prefill's ``lax.scan``-ed full chunks
+    are the same math as one directly traced chunk: every position
+    attends the already-quantized cache either way, so the chunk size
+    is invisible (the identity generate_ring_dense's docstring claims).
+    chunk=4 over a 13-token prompt forces the scan body (3 full chunks)
+    plus the ragged tail; chunk=64 traces the whole prompt at once."""
+    cfg = dataclasses.replace(CFG, attn_window=5)
+    params = init_params(cfg, seed=9)
+    prompt = _toks(2, 13, seed=10)
+
+    def run(chunk):
+        c = init_cache(cfg, 2, 13, quantize_kv=True)
+        return _aligned_quantized_prefill(
+            params, prompt, c, cfg, decode_kernel=False, chunk=chunk
+        )
+
+    lg_scan, c_scan = run(4)
+    lg_one, c_one = run(64)
+    # each call returns its LAST chunk's logits; only the final
+    # position overlaps (and it is the one generation consumes)
+    np.testing.assert_allclose(
+        np.asarray(lg_scan[:, -1]), np.asarray(lg_one[:, -1]),
+        atol=1e-4, rtol=0,
+    )
+    for a, b in zip(c_scan, c_one):
+        np.testing.assert_array_equal(
+            np.asarray(a["k"]), np.asarray(b["k"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a["v"]), np.asarray(b["v"])
+        )
+
+
 def test_chunked_extend_quantized_matches_prefill():
     """Streaming prefill vs one-shot with int8 cache. Layer 0's cache
     is BITWISE equal (same embeddings -> same K/V -> same quantizer).
@@ -182,6 +217,81 @@ def test_chunked_extend_quantized_matches_prefill():
                 lb[f"{kk}_s"]
             )[..., None]
             np.testing.assert_allclose(da, db, atol=2e-2)
+
+
+D128 = TransformerConfig(
+    vocab=97, d_model=256, n_heads=2, n_kv_heads=1, n_layers=2,
+    d_ff=256,
+)  # head_dim 128: the decode kernel's lane gate
+
+
+@pytest.mark.parametrize("window", [None, 128])
+def test_batched_auto_kernel_in_scan_matches_einsum(window):
+    """B=4 >= KERNEL_MIN_BATCH: the AUTO default routes the in-scan
+    decode steps through the Pallas int8 kernel (interpreted on the CI
+    mesh) — token streams equal the einsum dequant path exactly, full
+    and sliding-window masks both."""
+    from mpistragglers_jl_tpu.models.decode import (
+        KERNEL_MIN_BATCH,
+        use_decode_kernel,
+    )
+
+    cfg = dataclasses.replace(D128, attn_window=window)
+    params = init_params(cfg, seed=9)
+    B = KERNEL_MIN_BATCH
+    rng = np.random.default_rng(10)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (B, 6)), jnp.int32)
+    use_decode_kernel(False)
+    try:
+        want = generate_dense(params, prompt, 7, cfg, quantize_kv=True)
+    finally:
+        use_decode_kernel(None)  # the AUTO default routes at B >= 4
+    got = generate_dense(params, prompt, 7, cfg, quantize_kv=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_ring_kernel_in_scan_matches_einsum():
+    """The O(W) ring generator at batch: AUTO routes the kernel's
+    ring mode inside the decode scan; streams equal the einsum path."""
+    from mpistragglers_jl_tpu.models.decode import use_decode_kernel
+
+    cfg = dataclasses.replace(D128, attn_window=128)
+    params = init_params(cfg, seed=11)
+    rng = np.random.default_rng(12)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (4, 6)), jnp.int32)
+    use_decode_kernel(False)
+    try:
+        want = generate_ring_dense(params, prompt, 8, cfg,
+                                   quantize_kv=True)
+    finally:
+        use_decode_kernel(None)
+    got = generate_ring_dense(params, prompt, 8, cfg, quantize_kv=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_auto_skips_kernel_below_min_batch():
+    """B=1 under AUTO stays on the einsum path (the per-call scan
+    boundary cost isn't amortized): the program must still match the
+    forced-einsum stream AND the forced-kernel stream — routing is a
+    perf decision, never a numerics one."""
+    from mpistragglers_jl_tpu.models.decode import use_decode_kernel
+
+    params = init_params(D128, seed=13)
+    rng = np.random.default_rng(14)
+    prompt = jnp.asarray(rng.integers(0, D128.vocab, (1, 5)), jnp.int32)
+    auto = generate_dense(params, prompt, 6, D128, quantize_kv=True)
+    use_decode_kernel(False)
+    try:
+        ein = generate_dense(params, prompt, 6, D128, quantize_kv=True)
+    finally:
+        use_decode_kernel(None)
+    use_decode_kernel(True)
+    try:
+        kern = generate_dense(params, prompt, 6, D128, quantize_kv=True)
+    finally:
+        use_decode_kernel(None)
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(ein))
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(kern))
 
 
 def test_shard_cache_places_scale_leaves():

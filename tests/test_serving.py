@@ -233,7 +233,13 @@ def test_admission_time_retirement_in_step_return():
 
 def test_quantized_scheduler_matches_quantized_oracle():
     """quantize_kv=True serves the int8 ring cache end-to-end; streams
-    equal the quantized single-request oracle."""
+    equal the quantized single-request oracle AS AN IDENTITY: the
+    oracle's quantized-ring prefill attends the already-quantized cache
+    (decode.py ``_dense_runner``), the only math the scheduler's
+    chunked admission can evaluate (raw K/V of earlier chunks are gone
+    once written), and per-position absmax quantization makes chunking
+    itself invisible — so exact token equality is the contract, not an
+    empirical coincidence of this checkpoint."""
     sched = ServingScheduler(PARAMS, CFG, slots=2, n_inner=3,
                              prompt_chunk=8, max_prompt=32,
                              quantize_kv=True)
@@ -244,6 +250,35 @@ def test_quantized_scheduler_matches_quantized_oracle():
     for r, p, n in pairs:
         toks = generate_ring_dense(
             PARAMS, jnp.asarray(p)[None], n, CFG, quantize_kv=True
+        )
+        assert r.tokens == [int(t) for t in np.asarray(toks)[0]], (
+            f"request {r.id}"
+        )
+
+
+def test_quantized_scheduler_kernel_tick_matches_oracle():
+    """head_dim-128 config at S=4 slots: the scheduler's tick routes
+    the batched int8 Pallas ring kernel (AUTO gate — S >= 4 amortizes
+    the per-call scan boundary; interpreted on the CI mesh) while the
+    B=1 oracle stays einsum — streams must still be identical, which
+    pins kernel-vs-einsum parity through the full serving path."""
+    cfg = TransformerConfig(
+        vocab=97, d_model=256, n_heads=2, n_kv_heads=1, n_layers=2,
+        d_ff=256, attn_window=128,
+    )
+    params = init_params(cfg, seed=31)
+    sched = ServingScheduler(params, cfg, slots=4, n_inner=3,
+                             prompt_chunk=8, max_prompt=32,
+                             quantize_kv=True)
+    assert sched.use_kernel  # the whole point: the tick is kernelized
+    pairs = [(sched.submit(p, max_new=n), p, n)
+             for p, n in [(_prompt(5), 8), (_prompt(9), 6),
+                          (_prompt(3), 10), (_prompt(7), 7),
+                          (_prompt(12), 5)]]
+    sched.run()
+    for r, p, n in pairs:
+        toks = generate_ring_dense(
+            params, jnp.asarray(p)[None], n, cfg, quantize_kv=True
         )
         assert r.tokens == [int(t) for t in np.asarray(toks)[0]], (
             f"request {r.id}"

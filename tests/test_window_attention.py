@@ -362,4 +362,4 @@ def test_use_decode_kernel_toggle_takes_effect_after_compile():
         generate_dense(params, prompt, 3, cfg, quantize_kv=True)
         assert _dense_runner.cache_info().currsize == n_after_bf16
     finally:
-        use_decode_kernel(False)
+        use_decode_kernel(None)  # restore the batched-AUTO default
