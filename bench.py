@@ -450,6 +450,9 @@ def driver_contract(budget_s: float | None = None) -> dict:
             last_total = time.perf_counter() - t_step
             out["headline_cube"] = cube
         out["adaptive_nwait"] = _try_rung(bench_adaptive_nwait, est=15)
+        # telemetry rung (numpy-only, seconds): every capture from here
+        # on carries a metrics snapshot + the no-op-overhead reading
+        out["observability"] = _try_rung(bench_observability, est=10)
         # round-3 flagship rung block: the REAL train step (shard_map +
         # Ulysses + Pallas flash attention under Mosaic) on this chip.
         # The flagship stays loud-fail (VERDICT r2 item 1: if the
@@ -528,6 +531,8 @@ def _contract_line(out: dict) -> str:
     rungs = {
         "adaptive_speedup": _rung_summary(
             out.get("adaptive_nwait"), "speedup"),
+        "obs_overhead_pct": _rung_summary(
+            out.get("observability"), "overhead_pct"),
         "train_s_per_step": _rung_summary(tt, "value"),
         "train_mfu": _rung_summary(tt, "mfu_vs_raw_matmul"),
         "decode_ms_per_token": _rung_summary(
@@ -789,6 +794,106 @@ def _transformer_rungs(into: dict | None = None):
 
     tt["moe_rung"] = _try_rung(rung_moe, est=60)
     return tt
+
+
+def bench_observability(epochs=50, n=8):
+    """Telemetry rung: the pool loop runs DARK and then INSTRUMENTED
+    (EpochTracer + MetricsRegistry + latency-model publish + a hedged
+    section), so every BENCH capture from here on carries (a) a real
+    metrics snapshot — the series the obs/ registry exports — and (b)
+    the measured cost of the instrumentation against the no-op fast
+    path (the opt-in contract: a dark hot path pays only `is None`
+    checks; tests/test_obs.py pins the scheduler side, this rung
+    measures the pool side end to end). Thread workers with small
+    deterministic delays: epoch wall is milliseconds, instrument cost
+    is microseconds, so overhead_pct ~ 0 is the expected healthy
+    reading."""
+    from mpistragglers_jl_tpu import AsyncPool, LocalBackend, asyncmap, waitall
+    from mpistragglers_jl_tpu.obs import MetricsRegistry
+    from mpistragglers_jl_tpu.utils import (
+        EpochTracer,
+        HedgedServer,
+        PoolLatencyModel,
+        faults,
+    )
+
+    def work(i, payload, epoch):
+        return payload * (i + 1)
+
+    delays = faults.per_worker(
+        [0.001 + 0.0005 * i for i in range(n - 1)] + [0.008]
+    )
+
+    def run(instrumented):
+        backend = LocalBackend(work, n, delay_fn=delays)
+        tracer = EpochTracer() if instrumented else None
+        registry = MetricsRegistry() if instrumented else None
+        model = PoolLatencyModel(n) if instrumented else None
+        epoch_h = (
+            registry.histogram(
+                "pool_epoch_seconds", help="asyncmap wall per epoch"
+            )
+            if instrumented else None
+        )
+        try:
+            pool = AsyncPool(n)
+            payload = np.ones(64, np.float32)
+            asyncmap(pool, payload, backend, nwait=n - 2)  # warmup
+            waitall(pool, backend)
+            t0 = time.perf_counter()
+            for _ in range(epochs):
+                te = time.perf_counter()
+                asyncmap(
+                    pool, payload, backend, nwait=n - 2, tracer=tracer
+                )
+                if instrumented:
+                    epoch_h.observe(time.perf_counter() - te)
+                    model.observe_pool(pool)
+            per_epoch = (time.perf_counter() - t0) / epochs
+            waitall(pool, backend, tracer=tracer)
+            if instrumented:
+                model.publish(registry)
+                srv = HedgedServer(backend, registry=registry)
+                for q in range(8):
+                    srv.request(np.full(4, float(q)), hedge=2)
+                srv.drain()
+        finally:
+            backend.shutdown()
+        return per_epoch, tracer, registry
+
+    dark_s, _, _ = run(False)
+    inst_s, tracer, registry = run(True)
+    s = tracer.summary()
+    snap = registry.snapshot()
+    eh = snap["pool_epoch_seconds"]["series"][0]["value"]
+    return {
+        "noop_epoch_ms": round(dark_s * 1e3, 3),
+        "instrumented_epoch_ms": round(inst_s * 1e3, 3),
+        # thread-scheduling noise can make the instrumented loop read
+        # FASTER than the dark one; clamp at 0 so the digest scalar
+        # reads as "measured overhead", never a nonsense negative
+        "overhead_pct": round(max(inst_s / dark_s - 1.0, 0.0) * 100, 2),
+        "epochs": epochs,
+        "metrics_snapshot": {
+            "series": len(registry),
+            "pool_epoch_seconds_p50": eh["p50"],
+            "pool_epoch_seconds_p95": eh["p95"],
+            "straggler_rate": round(s["straggler_rate"], 4),
+            "delivered_rate": round(s["delivered_rate"], 4),
+            "n_waitall_arrivals": s["n_waitall_arrivals"],
+            "hedge_requests": snap["hedge_requests_total"]["series"][0][
+                "value"
+            ],
+            "hedge_width_mean": round(
+                registry.histogram("hedge_width").mean, 3
+            ),
+            "worker7_latency_mean_s": round(
+                registry.gauge(
+                    "pool_worker_latency_mean_seconds", worker=str(n - 1)
+                ).value, 5,
+            ),
+        },
+    }
 
 
 def bench_adaptive_nwait(epochs=80, n=8):

@@ -29,6 +29,7 @@ the transformer trajectory against direct full-batch SGD.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Sequence
 
 import jax
@@ -43,6 +44,77 @@ from ..ops.gradcode import GradientCode
 from .transformer import TransformerConfig, forward_dense
 
 __all__ = ["CodedGradTrainer", "transformer_chunk_loss"]
+
+
+class _TrainObs:
+    """Instrument bundle for one trainer, resolved once at
+    construction (the opt-in contract shared with the scheduler's
+    ``_ServingObs`` and the pool tracer: a dark trainer's step pays
+    only ``is not None`` checks)."""
+
+    def __init__(self, trainer: "CodedGradTrainer", registry, spans):
+        self.registry = registry
+        self.spans = spans
+        self._r = registry is not None
+        if not self._r:
+            return
+        registry.gauge(
+            "train_workers", help="pool size n of the gradient code"
+        ).set(trainer.n)
+        registry.gauge(
+            "train_code_tolerance",
+            help="stragglers s the cyclic code absorbs",
+        ).set(trainer.s)
+        self.m_steps = registry.counter("train_steps_total")
+        self.m_step_s = registry.histogram(
+            "train_step_seconds",
+            help="asyncmap -> decode -> update wall clock",
+        )
+        self.m_fresh_k = registry.gauge(
+            "train_decode_fresh_k",
+            help="fresh arrivals the last decode recovered from",
+        )
+        self.m_stale = registry.counter(
+            "train_stale_arrivals_total",
+            help="stale pool arrivals (bridged from the EpochTracer)",
+        )
+        self.m_retask = registry.counter(
+            "train_retasks_total",
+            help="immediate re-dispatches (bridged from the EpochTracer)",
+        )
+        self.m_recovered = [
+            registry.counter(
+                "train_worker_recovered_total",
+                help="steps whose decode consumed this worker's shard",
+                worker=str(i),
+            )
+            for i in range(trainer.n)
+        ]
+
+    def step_done(
+        self, trainer: "CodedGradTrainer", fresh, t0: float,
+        epoch_rec,
+    ) -> None:
+        t1 = time.perf_counter()
+        if self._r:
+            self.m_steps.inc()
+            self.m_step_s.observe(t1 - t0)
+            self.m_fresh_k.set(len(fresh))
+            for i in fresh:
+                self.m_recovered[int(i)].inc()
+            if epoch_rec is not None:
+                self.m_stale.inc(epoch_rec.n_stale)
+                self.m_retask.inc(epoch_rec.n_retask)
+        if self.spans is not None:
+            args = {"fresh_k": len(fresh)}
+            if epoch_rec is not None:
+                args["epoch"] = epoch_rec.epoch
+                args["n_stale"] = epoch_rec.n_stale
+                args["n_retask"] = epoch_rec.n_retask
+            self.spans.add(
+                f"coded step ({len(fresh)}/{trainer.n})", t0, t1 - t0,
+                track="train", **args,
+            )
 
 
 def transformer_chunk_loss(cfg: TransformerConfig) -> Callable:
@@ -78,6 +150,15 @@ class CodedGradTrainer:
     SGD; the optimizer state lives coordinator-side and steps on the
     decoded exact gradient, so adaptive moments see the same gradient
     stream a bulk-synchronous run would.
+
+    Observability (all opt-in, zero cost when omitted): ``tracer=`` (an
+    :class:`~..utils.trace.EpochTracer`) threads through every
+    ``asyncmap``/``waitall`` this trainer issues; ``registry=`` records
+    per-step wall clock, which k-of-n workers each decode recovered
+    from, and stale/re-task totals bridged from the tracer's epoch
+    records; ``spans=`` (an :class:`~..obs.SpanRecorder`) draws one
+    span per training step in the merged Perfetto timeline beside the
+    tracer's worker spans.
     """
 
     def __init__(
@@ -92,6 +173,9 @@ class CodedGradTrainer:
         delay_fn: DelayFn | None = None,
         tx=None,
         seed: int = 0,
+        tracer=None,
+        registry=None,
+        spans=None,
     ):
         if devices is None:
             devices = jax.devices()
@@ -133,6 +217,13 @@ class CodedGradTrainer:
         self.backend = XLADeviceBackend(
             self._work, self.n, devices=devices, delay_fn=delay_fn
         )
+        self.tracer = tracer
+        self.last_fresh: np.ndarray = np.array([], dtype=np.int64)
+        self._obs = (
+            _TrainObs(self, registry, spans)
+            if registry is not None or spans is not None
+            else None
+        )
 
         if tx is not None:
             self.opt_state = tx.init(params0)
@@ -147,8 +238,11 @@ class CodedGradTrainer:
         return self._coded_grad(flat_w, stacked, coeffs)
 
     def _decode(self, pool: AsyncPool, dev) -> jax.Array:
-        """Exact mean-of-chunks gradient from the arrived workers."""
+        """Exact mean-of-chunks gradient from the arrived workers.
+        Records the recovery set in ``last_fresh`` — which k-of-n
+        workers this step's gradient actually came from."""
         fresh = pool.fresh_indices()
+        self.last_fresh = fresh
         a = jnp.asarray(self.code.decode_weights(fresh), jnp.float32)
         G = jnp.stack([
             jax.device_put(jnp.asarray(pool.results[i]), dev)
@@ -169,20 +263,32 @@ class CodedGradTrainer:
                 "pass lr for plain SGD, or construct with tx= for optax "
                 "(exactly one of the two)"
             )
+        obs = self._obs
+        t0 = time.perf_counter() if obs is not None else 0.0
         dev = self.backend.devices[0]
         flat_w, _ = ravel_pytree(params)
         flat_w = jax.device_put(flat_w.astype(jnp.float32), dev)
-        asyncmap(pool, flat_w, self.backend, nwait=nwait, epoch=epoch)
+        asyncmap(pool, flat_w, self.backend, nwait=nwait, epoch=epoch,
+                 tracer=self.tracer)
         g_flat = self._decode(pool, dev)
         if self.tx is None:
-            return self._unravel(self._apply_sgd(flat_w, g_flat, lr))
-        g = self._unravel(g_flat)
-        updates, self.opt_state = self.tx.update(
-            g, self.opt_state, params
-        )
-        import optax
+            out = self._unravel(self._apply_sgd(flat_w, g_flat, lr))
+        else:
+            g = self._unravel(g_flat)
+            updates, self.opt_state = self.tx.update(
+                g, self.opt_state, params
+            )
+            import optax
 
-        return optax.apply_updates(params, updates)
+            out = optax.apply_updates(params, updates)
+        if obs is not None:
+            obs.step_done(
+                self, self.last_fresh, t0,
+                self.tracer.records[-1]
+                if self.tracer is not None and self.tracer.records
+                else None,
+            )
+        return out
 
     def full_batch_loss(self, params) -> float:
         """Mean per-chunk loss over all n chunks (each chunk counted
@@ -212,6 +318,7 @@ class CodedGradTrainer:
             params = self.step(pool, params, lr=lr)
             if eval_every is not None and e % eval_every == 0:
                 history.append(self.full_batch_loss(params))
-        # drain in-flight stragglers so the backend is reusable
-        waitall(pool, self.backend)
+        # drain in-flight stragglers so the backend is reusable (traced:
+        # the drains feed summary()'s waitall-aware straggler accounting)
+        waitall(pool, self.backend, tracer=self.tracer)
         return params, history

@@ -67,6 +67,7 @@ compiles and checks against the dense tick.
 from __future__ import annotations
 
 import functools
+import time
 from collections import deque
 from typing import Any, Sequence
 
@@ -77,6 +78,7 @@ from .. import _jax_compat  # noqa: F401  (installs older-JAX aliases)
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..obs.timeline import annotate as _annotate
 from .decode import (
     _NEG,
     _cache_pv,
@@ -459,6 +461,143 @@ def _place_dense(cfg: TransformerConfig):
 
 
 # --------------------------------------------------------------------------
+# observability (obs/ registry + timeline, strictly opt-in)
+# --------------------------------------------------------------------------
+
+
+class _ServingObs:
+    """Instrument bundle for one scheduler, resolved ONCE at
+    construction so the tick path only increments/observes. Built only
+    when a registry or span recorder is attached — a dark scheduler's
+    tick does no observability work beyond ``is not None`` checks (the
+    tracer's opt-in contract, utils/trace.py), which the no-op
+    overhead test in tests/test_obs.py pins.
+    """
+
+    def __init__(self, sched: "ServingScheduler", registry, spans):
+        self.registry = registry
+        self.spans = spans
+        self.annotate = _annotate
+        # tokens delivered in the CURRENT tick (admission first-tokens
+        # + trimmed decode harvest — the same population as
+        # serving_tokens_total, so the per-tick rate and the running
+        # counter always cross-check)
+        self._tick_toks = 0
+        self._r = registry is not None
+        if not self._r:
+            return
+        registry.gauge(
+            "serving_slots", help="configured serving slots"
+        ).set(sched.S)
+        self.m_queue = registry.gauge(
+            "serving_queue_depth",
+            help="requests queued, not yet admitted",
+        )
+        self.m_active = registry.gauge(
+            "serving_active_slots", help="slots decoding or admitting"
+        )
+        self.m_ticks = registry.counter("serving_ticks_total")
+        self.m_tick_s = registry.histogram(
+            "serving_tick_seconds", help="scheduler tick wall clock"
+        )
+        self.m_tokens = registry.counter(
+            "serving_tokens_total",
+            help="tokens delivered into request streams (first tokens "
+            "+ decode harvest, post-retirement trim)",
+        )
+        self.m_tok_rate = registry.gauge(
+            "serving_tokens_per_s",
+            help="tokens delivered / tick wall, last tick",
+        )
+        self.m_ttft = registry.histogram(
+            "serving_ttft_seconds", help="submit -> first token"
+        )
+        self.m_intertoken = registry.histogram(
+            "serving_intertoken_seconds",
+            help="mean per-token gap, one sample per (slot, tick)",
+        )
+        self.m_admitted = registry.counter("serving_admitted_total")
+        self.m_retired = {
+            "eos": registry.counter(
+                "serving_retired_total", reason="eos"
+            ),
+            "length": registry.counter(
+                "serving_retired_total", reason="length"
+            ),
+        }
+        self.m_prefill = registry.counter(
+            "serving_prefill_chunks_total",
+            help="admission prefill chunks advanced",
+        )
+        # the AUTO gate's resolved decision for THIS scheduler (fixed
+        # at construction against its slot count — see use_kernel);
+        # incremented once per decode tick, so the series records when
+        # the kernel route actually fired, not just that it could
+        self.m_route = registry.counter(
+            "serving_kernel_route_total",
+            help="decode ticks by resolved int8-kernel route",
+            route="kernel" if sched.use_kernel else "einsum",
+        )
+
+    # -- hooks (each guards its own registry half) ----------------------
+    def first_token(self, req: "Request", t: float) -> None:
+        self._tick_toks += 1
+        if self._r:
+            self.m_admitted.inc()
+            self.m_tokens.inc()
+            if req._t_submit is not None:
+                self.m_ttft.observe(t - req._t_submit)
+        req._t_last_tok = t
+
+    def tokens_emitted(self, req: "Request", n: int, t: float) -> None:
+        self._tick_toks += n
+        if self._r:
+            self.m_tokens.inc(n)
+            last = req._t_last_tok
+            if last is not None and n:
+                self.m_intertoken.observe((t - last) / n)
+        req._t_last_tok = t
+
+    def prefill_chunk(self) -> None:
+        if self._r:
+            self.m_prefill.inc()
+
+    def tick_done(
+        self, sched: "ServingScheduler", retired, t0: float,
+        t1: float, t2: float | None,
+    ) -> None:
+        """t0 tick begin, t1 admissions done, t2 decode scan fetched
+        (None when no slot decoded this tick)."""
+        t3 = time.perf_counter()
+        wall = t3 - t0
+        n_toks, self._tick_toks = self._tick_toks, 0
+        if self._r:
+            self.m_ticks.inc()
+            self.m_tick_s.observe(wall)
+            self.m_queue.set(sched.pending)
+            self.m_active.set(sched.active)
+            self.m_tok_rate.set(n_toks / wall if wall > 0 else 0.0)
+            if t2 is not None:
+                self.m_route.inc()
+            for req in retired:
+                self.m_retired[req.reason].inc()
+        sp = self.spans
+        if sp is not None:
+            tick = sched.tick_count
+            sp.add(
+                f"tick {tick}", t0, wall, track="scheduler",
+                queue=sched.pending, active=sched.active,
+                tokens=n_toks, retired=len(retired),
+            )
+            sp.add("admit", t0, t1 - t0, track="scheduler")
+            if t2 is not None:
+                sp.add("decode", t1, t2 - t1, track="scheduler")
+                sp.add("retire", t2, t3 - t2, track="scheduler")
+            sp.count("queue_depth", sched.pending, t=t3)
+            sp.count("active_slots", sched.active, t=t3)
+
+
+# --------------------------------------------------------------------------
 # the scheduler
 # --------------------------------------------------------------------------
 
@@ -489,6 +628,10 @@ class Request:
         # the observability hooks the tests and bench read
         self.admitted_tick: int | None = None
         self.retired_tick: int | None = None
+        # latency stamps (perf_counter), set only by an instrumented
+        # scheduler (registry=/spans=): submit time and last-token time
+        self._t_submit: float | None = None
+        self._t_last_tok: float | None = None
         # incremental EOS-scan state (scheduler-internal): index of the
         # first EOS if found, and how many tokens were already scanned
         self._eos_at: int | None = None
@@ -532,13 +675,22 @@ class ServingScheduler:
     ``prompt_chunk`` bounds the decode stall a long prompt can inject
     into in-flight requests (one chunk per tick); ``max_prompt`` sizes
     the transient prefill arena (one compile for all prompt lengths).
+
+    Observability is strictly opt-in (the tracer contract): pass
+    ``registry=`` (an :class:`~..obs.MetricsRegistry`) for tick/queue/
+    slot/tokens-per-s series, TTFT and inter-token histograms, and
+    kernel-route counters, and/or ``spans=`` (an
+    :class:`~..obs.SpanRecorder`) for per-tick admit/decode/retire
+    spans in the merged Perfetto timeline
+    (:func:`~..obs.dump_merged_chrome_trace`). With neither, the tick
+    path does no observability work at all.
     """
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
                  n_inner: int = 8, eos_id: int | None = None,
                  prompt_chunk: int = 256, max_prompt: int = 2048,
                  quantize_kv: bool = False, temperature: float = 0.0,
-                 top_k: int | None = None):
+                 top_k: int | None = None, registry=None, spans=None):
         W = _check_ring_cfg(cfg)
         _check_sampling_params(temperature, top_k)
         if cfg.n_experts:
@@ -588,6 +740,12 @@ class ServingScheduler:
             cfg, self.Lmax, self.temperature, top_k
         )
         self._place = _place_dense(cfg)
+        # instruments resolved once here; None = dark (no tick cost)
+        self._obs = (
+            _ServingObs(self, registry, spans)
+            if registry is not None or spans is not None
+            else None
+        )
 
     # -- public API -----------------------------------------------------
 
@@ -612,7 +770,12 @@ class ServingScheduler:
                 f"prompt of {req.prompt.size} tokens exceeds max_prompt "
                 f"{self.Lmax}; raise max_prompt (one-time recompile)"
             )
+        obs = self._obs
+        if obs is not None:
+            req._t_submit = time.perf_counter()
         self._queue.append(req)
+        if obs is not None and obs._r:
+            obs.m_queue.set(len(self._queue))
         return req
 
     @property
@@ -624,29 +787,60 @@ class ServingScheduler:
     def pending(self) -> int:
         return len(self._queue)
 
+    def _decode_scan_fetch(self) -> np.ndarray:
+        """Run the jitted decode tick and fence the tokens to host."""
+        (self._tok, self._pos, self._done, self._caches,
+         toks) = self._scan(self.params, self._tok, self._pos,
+                            self._done, self._caches, self._keys)
+        return np.asarray(toks)  # (S, n_inner) one fetch per tick
+
     def step(self) -> list[Request]:
         """One scheduler tick; returns the requests retired in it
         (including any that retire at admission — max_new == 1 or a
-        first-token EOS)."""
+        first-token EOS). When instrumented (``registry=``/``spans=``)
+        the tick records admit/decode/retire spans and the queue/slot/
+        token series; dark, the only additions to the hot path are
+        ``obs is not None`` checks."""
+        obs = self._obs
+        t0 = time.perf_counter() if obs is not None else 0.0
         self.tick_count += 1
         retired: list[Request] = []
         self._advance_admissions(retired)
         self._admit_from_queue(retired)
+        t1 = time.perf_counter() if obs is not None else 0.0
+        t2 = None
         decoding = [
             s for s, r in enumerate(self._slot_req)
             if r is not None and s not in self._admitting
         ]
         if decoding:
-            (self._tok, self._pos, self._done, self._caches,
-             toks) = self._scan(self.params, self._tok, self._pos,
-                                self._done, self._caches, self._keys)
-            host = np.asarray(toks)  # (S, n_inner) one fetch per tick
+            if obs is None:
+                host = self._decode_scan_fetch()
+            else:
+                # device-side span: visible inside jax.profiler traces
+                # on real chips, a no-op wherever the profiler is not
+                with obs.annotate("serving.decode_scan"):
+                    host = self._decode_scan_fetch()
+                t2 = time.perf_counter()
             for s in decoding:
                 req = self._slot_req[s]
+                n_before = len(req.tokens) if obs is not None else 0
                 req.tokens.extend(int(t) for t in host[s])
-                if self._retire_if_due(req):
+                due = self._retire_if_due(req)
+                if obs is not None:
+                    # count AFTER the retirement trim: the EOS-clamped
+                    # tail the host strips was never delivered to
+                    # anyone, and a tokens/s series inflated by it
+                    # would overstate throughput by up to n_inner-1
+                    # per retiring request
+                    obs.tokens_emitted(
+                        req, len(req.tokens) - n_before, t2
+                    )
+                if due:
                     self._free_slot(s)
                     retired.append(req)
+        if obs is not None:
+            obs.tick_done(self, retired, t0, t1, t2)
         return retired
 
     def run(self, max_ticks: int = 10_000) -> None:
@@ -697,6 +891,8 @@ class ServingScheduler:
             self.params, chunk, st.cache, jnp.int32(i * self.C)
         )
         st.next_chunk += 1
+        if self._obs is not None:
+            self._obs.prefill_chunk()
         if st.next_chunk < st.n_chunks:
             return
         Tp = st.req.prompt.size
@@ -712,6 +908,8 @@ class ServingScheduler:
             self._keys, jnp.int32(s), tok0, jnp.int32(Tp), rkey,
         )
         st.req.tokens.append(int(tok0))
+        if self._obs is not None:
+            self._obs.first_token(st.req, time.perf_counter())
         del self._admitting[s]
         if self._retire_if_due(st.req):  # max_new == 1 or prompt EOS
             self._free_slot(s)

@@ -1,0 +1,38 @@
+"""Runtime observability: metrics registry + unified Perfetto timeline.
+
+The subsystem the reference never had (its only telemetry is the
+per-worker ``pool.latency`` field — SURVEY §5 "Metrics / logging:
+absent") and the tracer alone does not cover: :mod:`.metrics` is a
+zero-dependency, thread-safe series store (counters, gauges, fixed
+log-bucket histograms) with JSON and Prometheus text exports;
+:mod:`.timeline` records host-side spans (scheduler ticks, training
+steps) and merges them with :class:`~..utils.trace.EpochTracer` pool
+timelines into one Chrome/Perfetto trace.
+
+Everything here is strictly OPT-IN, mirroring the tracer contract:
+instrumented layers (``ServingScheduler``, ``CodedGradTrainer``,
+``CodedGemm``, ``HedgedServer``) accept ``registry=``/``spans=`` and
+pay nothing — no allocation, no clock reads — when neither is passed.
+Stdlib-only at import: the package root's jax-free import contract
+holds.
+"""
+
+from .metrics import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from .timeline import SpanRecorder, annotate, dump_merged_chrome_trace
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "DEFAULT_BUCKETS",
+    "SpanRecorder",
+    "annotate",
+    "dump_merged_chrome_trace",
+]

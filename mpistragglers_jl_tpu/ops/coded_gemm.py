@@ -74,6 +74,7 @@ class CodedGemm:
         precision: jax.lax.Precision | None = jax.lax.Precision.HIGHEST,
         batch: bool = False,
         batch_arrival: str = "ready",
+        registry=None,
     ):
         """``batch=True`` turns on coalesced dispatch: all pool workers
         sharing a device run as ONE fused stacked-einsum program per
@@ -82,7 +83,12 @@ class CodedGemm:
         round-trip — the dominant epoch cost — at the price of per-worker
         straggler independence on that chip (which a time-sliced single
         chip does not truly have anyway; a real slice runs one worker
-        per device and is unaffected). Incompatible with ``delay_fn``."""
+        per device and is unaffected). Incompatible with ``delay_fn``.
+
+        ``registry=`` (an :class:`~..obs.MetricsRegistry`, opt-in like
+        the pool's ``tracer=``) counts decodes and records, per worker,
+        how often the fastest-k recovery actually consumed its shard —
+        the "which k of n fired" series the straggler story needs."""
         if dtype is not None:
             A = np.asarray(A, dtype=dtype)
         m = A.shape[0]
@@ -121,6 +127,34 @@ class CodedGemm:
             batch_fn=self._batch_work if batch else None,
             batch_arrival=batch_arrival,
         )
+        # opt-in decode telemetry (instruments resolved once; None =
+        # dark, result_device pays one `is None` check)
+        self._m = None
+        if registry is not None:
+            registry.gauge(
+                "coded_gemm_n", help="workers n of the MDS code"
+            ).set(n)
+            registry.gauge(
+                "coded_gemm_k", help="recovery threshold k"
+            ).set(k)
+            self._m = {
+                "decodes": registry.counter(
+                    "coded_gemm_decodes_total",
+                    help="full products decoded",
+                ),
+                "fresh_k": registry.gauge(
+                    "coded_gemm_last_fresh",
+                    help="fresh shards available at the last decode",
+                ),
+                "recovered": [
+                    registry.counter(
+                        "coded_gemm_worker_recovered_total",
+                        help="decodes that consumed this worker's shard",
+                        worker=str(i),
+                    )
+                    for i in range(n)
+                ],
+            }
 
     def _work(self, i: int, payload: jax.Array, epoch: int) -> jax.Array:
         return _block_matmul(self.blocks[i], payload, precision=self.precision)
@@ -150,6 +184,11 @@ class CodedGemm:
                 f"{pool.epoch if epoch is None else epoch}, need k={self.k}"
             )
         idx = fresh[: self.k]
+        if self._m is not None:
+            self._m["decodes"].inc()
+            self._m["fresh_k"].set(fresh.size)
+            for i in idx:
+                self._m["recovered"][int(i)].inc()
         results = [pool.results[i] for i in idx]
         # batch-mode fast path: the k winners are lazy views of ONE
         # fused stack — decode straight off it in a single device

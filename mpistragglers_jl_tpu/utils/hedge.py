@@ -50,9 +50,17 @@ class HedgedServer:
     returns the first arrival. Explicit ``replicas=[...]`` overrides
     the choice (the caller owns disjointness then — a rank busy in
     another subset raises from the backend's slot check).
+
+    ``registry=`` (an :class:`~..obs.MetricsRegistry`, opt-in like the
+    pool's ``tracer=``) exports the hedge's behavior as first-class
+    series — requests, dispatched widths (fire rate), narrowed hedges,
+    winner latency, per-rank wins, loser failures, dead replicas — so
+    operators read the state the server already tracks publicly
+    (``history``, ``last_hedge_width``, ``failures``) as live metrics
+    instead of reaching into attributes.
     """
 
-    def __init__(self, backend: Backend):
+    def __init__(self, backend: Backend, *, registry=None):
         self.backend = backend
         self._pools: dict[tuple[int, ...], AsyncPool] = {}
         self._rr = 0  # round-robin cursor over backend ranks
@@ -65,6 +73,48 @@ class HedgedServer:
         # caller repairs it (backend.respawn + reset_dead)
         self.failures: list[WorkerFailure] = []
         self._dead: set[int] = set()
+        # opt-in metrics, instruments resolved once (None = dark)
+        self._m = None
+        if registry is not None:
+            n = backend.n_workers
+            self._m = {
+                "requests": registry.counter("hedge_requests_total"),
+                "dispatches": registry.counter(
+                    "hedge_dispatches_total",
+                    help="replica dispatches (sum of hedge widths "
+                    "actually fired)",
+                ),
+                "width": registry.histogram(
+                    "hedge_width",
+                    help="replicas dispatched per request",
+                    buckets=tuple(float(b) for b in range(1, n + 1)),
+                ),
+                "narrowed": registry.counter(
+                    "hedge_narrowed_total",
+                    help="requests whose hedge narrowed below the "
+                    "requested width (losers held ranks)",
+                ),
+                "latency": registry.histogram(
+                    "hedge_winner_latency_seconds",
+                    help="first-arrival round trip per request",
+                ),
+                "wins": [
+                    registry.counter(
+                        "hedge_wins_total",
+                        help="requests this rank answered first",
+                        rank=str(r),
+                    )
+                    for r in range(n)
+                ],
+                "loser_failures": registry.counter(
+                    "hedge_loser_failures_total",
+                    help="losing dispatches that died (rank benched)",
+                ),
+                "dead": registry.gauge(
+                    "hedge_dead_replicas",
+                    help="ranks benched until repair",
+                ),
+            }
 
     # -- busy/harvest bookkeeping ---------------------------------------
 
@@ -89,6 +139,9 @@ class HedgedServer:
                     # the rank, keep serving
                     self.failures.append(e)
                     self._dead.add(int(pool.ranks[i]))
+                    if self._m is not None:
+                        self._m["loser_failures"].inc()
+                        self._m["dead"].set(len(self._dead))
                 pool.active[int(i)] = False
 
     def _busy_ranks(self) -> set[int]:
@@ -190,12 +243,23 @@ class HedgedServer:
         winner = (pool.results[i], int(pool.ranks[i]),
                   float(pool.latency[i]))
         self.history.append(winner[1:] + (len(ranks),))
+        if self._m is not None:
+            m = self._m
+            m["requests"].inc()
+            m["dispatches"].inc(len(ranks))
+            m["width"].observe(len(ranks))
+            if replicas is None and len(ranks) < hedge:
+                m["narrowed"].inc()
+            m["latency"].observe(winner[2])
+            m["wins"][winner[1]].inc()
         return winner
 
     def reset_dead(self, rank: int) -> None:
         """Return a repaired replica (e.g. after ``backend.respawn``)
         to the rotation."""
         self._dead.discard(int(rank))
+        if self._m is not None:
+            self._m["dead"].set(len(self._dead))
         for pool in self._pools.values():
             if rank in pool.ranks:
                 pool.reset_worker(pool._idx_of_rank[int(rank)])
@@ -214,3 +278,6 @@ class HedgedServer:
                     # retry drains only the remaining workers
                     self.failures.append(e)
                     self._dead.add(int(e.worker))
+                    if self._m is not None:
+                        self._m["loser_failures"].inc()
+                        self._m["dead"].set(len(self._dead))

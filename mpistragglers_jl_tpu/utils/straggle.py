@@ -307,6 +307,41 @@ class PoolLatencyModel:
     def summary(self) -> list[dict]:
         return [w.to_dict() for w in self.workers]
 
+    def publish(self, registry, *, prefix: str = "pool_worker") -> None:
+        """Write the current per-worker fits into ``registry`` gauges
+        (one ``worker=<i>``-labeled series per instrument): sample
+        count, fitted mean, service floor (``shift``), exponential tail
+        rate, and CUSUM change-point resets. Call after
+        :meth:`observe_pool` at whatever cadence the scrape needs —
+        gauges overwrite, so the registry always shows the live fit and
+        the model's internals stay private. A worker with no tail
+        (``rate == inf``) publishes rate 0 (Prometheus has no inf
+        convention for "all samples at the floor")."""
+        for i, w in enumerate(self.workers):
+            lbl = {"worker": str(i)}
+            registry.gauge(
+                f"{prefix}_latency_samples",
+                help="latency samples in the current fit", **lbl,
+            ).set(w.count)
+            registry.gauge(
+                f"{prefix}_latency_mean_seconds",
+                help="fitted mean round-trip", **lbl,
+            ).set(w.mean)
+            registry.gauge(
+                f"{prefix}_latency_floor_seconds",
+                help="fitted service floor (shift)", **lbl,
+            ).set(w.shift)
+            rate = w.rate
+            registry.gauge(
+                f"{prefix}_latency_tail_rate_hz",
+                help="fitted exponential tail rate (0 = no tail "
+                "observed)", **lbl,
+            ).set(0.0 if not np.isfinite(rate) else rate)
+            registry.gauge(
+                f"{prefix}_cusum_resets",
+                help="change-points detected on this worker", **lbl,
+            ).set(w.resets)
+
 
 class AdaptiveNwait:
     """Epoch-to-epoch ``nwait`` controller.

@@ -150,12 +150,16 @@ class EpochTracer:
             for r in self.records:
                 f.write(json.dumps(r.to_dict()) + "\n")
 
-    def dump_chrome_trace(self, path) -> int:
-        """Export the timeline in Chrome trace-event format (open in
-        ui.perfetto.dev or chrome://tracing). One track per worker with a
-        span per task (dispatch -> arrival, stale spans flagged), plus a
-        coordinator track with one span per ``asyncmap``/``waitall``
-        call. Returns the number of events written.
+    def chrome_events(
+        self, pid: int = 0
+    ) -> tuple[list[dict[str, Any]], list[dict[str, Any]]]:
+        """(metadata events, span events) in Chrome trace-event form
+        under process ``pid`` — the merge contract consumed by
+        :func:`~..obs.timeline.dump_merged_chrome_trace`, which lays a
+        pool timeline beside scheduler/training span recorders. One
+        track per worker with a span per task (dispatch -> arrival,
+        stale spans flagged), plus a coordinator track with one span
+        per ``asyncmap``/``waitall`` call.
 
         Spans may cross record boundaries: a payload dispatched in epoch
         N and drained in epoch N+1 (the reference's late-arrival harvest,
@@ -167,7 +171,7 @@ class EpochTracer:
         for r in self.records:
             events.append({
                 "name": f"{r.call}(epoch={r.epoch}, nwait={r.nwait})",
-                "ph": "X", "pid": 0, "tid": -1,
+                "ph": "X", "pid": pid, "tid": -1,
                 "ts": r.t_begin * us, "dur": r.wall * us,
                 "args": {"n_fresh": r.n_fresh, "n_stale": r.n_stale,
                          "n_retask": r.n_retask},
@@ -184,33 +188,61 @@ class EpochTracer:
                     events.append({
                         "name": f"epoch {sepoch}"
                         + ("" if e.fresh else " (stale)"),
-                        "ph": "X", "pid": 0, "tid": e.worker,
+                        "ph": "X", "pid": pid, "tid": e.worker,
                         "ts": t0 * us, "dur": (t_abs - t0) * us,
                         "args": {"fresh": bool(e.fresh), "kind": e.kind},
                     })
         meta = [
-            {"name": "thread_name", "ph": "M", "pid": 0, "tid": -1,
+            {"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": "pool"}},
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": -1,
              "args": {"name": "coordinator"}},
         ] + [
-            {"name": "thread_name", "ph": "M", "pid": 0, "tid": w,
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": w,
              "args": {"name": f"worker {w}"}}
             for w in sorted({e["tid"] for e in events if e["tid"] >= 0})
         ]
+        return meta, events
+
+    def dump_chrome_trace(self, path) -> int:
+        """Export the timeline in Chrome trace-event format (open in
+        ui.perfetto.dev or chrome://tracing) — see :meth:`chrome_events`
+        for the track layout. Returns the number of events written."""
+        meta, events = self.chrome_events()
         with open(path, "w") as f:
             json.dump({"traceEvents": meta + events}, f)
         return len(events)
 
     def summary(self) -> dict[str, Any]:
-        """Aggregate statistics over recorded asyncmap epochs.
+        """Aggregate statistics over ALL recorded calls.
 
-        ``straggler_rate``: fraction of dispatches that did NOT come back
-        fresh within their epoch (the straggle the pool absorbed).
-        ``latency_p50/p95``: distribution over all fresh-arrival
-        round-trips.
+        Arrival totals span asyncmap AND waitall records: a dispatch
+        harvested only by a later ``waitall`` used to vanish from the
+        accounting entirely (counted dispatched, its arrival dropped),
+        so a traced ``fit()`` loop under-reported stale results by
+        exactly its shutdown drain. ``n_waitall_arrivals`` breaks those
+        drains out, and ``delivered_rate`` is the fraction of
+        dispatches that eventually produced ANY arrival in the trace
+        (< 1 means tasks were still in flight when tracing stopped).
+
+        ``straggler_rate`` keeps its original meaning — the fraction of
+        dispatches that did NOT come back fresh within their own
+        ``asyncmap`` epoch (the straggle the pool absorbed); a waitall
+        drain arriving after the fastest-k cut is still a straggle, it
+        just no longer disappears from ``n_fresh``/``n_stale``.
+
+        Asyncmap-only fields (a waitall drains whatever is in flight —
+        its wall measures the drain, and its arrivals' round-trips span
+        call boundaries): ``epochs``, ``wall_total/mean/p95_s``,
+        ``arrival_p50/p95_s`` (fresh within-epoch round-trips). A
+        waitall-only trace (a tracer attached just to a shutdown drain)
+        still reports the full key set — ``epochs`` 0, wall/arrival
+        fields None, the arrival totals real.
         """
-        maps = [r for r in self.records if r.call == "asyncmap"]
-        if not maps:
+        if not self.records:
             return {"epochs": 0}
+        maps = [r for r in self.records if r.call == "asyncmap"]
+        waits = [r for r in self.records if r.call == "waitall"]
         walls = np.array([r.wall for r in maps])
         lat = np.array(
             [
@@ -223,17 +255,26 @@ class EpochTracer:
         dispatched = sum(
             1 for r in maps for e in r.events if e.kind in ("dispatch", "retask")
         )
-        fresh = sum(r.n_fresh for r in maps)
+        fresh_in_epoch = sum(r.n_fresh for r in maps)
+        fresh = fresh_in_epoch + sum(r.n_fresh for r in waits)
+        stale = sum(r.n_stale for r in self.records)
         return {
             "epochs": len(maps),
-            "wall_total_s": float(walls.sum()),
-            "wall_mean_s": float(walls.mean()),
-            "wall_p95_s": float(np.percentile(walls, 95)),
+            "wall_total_s": float(walls.sum()) if maps else None,
+            "wall_mean_s": float(walls.mean()) if maps else None,
+            "wall_p95_s": float(np.percentile(walls, 95))
+            if maps else None,
             "n_dispatched": dispatched,
             "n_fresh": fresh,
-            "n_stale": sum(r.n_stale for r in maps),
-            "n_retask": sum(r.n_retask for r in maps),
-            "straggler_rate": float(1.0 - fresh / dispatched)
+            "n_stale": stale,
+            "n_retask": sum(r.n_retask for r in self.records),
+            "n_waitall_arrivals": sum(
+                r.n_fresh + r.n_stale for r in waits
+            ),
+            "straggler_rate": float(1.0 - fresh_in_epoch / dispatched)
+            if dispatched
+            else 0.0,
+            "delivered_rate": float(min(fresh + stale, dispatched) / dispatched)
             if dispatched
             else 0.0,
             "arrival_p50_s": float(np.percentile(lat, 50)) if lat.size else None,

@@ -122,6 +122,27 @@ def test_serving_decode_example():
 
 
 @pytest.mark.slow
+def test_observability_demo(tmp_path):
+    out = _run_example(
+        "observability_demo.py", str(tmp_path),
+        env_extra={"JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "open in ui.perfetto.dev" in out.stdout
+    assert "observability demo ok" in out.stdout
+    # the artifacts really exist and the trace is valid trace-event JSON
+    import json
+
+    doc = json.loads((tmp_path / "unified_trace.json").read_text())
+    assert any(
+        e.get("name", "").startswith("tick ")
+        for e in doc["traceEvents"]
+    )
+    prom = (tmp_path / "metrics.prom").read_text()
+    assert "serving_ttft_seconds_bucket" in prom
+
+
+@pytest.mark.slow
 def test_continuous_batching_example():
     out = _run_example(
         "continuous_batching.py",
