@@ -270,7 +270,7 @@ def _probe_raw_rate() -> float:
     return 2.0 * 1024**3 / max(best, 1e-9)
 
 
-def _try_rung(fn, est: float = 60.0, **kw):
+def _try_rung(fn, est: float = 60.0, scale: bool = True, **kw):
     """Round-4 auxiliary rungs record a VISIBLE error instead of
     zeroing out the whole contract on a transient tunnel failure (the
     axon link can flake mid-session — docs/PERF.md drift notes). The
@@ -290,7 +290,11 @@ def _try_rung(fn, est: float = 60.0, **kw):
     that accumulation."""
     import gc
 
-    est = est * _EST_SCALE  # chip estimate -> this machine (see above)
+    if scale:
+        # chip estimate -> this machine (see above). scale=False is
+        # for device-free rungs (graftcheck's AST walk) whose cost
+        # does not track the matmul rate the calibration measures.
+        est = est * _EST_SCALE
     left = _budget_left()
     if left is not None and left < est:
         return {
@@ -409,6 +413,14 @@ def driver_contract(budget_s: float | None = None) -> dict:
             "raw_matmul_gflops": round(rate / 1e9, 1),
             "est_scale": round(_EST_SCALE, 1),
         }
+        # static-analysis rung FIRST, with the machine-calibration
+        # scaling OFF (scale=False): pure-stdlib AST over ~70 files,
+        # ~1 s on any machine — its cost does not track the matmul
+        # rate, so the calibration factor must never inflate its
+        # estimate into a bogus budget skip
+        out["graftcheck"] = _try_rung(
+            bench_graftcheck, est=5, scale=False
+        )
         # headline: never budget-skipped, loud-fail (it IS the
         # contract) — but SIZED by measurement. Each ladder step is a
         # complete config-3 bench at that cube; the next step runs only
@@ -529,6 +541,7 @@ def _contract_line(out: dict) -> str:
         serving if ("skipped" in serving or "error" in serving) else None
     )
     rungs = {
+        "graftcheck": _rung_summary(out.get("graftcheck"), "digest"),
         "adaptive_speedup": _rung_summary(
             out.get("adaptive_nwait"), "speedup"),
         "obs_overhead_pct": _rung_summary(
@@ -569,6 +582,50 @@ def _contract_line(out: dict) -> str:
         line["rungs"] = {"dropped": "line cap"}
         s = json.dumps(line, default=str)
     return s
+
+
+def bench_graftcheck():
+    """Static-analysis rung: the graftcheck self-run over the shipped
+    package as a measured contract entry (ISSUE 3 CI wiring) — rule
+    count, fresh/baselined finding counts, baseline size, wall clock.
+    The analyzer is stdlib-ast-only (no jax import of its own;
+    tests/test_graftcheck.py pins that in a clean subprocess), runs
+    uncached here so ``runtime_s`` is the honest cold cost, and a
+    non-empty fresh set is recorded as this rung's error — the same
+    state that fails tier-1. The compact digest scalar is
+    ``digest`` = rules r / fresh f / baseline b / seconds
+    (benchmarks/README.md)."""
+    from mpistragglers_jl_tpu.tools.graftcheck import (
+        DEFAULT_BASELINE,
+        run as graftcheck_run,
+    )
+
+    pkg = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "mpistragglers_jl_tpu",
+    )
+    t0 = time.perf_counter()
+    res = graftcheck_run([pkg], baseline_path=DEFAULT_BASELINE)
+    dt = time.perf_counter() - t0
+    out = {
+        "rules": res.n_rules,
+        "files": res.n_files,
+        "fresh": len(res.fresh),
+        "baselined": len(res.baselined),
+        "suppressed": len(res.suppressed),
+        "baseline_size": res.baseline_size,
+        "runtime_s": round(dt, 3),
+        "digest": (
+            f"{res.n_rules}r/{len(res.fresh)}f/"
+            f"b{res.baseline_size}/{dt:.2f}s"
+        ),
+    }
+    if res.fresh:
+        out["error"] = (
+            f"{len(res.fresh)} fresh findings: "
+            + "; ".join(f.format() for f in res.fresh[:5])
+        )
+    return out
 
 
 def bench_rateless_overhead(m=2048, ncols=256, n=8, k=8, seeds=(0, 1, 2)):

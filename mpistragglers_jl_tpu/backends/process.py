@@ -154,7 +154,10 @@ class ProcessBackend(SlotBackend):
         child.close()  # parent keeps only its end; EOF works
         self._conns[i] = parent
         self._procs[i] = proc
-        self._dead[i] = False
+        # _dead is written from reader threads too (_on_worker_death);
+        # all its writers take the completion lock (GC005)
+        with self._cond:
+            self._dead[i] = False
         reader = threading.Thread(
             target=self._reader_loop, args=(i,), daemon=True,
             name=f"pool-proc-reader-{i}",
@@ -205,10 +208,13 @@ class ProcessBackend(SlotBackend):
         capability the reference lacks (dead rank hangs ``Waitall!``)."""
         if self._conns[i] is not conn:
             return  # stale EOF from a pre-respawn incarnation
-        self._dead[i] = True
         # fail the outstanding task on EVERY tag channel: the process is
-        # gone, so no channel's completion can ever arrive
+        # gone, so no channel's completion can ever arrive. The _dead
+        # stamp shares the same lock acquisition — this runs on the
+        # reader thread while _start/_spawn_worker write the flag from
+        # the coordinator (GC005 lock discipline)
         with self._cond:
+            self._dead[i] = True
             pending = [
                 (tag, slots[i].seq)
                 for tag, slots in self._channels.items()
@@ -236,7 +242,8 @@ class ProcessBackend(SlotBackend):
             with self._send_lock:
                 self._conns[i].send((seq, payload, epoch, tag))
         except (BrokenPipeError, OSError):
-            self._dead[i] = True
+            with self._cond:  # racing _on_worker_death's stamp (GC005)
+                self._dead[i] = True
             self._complete(
                 i, seq, WorkerError(i, epoch, WorkerProcessDied(i)), tag
             )

@@ -35,7 +35,15 @@ Design (TPU-first):
   stall in-flight requests behind a long prompt: each tick advances
   every admitting request by ONE C-token prefill chunk (through the
   masked cached-attention path, exactly ``make_extend``'s semantics)
-  and then runs the decode scan. A request's prefill lands in a
+  and then runs the decode scan. With ``quantize_kv=True`` each chunk
+  attends the already-quantized cache — the only math available once
+  earlier chunks' raw K/V are gone — and per-position absmax
+  quantization makes the chunk size invisible, so the stream is
+  IDENTICAL at any ``prompt_chunk`` and equals the quantized oracle
+  (``generate_ring_dense(quantize_kv=True)``, whose prefill runs the
+  same cached-attention math — ADVICE r5 repaired in PR 1; both the
+  identity and its chunk-invariance premise are pinned by
+  tests/test_serving.py). A request's prefill lands in a
   transient positional cache; on the last chunk the final-W window
   gathers into its slot's ring rows (``ring_from_cache`` math with a
   traced length) and the first token comes from the last chunk's

@@ -1,0 +1,10 @@
+"""Developer tooling shipped with the package (stdlib-only).
+
+Nothing here is imported by the runtime: the tools layer sits beside
+the library, not under it, so ``import mpistragglers_jl_tpu`` never
+pays for an analyzer and the analyzers never import the device stack
+they inspect. Current tools:
+
+* :mod:`.graftcheck` — the project-invariant static-analysis suite
+  (``python -m mpistragglers_jl_tpu.tools.graftcheck``).
+"""

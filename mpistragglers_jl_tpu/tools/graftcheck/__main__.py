@@ -1,0 +1,147 @@
+"""CLI: ``python -m mpistragglers_jl_tpu.tools.graftcheck [paths]``.
+
+Exit codes: 0 clean, 1 fresh findings, 2 configuration error (invalid
+or stale baseline, unknown rule, bad path). Default scan target is the
+package this tool ships inside; default baseline is the checked-in
+``baseline.json`` beside the tool. The per-file result cache lives in
+the system temp dir keyed by scan root (``--no-cache`` disables,
+``--cache PATH`` relocates).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+from . import DEFAULT_BASELINE, BaselineError, all_checkers, run
+
+
+def _default_target() -> str:
+    # tools/graftcheck/__main__.py -> the package root two levels up
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def _default_cache(paths: list[str]) -> str:
+    """Per-user private cache dir (0700) under the temp root: on a
+    shared box the default cache path must not be a predictable
+    world-writable file another user can pre-create to feed the gate
+    poisoned results."""
+    key = hashlib.sha256(
+        "\0".join(os.path.abspath(p) for p in paths).encode()
+    ).hexdigest()[:16]
+    uid = os.getuid() if hasattr(os, "getuid") else "u"
+    d = os.path.join(tempfile.gettempdir(), f"graftcheck-{uid}")
+    try:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+    except OSError:
+        d = tempfile.mkdtemp(prefix="graftcheck-")
+    return os.path.join(d, f"cache-{key}.json")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="project-invariant static analysis (GC001-GC005)",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/directories to scan (default: the package)",
+    )
+    ap.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help="baseline JSON (default: the checked-in one); "
+        "'none' disables",
+    )
+    ap.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule subset (e.g. GC001,GC005)",
+    )
+    ap.add_argument("--cache", default=None, help="cache file path")
+    ap.add_argument(
+        "--no-cache", action="store_true",
+        help="disable the per-file result cache",
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable report on stdout",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    ap.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="findings only, no summary line",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, chk in all_checkers().items():
+            print(f"{rule}  {chk.name}: {chk.description}")
+        return 0
+
+    paths = args.paths or [_default_target()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"graftcheck: no such path: {p}", file=sys.stderr)
+            return 2
+    baseline = (
+        None if args.baseline in ("none", "") else args.baseline
+    )
+    cache = (
+        None if args.no_cache
+        else (args.cache or _default_cache(paths))
+    )
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules else None
+    )
+
+    t0 = time.perf_counter()
+    try:
+        result = run(
+            paths, baseline_path=baseline, cache_path=cache,
+            rules=rules,
+        )
+    except (BaselineError, ValueError, SyntaxError, OSError) as e:
+        # OSError: a file vanished or became unreadable mid-scan —
+        # an environment failure, which must exit 2 like every other
+        # config error, never 1 (the "fresh findings" code)
+        print(f"graftcheck: {e}", file=sys.stderr)
+        return 2
+    dt = time.perf_counter() - t0
+
+    if args.as_json:
+        print(json.dumps({
+            "fresh": [f.__dict__ for f in result.fresh],
+            "baselined": [f.__dict__ for f in result.baselined],
+            "suppressed": [f.__dict__ for f in result.suppressed],
+            "files": result.n_files,
+            "rules": result.n_rules,
+            "baseline_size": result.baseline_size,
+            "runtime_s": round(dt, 3),
+            "ok": result.ok,
+        }))
+    else:
+        for f in result.fresh:
+            print(f.format())
+        if not args.quiet:
+            print(
+                f"graftcheck: {len(result.fresh)} fresh finding(s), "
+                f"{len(result.baselined)} baselined, "
+                f"{len(result.suppressed)} suppressed — "
+                f"{result.n_files} files x {result.n_rules} rules "
+                f"in {dt:.2f}s",
+                file=sys.stderr,
+            )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""The shipped rule set. Importing this package registers every
+checker with :mod:`..core`'s registry (the ``@register`` decorators
+run at import); :func:`~..core.all_checkers` imports it lazily.
+
+Rule catalog (details in each module's docstring and docs/API.md):
+
+====== ==================== ==========================================
+GC001  import-hygiene       package-root import closure stays free of
+                            jax/accelerator stacks (module-level walk)
+GC002  compat-shim          shimmed jax APIs reached only after a
+                            module-level ``_jax_compat`` import;
+                            ``pltpu.CompilerParams`` only in
+                            ops/flash_attention.py
+GC003  tracer-leak          no host clocks / host RNG / ``.item()`` /
+                            casts or Python branches on traced args in
+                            jitted functions and lax bodies
+GC004  dark-path            registry/spans/tracer kwargs default None,
+                            dereferences guarded; literal metric names
+                            match the Prometheus grammar
+GC005  lock-discipline      cross-thread attribute writes in
+                            thread/lock classes happen under a lock
+====== ==================== ==========================================
+"""
+
+from . import (  # noqa: F401  (import == register)
+    gc001_import_hygiene,
+    gc002_compat_shim,
+    gc003_tracer_leak,
+    gc004_dark_path,
+    gc005_lock_discipline,
+)

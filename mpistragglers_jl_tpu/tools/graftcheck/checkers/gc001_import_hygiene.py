@@ -1,0 +1,187 @@
+"""GC001: the package root's import closure stays free of jax (and
+every other accelerator-sized dependency).
+
+The runtime test ``test_import_is_jax_free`` (tests/test_pool_local.py)
+executes ``import mpistragglers_jl_tpu`` in a subprocess and asserts
+jax never loaded — one probe, of one entry point, at test time. This
+checker generalizes it statically: it builds the package-internal
+import graph from MODULE-LEVEL imports (lazy imports inside functions
+and ``__getattr__``, and ``if TYPE_CHECKING:`` blocks, are exactly the
+sanctioned escape hatches and are excluded), walks everything reachable
+from the package ``__init__``, and flags any module-level import of a
+heavy dependency anywhere in that closure — with the import chain that
+makes it reachable, so the finding names the edge to cut.
+
+numpy is NOT in the forbidden set: it is the package's core hard
+dependency (the pool is numpy bookkeeping). The forbidden roots are
+the device/toolchain stacks a LocalBackend-only user must never pay
+import (or plugin registration) cost for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, Finding, ModuleInfo, register
+
+FORBIDDEN_ROOTS = frozenset({
+    "jax",
+    "jaxlib",
+    "torch",
+    "tensorflow",
+    "scipy",
+    "pandas",
+    "orbax",
+    "flax",
+    "optax",
+})
+
+
+def _is_type_checking(test: ast.expr) -> bool:
+    return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") or (
+        isinstance(test, ast.Attribute)
+        and test.attr == "TYPE_CHECKING"
+    )
+
+
+def module_level_imports(
+    tree: ast.Module,
+) -> Iterator[ast.Import | ast.ImportFrom]:
+    """Imports that execute at module import time: top level, plus
+    inside try/except and non-TYPE_CHECKING ifs — NOT inside function
+    or class-method bodies (class bodies themselves do execute)."""
+    stack: list[ast.AST] = [tree]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.Import, ast.ImportFrom)):
+                yield child
+            elif isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue  # lazy by definition
+            elif isinstance(child, ast.If) and _is_type_checking(
+                child.test
+            ):
+                # the orelse of `if TYPE_CHECKING:` DOES execute
+                stack.extend(child.orelse)
+            else:
+                stack.append(child)
+
+
+def resolve_relative(
+    mod_name: str, is_package: bool, node: ast.ImportFrom
+) -> str | None:
+    """Absolute dotted target of a (possibly relative) ImportFrom, or
+    None when the relative level climbs out of the root package."""
+    if node.level == 0:
+        return node.module
+    parts = mod_name.split(".") if mod_name else []
+    pkg = parts if is_package else parts[:-1]
+    up = node.level - 1
+    if up > len(pkg):
+        return None
+    base = pkg[: len(pkg) - up]
+    if node.module:
+        base = base + node.module.split(".")
+    return ".".join(base) if base else None
+
+
+def _edges(
+    mod: ModuleInfo, names: set[str], packages: set[str]
+) -> set[str]:
+    """Package-internal modules whose import-time code runs when
+    ``mod`` is imported (its module-level imports, expanded with every
+    ancestor package ``__init__`` — importing ``a.b.c`` executes ``a``
+    and ``a.b`` too)."""
+    out: set[str] = set()
+
+    def add(target: str | None) -> None:
+        if not target:
+            return
+        parts = target.split(".")
+        for i in range(1, len(parts) + 1):
+            prefix = ".".join(parts[:i])
+            if prefix in names:
+                out.add(prefix)
+
+    is_pkg = mod.name in packages
+    for node in module_level_imports(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                add(alias.name)
+        else:
+            base = resolve_relative(mod.name, is_pkg, node)
+            add(base)
+            if base:
+                for alias in node.names:
+                    # `from .backends import local` imports a submodule
+                    add(f"{base}.{alias.name}")
+    out.discard(mod.name)
+    return out
+
+
+@register
+class ImportHygiene(Checker):
+    rule = "GC001"
+    name = "import-hygiene"
+    description = (
+        "modules reachable from the package root via module-level "
+        "imports must not import jax (or any other accelerator-stack "
+        "dependency) at module level"
+    )
+    project = True
+
+    def check_project(
+        self, mods: list[ModuleInfo]
+    ) -> Iterator[Finding]:
+        by_name = {m.name: m for m in mods if m.name}
+        packages = {
+            m.name for m in mods
+            if m.path.endswith("__init__.py")
+        }
+        roots = sorted(
+            n for n in packages if "." not in n
+        )
+        names = set(by_name)
+        graph = {
+            n: _edges(m, names, packages) for n, m in by_name.items()
+        }
+        for root in roots:
+            # BFS from the package __init__, remembering one shortest
+            # chain per module for the diagnostic
+            chain: dict[str, list[str]] = {root: [root]}
+            queue = [root]
+            while queue:
+                cur = queue.pop(0)
+                for nxt in sorted(graph.get(cur, ())):
+                    if nxt not in chain:
+                        chain[nxt] = chain[cur] + [nxt]
+                        queue.append(nxt)
+            for name in sorted(chain):
+                mod = by_name[name]
+                for node in module_level_imports(mod.tree):
+                    for bad, site in _forbidden(mod, node):
+                        yield mod.finding(
+                            self.rule,
+                            site,
+                            f"module-level `import {bad}` is reachable "
+                            f"from `import {root}` via "
+                            f"{' -> '.join(chain[name])}; the root "
+                            "closure must stay free of "
+                            "accelerator-stack imports (lazy-import "
+                            "inside the function that needs it)",
+                        )
+
+
+def _forbidden(mod: ModuleInfo, node: ast.AST):
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            root = alias.name.split(".")[0]
+            if root in FORBIDDEN_ROOTS:
+                yield alias.name, node
+    elif isinstance(node, ast.ImportFrom) and node.level == 0:
+        root = (node.module or "").split(".")[0]
+        if root in FORBIDDEN_ROOTS:
+            yield node.module, node

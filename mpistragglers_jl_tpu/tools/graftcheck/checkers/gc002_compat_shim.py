@@ -1,0 +1,102 @@
+"""GC002: current-generation jax APIs are reached through the compat
+shim, never bare.
+
+The device modules are written against the ``jax.shard_map`` /
+``jax.typeof`` / ``jax.lax.axis_size`` / ``jax.lax.pcast`` generation;
+``_jax_compat.install()`` backfills those names on lagging toolchains
+(the CPU CI image trails the dev chip by several releases). The
+invariant is ordering: any module that CALLS one of the shimmed names
+must itself import ``_jax_compat`` at module level — relying on some
+other module having installed the aliases first is an import-order
+time bomb that only detonates on the lagging toolchain, where no test
+box notices until CI does.
+
+``pltpu.CompilerParams`` is the second half of the shim and lives in
+``ops/flash_attention.py`` (as ``_CompilerParams``, beside its only
+legitimate construction site): direct ``pltpu.CompilerParams`` /
+``pltpu.TPUCompilerParams`` attribute access anywhere else is flagged
+regardless of a ``_jax_compat`` import, because the compat alias for
+it is the flash module's symbol, not a monkeypatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, Finding, ModuleInfo, dotted_path, register
+from .gc001_import_hygiene import module_level_imports
+
+# jax attribute paths _jax_compat.install() backfills
+SHIMMED = {
+    ("jax", "shard_map"),
+    ("jax", "typeof"),
+    ("jax", "lax", "axis_size"),
+    ("jax", "lax", "pcast"),
+    ("lax", "axis_size"),
+    ("lax", "pcast"),
+}
+
+_COMPILER_PARAMS_HOME = "ops/flash_attention.py"
+
+
+def imports_jax_compat(mod: ModuleInfo) -> bool:
+    for node in module_level_imports(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            if any(a.name == "_jax_compat" for a in node.names):
+                return True
+            if (node.module or "").endswith("_jax_compat"):
+                return True
+        else:
+            if any(
+                a.name.endswith("_jax_compat") for a in node.names
+            ):
+                return True
+    return False
+
+
+@register
+class CompatShim(Checker):
+    rule = "GC002"
+    name = "compat-shim"
+    description = (
+        "modules calling jax.shard_map / jax.typeof / lax.axis_size / "
+        "lax.pcast must import _jax_compat at module level; "
+        "pltpu.CompilerParams is accessed only inside "
+        "ops/flash_attention.py (use its _CompilerParams alias)"
+    )
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if mod.relpath.endswith("_jax_compat.py"):
+            return
+        has_compat = imports_jax_compat(mod)
+        in_home = mod.relpath.endswith(_COMPILER_PARAMS_HOME)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            path = dotted_path(node)
+            if path is None:
+                continue
+            if path[-1] in ("CompilerParams", "TPUCompilerParams"):
+                if path[0] == "pltpu" and not in_home:
+                    yield mod.finding(
+                        self.rule,
+                        node,
+                        f"direct pltpu.{path[-1]} access outside "
+                        f"{_COMPILER_PARAMS_HOME}; import "
+                        "_CompilerParams from ops.flash_attention "
+                        "(the toolchain-spelling shim lives beside "
+                        "its one construction site)",
+                    )
+                continue
+            if path in SHIMMED and not has_compat:
+                dotted = ".".join(path)
+                yield mod.finding(
+                    self.rule,
+                    node,
+                    f"`{dotted}` used without a module-level "
+                    "`from .. import _jax_compat` — on a lagging "
+                    "toolchain this name only exists after the shim "
+                    "installs, and relying on another module to have "
+                    "imported it first is import-order dependent",
+                )

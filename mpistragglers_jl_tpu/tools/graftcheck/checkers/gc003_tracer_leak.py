@@ -1,0 +1,282 @@
+"""GC003: host-side effects and Python control flow inside traced
+code.
+
+Functions that jax traces — ``@jax.jit`` (bare, called, or wrapped in
+``functools.partial``), and functions handed to ``lax.scan`` /
+``lax.cond`` / ``lax.while_loop`` / ``lax.fori_loop`` as bodies — run
+ONCE at trace time. Host-side reads inside them silently freeze into
+the compiled program (a ``time.perf_counter()`` stamps compile time
+forever; ``np.random`` draws one constant); tracer-value leaks
+(``.item()``, ``float()/int()/bool()`` on a traced argument, ``if`` on
+a traced argument) either throw ``TracerConversionError`` at trace
+time on the chip or — worse, with weak types and python scalars —
+trace through and bake a stale branch. numba-mpi-style JIT/host
+boundaries are exactly where such regressions hide (PAPERS.md), and
+this repo's scan bodies are its hottest code.
+
+Static allowances (all trace-time constants): ``.shape``, ``.dtype``,
+``.ndim``, ``.size``, ``len()``, ``isinstance()``, and ``is None`` /
+``is not None`` tests — configuration-style branching on static
+arguments is the codebase's idiom and stays legal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, Finding, ModuleInfo, dotted_path, register
+
+_LAX_BODY_ARGS = {
+    # callee attr name -> positional indices that take traced callables
+    "scan": (0,),
+    "cond": (1, 2),
+    "switch": None,  # every arg from 1 on is a branch
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "associative_scan": (0,),
+    "checkpoint": (0,),
+}
+
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding"}
+
+_TIME_FUNCS = "host clock read inside traced code"
+_NP_RANDOM = "host-side numpy RNG inside traced code"
+
+
+def _callee_path(call: ast.Call) -> tuple[str, ...] | None:
+    return dotted_path(call.func)
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    """jax.jit / jit, called or bare, possibly functools.partial-
+    wrapped (the repo's donate_argnums idiom)."""
+
+    def is_jit_name(e: ast.expr) -> bool:
+        if isinstance(e, ast.Attribute):
+            return e.attr == "jit"
+        return isinstance(e, ast.Name) and e.id == "jit"
+
+    if is_jit_name(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if is_jit_name(dec.func):
+            return True
+        path = _callee_path(dec)
+        if path and path[-1] == "partial":
+            for arg in dec.args[:1]:
+                if is_jit_name(arg):
+                    return True
+    return False
+
+
+def _collect_traced(tree: ast.Module) -> list[ast.FunctionDef]:
+    """Function defs that jax traces: jit-decorated, or referenced by
+    name as a lax control-flow body. Name references resolve through
+    LEXICAL scopes (nearest enclosing function/module def wins, class
+    bodies do not contribute — Python's own lookup for a bare name),
+    so a host-side method that happens to share a name with a scan
+    body is never misattributed."""
+    traced: dict[int, ast.FunctionDef] = {}
+
+    def scope_walk(scope: ast.AST, env: dict[str, ast.FunctionDef]):
+        is_class = isinstance(scope, ast.ClassDef)
+        local: dict[str, ast.FunctionDef] = {}
+        for child in ast.iter_child_nodes(scope):
+            if isinstance(child, ast.FunctionDef):
+                local[child.name] = child
+                if any(
+                    _is_jit_decorator(d)
+                    for d in child.decorator_list
+                ):
+                    traced[id(child)] = child
+        # methods do not see their class's namespace via bare names
+        inner_env = env if is_class else {**env, **local}
+
+        # visit this scope's own statements (not nested defs/classes)
+        def visit(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef,
+                     ast.ClassDef),
+                ):
+                    continue
+                if isinstance(child, ast.Call):
+                    _resolve_call(child, inner_env)
+                visit(child)
+
+        def _resolve_call(
+            call: ast.Call, env_: dict[str, ast.FunctionDef]
+        ) -> None:
+            path = _callee_path(call)
+            if not path or len(path) < 2:
+                return
+            # jax.lax.scan / lax.scan / jax.checkpoint
+            if path[-2] not in ("lax", "jax"):
+                return
+            if path[-1] not in _LAX_BODY_ARGS:
+                return
+            spec = _LAX_BODY_ARGS[path[-1]]
+            idxs = (
+                range(1, len(call.args)) if spec is None else spec
+            )
+            for i in idxs:
+                if i < len(call.args) and isinstance(
+                    call.args[i], ast.Name
+                ):
+                    fn = env_.get(call.args[i].id)
+                    if fn is not None:
+                        traced[id(fn)] = fn
+
+        visit(scope)
+        for child in ast.iter_child_nodes(scope):
+            if isinstance(child, (ast.FunctionDef, ast.ClassDef)):
+                scope_walk(child, inner_env)
+
+    scope_walk(tree, {})
+    return list(traced.values())
+
+
+def _param_names(fn: ast.FunctionDef) -> set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return {n for n in names if n != "self"}
+
+
+def _dynamic_param_refs(
+    expr: ast.expr, params: set[str]
+) -> list[ast.Name]:
+    """Bare references to traced parameters inside ``expr`` that are
+    NOT behind a static accessor (.shape/.dtype/..., len(),
+    isinstance(), `is [not] None`)."""
+    hits: list[ast.Name] = []
+
+    def visit(node: ast.expr) -> None:
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return  # x.shape[...] etc. — static
+            visit(node.value)
+            return
+        if isinstance(node, ast.Call):
+            path = _callee_path(node)
+            if path and path[-1] in ("len", "isinstance", "getattr",
+                                     "hasattr", "type"):
+                return
+            for a in node.args:
+                visit(a)
+            for kw in node.keywords:
+                visit(kw.value)
+            if not path:
+                visit(node.func)
+            return
+        if isinstance(node, ast.Compare):
+            static = all(
+                isinstance(op, (ast.Is, ast.IsNot))
+                for op in node.ops
+            )
+            if static:
+                return  # `x is None` — config test on a static arg
+        if isinstance(node, ast.Name):
+            if node.id in params:
+                hits.append(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                visit(child)
+
+    visit(expr)
+    return hits
+
+
+@register
+class TracerLeak(Checker):
+    rule = "GC003"
+    name = "tracer-leak"
+    description = (
+        "no host clocks, host RNG, .item(), float()/int()/bool() "
+        "casts of traced arguments, or Python branching on traced "
+        "arguments inside jit-decorated functions or lax control-flow "
+        "bodies"
+    )
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for fn in _collect_traced(mod.tree):
+            yield from self._check_fn(mod, fn)
+
+    def _check_fn(
+        self, mod: ModuleInfo, fn: ast.FunctionDef
+    ) -> Iterator[Finding]:
+        params = _param_names(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                path = _callee_path(node)
+                if path:
+                    if path[0] == "time" and len(path) > 1:
+                        yield mod.finding(
+                            self.rule, node,
+                            f"`{'.'.join(path)}()` — {_TIME_FUNCS} "
+                            f"(freezes one trace-time value into the "
+                            f"compiled program of `{fn.name}`)",
+                        )
+                    elif (
+                        path[0] in ("np", "numpy")
+                        and len(path) > 2
+                        and path[1] == "random"
+                    ):
+                        yield mod.finding(
+                            self.rule, node,
+                            f"`{'.'.join(path)}()` — {_NP_RANDOM} "
+                            "(draws once at trace time; use "
+                            "jax.random with a threaded key)",
+                        )
+                    elif path[-1] == "item" and len(path) > 1:
+                        yield mod.finding(
+                            self.rule, node,
+                            "`.item()` forces a device sync and "
+                            "fails on tracers inside "
+                            f"`{fn.name}`",
+                        )
+                    elif (
+                        path[-1] in ("float", "int", "bool")
+                        and len(path) == 1
+                        and node.args
+                        and _dynamic_param_refs(node.args[0], params)
+                    ):
+                        yield mod.finding(
+                            self.rule, node,
+                            f"`{path[-1]}()` cast of traced argument "
+                            f"inside `{fn.name}` concretizes the "
+                            "tracer (TracerConversionError on the "
+                            "chip; jnp.asarray/astype instead)",
+                        )
+            elif isinstance(node, (ast.If, ast.While)):
+                refs = _dynamic_param_refs(node.test, params)
+                if refs:
+                    names = sorted({r.id for r in refs})
+                    kind = (
+                        "while" if isinstance(node, ast.While) else "if"
+                    )
+                    yield mod.finding(
+                        self.rule, node,
+                        f"Python `{kind}` on traced argument(s) "
+                        f"{names} inside `{fn.name}` bakes one branch "
+                        "at trace time — use lax.cond/jnp.where "
+                        "(static shape/dtype/`is None` tests are "
+                        "exempt)",
+                    )
+            elif isinstance(node, ast.IfExp):
+                refs = _dynamic_param_refs(node.test, params)
+                if refs:
+                    names = sorted({r.id for r in refs})
+                    yield mod.finding(
+                        self.rule, node,
+                        f"conditional expression on traced "
+                        f"argument(s) {names} inside `{fn.name}` "
+                        "bakes one branch at trace time — use "
+                        "jnp.where",
+                    )

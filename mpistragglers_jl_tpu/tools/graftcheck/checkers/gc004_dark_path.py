@@ -1,0 +1,365 @@
+"""GC004: observability stays strictly opt-in, and metric names stay
+scrapeable.
+
+The contract every instrumented layer honors (utils/trace.py set it;
+obs/ inherited it): ``registry=`` / ``spans=`` / ``tracer=`` kwargs
+default to ``None``, and the dark path pays nothing beyond ``is None``
+checks. Two halves, statically checked:
+
+1. **Defaults + guards.** Any public function/method taking a
+   parameter named ``registry``/``spans``/``tracer`` must default it
+   to ``None``, and every *dereference* of the parameter
+   (``tracer.begin(...)``, ``registry.counter(...)``) must sit under a
+   ``<name> is not None`` guard (an enclosing ``if``/ternary test, a
+   containing ``and`` chain, or after an early ``if <name> is None:
+   return``). Bare forwarding (``tracer=tracer``) is not a
+   dereference and is always fine. Private helpers (leading
+   underscore, or methods of private classes) that REQUIRE an
+   instrument are exempt from the default rule — they exist on the
+   instrumented side of the guard — but their dereferences are still
+   checked whenever the default is None.
+
+2. **Metric-name grammar.** String literals passed as the name of
+   ``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` must
+   match the Prometheus exposition grammar
+   ``[a-zA-Z_:][a-zA-Z0-9_:]*`` that ``obs/metrics.py`` enforces at
+   runtime — the static check moves the crash from the first
+   instrumented run (which dark CI never executes) to every CI run.
+   In f-string names the literal fragments are checked.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..core import Checker, Finding, ModuleInfo, register
+
+PARAMS = ("registry", "spans", "tracer")
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_FRAGMENT_RE = re.compile(r"[a-zA-Z0-9_:]*\Z")
+
+_FACTORY_METHODS = ("counter", "gauge", "histogram")
+
+
+def _defaults_of(fn: ast.FunctionDef) -> dict[str, ast.expr | None]:
+    """param name -> default expr (None when the param has none)."""
+    out: dict[str, ast.expr | None] = {}
+    a = fn.args
+    pos = a.posonlyargs + a.args
+    pos_defaults = [None] * (len(pos) - len(a.defaults)) + list(
+        a.defaults
+    )
+    for p, d in zip(pos, pos_defaults):
+        out[p.arg] = d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        out[p.arg] = d
+    return out
+
+
+def _is_none(expr: ast.expr | None) -> bool:
+    return isinstance(expr, ast.Constant) and expr.value is None
+
+
+def _tests_not_none(test: ast.expr, name: str) -> bool:
+    """Does ``test`` establish ``name is not None`` (directly or as an
+    ``and`` conjunct)? Truthiness (``if tracer:``) counts too."""
+    if isinstance(test, ast.Compare):
+        return (
+            isinstance(test.left, ast.Name)
+            and test.left.id == name
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.IsNot)
+            and _is_none(test.comparators[0])
+        )
+    if isinstance(test, ast.Name):
+        return test.id == name
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        return any(_tests_not_none(v, name) for v in test.values)
+    return False
+
+
+def _tests_is_none(test: ast.expr, name: str) -> bool:
+    if isinstance(test, ast.Compare):
+        return (
+            isinstance(test.left, ast.Name)
+            and test.left.id == name
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.Is)
+            and _is_none(test.comparators[0])
+        )
+    if isinstance(test, ast.UnaryOp) and isinstance(
+        test.op, ast.Not
+    ):
+        return isinstance(test.operand, ast.Name) and (
+            test.operand.id == name
+        )
+    return False
+
+
+def _returns_or_raises(body: list[ast.stmt]) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue)
+    )
+
+
+class _GuardVisitor(ast.NodeVisitor):
+    """Find unguarded dereferences of ``name`` in one function body.
+
+    Tracks (a) structural guards — enclosing ``if``/ternary whose test
+    proves not-None; (b) flow guards — a prior ``if name is None:
+    return`` at the same or outer block level. Rebinding the name
+    (``tracer = ...``) ends the analysis for the rest of the scope —
+    conservative, but rebinding an opt-in kwarg is itself a smell the
+    human reviewer sees.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.guard_depth = 0
+        self.proven = False  # an early-return guard has fired
+        self.stopped = False
+        self.hits: list[ast.Attribute] = []
+
+    def visit_body(self, stmts: list[ast.stmt]) -> None:
+        """Visit a straight-line statement list, promoting dominance
+        guards BETWEEN its statements: an `if x is None: return` (or
+        `assert x is not None`) at this level guards everything after
+        it in this list; the same statement nested inside another
+        conditional proves nothing beyond its own block (review
+        finding — visit_If deliberately does not promote)."""
+        for stmt in stmts:
+            self.visit(stmt)
+            if (
+                isinstance(stmt, ast.If)
+                and _tests_is_none(stmt.test, self.name)
+                and _returns_or_raises(stmt.body)
+            ) or (
+                isinstance(stmt, ast.Assert)
+                and _tests_not_none(stmt.test, self.name)
+            ):
+                self.proven = True
+
+    # -- dereferences ---------------------------------------------------
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (
+            not self.stopped
+            and not self.proven
+            and self.guard_depth == 0
+            and isinstance(node.value, ast.Name)
+            and node.value.id == self.name
+        ):
+            self.hits.append(node)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if (
+            not self.stopped
+            and not self.proven
+            and self.guard_depth == 0
+            and isinstance(node.value, ast.Name)
+            and node.value.id == self.name
+        ):
+            self.hits.append(node)  # registry[...] — same contract
+        self.generic_visit(node)
+
+    # -- guards ---------------------------------------------------------
+    def visit_If(self, node: ast.If) -> None:
+        if _tests_not_none(node.test, self.name):
+            self.guard_depth += 1
+            for stmt in node.body:
+                self.visit(stmt)
+            self.guard_depth -= 1
+            for stmt in node.orelse:
+                self.visit(stmt)
+            return
+        if _tests_is_none(node.test, self.name):
+            # body runs with the name None (a deref there is a real
+            # bug — visit unguarded); the else branch is proven
+            # not-None. A returning body guards the rest of the scope
+            # ONLY at the function's top statement level — the caller
+            # (_check_params) promotes that; promoting here would let
+            # a guard nested under `if flag:` "prove" code that runs
+            # when flag is False (review finding).
+            for stmt in node.body:
+                self.visit(stmt)
+            self.guard_depth += 1
+            for stmt in node.orelse:
+                self.visit(stmt)
+            self.guard_depth -= 1
+            return
+        self.visit(node.test)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        if _tests_not_none(node.test, self.name):
+            self.guard_depth += 1
+            self.visit(node.body)
+            self.guard_depth -= 1
+            self.visit(node.orelse)
+            return
+        if _tests_is_none(node.test, self.name):
+            self.visit(node.body)
+            self.guard_depth += 1
+            self.visit(node.orelse)
+            self.guard_depth -= 1
+            return
+        self.generic_visit(node)
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> None:
+        # `tracer is not None and tracer.begin(...)` short-circuits
+        if isinstance(node.op, ast.And) and any(
+            _tests_not_none(v, self.name) for v in node.values
+        ):
+            self.guard_depth += 1
+            self.generic_visit(node)
+            self.guard_depth -= 1
+            return
+        self.generic_visit(node)
+
+    # assert-based proof is promoted by the caller at top statement
+    # level only (same dominance argument as the early-return guard)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == self.name:
+                self.stopped = True
+
+    # nested defs that rebind the name get their own scope — do not
+    # descend; ones that close over it are a straight-line body whose
+    # own top-level guards dominate only within it (save/restore)
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        params = {a.arg for a in node.args.args + node.args.kwonlyargs}
+        if self.name not in params:
+            saved = self.proven
+            self.visit_body(node.body)
+            self.proven = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = lambda self, node: None  # noqa: E731
+
+
+def _is_private(fn: ast.FunctionDef, cls: ast.ClassDef | None) -> bool:
+    if fn.name.startswith("_") and not fn.name.startswith("__"):
+        return True
+    if fn.name.startswith("__") and fn.name.endswith("__"):
+        # dunder of a private class counts as private
+        return cls is not None and cls.name.startswith("_")
+    return cls is not None and cls.name.startswith("_")
+
+
+def _literal_fragments(node: ast.expr) -> list[tuple[str, bool]] | None:
+    """(text, is_whole) pieces of a metric-name expression: a plain
+    literal yields one whole piece; an f-string yields its constant
+    fragments (checked against the mid-name grammar); anything fully
+    dynamic returns None (not statically checkable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [(node.value, True)]
+    if isinstance(node, ast.JoinedStr):
+        out = []
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(
+                part.value, str
+            ):
+                out.append((part.value, False))
+        return out
+    return None
+
+
+@register
+class DarkPath(Checker):
+    rule = "GC004"
+    name = "dark-path"
+    description = (
+        "registry/spans/tracer parameters default to None with every "
+        "dereference guarded by `is not None`; literal metric names "
+        "match the Prometheus grammar [a-zA-Z_:][a-zA-Z0-9_:]*"
+    )
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        # (fn, enclosing class) pairs
+        fns: list[tuple[ast.FunctionDef, ast.ClassDef | None]] = []
+
+        def collect(node: ast.AST, cls: ast.ClassDef | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    fns.append((child, cls))
+                    collect(child, cls)
+                elif isinstance(child, ast.ClassDef):
+                    collect(child, child)
+                else:
+                    collect(child, cls)
+
+        collect(mod.tree, None)
+        for fn, cls in fns:
+            yield from self._check_params(mod, fn, cls)
+        yield from self._check_metric_names(mod)
+
+    def _check_params(
+        self,
+        mod: ModuleInfo,
+        fn: ast.FunctionDef,
+        cls: ast.ClassDef | None,
+    ) -> Iterator[Finding]:
+        defaults = _defaults_of(fn)
+        for name in PARAMS:
+            if name not in defaults:
+                continue
+            default = defaults[name]
+            optional = _is_none(default)
+            if not optional:
+                if not _is_private(fn, cls):
+                    what = (
+                        "no default" if default is None
+                        else "a non-None default"
+                    )
+                    yield mod.finding(
+                        self.rule, fn,
+                        f"public `{fn.name}` takes `{name}` with "
+                        f"{what}; observability is opt-in — the "
+                        f"contract is `{name}=None` plus `is None` "
+                        "guards (utils/trace.py)",
+                    )
+                continue  # required param: non-None by contract,
+                # dereferences need no guard
+            v = _GuardVisitor(name)
+            v.visit_body(fn.body)
+            for hit in v.hits:
+                yield mod.finding(
+                    self.rule, hit,
+                    f"`{name}.{getattr(hit, 'attr', '[…]')}` "
+                    f"dereferenced without a `{name} is not None` "
+                    f"guard in `{fn.name}` — the dark path must pay "
+                    "only the None check",
+                )
+
+    def _check_metric_names(
+        self, mod: ModuleInfo
+    ) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _FACTORY_METHODS
+                and node.args
+            ):
+                continue
+            frags = _literal_fragments(node.args[0])
+            if frags is None:
+                continue
+            for text, whole in frags:
+                rx = _NAME_RE if whole else _FRAGMENT_RE
+                if not rx.match(text):
+                    yield mod.finding(
+                        self.rule, node.args[0],
+                        f"metric name fragment {text!r} violates the "
+                        "Prometheus grammar "
+                        "[a-zA-Z_:][a-zA-Z0-9_:]* that "
+                        "obs/metrics.py rejects at runtime",
+                    )

@@ -1,0 +1,209 @@
+"""GC005: shared mutable attributes written off-thread are written
+under a lock.
+
+The pool/hedge/backend layers share mutable state across threads the
+same way the reference's MPI progress loop does — and the reference
+ships zero race detection (SURVEY §5). Round 1's TSAN harness covers
+the C++ transport only; the Python side (reader threads in
+ProcessBackend, mailbox worker threads, the registry's cross-thread
+writers) has had nothing. This checker is the Python-side analog:
+
+In any class that constructs ``threading.Thread`` / ``Lock`` /
+``RLock`` / ``Condition``, take every attribute written (``self.x =``,
+``self.x[i] =``, ``self.x += ``) from two or more methods, where at
+least one of the writers runs on a spawned thread (it is a
+``Thread(target=self.m)`` entry, or is called — transitively, within
+the class — from one). Every such write must execute under ``with
+self.<lock>:``. Unlocked sites are flagged.
+
+Deliberate scope cuts (the checker is a tripwire, not a prover):
+
+* ``__init__`` writes are exempt — construction happens-before any
+  thread this object starts (publication to PRE-existing threads is
+  beyond a per-file checker).
+* Any ``with self.<attr>:`` counts as a lock — in this codebase a
+  with-ed instance attribute is always a Lock/Condition, and binding
+  which lock guards which attribute is a dynamic property.
+* Single-writer attributes (one method writes, others only read) pass:
+  benign-race reads are the pool's documented design (GIL-atomic
+  flag reads); the invariant enforced here is write-write discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, Finding, ModuleInfo, dotted_path, register
+
+_THREADING_CTORS = {"Thread", "Lock", "RLock", "Condition", "Event",
+                    "Semaphore", "BoundedSemaphore"}
+
+
+def _callee(node: ast.Call) -> tuple[str, ...] | None:
+    return dotted_path(node.func)
+
+
+def _is_threading_ctor(path: tuple[str, ...]) -> bool:
+    return (
+        len(path) >= 2
+        and path[-2] == "threading"
+        and path[-1] in _THREADING_CTORS
+    ) or (len(path) == 1 and path[0] in ("Thread", "Lock", "RLock",
+                                         "Condition"))
+
+
+def _self_attr(expr: ast.expr) -> str | None:
+    """'x' for ``self.x``; also resolves ``self.x[i]`` to 'x'."""
+    if isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Per-method facts: self-attr writes (+ lock depth at the site),
+    self-method calls, thread targets constructed here."""
+
+    def __init__(self) -> None:
+        self.writes: list[tuple[str, ast.AST, bool]] = []
+        self.calls: set[str] = set()
+        self.thread_targets: set[str] = set()
+        self.makes_threading = False
+        self._lock_depth = 0
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(
+            _self_attr(item.context_expr) is not None
+            and not isinstance(item.context_expr, ast.Call)
+            for item in node.items
+        )
+        if locked:
+            self._lock_depth += 1
+        self.generic_visit(node)
+        if locked:
+            self._lock_depth -= 1
+
+    def _record_target(self, target: ast.expr, node: ast.AST) -> None:
+        for t in (
+            target.elts if isinstance(target, (ast.Tuple, ast.List))
+            else [target]
+        ):
+            attr = _self_attr(t)
+            if attr is not None:
+                self.writes.append(
+                    (attr, node, self._lock_depth > 0)
+                )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record_target(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_target(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_target(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        path = _callee(node)
+        if path is not None:
+            if _is_threading_ctor(path):
+                self.makes_threading = True
+                if path[-1] == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            attr = _self_attr(kw.value)
+                            if attr is not None:
+                                self.thread_targets.add(attr)
+            if (
+                len(path) == 2
+                and path[0] == "self"
+            ):
+                self.calls.add(path[1])
+        self.generic_visit(node)
+
+
+@register
+class LockDiscipline(Checker):
+    rule = "GC005"
+    name = "lock-discipline"
+    description = (
+        "in thread-spawning/lock-holding classes, attributes written "
+        "from >= 2 methods with at least one writer on a spawned "
+        "thread must be written under `with self.<lock>:`"
+    )
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(mod, node)
+
+    def _check_class(
+        self, mod: ModuleInfo, cls: ast.ClassDef
+    ) -> Iterator[Finding]:
+        scans: dict[str, _MethodScan] = {}
+        for item in cls.body:
+            if isinstance(item, ast.FunctionDef):
+                s = _MethodScan()
+                for stmt in item.body:
+                    s.visit(stmt)
+                scans[item.name] = s
+        if not any(s.makes_threading for s in scans.values()):
+            return
+
+        # thread-entry closure: Thread targets + everything they call
+        # through self.* within this class, to a fixpoint
+        entries: set[str] = set()
+        for s in scans.values():
+            entries |= s.thread_targets & set(scans)
+        changed = True
+        while changed:
+            changed = False
+            for name in list(entries):
+                for callee in scans[name].calls & set(scans):
+                    if callee not in entries:
+                        entries.add(callee)
+                        changed = True
+
+        # attr -> {method: [(node, locked)]}, __init__ exempt
+        writers: dict[str, dict[str, list[tuple[ast.AST, bool]]]] = {}
+        for mname, s in scans.items():
+            if mname in ("__init__", "__new__"):
+                continue
+            for attr, node, locked in s.writes:
+                writers.setdefault(attr, {}).setdefault(
+                    mname, []
+                ).append((node, locked))
+
+        for attr, per_method in sorted(writers.items()):
+            if len(per_method) < 2:
+                continue
+            if not (set(per_method) & entries):
+                continue  # all writers on the caller's thread
+            for mname, sites in sorted(per_method.items()):
+                for node, locked in sites:
+                    if not locked:
+                        onthread = (
+                            "a spawned thread"
+                            if mname in entries
+                            else "the coordinator"
+                        )
+                        others = sorted(set(per_method) - {mname})
+                        yield mod.finding(
+                            self.rule, node,
+                            f"`self.{attr}` written in "
+                            f"`{cls.name}.{mname}` (runs on "
+                            f"{onthread}) without `with self.<lock>:`"
+                            f" while also written by {others} — "
+                            "cross-thread writes take the lock",
+                        )

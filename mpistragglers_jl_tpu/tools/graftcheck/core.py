@@ -1,0 +1,615 @@
+"""graftcheck core: checker registry, suppressions, baseline, cache.
+
+The framework half of the suite — rule-agnostic machinery that the
+checkers (:mod:`.checkers`) plug into:
+
+* :class:`Checker` + :func:`register` — the registry. A checker is
+  per-file (``check_module``) or project-wide (``check_project``, for
+  rules that need the whole import graph).
+* ``# graftcheck: disable=GC003`` — line-level suppression, honored on
+  the flagged line or the line directly above it (so a suppression can
+  sit on its own line when the flagged one is full). ``disable=all``
+  silences every rule for that line. Suppressed findings are dropped
+  from the fresh set but still counted.
+* :class:`Baseline` — a checked-in JSON of *documented false
+  positives*, each entry carrying a mandatory justification. Entries
+  match findings by ``(rule, path, symbol)`` — line-free, so ordinary
+  refactors don't churn the file. The file is CAPPED (its own ``cap``
+  field): growing it past the cap fails the run, and a stale entry
+  (matching nothing) fails too — the baseline can only shrink quietly,
+  never grow or rot.
+* per-file result cache keyed on (content sha, tool fingerprint): a
+  clean re-run over an unchanged tree re-parses nothing. Project-wide
+  checkers always run live (they are cheap; their inputs span files).
+
+Stdlib-only by contract (the tier-1 self-run asserts the tool pulls in
+no jax): everything here is :mod:`ast` + :mod:`json` + :mod:`hashlib`.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator
+
+__all__ = [
+    "Finding",
+    "ModuleInfo",
+    "Checker",
+    "register",
+    "all_checkers",
+    "Baseline",
+    "BaselineError",
+    "dotted_path",
+    "load_modules",
+    "run",
+    "RunResult",
+]
+
+
+# --------------------------------------------------------------------------
+# findings
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    ``symbol`` is the enclosing ``Class.method`` / ``function``
+    qualname ("<module>" at module scope) — the stable half of the
+    identity baseline entries match on; ``line``/``col`` are 1-based /
+    0-based like CPython's own diagnostics.
+    """
+
+    rule: str
+    path: str  # posix-relative to the scan root's parent
+    line: int
+    col: int
+    symbol: str
+    message: str
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"[{self.symbol}] {self.message}"
+        )
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+
+def dotted_path(expr: ast.expr) -> tuple[str, ...] | None:
+    """``('jax', 'lax', 'axis_size')`` for an attribute chain rooted
+    at a bare name; None when rooted elsewhere (call results,
+    subscripts). The one shared walker every checker matches
+    attribute/callee chains with — for a call, pass ``call.func``."""
+    parts: list[str] = []
+    cur: ast.expr = expr
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    parts.append(cur.id)
+    return tuple(reversed(parts))
+
+
+def symbol_of(tree: ast.Module, node: ast.AST) -> str:
+    """Enclosing qualname of ``node`` ("<module>" at top level).
+
+    Computed by walking down the scopes that contain the node's
+    position — cheap and parent-pointer-free.
+    """
+    line = getattr(node, "lineno", None)
+    if line is None:
+        return "<module>"
+    parts: list[str] = []
+    scope: ast.AST = tree
+    while True:
+        inner = None
+        for child in ast.iter_child_nodes(scope):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                end = getattr(child, "end_lineno", child.lineno)
+                if child.lineno <= line <= end:
+                    inner = child
+                    break
+        if inner is None:
+            break
+        parts.append(inner.name)
+        scope = inner
+    return ".".join(parts) if parts else "<module>"
+
+
+# --------------------------------------------------------------------------
+# module loading
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file handed to the checkers."""
+
+    path: str  # absolute
+    relpath: str  # posix, relative to the scan root's parent
+    name: str  # dotted module name ("pkg.sub.mod"; "" outside a pkg)
+    source: str
+    tree: ast.Module
+    sha: str
+
+    _lines: list[str] | None = field(default=None, repr=False)
+
+    @property
+    def lines(self) -> list[str]:
+        if self._lines is None:
+            self._lines = self.source.splitlines()
+        return self._lines
+
+    def finding(
+        self, rule: str, node: ast.AST, message: str
+    ) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.relpath,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            symbol=symbol_of(self.tree, node),
+            message=message,
+        )
+
+
+def _module_name(abspath: str, base: str) -> str:
+    """Dotted module name of ``abspath`` relative to namespace base
+    ``base`` (``pkg.sub.mod``; ``__init__.py`` maps to its package's
+    name; loose files get their stem)."""
+    rel = os.path.relpath(abspath, base)
+    parts = rel.replace(os.sep, "/").split("/")
+    if parts[-1] == "__init__.py":
+        parts = parts[:-1]
+    else:
+        parts[-1] = parts[-1][: -len(".py")]
+    return ".".join(parts)
+
+
+def package_base(top: str) -> str:
+    """The directory whose children are the top of the dotted
+    namespace for ``top``: walk UP past ``__init__.py`` packages, so a
+    scan started anywhere INSIDE a package yields the same relpaths
+    and dotted names as a scan of the whole package — baseline entries
+    (recorded package-root-relative) keep matching on sub-path and
+    single-file scans."""
+    d = top if os.path.isdir(top) else os.path.dirname(top)
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        parent = os.path.dirname(d)
+        if parent == d:  # filesystem root: stop
+            break
+        d = parent
+    return d
+
+
+def load_modules(paths: Iterable[str]) -> list[ModuleInfo]:
+    """Parse every ``.py`` under ``paths`` (files or directories).
+
+    Files that fail to parse raise — a syntax error in the tree is a
+    finding-level event for CI, not something to skip silently.
+    """
+    out: list[ModuleInfo] = []
+    seen: set[str] = set()
+    for top in paths:
+        top = os.path.abspath(top)
+        if os.path.isfile(top):
+            files = [top]
+        else:
+            files = []
+            for dirpath, dirnames, filenames in os.walk(top):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                files += [
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames)
+                    if f.endswith(".py")
+                ]
+        base = package_base(top)
+        for f in files:
+            if f in seen:
+                continue
+            seen.add(f)
+            with open(f, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            out.append(
+                ModuleInfo(
+                    path=f,
+                    relpath=os.path.relpath(f, base).replace(os.sep, "/"),
+                    name=_module_name(f, base),
+                    source=src,
+                    tree=ast.parse(src, filename=f),
+                    sha=hashlib.sha256(src.encode()).hexdigest(),
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# checker registry
+# --------------------------------------------------------------------------
+
+
+class Checker:
+    """Base class: subclass, set ``rule``/``name``/``description``,
+    implement ``check_module`` (per-file; cached) or ``check_project``
+    (whole module set; always live — set ``project = True``)."""
+
+    rule: str = "GC000"
+    name: str = "unnamed"
+    description: str = ""
+    project: bool = False
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        return iter(())
+
+    def check_project(
+        self, mods: list[ModuleInfo]
+    ) -> Iterator[Finding]:
+        return iter(())
+
+
+_REGISTRY: dict[str, Checker] = {}
+
+
+def register(cls: type[Checker]) -> type[Checker]:
+    """Class decorator: instantiate + index by rule id (unique)."""
+    inst = cls()
+    if inst.rule in _REGISTRY:
+        raise ValueError(f"duplicate checker rule {inst.rule}")
+    _REGISTRY[inst.rule] = inst
+    return cls
+
+
+def all_checkers() -> dict[str, Checker]:
+    # the checkers package self-registers on import; imported lazily so
+    # `import ...graftcheck.core` alone stays side-effect-free
+    from . import checkers  # noqa: F401
+
+    return dict(sorted(_REGISTRY.items()))
+
+
+# --------------------------------------------------------------------------
+# suppressions
+# --------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftcheck:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+)
+
+
+def _suppressed_rules(line_text: str) -> set[str]:
+    m = _SUPPRESS_RE.search(line_text)
+    if not m:
+        return set()
+    return {t.strip() for t in m.group(1).split(",") if t.strip()}
+
+
+def is_suppressed(mod: ModuleInfo, f: Finding) -> bool:
+    """True iff the finding's line (or the line directly above it)
+    carries ``# graftcheck: disable=<rule>`` naming the rule (or
+    ``all``)."""
+    for ln in (f.line, f.line - 1):
+        if 1 <= ln <= len(mod.lines):
+            rules = _suppressed_rules(mod.lines[ln - 1])
+            if f.rule in rules or "all" in rules:
+                return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# baseline
+# --------------------------------------------------------------------------
+
+
+class BaselineError(ValueError):
+    """The baseline file itself is invalid (over cap, stale entry,
+    missing justification): a CONFIG failure, reported distinctly from
+    code findings so CI can tell 'the tree regressed' from 'the
+    baseline rotted'."""
+
+
+class Baseline:
+    """Checked-in false-positive ledger; see the module docstring for
+    the policy. Entry shape::
+
+        {"rule": "GC004", "path": "pkg/utils/straggle.py",
+         "symbol": "PoolLatencyModel.publish",
+         "justification": "..."}
+    """
+
+    def __init__(self, entries: list[dict], cap: int):
+        self.entries = entries
+        self.cap = cap
+        for i, e in enumerate(entries):
+            missing = {"rule", "path", "symbol", "justification"} - set(e)
+            if missing:
+                raise BaselineError(
+                    f"baseline entry {i} is missing {sorted(missing)}"
+                )
+            if not str(e["justification"]).strip():
+                raise BaselineError(
+                    f"baseline entry {i} ({e['rule']} {e['path']}) has "
+                    "an empty justification — baselines are for "
+                    "DOCUMENTED false positives only"
+                )
+        if len(entries) > cap:
+            raise BaselineError(
+                f"baseline holds {len(entries)} entries but is capped "
+                f"at {cap}; fix the new findings instead of baselining "
+                "them (raising the cap is a reviewed change)"
+            )
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(
+            list(data.get("entries", [])), int(data.get("cap", 0))
+        )
+
+    def split(
+        self,
+        findings: list[Finding],
+        *,
+        active_rules: set[str] | None = None,
+        scan_prefixes: list[str] | None = None,
+    ) -> tuple[list[Finding], list[Finding]]:
+        """(fresh, baselined). Raises :class:`BaselineError` on a stale
+        entry — one matching no finding.
+
+        Staleness is judged only over entries the scan could have
+        matched: a ``--rules`` subset or a sub-path scan must not die
+        on the full baseline's out-of-scope entries (``active_rules``:
+        rule ids that ran; ``scan_prefixes``: relpath prefixes covered
+        by the scan roots). An entry whose FILE was deleted is still
+        stale on a covering scan — the prefix test is against the scan
+        roots, not against the files found under them.
+        """
+        keys = {
+            (e["rule"], e["path"], e["symbol"]): e for e in self.entries
+        }
+        hit: set[tuple] = set()
+        fresh, old = [], []
+        for f in findings:
+            if f.key() in keys:
+                hit.add(f.key())
+                old.append(f)
+            else:
+                fresh.append(f)
+
+        def applicable(k: tuple[str, str, str]) -> bool:
+            rule, path, _ = k
+            if active_rules is not None and rule not in active_rules:
+                return False
+            if scan_prefixes is not None and not any(
+                path == p or path.startswith(p + "/")
+                for p in scan_prefixes
+            ):
+                return False
+            return True
+
+        stale = [k for k in keys if k not in hit and applicable(k)]
+        if stale:
+            raise BaselineError(
+                "stale baseline entries (match no current finding — "
+                f"delete them): {sorted(stale)}"
+            )
+        return fresh, old
+
+
+# --------------------------------------------------------------------------
+# per-file cache
+# --------------------------------------------------------------------------
+
+
+def _tool_fingerprint() -> str:
+    """sha over the graftcheck package's own sources: any edit to the
+    framework or a checker invalidates every cached result."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__"
+        )
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                with open(os.path.join(dirpath, f), "rb") as fh:
+                    h.update(fh.read())
+    return h.hexdigest()
+
+
+class _Cache:
+    """{(relpath, content sha) key -> [finding dicts]} for the
+    per-file checkers, valid for one (tool fingerprint, active rule
+    set) — stored alongside, checked on load. The rule set is part of
+    the fingerprint because a ``--rules`` subset run records only its
+    subset's findings; without the salt a later full scan would
+    replay those partial results as if they were complete (a dirty
+    tree reading clean)."""
+
+    def __init__(self, path: str | None, salt: str = ""):
+        self.path = path
+        self.fingerprint = _tool_fingerprint() + "|" + salt
+        self.data: dict[str, list[dict]] = {}
+        self.dirty = False
+        if path and os.path.exists(path):
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    raw = json.load(f)
+                if raw.get("fingerprint") == self.fingerprint:
+                    self.data = raw.get("files", {})
+            except (OSError, ValueError):
+                self.data = {}
+
+    _FIELDS = frozenset(
+        ("rule", "path", "line", "col", "symbol", "message")
+    )
+
+    def get(self, key: str) -> list[Finding] | None:
+        """Cached findings for ``key``, or None. The file's contents
+        are NOT trusted: any structurally invalid entry voids that
+        sha's record (treated as a miss and re-analyzed) instead of
+        crashing or replaying garbage."""
+        got = self.data.get(key)
+        if not isinstance(got, list):
+            return None
+        out = []
+        for d in got:
+            if not (
+                isinstance(d, dict) and set(d) == self._FIELDS
+            ):
+                return None
+            out.append(Finding(**d))
+        return out
+
+    def put(self, key: str, findings: list[Finding]) -> None:
+        self.data[key] = [f.__dict__ for f in findings]
+        self.dirty = True
+
+    def save(self) -> None:
+        if not self.path or not self.dirty:
+            return
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(
+                    {"fingerprint": self.fingerprint,
+                     "files": self.data},
+                    f,
+                )
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # a cache that cannot persist is just a slow cache
+
+
+# --------------------------------------------------------------------------
+# the runner
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class RunResult:
+    fresh: list[Finding]
+    baselined: list[Finding]
+    suppressed: list[Finding]
+    n_files: int
+    n_rules: int
+    baseline_size: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.fresh
+
+
+def run(
+    paths: Iterable[str],
+    *,
+    baseline_path: str | None = None,
+    cache_path: str | None = None,
+    rules: Iterable[str] | None = None,
+    progress: Callable[[str], None] | None = None,
+) -> RunResult:
+    """Analyze ``paths`` with every registered checker.
+
+    Returns a :class:`RunResult`; raises :class:`BaselineError` when
+    the baseline file itself is invalid. ``rules`` restricts to a
+    subset of rule ids (the fixture tests use this to isolate one
+    checker).
+    """
+    paths = [str(p) for p in paths]  # consumed twice (modules, prefixes)
+    checkers = all_checkers()
+    if rules is not None:
+        want = set(rules)
+        unknown = want - set(checkers)
+        if unknown:
+            raise ValueError(f"unknown rules {sorted(unknown)}")
+        checkers = {r: c for r, c in checkers.items() if r in want}
+    mods = load_modules(paths)
+    by_path = {m.relpath: m for m in mods}
+
+    per_file = [c for c in checkers.values() if not c.project]
+    project = [c for c in checkers.values() if c.project]
+    cache = _Cache(
+        cache_path, salt=",".join(sorted(c.rule for c in per_file))
+    )
+
+    findings: list[Finding] = []
+    for mod in mods:
+        # keyed on (relpath, content sha) — NOT content alone: checker
+        # results are path-dependent (GC002's CompilerParams home), so
+        # two identical-content files at different paths must never
+        # replay each other's records
+        key = f"{mod.relpath}\0{mod.sha}"
+        cached = cache.get(key)
+        if cached is not None and per_file:
+            findings += cached
+            continue
+        mine: list[Finding] = []
+        for chk in per_file:
+            mine += list(chk.check_module(mod))
+        cache.put(key, mine)
+        findings += mine
+        if progress is not None:
+            progress(mod.relpath)
+    for chk in project:
+        findings += list(chk.check_project(mods))
+    cache.save()
+
+    live: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None and is_suppressed(mod, f):
+            suppressed.append(f)
+        else:
+            live.append(f)
+
+    if baseline_path is not None and not os.path.exists(baseline_path):
+        # a typo'd --baseline must be a loud config error, not a
+        # silent ledger-off run (the CLI documents exit 2 for this)
+        raise BaselineError(
+            f"baseline file not found: {baseline_path} "
+            "(pass --baseline none to run without one)"
+        )
+    if baseline_path:
+        # the prefix a scan root covers, in the same namespace the
+        # relpaths use (relative to the enclosing package's parent)
+        prefixes = [
+            os.path.relpath(
+                os.path.abspath(p), package_base(os.path.abspath(p))
+            ).replace(os.sep, "/")
+            for p in paths
+        ]
+        bl = Baseline.load(baseline_path)
+        fresh, baselined = bl.split(
+            live,
+            active_rules=set(checkers),
+            scan_prefixes=prefixes,
+        )
+        baseline_size = len(bl.entries)
+    else:
+        fresh, baselined, baseline_size = live, [], 0
+
+    order = lambda f: (f.path, f.line, f.rule)  # noqa: E731
+    return RunResult(
+        fresh=sorted(fresh, key=order),
+        baselined=sorted(baselined, key=order),
+        suppressed=sorted(suppressed, key=order),
+        n_files=len(mods),
+        n_rules=len(checkers),
+        baseline_size=baseline_size,
+    )

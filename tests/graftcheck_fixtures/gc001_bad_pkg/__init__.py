@@ -1,0 +1,4 @@
+"""GC001 bad fixture: the root pulls in a module that imports jax at
+module level — the closure is no longer jax-free."""
+
+from .core import Pool  # noqa: F401

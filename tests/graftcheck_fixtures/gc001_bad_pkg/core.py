@@ -1,0 +1,11 @@
+"""Reachable from the package root; the jax import below is the
+violation (line 6 — pinned by the fixture test)."""
+
+import numpy as np  # the sanctioned hard dependency
+
+import jax  # GC001: module-level accelerator-stack import
+
+
+class Pool:
+    def run(self, x):
+        return jax.numpy.asarray(np.asarray(x))

@@ -1,0 +1,4 @@
+"""GC001 good fixture: jax stays behind lazy imports and
+TYPE_CHECKING, exactly the escape hatches the rule sanctions."""
+
+from .core import Pool  # noqa: F401

@@ -1,0 +1,15 @@
+"""Reachable from the root, but every jax touch is lazy."""
+
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+if TYPE_CHECKING:  # never executes: sanctioned
+    import jax
+
+
+class Pool:
+    def run(self, x):
+        import jax  # lazy: first device use pays it, import does not
+
+        return jax.numpy.asarray(np.asarray(x))

@@ -1,0 +1,23 @@
+"""GC002 bad fixture: shimmed jax APIs used without importing
+_jax_compat, plus a direct pltpu.CompilerParams access outside its
+home module. Violation lines pinned by the fixture test."""
+
+import jax
+from jax.experimental import pallas as pl  # noqa: F401
+from jax.experimental.pallas import tpu as pltpu
+
+
+def sharded(f, mesh, spec):
+    return jax.shard_map(  # GC002 line 11: no _jax_compat import
+        f, mesh=mesh, in_specs=spec, out_specs=spec
+    )
+
+
+def axis(name):
+    return jax.lax.axis_size(name)  # GC002 line 17
+
+
+def params():
+    return pltpu.CompilerParams(  # GC002 line 21: outside flash home
+        dimension_semantics=("parallel",)
+    )

@@ -1,0 +1,21 @@
+"""GC002 good fixture: the module-level _jax_compat import makes the
+shimmed spellings safe on lagging toolchains; CompilerParams is
+reached through the flash module's alias."""
+
+import jax
+from mpistragglers_jl_tpu import _jax_compat  # noqa: F401
+from mpistragglers_jl_tpu.ops.flash_attention import _CompilerParams
+
+
+def sharded(f, mesh, spec):
+    return jax.shard_map(
+        f, mesh=mesh, in_specs=spec, out_specs=spec
+    )
+
+
+def axis(name):
+    return jax.lax.axis_size(name)
+
+
+def params():
+    return _CompilerParams(dimension_semantics=("parallel",))

@@ -1,0 +1,39 @@
+"""GC003 bad fixture: host effects and tracer leaks inside traced
+code. Violation lines pinned by the fixture test; one site carries a
+suppression to pin the round-trip."""
+
+import functools
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def leaky(x):
+    t0 = time.perf_counter()  # GC003 line 16: host clock
+    noise = np.random.normal(size=3)  # GC003 line 17: host RNG
+    if x > 0:  # GC003 line 18: Python branch on traced arg
+        x = x + jnp.asarray(noise)
+    return x, t0
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def casty(x):
+    return float(x)  # GC003 line 25: concretizes the tracer
+
+
+def scanner(xs):
+    def body(carry, x):
+        stamp = time.time()  # GC003 line 30: host clock in scan body
+        return carry + x, stamp
+
+    return jax.lax.scan(body, jnp.zeros(()), xs)
+
+
+@jax.jit
+def suppressed(x):
+    t = time.time()  # graftcheck: disable=GC003  (pinned round-trip)
+    return x, t

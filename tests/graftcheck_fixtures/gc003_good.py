@@ -1,0 +1,28 @@
+"""GC003 good fixture: every allowance the rule grants — static
+shape/dtype/`is None` branching inside traced code, and free use of
+host clocks OUTSIDE it."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clean(x, eos_id=None):
+    if eos_id is None:  # static config test: allowed
+        eos_id = 0
+    if x.shape[0] > 4:  # shape is a trace-time constant: allowed
+        x = x[:4]
+    n = len(x.shape)  # len(): allowed
+    return jnp.where(x > 0, x, eos_id) * n
+
+
+def host_step(xs):
+    t0 = time.perf_counter()  # not traced: allowed
+
+    def body(carry, x):
+        return carry + jnp.square(x), x.dtype.type(0)
+
+    out = jax.lax.scan(body, jnp.zeros(()), xs)
+    return out, time.perf_counter() - t0

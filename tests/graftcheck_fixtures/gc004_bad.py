@@ -1,0 +1,18 @@
+"""GC004 bad fixture: opt-in contract violations. Violation lines
+pinned by the fixture test."""
+
+
+def serve(payload, registry):  # GC004 line 5: public, no default
+    registry.counter("serving_requests_total").inc()
+    return payload
+
+
+def tick(payload, tracer=None):
+    tracer.begin("tick", 0, 0)  # GC004 line 11: unguarded deref
+    return payload
+
+
+def observe(payload, registry=None):
+    if registry is not None:
+        registry.counter("serving.bad.name").inc()  # GC004 line 17
+    return payload

@@ -1,0 +1,42 @@
+"""GC004 good fixture: every guard shape the rule accepts."""
+
+
+def serve(payload, registry=None):
+    if registry is not None:
+        registry.counter("serving_requests_total").inc()
+    return payload
+
+
+def tick(payload, tracer=None, registry=None):
+    if tracer is None:
+        return payload  # early-return: everything below is guarded
+    tracer.begin("tick", 0, 0)
+    depth = registry.gauge("queue_depth") if registry is not None else None
+    ok = registry is not None and registry.counter("ticks_total")
+    if ok:
+        ok.inc()
+    forward(payload, tracer=tracer, registry=registry)  # bare forward
+    return depth
+
+
+def forward(payload, *, tracer=None, registry=None):
+    del tracer, registry
+    return payload
+
+
+def branchy(payload, tracer=None):
+    """The plain if/else guard (no early return): the else branch is
+    proven not-None and must not be re-visited unguarded."""
+    if tracer is None:
+        payload = payload * 2
+    else:
+        tracer.begin("tick", 0, 0)
+    return payload
+
+
+class _Bundle:
+    """Private helper on the instrumented side of the guard: a
+    required registry is its contract, not a dark-path kwarg."""
+
+    def __init__(self, registry):
+        self.requests = registry.counter("hedge_requests_total")

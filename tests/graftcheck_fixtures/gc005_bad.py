@@ -1,0 +1,25 @@
+"""GC005 bad fixture: cross-thread writes with no lock. Violation
+lines pinned by the fixture test."""
+
+import threading
+
+
+class Harvester:
+    def __init__(self):
+        self.results = {}
+        self.closed = False  # __init__ writes are exempt
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self.closed:
+            self.results = dict(self.results)  # GC005 line 17
+            self.closed = self.closed or False  # GC005 line 18
+
+    def reset(self):
+        self.results = {}  # GC005 line 21: races _loop, unlocked
+        self.closed = False  # GC005 line 22
+
+    def read_only(self):
+        return len(self.results)  # reads are out of scope

@@ -1,0 +1,30 @@
+"""GC005 good fixture: the same shape with every cross-thread write
+under the lock (and a single-writer attribute, which is exempt)."""
+
+import threading
+
+
+class Harvester:
+    def __init__(self):
+        self.results = {}
+        self.closed = False
+        self.stats = None
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                if self.closed:
+                    return
+                self.results = dict(self.results)
+
+    def reset(self):
+        with self._lock:
+            self.results = {}
+            self.closed = False
+
+    def summarize(self):
+        self.stats = len(self.results)  # single writer: exempt
+        return self.stats

@@ -156,11 +156,17 @@ def test_uncoded_gemm_full():
 
 
 def test_uncoded_gemm_fastest_k_masks_straggler_rows():
+    """`repochs[2] == 0` below needs the three fast workers to finish
+    inside the straggler's injected delay; at the old 50 ms a loaded
+    CI box could occasionally run the fast sub-ms matmuls slower than
+    the stall and the straggler arrived in time (observed flake). The
+    bound is generous now — 0.5 s buys ~3 orders of margin over the
+    fast path while waitall's drain only pays the remainder once."""
     rng = np.random.default_rng(1)
     n = 4
     A = rng.standard_normal((64, 32)).astype(np.float32)
     B = rng.standard_normal((32, 16)).astype(np.float32)
-    delay_fn = lambda i, e: 0.050 if i == 2 else 0.0
+    delay_fn = lambda i, e: 0.5 if i == 2 else 0.0
     g = DistributedGemm(A, n, delay_fn=delay_fn)
     pool = AsyncPool(n)
     repochs = asyncmap(pool, B, g.backend, nwait=3)

@@ -1,0 +1,373 @@
+"""graftcheck (tools/graftcheck): the tier-1 static-analysis gate.
+
+Three layers: (1) the fixture corpus pins each rule's exact findings —
+rule ids AND line numbers — plus the good twin staying clean; (2) the
+suppression/baseline/cache machinery round-trips; (3) the SELF-RUN:
+the analyzer over the whole shipped package must be clean, fast, and
+must not import jax — this is the test that makes every invariant in
+the rule catalog gate every future PR.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mpistragglers_jl_tpu.tools.graftcheck import (
+    Baseline,
+    BaselineError,
+    run,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO, "mpistragglers_jl_tpu")
+_FIX = os.path.join(_REPO, "tests", "graftcheck_fixtures")
+
+
+def _findings(target, **kw):
+    res = run([os.path.join(_FIX, target)], **kw)
+    return res
+
+
+def _keys(findings):
+    return [(f.rule, f.line) for f in findings]
+
+
+# --------------------------------------------------------------------------
+# fixture corpus: exact rule ids + line numbers per checker
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "bad,expected",
+    [
+        ("gc001_bad_pkg", [("GC001", 6)]),
+        ("gc002_bad.py", [("GC002", 11), ("GC002", 17), ("GC002", 21)]),
+        (
+            "gc003_bad.py",
+            [("GC003", 16), ("GC003", 17), ("GC003", 18),
+             ("GC003", 25), ("GC003", 30)],
+        ),
+        ("gc004_bad.py", [("GC004", 5), ("GC004", 11), ("GC004", 17)]),
+        (
+            "gc005_bad.py",
+            [("GC005", 17), ("GC005", 18), ("GC005", 21),
+             ("GC005", 22)],
+        ),
+    ],
+)
+def test_bad_fixture_exact_findings(bad, expected):
+    res = _findings(bad)
+    assert _keys(res.fresh) == expected
+    assert not res.baselined
+
+
+@pytest.mark.parametrize(
+    "good",
+    ["gc001_good_pkg", "gc002_good.py", "gc003_good.py",
+     "gc004_good.py", "gc005_good.py"],
+)
+def test_good_fixture_clean(good):
+    res = _findings(good)
+    assert res.fresh == [], [f.format() for f in res.fresh]
+
+
+def test_rule_subset_isolates_one_checker():
+    res = _findings("gc003_bad.py", rules=["GC005"])
+    assert res.fresh == []
+    with pytest.raises(ValueError, match="unknown rules"):
+        _findings("gc003_bad.py", rules=["GC999"])
+
+
+# --------------------------------------------------------------------------
+# suppression / baseline / cache round-trips
+# --------------------------------------------------------------------------
+
+
+def test_suppression_roundtrip():
+    """Line 38 of gc003_bad.py carries `# graftcheck: disable=GC003`:
+    the finding moves to the suppressed bucket, never to fresh."""
+    res = _findings("gc003_bad.py")
+    assert ("GC003", 38) in _keys(res.suppressed)
+    assert ("GC003", 38) not in _keys(res.fresh)
+
+
+def test_baseline_roundtrip(tmp_path):
+    entry = {
+        "rule": "GC004",
+        "path": "gc004_bad.py",
+        "symbol": "serve",
+        "justification": "fixture: exercising the ledger",
+    }
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"cap": 1, "entries": [entry]}))
+    res = _findings("gc004_bad.py", baseline_path=str(bl))
+    assert _keys(res.baselined) == [("GC004", 5)]
+    assert _keys(res.fresh) == [("GC004", 11), ("GC004", 17)]
+    assert res.baseline_size == 1
+
+
+def test_baseline_stale_entry_fails(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({
+        "cap": 1,
+        "entries": [{
+            "rule": "GC004", "path": "gc004_bad.py",
+            "symbol": "no_such_function",
+            "justification": "matches nothing",
+        }],
+    }))
+    with pytest.raises(BaselineError, match="stale"):
+        _findings("gc004_bad.py", baseline_path=str(bl))
+
+
+def test_baseline_cap_and_justification_enforced():
+    entry = {
+        "rule": "GC004", "path": "p.py", "symbol": "f",
+        "justification": "ok",
+    }
+    with pytest.raises(BaselineError, match="capped"):
+        Baseline([entry, {**entry, "symbol": "g"}], cap=1)
+    with pytest.raises(BaselineError, match="justification"):
+        Baseline([{**entry, "justification": "  "}], cap=5)
+    with pytest.raises(BaselineError, match="missing"):
+        Baseline([{"rule": "GC004"}], cap=5)
+
+
+def test_cache_roundtrip(tmp_path):
+    cache = str(tmp_path / "cache.json")
+    first = _findings("gc005_bad.py", cache_path=cache)
+    assert os.path.exists(cache)
+    second = _findings("gc005_bad.py", cache_path=cache)
+    assert _keys(second.fresh) == _keys(first.fresh)
+    # cached findings carry the full identity, not just the keys
+    assert [f.format() for f in second.fresh] == [
+        f.format() for f in first.fresh
+    ]
+
+
+def test_cache_keyed_by_rule_subset(tmp_path):
+    """A --rules subset run must not poison the cache for a later full
+    scan (review finding): the subset's partial results are keyed
+    separately, so the full scan re-analyzes and reports everything."""
+    cache = str(tmp_path / "cache.json")
+    subset = _findings("gc003_bad.py", cache_path=cache,
+                       rules=["GC005"])
+    assert subset.fresh == []
+    full = _findings("gc003_bad.py", cache_path=cache)
+    assert ("GC003", 16) in _keys(full.fresh)
+    # and the reverse: the full-run cache must not leak other rules'
+    # findings into a subset run
+    again = _findings("gc003_bad.py", cache_path=cache,
+                      rules=["GC005"])
+    assert again.fresh == []
+
+
+def test_baseline_scoped_to_partial_scans():
+    """The shipped baseline's GC004 entry is out of scope for a rules
+    subset or a sub-path scan — neither may die with a stale-baseline
+    error (review finding: docs' own --rules example exited 2)."""
+    from mpistragglers_jl_tpu.tools.graftcheck import DEFAULT_BASELINE
+
+    sub = run(
+        [os.path.join(_PKG, "models")],
+        baseline_path=DEFAULT_BASELINE,
+    )
+    assert sub.ok
+    subset = run(
+        [_PKG], baseline_path=DEFAULT_BASELINE,
+        rules=["GC003", "GC005"],
+    )
+    assert subset.ok
+    # staleness on a COVERING scan keeps working: pinned by
+    # test_baseline_stale_entry_fails (entry under the scan root,
+    # matching nothing -> BaselineError)
+
+
+def test_baseline_matches_on_subpath_and_single_file_scans():
+    """Finding paths are package-root-relative no matter where inside
+    the package the scan starts (package_base walks up past
+    __init__.py), so the shipped baseline's entry keeps matching —
+    a sub-path or single-file scan of a clean tree exits clean with
+    the false positive still baselined, not resurfaced fresh (review
+    finding)."""
+    from mpistragglers_jl_tpu.tools.graftcheck import DEFAULT_BASELINE
+
+    for target in (
+        os.path.join(_PKG, "utils"),
+        os.path.join(_PKG, "utils", "straggle.py"),
+    ):
+        res = run([target], baseline_path=DEFAULT_BASELINE)
+        assert res.ok, "\n".join(f.format() for f in res.fresh)
+        assert [f.key() for f in res.baselined] == [
+            ("GC004", "mpistragglers_jl_tpu/utils/straggle.py",
+             "PoolLatencyModel.publish")
+        ]
+
+
+def test_missing_baseline_is_config_error():
+    """A typo'd baseline path must be exit-2 loud, not a silent
+    ledger-off run (review finding)."""
+    with pytest.raises(BaselineError, match="not found"):
+        run([os.path.join(_FIX, "gc004_bad.py")],
+            baseline_path="/no/such/baseline.json")
+
+
+def test_identical_content_distinct_paths_not_conflated(tmp_path):
+    """GC002's verdict depends on the file's PATH (CompilerParams is
+    legal only in its home module), so two identical-content files
+    must be analyzed separately — the result record is keyed on
+    (relpath, sha), not content alone (review finding)."""
+    pkg = tmp_path / "pkg" / "ops"
+    pkg.mkdir(parents=True)
+    (tmp_path / "pkg" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    src = (
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "def params():\n"
+        "    return pltpu.CompilerParams()\n"
+    )
+    (pkg / "flash_attention.py").write_text(src)  # the home: legal
+    (pkg / "attn_copy.py").write_text(src)  # same bytes: violation
+    for cache in (None, str(tmp_path / "c.json")):
+        res = run([str(tmp_path / "pkg")], cache_path=cache)
+        assert [(f.rule, f.path) for f in res.fresh] == [
+            ("GC002", "pkg/ops/attn_copy.py")
+        ], [f.format() for f in res.fresh]
+
+
+def test_gc004_nested_early_return_does_not_prove(tmp_path):
+    """An `if x is None: return` nested inside another conditional
+    dominates nothing outside its block: the deref after the enclosing
+    `if` still runs with x=None when the condition is false, and must
+    be flagged (review finding). The same guard at the function's top
+    level, or at the top level of a closure, still proves."""
+    p = tmp_path / "m.py"
+    p.write_text(
+        "def f(payload, flag, tracer=None):\n"
+        "    if flag:\n"
+        "        if tracer is None:\n"
+        "            return payload\n"
+        "    tracer.begin('t')\n"  # line 5: unguarded when not flag
+        "    return payload\n"
+        "\n"
+        "def g(tracer=None):\n"
+        "    def inner():\n"
+        "        if tracer is None:\n"
+        "            return None\n"
+        "        return tracer.begin('t')\n"  # closure top level: ok
+        "    inner()\n"
+        "    tracer.begin('t')\n"  # line 14: inner's guard is local
+        "    return None\n"
+    )
+    res = run([str(p)], rules=["GC004"])
+    assert [(f.rule, f.line) for f in res.fresh] == [
+        ("GC004", 5), ("GC004", 14)
+    ], [f.format() for f in res.fresh]
+
+
+def test_cache_rejects_malformed_entries(tmp_path):
+    """Cache contents are untrusted: a structurally invalid record is
+    a miss (re-analyzed), never a crash or a replayed fabrication
+    (review finding)."""
+    from mpistragglers_jl_tpu.tools.graftcheck.core import _Cache
+
+    c = _Cache(str(tmp_path / "c.json"), salt="s")
+    c.data["sha1"] = [{"rule": "GC001"}]  # missing fields
+    c.data["sha2"] = "not-a-list"
+    c.data["sha3"] = [{"rule": "GC001", "path": "p", "line": 1,
+                       "col": 0, "symbol": "s", "message": "m",
+                       "extra": "smuggled"}]
+    assert c.get("sha1") is None
+    assert c.get("sha2") is None
+    assert c.get("sha3") is None
+    assert c.get("absent") is None
+
+
+# --------------------------------------------------------------------------
+# the self-run gate
+# --------------------------------------------------------------------------
+
+
+def test_package_self_run_is_clean():
+    """The shipped tree passes its own analyzer: zero fresh findings
+    against the checked-in baseline. Every future PR inherits this
+    gate."""
+    from mpistragglers_jl_tpu.tools.graftcheck import DEFAULT_BASELINE
+
+    res = run([_PKG], baseline_path=DEFAULT_BASELINE)
+    assert res.ok, "\n".join(f.format() for f in res.fresh)
+    assert res.n_rules == 5
+    assert res.n_files > 50  # the whole package, not a subset
+
+
+def test_cli_self_run_subprocess_no_jax():
+    """CLI contract: `python -m mpistragglers_jl_tpu.tools.graftcheck
+    mpistragglers_jl_tpu/` exits 0 on the shipped tree AND the tool
+    itself never imports jax (stdlib ast only) — asserted inside the
+    subprocess, where nothing else has polluted sys.modules."""
+    code = (
+        "import sys\n"
+        "from mpistragglers_jl_tpu.tools.graftcheck.__main__ "
+        "import main\n"
+        "rc = main(['mpistragglers_jl_tpu', '--no-cache', '-q'])\n"
+        "bad = [m for m in sys.modules"
+        " if m == 'jax' or m.startswith('jax.')]\n"
+        "assert not bad, f'graftcheck pulled in jax: {bad}'\n"
+        "sys.exit(rc)\n"
+    )
+    env = dict(os.environ)
+    # drop any sitecustomize that preloads jax (same discipline as
+    # test_import_is_jax_free)
+    env["PYTHONPATH"] = _REPO
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, cwd=_REPO, env=env,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_exit_codes():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m",
+             "mpistragglers_jl_tpu.tools.graftcheck", *args],
+            capture_output=True, text=True, cwd=_REPO, env=env,
+            timeout=120,
+        )
+
+    bad = cli(os.path.join(_FIX, "gc002_bad.py"),
+              "--baseline", "none", "--no-cache")
+    assert bad.returncode == 1
+    assert "GC002" in bad.stdout
+    good = cli(os.path.join(_FIX, "gc002_good.py"),
+               "--baseline", "none", "--no-cache")
+    assert good.returncode == 0
+    missing = cli("definitely/not/a/path.py")
+    assert missing.returncode == 2
+    rules = cli("--list-rules")
+    assert rules.returncode == 0
+    for rule in ("GC001", "GC002", "GC003", "GC004", "GC005"):
+        assert rule in rules.stdout
+
+
+def test_bad_snippet_injection_fails_package_scan(tmp_path):
+    """Acceptance shape: copying any bad fixture into a scanned tree
+    flips the exit to non-zero — the gate actually gates."""
+    import shutil
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    shutil.copy(
+        os.path.join(_FIX, "gc005_bad.py"), pkg / "harvest.py"
+    )
+    res = run([str(pkg)])
+    assert not res.ok
+    assert {f.rule for f in res.fresh} == {"GC005"}

@@ -256,6 +256,35 @@ def test_quantized_scheduler_matches_quantized_oracle():
         )
 
 
+def test_quantized_parity_is_chunk_size_invariant():
+    """The load-bearing premise of the identity above (ADVICE r5 ->
+    repaired in PR 1): admission attends the ALREADY-QUANTIZED cache,
+    and because quantization is per-position absmax (a position's
+    scale never depends on its neighbours), the chunking itself must
+    be invisible — the same request must emit the same stream at ANY
+    prompt_chunk, including one larger than the whole prompt (the
+    oracle's shape). If this ever breaks, the scheduler==oracle parity
+    silently degrades from identity to coincidence; this test makes
+    that failure loud and names the property, not just the symptom."""
+    prompts = [(_prompt(11), 7), (_prompt(4), 9)]
+    streams = []
+    for chunk in (2, 4, 8, 16):
+        sched = ServingScheduler(PARAMS, CFG, slots=2, n_inner=3,
+                                 prompt_chunk=chunk, max_prompt=32,
+                                 quantize_kv=True)
+        reqs = [sched.submit(p, max_new=n) for p, n in prompts]
+        sched.run()
+        streams.append([r.tokens for r in reqs])
+    for other in streams[1:]:
+        assert other == streams[0]
+    # and the chunk-invariant stream IS the oracle stream
+    for (p, n), toks in zip(prompts, streams[0]):
+        want = generate_ring_dense(
+            PARAMS, jnp.asarray(p)[None], n, CFG, quantize_kv=True
+        )
+        assert toks == [int(t) for t in np.asarray(want)[0]]
+
+
 def test_quantized_scheduler_kernel_tick_matches_oracle():
     """head_dim-128 config at S=4 slots: the scheduler's tick routes
     the batched int8 Pallas ring kernel (AUTO gate — S >= 4 amortizes

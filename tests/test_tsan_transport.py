@@ -57,8 +57,23 @@ def _sanitizer_usable(flag: str) -> bool:
         # handles, shm regions, and peer state must all be released by
         # destroy/close — a leak or heap error fails the run
         ("-fsanitize=address", {"ASAN_OPTIONS": "halt_on_error=1 exitcode=66 detect_leaks=1"}),
+        # ASan+UBSan combined: the frame codec does the pointer-cast /
+        # length-arithmetic work (size headers, offset math into
+        # payload buffers, enum kinds off the wire) where undefined
+        # behavior hides without corrupting memory — shift overflows,
+        # misaligned loads, out-of-range enums. One combined binary
+        # (the probe compiles the joint flag, skipping cleanly where
+        # either runtime is absent); UBSan halts like ASan so a UB
+        # report is a test failure, not a stderr footnote.
+        (
+            "-fsanitize=address,undefined",
+            {
+                "ASAN_OPTIONS": "halt_on_error=1 exitcode=66 detect_leaks=1",
+                "UBSAN_OPTIONS": "halt_on_error=1 exitcode=66 print_stacktrace=1",
+            },
+        ),
     ],
-    ids=["tsan", "asan+lsan"],
+    ids=["tsan", "asan+lsan", "asan+ubsan"],
 )
 def test_transport_under_sanitizer(tmp_path, flag, env_opts):
     if not _sanitizer_usable(flag):
