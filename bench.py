@@ -864,9 +864,23 @@ def bench_observability(epochs=50, n=8):
     measures the pool side end to end). Thread workers with small
     deterministic delays: epoch wall is milliseconds, instrument cost
     is microseconds, so overhead_pct ~ 0 is the expected healthy
-    reading."""
+    reading.
+
+    Round-9 extension (live telemetry plane): the instrumented
+    registry is then served by an ObsServer and scraped over real HTTP
+    — `scrape_ms_p50` / `scrape_ms_p95` are the /metrics GET wall
+    (loopback, Prometheus text of the full series set, `scrape_series`
+    wide), the operator-facing latency of the production scrape path —
+    and a third pool loop runs with a FlightRecorder attached
+    (`flight_epoch_ms`, `flight_overhead_pct` vs dark) plus the raw
+    per-record ring cost (`flight_record_us`), the price of keeping
+    the postmortem ring armed in production."""
     from mpistragglers_jl_tpu import AsyncPool, LocalBackend, asyncmap, waitall
-    from mpistragglers_jl_tpu.obs import MetricsRegistry
+    from mpistragglers_jl_tpu.obs import (
+        FlightRecorder,
+        MetricsRegistry,
+        ObsServer,
+    )
     from mpistragglers_jl_tpu.utils import (
         EpochTracer,
         HedgedServer,
@@ -918,14 +932,73 @@ def bench_observability(epochs=50, n=8):
             backend.shutdown()
         return per_epoch, tracer, registry
 
+    def run_flight():
+        """The dark loop again, with only a FlightRecorder attached:
+        the marginal cost of keeping the postmortem ring armed."""
+        backend = LocalBackend(work, n, delay_fn=delays)
+        fl = FlightRecorder()
+        try:
+            pool = AsyncPool(n)
+            payload = np.ones(64, np.float32)
+            asyncmap(pool, payload, backend, nwait=n - 2)  # warmup
+            waitall(pool, backend)
+            t0 = time.perf_counter()
+            for _ in range(epochs):
+                asyncmap(pool, payload, backend, nwait=n - 2,
+                         flight=fl)
+            per_epoch = (time.perf_counter() - t0) / epochs
+            waitall(pool, backend, flight=fl)
+        finally:
+            backend.shutdown()
+        # raw ring record cost, isolated from the pool loop
+        reps = 20_000
+        t0 = time.perf_counter()
+        for i in range(reps):
+            fl.span("probe", 0.0, 1e-6, track="bench", i=i)
+        record_us = (time.perf_counter() - t0) / reps * 1e6
+        return per_epoch, record_us
+
+    def scrape(registry, reps=25):
+        """Serve the instrumented registry and GET /metrics over real
+        HTTP `reps` times: the operator's scrape-path latency."""
+        import urllib.request
+
+        walls = []
+        with ObsServer(registry) as srv:
+            url = srv.url + "/metrics"
+            urllib.request.urlopen(url).read()  # connection warmup
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                body = urllib.request.urlopen(url).read()
+                walls.append(time.perf_counter() - t0)
+        walls.sort()
+        return (
+            walls[len(walls) // 2] * 1e3,
+            walls[int(len(walls) * 0.95)] * 1e3,
+            body.count(b"\n"),
+        )
+
     dark_s, _, _ = run(False)
     inst_s, tracer, registry = run(True)
+    flight_s, flight_record_us = run_flight()
+    scrape_p50, scrape_p95, scrape_lines = scrape(registry)
     s = tracer.summary()
     snap = registry.snapshot()
     eh = snap["pool_epoch_seconds"]["series"][0]["value"]
     return {
         "noop_epoch_ms": round(dark_s * 1e3, 3),
         "instrumented_epoch_ms": round(inst_s * 1e3, 3),
+        # live-telemetry-plane fields (round 9): real-HTTP /metrics
+        # scrape wall + the flight ring's marginal pool cost
+        "scrape_ms_p50": round(scrape_p50, 3),
+        "scrape_ms_p95": round(scrape_p95, 3),
+        "scrape_series": len(registry),
+        "scrape_lines": scrape_lines,
+        "flight_epoch_ms": round(flight_s * 1e3, 3),
+        "flight_overhead_pct": round(
+            max(flight_s / dark_s - 1.0, 0.0) * 100, 2
+        ),
+        "flight_record_us": round(flight_record_us, 3),
         # thread-scheduling noise can make the instrumented loop read
         # FASTER than the dark one; clamp at 0 so the digest scalar
         # reads as "measured overhead", never a nonsense negative

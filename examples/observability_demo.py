@@ -1,6 +1,6 @@
-"""Unified runtime observability: one registry, one merged timeline.
+"""Unified runtime observability: one registry, one timeline, live HTTP.
 
-Three layers of the stack run instrumented and land in the SAME
+Four layers of the stack run instrumented and land in the SAME
 telemetry artifacts:
 
 1. a continuous-batching ``ServingScheduler`` (tiny transformer, CPU)
@@ -12,10 +12,18 @@ telemetry artifacts:
    with an ``EpochTracer`` and feeds a ``PoolLatencyModel`` whose
    per-worker fits publish into the same registry; a ``HedgedServer``
    on the same backend exports its fire rates beside them;
-3. everything merges: ``dump_merged_chrome_trace`` writes ONE
-   Chrome/Perfetto trace with the pool's worker/coordinator tracks and
-   the scheduler's tick track side by side on a shared clock — open it
-   at https://ui.perfetto.dev — and the registry dumps both Prometheus
+3. the LIVE telemetry plane: an ``ObsServer`` (loopback, port 0)
+   serves the registry while a straggling ``ProcessBackend`` pool —
+   real OS worker processes — runs with cross-process aggregation, and
+   the demo scrapes its own ``/metrics`` and ``/healthz`` over real
+   HTTP (``curl http://127.0.0.1:<printed port>/metrics`` works too
+   while it runs), then trips a ``FlightRecorder`` dump — the bounded
+   postmortem ring, with one Perfetto pid per worker process;
+4. everything merges: ``dump_merged_chrome_trace`` writes ONE
+   Chrome/Perfetto trace with the pool's worker/coordinator tracks,
+   the scheduler's tick track, and the worker processes' own task
+   spans (clock-aligned) side by side — open it at
+   https://ui.perfetto.dev — and the registry dumps both Prometheus
    text exposition and JSON.
 
 Run: ``python examples/observability_demo.py [outdir]`` (CPU-only,
@@ -33,8 +41,11 @@ sys.path.insert(
 )
 
 from mpistragglers_jl_tpu import AsyncPool, LocalBackend, asyncmap, waitall
+from mpistragglers_jl_tpu.backends.process import ProcessBackend
 from mpistragglers_jl_tpu.obs import (
+    FlightRecorder,
     MetricsRegistry,
+    ObsServer,
     SpanRecorder,
     dump_merged_chrome_trace,
 )
@@ -44,6 +55,21 @@ from mpistragglers_jl_tpu.utils import (
     PoolLatencyModel,
     faults,
 )
+
+
+def proc_work(i, payload, epoch):
+    """Module-level so it pickles into spawned worker processes."""
+    return payload * (i + 1)
+
+
+class ProcDelay:
+    """Picklable per-worker straggler injection for the process pool."""
+
+    def __init__(self, delays):
+        self.delays = list(delays)
+
+    def __call__(self, i, epoch):
+        return self.delays[i]
 
 
 def serving_section(registry, spans):
@@ -119,18 +145,79 @@ def pool_section(registry):
     return tracer
 
 
+def live_section(registry, flight, outdir):
+    """The telemetry plane: serve the registry over HTTP, run a real
+    process pool with cross-process aggregation, scrape ourselves."""
+    import urllib.request
+
+    srv = ObsServer(registry, flight=flight).start()
+    backend = ProcessBackend(
+        proc_work, 3, delay_fn=ProcDelay([0.002, 0.002, 0.05]),
+        registry=registry, flight=flight, exporter=srv,
+    )
+    try:
+        print(
+            f"live: ObsServer on {srv.url} — try "
+            f"`curl {srv.url}/metrics` while this runs"
+        )
+        pool = AsyncPool(3)
+        for _ in range(5):
+            asyncmap(pool, np.ones(8), backend, nwait=2, flight=flight)
+        waitall(pool, backend, flight=flight)
+
+        prom = urllib.request.urlopen(srv.url + "/metrics").read()
+        worker_lines = [
+            ln for ln in prom.decode().splitlines()
+            if ln.startswith("worker_tasks_total{")
+        ]
+        assert len(worker_lines) == 3, worker_lines  # one per process
+        health = json.loads(
+            urllib.request.urlopen(srv.url + "/healthz").read()
+        )
+        assert health["ok"] and "pool" in health["checks"]
+        trace = json.loads(
+            urllib.request.urlopen(srv.url + "/trace").read()
+        )
+        worker_pids = {
+            e["pid"] for e in trace["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "process_name"
+            and e["args"]["name"].startswith("worker ")
+        }
+        flight_path = os.path.join(outdir, "flight.json")
+        flight.arm(flight_path)
+        flight.trip("demo: operator-requested postmortem dump")
+        fdoc = json.load(open(flight_path))
+        assert any(
+            e.get("ph") == "I" and "postmortem" in e["name"]
+            for e in fdoc["traceEvents"]
+        )
+        print(
+            f"live: scraped {len(prom.splitlines())} exposition lines "
+            f"over HTTP, healthz ok, {len(worker_pids)} worker pids "
+            f"in /trace, flight ring ({len(flight)} entries) -> "
+            f"{flight_path}"
+        )
+        return backend.aggregator.recorders()
+    finally:
+        backend.shutdown()
+        srv.close()
+
+
 def main():
     outdir = sys.argv[1] if len(sys.argv) > 1 else "."
     os.makedirs(outdir, exist_ok=True)
     registry = MetricsRegistry()
     spans = SpanRecorder("serving")
+    flight = FlightRecorder()
 
     serving_section(registry, spans)
     tracer = pool_section(registry)
+    worker_recorders = live_section(registry, flight, outdir)
 
     trace_path = os.path.join(outdir, "unified_trace.json")
     n_events = dump_merged_chrome_trace(
-        trace_path, tracers=[tracer], recorders=[spans]
+        trace_path, tracers=[tracer],
+        recorders=[spans] + worker_recorders,
     )
     doc = json.load(open(trace_path))  # round-trips as valid JSON
     assert all(
@@ -153,6 +240,7 @@ def main():
         "serving_kernel_route_total",
         "pool_worker_latency_mean_seconds",
         "hedge_requests_total",
+        "worker_tasks_total",  # originated inside worker processes
     ):
         assert want in prom, want
     print(

@@ -20,14 +20,16 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import secrets
-from collections import deque
 import tempfile
+import time as _time
 import uuid
+from collections import deque
 from pathlib import Path
 from typing import Sequence
 
 from ..native import codec
 from ..native import transport as T
+from ..obs.aggregate import OBS_TAG as _OBS_TAG  # stdlib-only module
 from .base import Backend, Deadline, DeadWorkerError, DelayFn, WorkerError
 from .process import RemoteWorkerError, WorkerProcessDied, WorkFn
 
@@ -41,8 +43,6 @@ def _straggle_exhausted(ranks, deadline: Deadline, timeout):
     instead of hanging forever the way the reference's Waitall! does."""
     if timeout is None:
         raise DeadWorkerError(sorted({int(r) for r in ranks}), None)
-    import time as _time
-
     left = deadline.remaining()
     if left:
         _time.sleep(left)
@@ -51,7 +51,7 @@ def _straggle_exhausted(ranks, deadline: Deadline, timeout):
 
 def _native_worker_main(
     rank: int, path: str, work_fn: WorkFn, delay_fn: DelayFn | None,
-    token: bytes,
+    token: bytes, telemetry: bool = False,
 ) -> None:
     """Spawned-process entry: the shared worker loop (worker.py — the
     reference's receive -> stall -> compute -> send convention, SURVEY
@@ -59,7 +59,8 @@ def _native_worker_main(
     from ..worker import run_worker
 
     try:
-        run_worker(path, rank, work_fn, delay_fn, token=token)
+        run_worker(path, rank, work_fn, delay_fn, token=token,
+                   telemetry=telemetry)
     except (KeyboardInterrupt, Exception):
         pass
 
@@ -90,6 +91,9 @@ class NativeProcessBackend(Backend):
         accept: bool = True,
         auth: bytes | str | None = None,
         on_dead: str = "error",
+        registry=None,
+        flight=None,
+        exporter=None,
     ):
         """``address``: Unix-socket path (default: a fresh temp path) or
         ``tcp://host:port`` for multi-host (port 0 = ephemeral; the
@@ -111,7 +115,17 @@ class NativeProcessBackend(Backend):
         that can reach the port, and payloads are unpickled (arbitrary
         code execution); either pass an ``auth`` secret (give workers
         the same one via ``MSGT_AUTH`` / ``--auth-file``) or bind only
-        on a trusted network."""
+        on a trusted network.
+
+        ``registry`` / ``flight`` / ``exporter`` follow the obs/
+        contract (None = dark, zero cost): ``registry`` turns on
+        cross-process telemetry — spawned workers run with
+        ``telemetry=True`` (external ``spawn=False`` workers opt in
+        with ``--telemetry``) and their frames, arriving on the
+        reserved OBS tag, merge into the registry under
+        ``worker="<rank>"`` labels; ``flight`` mirrors merged worker
+        spans into the ring; ``exporter`` registers the pool health
+        check + trace sources on an :class:`~..obs.ObsServer`."""
         if on_dead not in ("error", "straggle"):
             raise ValueError(f"on_dead must be 'error'|'straggle', got {on_dead!r}")
         self.on_dead = on_dead
@@ -158,6 +172,13 @@ class NativeProcessBackend(Backend):
             auth = secrets.token_bytes(16) if self._spawn else b""
         self._token = auth.encode() if isinstance(auth, str) else bytes(auth)
         self._mp_context = mp_context
+        self.aggregator = None
+        if registry is not None or flight is not None:
+            from ..obs.aggregate import TelemetryAggregator
+
+            self.aggregator = TelemetryAggregator(
+                registry, flight=flight
+            )
         self._coord = T.Coordinator(
             address, self.n_workers, token=self._token
         )
@@ -169,6 +190,8 @@ class NativeProcessBackend(Backend):
                 self._spawn_worker(i)
         if accept:
             self.accept(timeout=connect_timeout)
+        if exporter is not None:
+            exporter.register_backend(self)
 
     def accept(self, timeout: float | None = None) -> None:
         """Complete the worker handshake (no-op if already done)."""
@@ -195,7 +218,7 @@ class NativeProcessBackend(Backend):
         proc = ctx.Process(
             target=_native_worker_main,
             args=(i, self._sock_path, self.work_fn, self.delay_fn,
-                  self._token),
+                  self._token, self.aggregator is not None),
             daemon=True,
             name=f"pool-native-worker-{i}",
         )
@@ -275,6 +298,12 @@ class NativeProcessBackend(Backend):
         self._seq_counter[i] += 1
         self._cur[key] = self._seq_counter[i]
         self._epochs[key] = int(epoch)
+        if self.aggregator is not None:
+            # half of a clock-offset sample; the worker's matching
+            # stamps ride back on its telemetry frame (same seq)
+            self.aggregator.note_dispatch(
+                i, self._seq_counter[i], _time.perf_counter()
+            )
         ok = self._send_payload(i, sendbuf, int(epoch), int(tag))
         if not ok:
             # rank already dead. "error": fail the task at the next
@@ -285,6 +314,52 @@ class NativeProcessBackend(Backend):
                 self._synthetic[key] = WorkerError(
                     i, epoch, WorkerProcessDied(i)
                 )
+
+    def _consume_obs(self, j: int, msg: T.Message) -> bool:
+        """Absorb a telemetry frame (the reserved OBS tag): merge it
+        into the aggregator when one is attached, drop it otherwise.
+        Returns True iff the frame was telemetry — callers skip it and
+        keep waiting for real completions either way. (The tag test is
+        one int compare, so dark wait loops stay at is-None cost.)"""
+        if int(msg.tag) != _OBS_TAG or msg.kind != T.KIND_DATA:
+            return False
+        if self.aggregator is not None:
+            try:
+                frame = codec.decode(msg.payload, msg.body)
+            except Exception:
+                return True  # malformed telemetry never kills a wait
+            self.aggregator.merge(
+                j, frame, t_recv_c=_time.perf_counter()
+            )
+        return True
+
+    def _drain_obs(self, i: int, timeout: float = 2.0) -> None:
+        """Pull queued telemetry frames for rank ``i`` (the
+        shutdown-drain frame workers flush before exiting). The worker
+        process has already been joined, but the frame still has to
+        travel socket buffer -> epoll progress thread -> queue, so an
+        empty poll retries briefly instead of declaring the queue
+        drained (a single non-blocking pass raced the progress thread
+        and lost end-of-run deltas). Non-telemetry DATA frames (an
+        unharvested straggler's late result) are dropped and skipped —
+        the backend is shutting down and no channel will be read again,
+        but the telemetry frames queued BEHIND them must still merge.
+        The loop ends at the sticky KIND_DEATH marker the dead rank's
+        poll synthesizes once its real frames are out — the
+        "everything drained" signal; ``timeout`` only bounds the
+        pathological no-death case."""
+        deadline = _time.perf_counter() + timeout
+        while True:
+            msg = self._coord.poll(i)
+            if msg is None:
+                if _time.perf_counter() >= deadline:
+                    return
+                _time.sleep(0.002)
+                continue
+            if msg.kind == T.KIND_DEATH:
+                return  # queue fully drained (marker fires last)
+            self._consume_obs(i, msg)  # telemetry merged; stale
+            # results dropped — keep going for the frames behind them
 
     def _decode(self, i: int, msg: T.Message, tag: int):
         if msg.kind == T.KIND_DEATH:
@@ -360,6 +435,8 @@ class NativeProcessBackend(Backend):
                 if not block:
                     return None
                 return _straggle_exhausted([i], deadline, timeout)
+            if self._consume_obs(i, msg):
+                continue  # piggybacked telemetry, not a completion
             msg = self._route(i, msg, key[1])
             if msg is not None:
                 return self._decode(i, msg, key[1])
@@ -415,6 +492,8 @@ class NativeProcessBackend(Backend):
                 # rank-wide: surface on this rank's first awaited channel
                 # (the sticky native marker re-fires for the others)
                 return j, self._decode(j, msg, awaited[j][0])
+            if self._consume_obs(j, msg):
+                continue  # piggybacked telemetry, not a completion
             mtag = int(msg.tag)
             if msg.seq != self._cur.get((j, mtag), -1):
                 continue  # superseded dispatch; drop
@@ -424,6 +503,16 @@ class NativeProcessBackend(Backend):
 
     def wait(self, i: int, timeout: float | None = None, *, tag: int = 0):
         return self._next(i, block=True, timeout=timeout, tag=tag)
+
+    def dead_workers(self) -> list[int]:
+        """Ranks the transport currently marks dead (not yet
+        respawned/reaccepted) — the ``/healthz`` pool check reads
+        this."""
+        if self._closed:
+            return list(range(self.n_workers))
+        return [
+            i for i in range(self.n_workers) if self._coord.is_dead(i)
+        ]
 
     def respawn(self, i: int, *, connect_timeout: float = 60.0) -> None:
         """Elastic recovery: replace a dead worker process with a fresh
@@ -488,6 +577,19 @@ class NativeProcessBackend(Backend):
         for p in self._procs:
             if p is not None:
                 p.join(timeout=self._join_timeout)
+        if self.aggregator is not None:
+            # the workers flushed a final telemetry frame before
+            # exiting; nothing polls the queues after this point, so
+            # drain them here or the end-of-run deltas are lost. A
+            # rank whose process is still alive (wedged in work_fn —
+            # about to be terminated below) never sent a drain frame
+            # and never will: poll it non-blockingly for whatever is
+            # already queued instead of burning the retry window per
+            # stuck rank
+            for i in range(self.n_workers):
+                p = self._procs[i]
+                alive = p is not None and p.is_alive()
+                self._drain_obs(i, timeout=0.0 if alive else 2.0)
         for p in self._procs:
             if p is not None and p.is_alive():  # pragma: no cover
                 p.terminate()

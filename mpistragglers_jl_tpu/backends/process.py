@@ -55,36 +55,81 @@ class WorkerProcessDied(RuntimeError):
         super().__init__(f"worker process {worker} died")
 
 
-def _worker_main(i: int, conn, work_fn: WorkFn, delay_fn: DelayFn | None) -> None:
+def _worker_main(
+    i: int, conn, work_fn: WorkFn, delay_fn: DelayFn | None,
+    telemetry: bool = False,
+) -> None:
     """Worker process entry: the reference's receive -> stall -> compute ->
-    send loop (§3.2) over a pipe instead of MPI point-to-point."""
+    send loop (§3.2) over a pipe instead of MPI point-to-point.
+
+    ``telemetry=True`` (set when the coordinator was constructed with a
+    ``registry``) keeps a worker-local
+    :class:`~..obs.aggregate.WorkerTelemetry` whose snapshot rides each
+    result tuple as a 6th element — tasks/errors counters, compute-wall
+    histogram, per-task spans, and the worker-clock stamps the
+    coordinator's clock aligner pairs with its own. One final frame is
+    sent on the shutdown drain so end-of-run telemetry is not lost."""
+    tele = None
+    if telemetry:
+        from ..obs.aggregate import WorkerTelemetry
+
+        tele = WorkerTelemetry(i)
     try:
         while True:
             msg = conn.recv()
+            t_recv_w = time.perf_counter() if tele is not None else 0.0
             if msg is None:  # shutdown sentinel (control channel)
+                if tele is not None:
+                    # drain frame: the last inter-result telemetry
+                    conn.send((-1, -1, "tele", tele.snapshot(), -1))
                 break
             seq, payload, epoch, tag = msg
+            stall = 0.0
             if delay_fn is not None:
                 d = float(delay_fn(i, epoch))
                 if d > 0:
+                    stall = d
                     time.sleep(d)
+            t0 = time.perf_counter() if tele is not None else 0.0
             try:
                 out = (seq, epoch, "ok", work_fn(i, payload, epoch), tag)
+                failed = False
             except BaseException as e:
                 out = (
                     seq, epoch, "error",
                     (type(e).__name__, str(e), traceback.format_exc()),
                     tag,
                 )
+                failed = True
+            frame = None
+            if tele is not None:
+                t1 = time.perf_counter()
+                tele.task_done(epoch, t0, t1, error=failed, stall=stall)
+                # t_send_w stamped by snapshot construction time — the
+                # tiny build cost lands in the transport-delay half,
+                # where the min-delay offset filter absorbs it
+                frame = tele.snapshot(pair=(seq, t_recv_w, t1))
+                out = out + (frame,)
             try:
                 conn.send(out)
             except Exception as e:  # result not picklable
-                conn.send((
+                err = (
                     seq, epoch, "error",
                     (type(e).__name__,
                      f"worker result could not be serialized: {e}", ""),
                     tag,
-                ))
+                )
+                try:
+                    # snapshot() drained the spans destructively;
+                    # reattach the SAME frame so the failing task's
+                    # span and clock pair survive — the postmortem
+                    # case needs them most
+                    conn.send(err if frame is None else err + (frame,))
+                except Exception:
+                    # the frame itself held the unpicklable value (a
+                    # custom span arg): the error result must still
+                    # reach the coordinator, not kill the worker
+                    conn.send(err)
     except (EOFError, OSError, KeyboardInterrupt):
         pass
     finally:
@@ -114,6 +159,23 @@ class ProcessBackend(SlotBackend):
         ``multiprocessing`` start method; ``"spawn"`` (default) is safe
         with JAX/threads in the coordinator, ``"fork"`` is faster to boot
         for pure-numpy workers.
+    registry:
+        Opt-in cross-process telemetry (the obs/ contract — None = dark,
+        zero cost): worker processes keep a local registry whose
+        snapshots piggyback on result frames and merge here under a
+        ``worker="<rank>"`` label with counter-delta semantics across
+        respawns; worker spans land clock-aligned in
+        ``self.aggregator.recorders()`` (one Perfetto pid per worker
+        process — :mod:`..obs.aggregate`).
+    flight:
+        Optional :class:`~..obs.FlightRecorder`: merged worker spans are
+        mirrored into the ring so a hang postmortem shows what every
+        worker process was doing last.
+    exporter:
+        Optional :class:`~..obs.ObsServer`: registers the pool's
+        worker-deadness health check (``/healthz`` flips when a worker
+        dies, recovers after :meth:`respawn`) and the aggregator's
+        per-worker trace sources.
     """
 
     def __init__(
@@ -124,6 +186,9 @@ class ProcessBackend(SlotBackend):
         delay_fn: DelayFn | None = None,
         mp_context: str = "spawn",
         join_timeout: float = 5.0,
+        registry=None,
+        flight=None,
+        exporter=None,
     ):
         super().__init__(n_workers)
         self.work_fn = work_fn
@@ -134,11 +199,20 @@ class ProcessBackend(SlotBackend):
         self._send_lock = threading.Lock()
         self._mp_context = mp_context
         ctx = mp.get_context(mp_context)
+        self.aggregator = None
+        if registry is not None or flight is not None:
+            from ..obs.aggregate import TelemetryAggregator
+
+            self.aggregator = TelemetryAggregator(
+                registry, flight=flight
+            )
         self._conns = [None] * self.n_workers
         self._procs = [None] * self.n_workers
         self._readers = [None] * self.n_workers
         for i in range(self.n_workers):
             self._spawn_worker(i)
+        if exporter is not None:
+            exporter.register_backend(self)
 
     def _spawn_worker(self, i: int) -> None:
         """Start (or restart) worker process i and its reader thread."""
@@ -146,7 +220,8 @@ class ProcessBackend(SlotBackend):
         parent, child = ctx.Pipe(duplex=True)
         proc = ctx.Process(
             target=_worker_main,
-            args=(i, child, self.work_fn, self.delay_fn),
+            args=(i, child, self.work_fn, self.delay_fn,
+                  self.aggregator is not None),
             daemon=True,
             name=f"pool-proc-worker-{i}",
         )
@@ -187,6 +262,7 @@ class ProcessBackend(SlotBackend):
     # -- coordinator-side completion pump ---------------------------------
     def _reader_loop(self, i: int) -> None:
         conn = self._conns[i]
+        agg = self.aggregator
         while True:
             try:
                 msg = conn.recv()
@@ -195,7 +271,18 @@ class ProcessBackend(SlotBackend):
                 return
             if msg is None:
                 return
-            seq, epoch, kind, payload, tag = msg
+            t_recv_c = (
+                time.perf_counter() if agg is not None else None
+            )
+            seq, epoch, kind, payload, tag, *tele = msg
+            if kind == "tele":  # shutdown-drain telemetry frame
+                if agg is not None:
+                    agg.merge(i, payload)
+                continue
+            if agg is not None and tele:
+                # merge BEFORE completing: a scrape racing the harvest
+                # sees the worker series of every result the pool has
+                agg.merge(i, tele[0], t_recv_c=t_recv_c)
             if kind == "error":
                 exc_type, message, tb = payload
                 payload = WorkerError(
@@ -226,6 +313,12 @@ class ProcessBackend(SlotBackend):
                     i, seq, WorkerError(i, -1, WorkerProcessDied(i)), tag
                 )
 
+    def dead_workers(self) -> list[int]:
+        """Ranks whose worker process is currently dead (not yet
+        respawned) — the ``/healthz`` pool check reads this."""
+        with self._cond:
+            return [i for i, d in enumerate(self._dead) if d]
+
     # -- SlotBackend surface ----------------------------------------------
     def _start(self, i: int, sendbuf, epoch: int, seq: int, tag: int) -> None:
         if self._closed:
@@ -238,6 +331,10 @@ class ProcessBackend(SlotBackend):
         payload = sendbuf
         if hasattr(payload, "__array__") and not isinstance(payload, np.ndarray):
             payload = np.asarray(payload)  # device arrays are not picklable
+        if self.aggregator is not None:
+            # half of a clock-offset sample; the worker's matching
+            # stamps ride back on the result frame
+            self.aggregator.note_dispatch(i, seq, time.perf_counter())
         try:
             with self._send_lock:
                 self._conns[i].send((seq, payload, epoch, tag))
@@ -267,5 +364,15 @@ class ProcessBackend(SlotBackend):
         for proc in self._procs:
             if not proc.is_alive():
                 proc.close()  # release the spawn sentinel fds deterministically
+        if self.aggregator is not None:
+            # the reader threads are the ones merging the workers'
+            # shutdown-drain telemetry frames; the workers have exited
+            # (pipes at EOF), so the readers finish promptly — join
+            # them BEFORE closing the conns, or the final deltas race
+            # the close and are lost nondeterministically (the pipe
+            # twin of the native backend's _drain_obs)
+            for reader in self._readers:
+                if reader is not None:
+                    reader.join(timeout=self._join_timeout)
         for conn in self._conns:
             conn.close()

@@ -690,15 +690,21 @@ class ServingScheduler:
     kernel-route counters, and/or ``spans=`` (an
     :class:`~..obs.SpanRecorder`) for per-tick admit/decode/retire
     spans in the merged Perfetto timeline
-    (:func:`~..obs.dump_merged_chrome_trace`). With neither, the tick
-    path does no observability work at all.
+    (:func:`~..obs.dump_merged_chrome_trace`); ``flight=`` (an
+    :class:`~..obs.FlightRecorder`) for per-tick spans in the bounded
+    postmortem ring plus the ``last_tick_at`` liveness stamp a flight
+    watchdog probes; ``exporter=`` (an :class:`~..obs.ObsServer`) to
+    register the tick-freshness ``/healthz`` check and the span
+    recorder as a ``/trace`` source. With none of them, the tick path
+    does no observability work at all.
     """
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
                  n_inner: int = 8, eos_id: int | None = None,
                  prompt_chunk: int = 256, max_prompt: int = 2048,
                  quantize_kv: bool = False, temperature: float = 0.0,
-                 top_k: int | None = None, registry=None, spans=None):
+                 top_k: int | None = None, registry=None, spans=None,
+                 flight=None, exporter=None):
         W = _check_ring_cfg(cfg)
         _check_sampling_params(temperature, top_k)
         if cfg.n_experts:
@@ -754,8 +760,36 @@ class ServingScheduler:
             if registry is not None or spans is not None
             else None
         )
+        # flight recorder (obs/flight.py, opt-in): per-tick spans land
+        # in the bounded postmortem ring; dark schedulers never stamp
+        self._flight = flight
+        # perf_counter of the latest completed tick — the liveness
+        # signal for /healthz tick-freshness checks and flight
+        # watchdogs; stays None on a fully dark scheduler (the dark
+        # tick reads no clocks, pinned by tests/test_obs.py). An
+        # exporter-ONLY scheduler must stamp too — its registered
+        # health check reads this, and a never-set stamp would report
+        # an actively-ticking scheduler as stuck forever.
+        self.last_tick_at: float | None = None
+        self._stamp_ticks = (
+            self._obs is not None or flight is not None
+            or exporter is not None
+        )
+        if exporter is not None:
+            # register the tick-freshness health check (+ the span
+            # recorder as a /trace source) on the ObsServer
+            exporter.register_scheduler(self)
 
     # -- public API -----------------------------------------------------
+
+    def enable_tick_stamping(self) -> None:
+        """Turn on the per-tick ``last_tick_at`` liveness stamp (one
+        ``perf_counter`` read per tick). Construction with any of
+        ``registry=``/``spans=``/``flight=``/``exporter=`` enables it
+        already; :meth:`ObsServer.register_scheduler` calls this so a
+        scheduler registered AFTER dark construction becomes probeable
+        — its tick-freshness health check reads the stamp."""
+        self._stamp_ticks = True
 
     def submit(self, prompt, max_new: int, key=None) -> Request:
         """Queue a request; returns the live :class:`Request` whose
@@ -810,7 +844,9 @@ class ServingScheduler:
         token series; dark, the only additions to the hot path are
         ``obs is not None`` checks."""
         obs = self._obs
-        t0 = time.perf_counter() if obs is not None else 0.0
+        flight = self._flight
+        lit = self._stamp_ticks  # obs, flight, OR exporter attached
+        t0 = time.perf_counter() if lit else 0.0
         self.tick_count += 1
         retired: list[Request] = []
         self._advance_admissions(retired)
@@ -849,6 +885,19 @@ class ServingScheduler:
                     retired.append(req)
         if obs is not None:
             obs.tick_done(self, retired, t0, t1, t2)
+        if lit:
+            now = time.perf_counter()
+            self.last_tick_at = now
+            if flight is not None:
+                flight.span(
+                    f"tick {self.tick_count}", t0, now - t0,
+                    src="scheduler", track="scheduler",
+                    queue=self.pending, active=self.active,
+                    retired=len(retired),
+                )
+                flight.counter(
+                    "serving_ticks_total", self.tick_count, t=now
+                )
         return retired
 
     def run(self, max_ticks: int = 10_000) -> None:

@@ -1,22 +1,38 @@
-"""Runtime observability: metrics registry + unified Perfetto timeline.
+"""Runtime observability: registry, timeline, and the live telemetry plane.
 
 The subsystem the reference never had (its only telemetry is the
 per-worker ``pool.latency`` field — SURVEY §5 "Metrics / logging:
-absent") and the tracer alone does not cover: :mod:`.metrics` is a
-zero-dependency, thread-safe series store (counters, gauges, fixed
-log-bucket histograms) with JSON and Prometheus text exports;
-:mod:`.timeline` records host-side spans (scheduler ticks, training
-steps) and merges them with :class:`~..utils.trace.EpochTracer` pool
-timelines into one Chrome/Perfetto trace.
+absent"), in two halves:
+
+**In-process** (PR 2): :mod:`.metrics` is a zero-dependency,
+thread-safe series store (counters, gauges, fixed log-bucket
+histograms) with JSON and Prometheus text exports; :mod:`.timeline`
+records host-side spans (scheduler ticks, training steps) and merges
+them with :class:`~..utils.trace.EpochTracer` pool timelines into one
+Chrome/Perfetto trace.
+
+**Distributed + live** (this PR): :mod:`.export` serves the registry,
+health checks, the merged timeline, and the flight ring over HTTP
+(:class:`ObsServer` — ``/metrics``, ``/healthz``, ``/trace``,
+``/flight``); :mod:`.aggregate` merges worker-process telemetry into
+the coordinator registry under ``worker="<rank>"`` labels with
+counter-delta semantics across respawns and clock-aligned spans;
+:mod:`.flight` keeps a bounded ring of recent spans/events/counter
+deltas and dumps it automatically on watchdog stalls, pool deadline
+expiries, and interpreter exit — the postmortem artifact for hangs.
 
 Everything here is strictly OPT-IN, mirroring the tracer contract:
 instrumented layers (``ServingScheduler``, ``CodedGradTrainer``,
-``CodedGemm``, ``HedgedServer``) accept ``registry=``/``spans=`` and
-pay nothing — no allocation, no clock reads — when neither is passed.
-Stdlib-only at import: the package root's jax-free import contract
-holds.
+``CodedGemm``, ``HedgedServer``, ``ProcessBackend``) accept
+``registry=`` / ``spans=`` / ``exporter=`` / ``flight=`` and pay
+nothing — no allocation, no clock reads — when none is passed (GC004
+checks it statically). Stdlib-only at import: the package root's
+jax-free import contract holds.
 """
 
+from .aggregate import OBS_TAG, TelemetryAggregator, WorkerTelemetry
+from .export import HealthCheck, ObsServer
+from .flight import FlightRecorder, FlightWatchdog
 from .metrics import (
     DEFAULT_BUCKETS,
     Counter,
@@ -24,7 +40,12 @@ from .metrics import (
     Histogram,
     MetricsRegistry,
 )
-from .timeline import SpanRecorder, annotate, dump_merged_chrome_trace
+from .timeline import (
+    SpanRecorder,
+    annotate,
+    dump_merged_chrome_trace,
+    merged_chrome_trace,
+)
 
 __all__ = [
     "Counter",
@@ -35,4 +56,12 @@ __all__ = [
     "SpanRecorder",
     "annotate",
     "dump_merged_chrome_trace",
+    "merged_chrome_trace",
+    "ObsServer",
+    "HealthCheck",
+    "TelemetryAggregator",
+    "WorkerTelemetry",
+    "OBS_TAG",
+    "FlightRecorder",
+    "FlightWatchdog",
 ]

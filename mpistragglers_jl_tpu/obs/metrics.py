@@ -218,6 +218,29 @@ class Histogram(_Instrument):
         with self._lock:
             return list(self._counts), self._sum, self._count
 
+    def merge_deltas(
+        self, counts: Iterable[int], sum_delta: float, count_delta: int
+    ) -> None:
+        """Fold another process's bucket-count DELTAS in (the fixed
+        grid is what makes histograms addable — module docstring); the
+        cross-process aggregation path (:mod:`.aggregate`). ``counts``
+        must cover the full grid including the +Inf overflow bucket;
+        negative deltas are rejected (a shrinking histogram is a
+        protocol bug upstream, never mergeable)."""
+        dc = [int(c) for c in counts]
+        if len(dc) != len(self._counts):
+            raise ValueError(
+                f"bucket delta length {len(dc)} != grid size "
+                f"{len(self._counts)} (bounds + overflow)"
+            )
+        if any(c < 0 for c in dc) or count_delta < 0:
+            raise ValueError("histogram deltas must be >= 0")
+        with self._lock:
+            for i, c in enumerate(dc):
+                self._counts[i] += c
+            self._sum += float(sum_delta)
+            self._count += int(count_delta)
+
 
 def _bucket_quantile(bounds, counts, total, q) -> float | None:
     """Quantile over an already-read (counts, total) snapshot."""

@@ -21,7 +21,12 @@ import time
 from contextlib import contextmanager
 from typing import Any
 
-__all__ = ["SpanRecorder", "dump_merged_chrome_trace", "annotate"]
+__all__ = [
+    "SpanRecorder",
+    "dump_merged_chrome_trace",
+    "merged_chrome_trace",
+    "annotate",
+]
 
 _US = 1e6
 
@@ -126,9 +131,18 @@ class SpanRecorder:
         self, pid: int = 0
     ) -> tuple[list[dict], list[dict]]:
         """(metadata events, span/counter events) under process ``pid``
-        — the merge contract shared with ``EpochTracer.chrome_events``."""
+        — the merge contract shared with ``EpochTracer.chrome_events``.
+
+        Snapshots the span/counter lists ONCE up front: the live
+        ``/trace`` endpoint calls this on recorders other threads are
+        still appending to, and a two-pass read (build the track map,
+        then the events) would KeyError on a span whose track landed
+        between the passes. ``list()`` of an append-only list is
+        GIL-atomic, so the snapshot is consistent."""
+        spans = list(self.spans)
+        counters = list(self.counters)
         tracks = []
-        for track, *_ in self.spans:
+        for track, *_ in spans:
             if track not in tracks:
                 tracks.append(track)
         tid_of = {t: i for i, t in enumerate(tracks)}
@@ -143,17 +157,17 @@ class SpanRecorder:
         events: list[dict[str, Any]] = [
             {"name": name, "ph": "X", "pid": pid, "tid": tid_of[track],
              "ts": t0 * _US, "dur": dur * _US, "args": args}
-            for track, name, t0, dur, args in self.spans
+            for track, name, t0, dur, args in spans
         ]
         events += [
             {"name": name, "ph": "C", "pid": pid,
              "ts": t * _US, "args": {name: value}}
-            for name, t, value in self.counters
+            for name, t, value in counters
         ]
         if self.dropped:
             # the cap must read as a visible truncation marker in the
             # UI, never as "the run ended here"
-            last = max((s[2] + s[3] for s in self.spans), default=0.0)
+            last = max((s[2] + s[3] for s in spans), default=0.0)
             events.append({
                 "name": f"[recorder cap: {self.dropped} events dropped]",
                 "ph": "I", "pid": pid, "tid": 0, "ts": last * _US,
@@ -165,6 +179,36 @@ class SpanRecorder:
         """Standalone export (one-process trace); the merged form is
         :func:`dump_merged_chrome_trace`."""
         return dump_merged_chrome_trace(path, recorders=[self])
+
+
+def merged_chrome_trace(
+    *, tracers=(), recorders=()
+) -> tuple[dict, int]:
+    """Merge pool tracers and span recorders into one trace document.
+
+    Returns ``(trace_doc, n_events)`` — the Chrome trace-event dict and
+    the number of non-metadata events in it. This is the in-memory half
+    of :func:`dump_merged_chrome_trace`, split out so a live exporter
+    (``obs/export.py``'s ``/trace`` endpoint) can serve the merged
+    timeline over HTTP without touching the filesystem.
+    """
+    meta: list[dict] = []
+    events: list[dict] = []
+    pid = 0
+    for tracer in tracers:
+        m, e = tracer.chrome_events(pid=pid)
+        meta += m
+        events += e
+        pid += 1
+    for rec in recorders:
+        m, e = rec.chrome_events(pid=pid)
+        meta += m
+        events += e
+        pid += 1
+    return (
+        {"traceEvents": meta + events, "displayTimeUnit": "ms"},
+        len(events),
+    )
 
 
 def dump_merged_chrome_trace(
@@ -180,23 +224,12 @@ def dump_merged_chrome_trace(
     events written. Open the file in ui.perfetto.dev (or
     chrome://tracing).
     """
-    meta: list[dict] = []
-    events: list[dict] = []
-    pid = 0
-    for tracer in tracers:
-        m, e = tracer.chrome_events(pid=pid)
-        meta += m
-        events += e
-        pid += 1
-    for rec in recorders:
-        m, e = rec.chrome_events(pid=pid)
-        meta += m
-        events += e
-        pid += 1
+    doc, n = merged_chrome_trace(tracers=tracers, recorders=recorders)
     with open(path, "w") as f:
-        json.dump({"traceEvents": meta + events,
-                   "displayTimeUnit": "ms"}, f)
-    return len(events)
+        # span args are arbitrary user objects; degrade to repr rather
+        # than refuse the whole trace over one value
+        json.dump(doc, f, default=repr)
+    return n
 
 
 @contextmanager

@@ -61,6 +61,7 @@ from .backends.base import (  # noqa: F401  (DeadWorkerError re-export)
 )
 
 if TYPE_CHECKING:  # runtime import would be circular (utils -> pool)
+    from .obs.flight import FlightRecorder
     from .utils.trace import EpochTracer
 
 NwaitArg = Union[int, Callable[[int, np.ndarray], bool]]
@@ -277,6 +278,7 @@ def asyncmap(
     tag: int = 0,
     timeout: float | None = None,
     tracer: "EpochTracer | None" = None,
+    flight: "FlightRecorder | None" = None,
 ) -> np.ndarray:
     """Broadcast ``sendbuf`` to all idle workers; wait for the fastest few.
 
@@ -300,6 +302,13 @@ def asyncmap(
     the whole call; on expiry a :class:`DeadWorkerError` names the
     workers still outstanding. The pool stays usable — tardy workers
     remain active and their late results are drained by later calls.
+
+    ``flight`` (an :class:`~.obs.FlightRecorder`, opt-in like
+    ``tracer``): the call records one epoch span + fresh/stale arrival
+    counter deltas into the bounded postmortem ring, and a wait that
+    blows its deadline TRIPS an automatic flight dump before the
+    :class:`DeadWorkerError` raises — the artifact for the hang exists
+    even though nothing after the raise runs cleanly.
     """
     n = pool.n_workers
     if nwait is None:
@@ -345,6 +354,8 @@ def asyncmap(
     backend.begin_epoch(pool.epoch)
     if tracer is not None:
         tracer.begin("asyncmap", pool.epoch, nwait)
+    _t_fl = time.perf_counter() if flight is not None else 0.0
+    _n_fresh = _n_stale = 0
 
     # the finally clause flushes the open trace record even when a
     # WorkerFailure or buffer-size error aborts the call — failure traces
@@ -406,10 +417,18 @@ def asyncmap(
                 # Report backend ranks, not pool indices: a subset pool
                 # over ranks [1,4,5] must name the dead worker as 4, not
                 # the misleading pool-local 1 (advisor r3 finding).
-                raise DeadWorkerError(
-                    [int(pool.ranks[j]) for j in np.flatnonzero(pool.active)],
-                    timeout,
-                )
+                dead = [
+                    int(pool.ranks[j]) for j in np.flatnonzero(pool.active)
+                ]
+                if flight is not None:
+                    # the hang postmortem: dump the ring NOW — nothing
+                    # after this raise is guaranteed to run
+                    flight.trip(
+                        f"asyncmap epoch {pool.epoch}: wait past "
+                        f"deadline ({timeout}s), workers {dead} "
+                        "outstanding"
+                    )
+                raise DeadWorkerError(dead, timeout)
             rank, result = got
             i = pool._idx_of_rank[rank]
             _store(pool, i, result, recvbufs)
@@ -418,8 +437,10 @@ def asyncmap(
                 tracer.arrival(i, pool.repochs[i], fresh=fresh)
             if fresh:
                 nrecv += 1
+                _n_fresh += 1
                 pool.active[i] = False
             else:
+                _n_stale += 1
                 _dispatch(pool, backend, i, sendbuf, tag)
                 if tracer is not None:
                     tracer.dispatch(i, pool.epoch, retask=True)
@@ -427,6 +448,15 @@ def asyncmap(
         backend.end_epoch()
         if tracer is not None:
             tracer.end(pool)
+        if flight is not None:
+            flight.span(
+                f"asyncmap {pool.epoch}", _t_fl,
+                time.perf_counter() - _t_fl,
+                track="pool", fresh=_n_fresh, stale=_n_stale,
+            )
+            # cumulative across the pool's life -> the ring stores the
+            # per-record delta (how much moved since the last record)
+            flight.counter("pool_epochs_total", pool.epoch - pool.epoch0)
     return pool.repochs
 
 
@@ -437,6 +467,7 @@ def waitall(
     *,
     timeout: float | None = None,
     tracer: "EpochTracer | None" = None,
+    flight: "FlightRecorder | None" = None,
 ) -> np.ndarray:
     """Drain the pool: block until every active worker has responded.
 
@@ -456,6 +487,7 @@ def waitall(
     if tracer is not None:
         # nwait field = number of workers actually being drained
         tracer.begin("waitall", pool.epoch, int(pool.active.sum()))
+    _t_fl = time.perf_counter() if flight is not None else 0.0
     try:
         deadline = Deadline(timeout)
         while pool.active.any():
@@ -477,6 +509,12 @@ def waitall(
                 dead = [
                     int(pool.ranks[j]) for j in np.flatnonzero(pool.active)
                 ]
+                if flight is not None:
+                    flight.trip(
+                        f"waitall at epoch {pool.epoch}: drain past "
+                        f"deadline ({timeout}s), workers {dead} "
+                        "outstanding"
+                    )
                 raise DeadWorkerError(dead, timeout)
             rank, result = got
             i = pool._idx_of_rank[rank]
@@ -489,6 +527,11 @@ def waitall(
     finally:
         if tracer is not None:
             tracer.end(pool)
+        if flight is not None:
+            flight.span(
+                f"waitall {pool.epoch}", _t_fl,
+                time.perf_counter() - _t_fl, track="pool",
+            )
     return pool.repochs
 
 

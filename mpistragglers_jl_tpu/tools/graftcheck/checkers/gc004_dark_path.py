@@ -6,18 +6,25 @@ obs/ inherited it): ``registry=`` / ``spans=`` / ``tracer=`` kwargs
 default to ``None``, and the dark path pays nothing beyond ``is None``
 checks. Two halves, statically checked:
 
-1. **Defaults + guards.** Any public function/method taking a
-   parameter named ``registry``/``spans``/``tracer`` must default it
-   to ``None``, and every *dereference* of the parameter
-   (``tracer.begin(...)``, ``registry.counter(...)``) must sit under a
-   ``<name> is not None`` guard (an enclosing ``if``/ternary test, a
-   containing ``and`` chain, or after an early ``if <name> is None:
-   return``). Bare forwarding (``tracer=tracer``) is not a
-   dereference and is always fine. Private helpers (leading
-   underscore, or methods of private classes) that REQUIRE an
-   instrument are exempt from the default rule — they exist on the
-   instrumented side of the guard — but their dereferences are still
-   checked whenever the default is None.
+1. **Defaults + guards.** Any function/method taking a parameter
+   named ``registry``/``spans``/``tracer``/``exporter``/``flight``
+   with a DEFAULT must default it to ``None``, and every *dereference*
+   of the parameter (``tracer.begin(...)``, ``registry.counter(...)``)
+   must sit under a ``<name> is not None`` guard (an enclosing
+   ``if``/ternary test, a containing ``and`` chain, or after an early
+   ``if <name> is None: return``). Bare forwarding (``tracer=tracer``)
+   is not a dereference and is always fine.
+
+   A REQUIRED parameter (no default at all) is an *export target*, not
+   a dark-path kwarg: ``PoolLatencyModel.publish(registry)`` is an
+   explicit action whose subject is the registry — there is no
+   meaningful publish-to-nothing, so forcing a ``None`` default would
+   turn a caller bug (forgot the registry) into a silent no-op. The
+   opt-in contract is for code that RUNS either way; a required
+   instrument is non-None by contract, so its dereferences need no
+   guard. (A non-None default like ``registry=False`` is still a
+   violation — the dark path must be the ``is None`` check, nothing
+   else.)
 
 2. **Metric-name grammar.** String literals passed as the name of
    ``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` must
@@ -36,7 +43,7 @@ from typing import Iterator
 
 from ..core import Checker, Finding, ModuleInfo, register
 
-PARAMS = ("registry", "spans", "tracer")
+PARAMS = ("registry", "spans", "tracer", "exporter", "flight")
 
 _NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
 _FRAGMENT_RE = re.compile(r"[a-zA-Z0-9_:]*\Z")
@@ -275,9 +282,11 @@ class DarkPath(Checker):
     rule = "GC004"
     name = "dark-path"
     description = (
-        "registry/spans/tracer parameters default to None with every "
-        "dereference guarded by `is not None`; literal metric names "
-        "match the Prometheus grammar [a-zA-Z_:][a-zA-Z0-9_:]*"
+        "registry/spans/tracer/exporter/flight parameters default to "
+        "None with every dereference guarded by `is not None` "
+        "(required params are export targets and exempt); literal "
+        "metric names match the Prometheus grammar "
+        "[a-zA-Z_:][a-zA-Z0-9_:]*"
     )
 
     def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
@@ -314,20 +323,23 @@ class DarkPath(Checker):
             default = defaults[name]
             optional = _is_none(default)
             if not optional:
+                if default is None:
+                    # REQUIRED param: an export target (the caller
+                    # must hand a live instrument — the publish(
+                    # registry) pattern), non-None by contract, so
+                    # dereferences need no guard and the None-default
+                    # rule does not apply
+                    continue
                 if not _is_private(fn, cls):
-                    what = (
-                        "no default" if default is None
-                        else "a non-None default"
-                    )
                     yield mod.finding(
                         self.rule, fn,
                         f"public `{fn.name}` takes `{name}` with "
-                        f"{what}; observability is opt-in — the "
-                        f"contract is `{name}=None` plus `is None` "
-                        "guards (utils/trace.py)",
+                        "a non-None default; observability is opt-in "
+                        f"— the contract is `{name}=None` plus "
+                        "`is None` guards (utils/trace.py), or no "
+                        "default at all for an export target",
                     )
-                continue  # required param: non-None by contract,
-                # dereferences need no guard
+                continue
             v = _GuardVisitor(name)
             v.visit_body(fn.body)
             for hit in v.hits:

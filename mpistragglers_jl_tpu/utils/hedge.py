@@ -57,10 +57,15 @@ class HedgedServer:
     winner latency, per-rank wins, loser failures, dead replicas — so
     operators read the state the server already tracks publicly
     (``history``, ``last_hedge_width``, ``failures``) as live metrics
-    instead of reaching into attributes.
+    instead of reaching into attributes. ``exporter=`` (an
+    :class:`~..obs.ObsServer`, same opt-in contract) registers the
+    replica-health ``/healthz`` check: unhealthy while any rank is
+    benched dead (``dead_replicas``), recovering after ``respawn`` +
+    :meth:`reset_dead`.
     """
 
-    def __init__(self, backend: Backend, *, registry=None):
+    def __init__(self, backend: Backend, *, registry=None,
+                 exporter=None):
         self.backend = backend
         self._pools: dict[tuple[int, ...], AsyncPool] = {}
         self._rr = 0  # round-robin cursor over backend ranks
@@ -115,6 +120,24 @@ class HedgedServer:
                     help="ranks benched until repair",
                 ),
             }
+        if exporter is not None:
+            # replica-health /healthz check on the live telemetry plane
+            exporter.register_hedge(self)
+
+    @property
+    def dead_replicas(self) -> frozenset[int]:
+        """Ranks currently benched dead (losers whose process died) —
+        read by the ``/healthz`` hedge check (which runs on ObsServer
+        scrape threads while request threads mutate the set, hence the
+        retry: the copy is GIL-atomic on CPython, but a concurrent
+        resize elsewhere must degrade to a re-read, never a raising
+        probe that reports a healthy hedge as failing); repair with
+        ``backend.respawn`` + :meth:`reset_dead`."""
+        while True:
+            try:
+                return frozenset(self._dead)
+            except RuntimeError:  # pragma: no cover - non-atomic copy
+                continue
 
     # -- busy/harvest bookkeeping ---------------------------------------
 

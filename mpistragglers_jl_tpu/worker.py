@@ -45,6 +45,7 @@ def run_worker(
     *,
     token: bytes = b"",
     connect_timeout: float = 30.0,
+    telemetry: bool = False,
 ) -> None:
     """Connect to the coordinator and serve until shutdown.
 
@@ -63,13 +64,36 @@ def run_worker(
     coordinator is busy reaccepting a different rank, re-attempts
     instead of exiting and permanently losing the rank. ``token`` is the
     shared auth secret (must match the coordinator's, if it has one).
+
+    ``telemetry=True`` (coordinator-requested via
+    ``NativeProcessBackend(registry=...)``, or ``--telemetry`` on the
+    CLI) keeps a worker-local
+    :class:`~.obs.aggregate.WorkerTelemetry`; its snapshot follows each
+    result as a standalone frame on the reserved
+    :data:`~.obs.aggregate.OBS_TAG` channel (plus one final frame
+    before shutdown), which an aggregating coordinator merges and a
+    dark one drops by the tag's seq guard — the frames are invisible to
+    the pool either way.
     """
+    tele = None
+    if telemetry:
+        from .obs.aggregate import OBS_TAG, WorkerTelemetry
+
+        tele = WorkerTelemetry(rank)
     w = _connect_retry(address, rank, token, connect_timeout)
     try:
         while True:
             msg = w.recv()
+            t_recv_w = time.perf_counter() if tele is not None else 0.0
             if msg is None or msg.kind == T.KIND_CONTROL:
+                if tele is not None and msg is not None:
+                    # shutdown drain: flush the last telemetry frame
+                    p, b = codec.encode(tele.snapshot())
+                    w.send2(p, b, seq=-1, tag=OBS_TAG)
                 break  # coordinator gone, or shutdown broadcast
+            failed = False
+            t0 = 0.0
+            stall = 0.0
             try:
                 # decoding is inside the capture: an undecodable payload
                 # (e.g. a class not importable on this host — the common
@@ -80,12 +104,15 @@ def run_worker(
                 if delay_fn is not None:
                     d = float(delay_fn(rank, msg.epoch))
                     if d > 0:
+                        stall = d
                         time.sleep(d)
+                t0 = time.perf_counter()
                 prefix, body = codec.encode(
                     work_fn(rank, payload, msg.epoch)
                 )
                 kind = T.KIND_DATA
             except BaseException as e:
+                failed = True
                 prefix, body = codec.encode(
                     (type(e).__name__, str(e), traceback.format_exc())
                 )
@@ -98,6 +125,26 @@ def run_worker(
                 kind=kind,
             ):
                 break
+            if tele is not None:
+                t1 = time.perf_counter()
+                tele.task_done(
+                    msg.epoch, t0 or t_recv_w, t1, error=failed,
+                    stall=stall,
+                )
+                try:
+                    p, b = codec.encode(
+                        tele.snapshot(pair=(msg.seq, t_recv_w, t1))
+                    )
+                except Exception:
+                    # span args are sanitized at record time, so this
+                    # is belt-and-braces: a pathological frame must
+                    # drop ITSELF, never kill a worker whose every
+                    # task computed fine
+                    continue
+                if not w.send2(
+                    p, b, seq=msg.seq, epoch=msg.epoch, tag=OBS_TAG
+                ):
+                    break
     finally:
         w.close()
 
@@ -181,6 +228,12 @@ def main(argv=None) -> None:
         "`auth=` bytes); the MSGT_AUTH environment variable is the "
         "argv-invisible alternative. No flag/env = unauthenticated",
     )
+    ap.add_argument(
+        "--telemetry", action="store_true",
+        help="keep a worker-local metrics registry and piggyback its "
+        "snapshots on result frames (merged by a coordinator built "
+        "with registry=; dropped harmlessly otherwise)",
+    )
     args = ap.parse_args(argv)
     ranks = parse_ranks(args.ranks)
     token = _resolve_token(args.auth_file)
@@ -188,7 +241,8 @@ def main(argv=None) -> None:
     work_fn = resolve_callable(args.work)
     delay_fn = resolve_callable(args.delay) if args.delay else None
     if len(ranks) == 1:
-        run_worker(args.address, ranks[0], work_fn, delay_fn, token=token)
+        run_worker(args.address, ranks[0], work_fn, delay_fn,
+                   token=token, telemetry=args.telemetry)
         return
     # one OS process per rank (ranks must not share a Python process:
     # work_fn may hold the GIL, and per-rank crash isolation is the
@@ -202,7 +256,8 @@ def main(argv=None) -> None:
     procs = [
         ctx.Process(
             target=_spawned_rank_main,
-            args=(args.address, r, args.work, args.delay, token),
+            args=(args.address, r, args.work, args.delay, token,
+                  args.telemetry),
             name=f"pool-cli-worker-{r}",
         )
         for r in ranks
@@ -257,7 +312,7 @@ def _resolve_token(auth_file: str | None) -> bytes:
 
 def _spawned_rank_main(
     address: str, rank: int, work_spec: str, delay_spec: str | None,
-    token: bytes = b"",
+    token: bytes = b"", telemetry: bool = False,
 ) -> None:
     """Child entry for multi-rank mode: resolve specs locally, serve."""
     run_worker(
@@ -266,6 +321,7 @@ def _spawned_rank_main(
         resolve_callable(work_spec),
         resolve_callable(delay_spec) if delay_spec else None,
         token=token,
+        telemetry=telemetry,
     )
 
 

@@ -2,17 +2,26 @@
 pinned by the fixture test."""
 
 
-def serve(payload, registry):  # GC004 line 5: public, no default
-    registry.counter("serving_requests_total").inc()
-    return payload
-
-
 def tick(payload, tracer=None):
-    tracer.begin("tick", 0, 0)  # GC004 line 11: unguarded deref
+    tracer.begin("tick", 0, 0)  # GC004 line 6: unguarded deref
     return payload
 
 
 def observe(payload, registry=None):
     if registry is not None:
-        registry.counter("serving.bad.name").inc()  # GC004 line 17
+        registry.counter("serving.bad.name").inc()  # GC004 line 12
+    return payload
+
+
+def serve(payload, exporter=None):
+    exporter.add_health("pool", None)  # GC004 line 17: unguarded deref
+    return payload
+
+
+def record(payload, flight=None):
+    flight.event("dispatch")  # GC004 line 22: unguarded deref
+    return payload
+
+
+def publish(payload, registry=False):  # GC004 line 26: non-None default
     return payload

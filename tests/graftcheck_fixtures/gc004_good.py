@@ -40,3 +40,19 @@ class _Bundle:
 
     def __init__(self, registry):
         self.requests = registry.counter("hedge_requests_total")
+
+
+def publish(model, registry):
+    """PUBLIC function with a REQUIRED registry: an export target (the
+    PoolLatencyModel.publish pattern) — the action's subject is the
+    registry, there is no publish-to-nothing, so no None default and
+    no guards; non-None by contract."""
+    registry.gauge("pool_worker_latency_mean_seconds").set(model)
+
+
+def scrape(payload, exporter=None, flight=None):
+    """The new telemetry-plane kwargs honor the same guard shapes."""
+    if exporter is not None:
+        exporter.add_health("pool", None)
+    ok = flight is not None and flight.snapshot()
+    return payload if ok else None

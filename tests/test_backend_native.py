@@ -1030,3 +1030,34 @@ def test_on_dead_straggle_spawned_workers():
         waitall(pool, backend, timeout=10.0)
     finally:
         backend.shutdown()
+
+
+def test_native_cross_process_telemetry_aggregation():
+    """registry= on the native backend: worker.py's loop (run by the
+    spawned processes) piggybacks telemetry frames on the reserved OBS
+    tag; the coordinator merges them under worker= labels with
+    clock-aligned per-task spans, and the frames never disturb the
+    pool's completions (every epoch still harvests normally)."""
+    from mpistragglers_jl_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    backend = NativeProcessBackend(_echo, 2, registry=reg)
+    try:
+        pool = AsyncPool(2)
+        for _ in range(3):
+            asyncmap(pool, np.ones(3), backend, nwait=2)
+        waitall(pool, backend)
+    finally:
+        backend.shutdown()
+    for r in range(2):
+        c = reg.counter("worker_tasks_total", worker=str(r))
+        assert c.value == 3
+        h = reg.histogram("worker_task_seconds", worker=str(r))
+        assert h.count == 3
+    recs = backend.aggregator.recorders()
+    assert [r.process for r in recs] == ["worker 0", "worker 1"]
+    assert all(len(r.spans) == 3 for r in recs)
+    # clock offset estimated from the send/recv stamp pairs (same
+    # host: perf_counter is system-wide monotonic, so it is tiny)
+    off = backend.aggregator.clock_offset(0)
+    assert off is not None and abs(off) < 0.5

@@ -130,6 +130,10 @@ def test_observability_demo(tmp_path):
     assert out.returncode == 0, out.stderr[-2000:]
     assert "open in ui.perfetto.dev" in out.stdout
     assert "observability demo ok" in out.stdout
+    # the live telemetry plane really served HTTP: metrics + healthz
+    # scraped, worker pids in /trace, the flight ring dumped
+    assert "live: ObsServer on http://127.0.0.1:" in out.stdout
+    assert "healthz ok, 3 worker pids in /trace" in out.stdout
     # the artifacts really exist and the trace is valid trace-event JSON
     import json
 
@@ -137,6 +141,16 @@ def test_observability_demo(tmp_path):
     assert any(
         e.get("name", "").startswith("tick ")
         for e in doc["traceEvents"]
+    )
+    # worker-process task spans merged into the unified timeline
+    assert any(
+        e.get("name", "").startswith("task e")
+        for e in doc["traceEvents"]
+    )
+    fdoc = json.loads((tmp_path / "flight.json").read_text())
+    assert any(
+        e.get("ph") == "I" and "postmortem" in e.get("name", "")
+        for e in fdoc["traceEvents"]
     )
     prom = (tmp_path / "metrics.prom").read_text()
     assert "serving_ttft_seconds_bucket" in prom

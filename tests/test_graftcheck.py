@@ -50,7 +50,8 @@ def _keys(findings):
             [("GC003", 16), ("GC003", 17), ("GC003", 18),
              ("GC003", 25), ("GC003", 30)],
         ),
-        ("gc004_bad.py", [("GC004", 5), ("GC004", 11), ("GC004", 17)]),
+        ("gc004_bad.py", [("GC004", 6), ("GC004", 12), ("GC004", 17),
+                          ("GC004", 22), ("GC004", 26)]),
         (
             "gc005_bad.py",
             [("GC005", 17), ("GC005", 18), ("GC005", 21),
@@ -98,14 +99,15 @@ def test_baseline_roundtrip(tmp_path):
     entry = {
         "rule": "GC004",
         "path": "gc004_bad.py",
-        "symbol": "serve",
+        "symbol": "tick",
         "justification": "fixture: exercising the ledger",
     }
     bl = tmp_path / "baseline.json"
     bl.write_text(json.dumps({"cap": 1, "entries": [entry]}))
     res = _findings("gc004_bad.py", baseline_path=str(bl))
-    assert _keys(res.baselined) == [("GC004", 5)]
-    assert _keys(res.fresh) == [("GC004", 11), ("GC004", 17)]
+    assert _keys(res.baselined) == [("GC004", 6)]
+    assert _keys(res.fresh) == [("GC004", 12), ("GC004", 17),
+                                ("GC004", 22), ("GC004", 26)]
     assert res.baseline_size == 1
 
 
@@ -166,9 +168,11 @@ def test_cache_keyed_by_rule_subset(tmp_path):
 
 
 def test_baseline_scoped_to_partial_scans():
-    """The shipped baseline's GC004 entry is out of scope for a rules
-    subset or a sub-path scan — neither may die with a stale-baseline
-    error (review finding: docs' own --rules example exited 2)."""
+    """Baseline entries out of scope for a rules subset or a sub-path
+    scan must not die with a stale-baseline error (review finding:
+    docs' own --rules example exited 2). The shipped baseline is empty
+    since the PoolLatencyModel.publish entry retired, so these runs
+    also prove the empty ledger is never itself an error."""
     from mpistragglers_jl_tpu.tools.graftcheck import DEFAULT_BASELINE
 
     sub = run(
@@ -186,13 +190,48 @@ def test_baseline_scoped_to_partial_scans():
     # matching nothing -> BaselineError)
 
 
-def test_baseline_matches_on_subpath_and_single_file_scans():
+def test_nonempty_baseline_matches_on_subpath_and_single_file(tmp_path):
     """Finding paths are package-root-relative no matter where inside
-    the package the scan starts (package_base walks up past
-    __init__.py), so the shipped baseline's entry keeps matching —
-    a sub-path or single-file scan of a clean tree exits clean with
-    the false positive still baselined, not resurfaced fresh (review
-    finding)."""
+    the package a scan starts (package_base walks up past
+    __init__.py), so a baseline entry keeps matching on sub-path and
+    single-file scans. The shipped baseline went empty this round, so
+    this is pinned against a synthetic package + ledger — the walk-up
+    relativization must not rot unnoticed (review finding)."""
+    pkg = tmp_path / "pkg" / "inner"
+    pkg.mkdir(parents=True)
+    (tmp_path / "pkg" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(
+        "def tick(payload, tracer=None):\n"
+        "    tracer.begin('t')\n"
+        "    return payload\n"
+    )
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"cap": 1, "entries": [{
+        "rule": "GC004",
+        "path": "pkg/inner/mod.py",
+        "symbol": "tick",
+        "justification": "fixture: pinning sub-path relativization",
+    }]}))
+    for target in (
+        str(tmp_path / "pkg"),              # package root
+        str(pkg),                           # sub-path
+        str(pkg / "mod.py"),                # single file
+    ):
+        res = run([target], baseline_path=str(bl))
+        assert res.ok, "\n".join(f.format() for f in res.fresh)
+        assert [f.key() for f in res.baselined] == [
+            ("GC004", "pkg/inner/mod.py", "tick")
+        ], target
+
+
+def test_required_registry_param_is_export_target_not_flagged():
+    """PoolLatencyModel.publish(registry) — a REQUIRED registry param —
+    is an export target, not a dark-path kwarg: GC004 no longer flags
+    it (the baseline entry that used to document this false positive
+    is retired; the shipped baseline is empty), and sub-path /
+    single-file scans of the clean tree stay clean with nothing
+    baselined."""
     from mpistragglers_jl_tpu.tools.graftcheck import DEFAULT_BASELINE
 
     for target in (
@@ -201,10 +240,8 @@ def test_baseline_matches_on_subpath_and_single_file_scans():
     ):
         res = run([target], baseline_path=DEFAULT_BASELINE)
         assert res.ok, "\n".join(f.format() for f in res.fresh)
-        assert [f.key() for f in res.baselined] == [
-            ("GC004", "mpistragglers_jl_tpu/utils/straggle.py",
-             "PoolLatencyModel.publish")
-        ]
+        assert res.baselined == []
+        assert res.baseline_size == 0
 
 
 def test_missing_baseline_is_config_error():
