@@ -421,6 +421,10 @@ def driver_contract(budget_s: float | None = None) -> dict:
         out["graftcheck"] = _try_rung(
             bench_graftcheck, est=5, scale=False
         )
+        # virtual-time simulator rung, also unscaled (numpy
+        # bookkeeping + one small real ProcessBackend recording whose
+        # cost is injected sleeps, not matmul rate)
+        out["sim"] = _try_rung(bench_sim, est=10, scale=False)
         # headline: never budget-skipped, loud-fail (it IS the
         # contract) — but SIZED by measurement. Each ladder step is a
         # complete config-3 bench at that cube; the next step runs only
@@ -542,6 +546,7 @@ def _contract_line(out: dict) -> str:
     )
     rungs = {
         "graftcheck": _rung_summary(out.get("graftcheck"), "digest"),
+        "sim": _rung_summary(out.get("sim"), "digest"),
         "adaptive_speedup": _rung_summary(
             out.get("adaptive_nwait"), "speedup"),
         "obs_overhead_pct": _rung_summary(
@@ -626,6 +631,97 @@ def bench_graftcheck():
             + "; ".join(f.format() for f in res.fresh[:5])
         )
     return out
+
+
+class _SimBenchDelays:
+    """Picklable (module-level) ProcessBackend delay schedule for the
+    replay-drift leg: distinct fast speeds + one hard straggler."""
+
+    BASE = (0.04, 0.06, 0.08, 0.0)
+
+    def __call__(self, i, epoch):
+        return 0.5 if i == 3 else self.BASE[i]
+
+
+def _sim_bench_work(i, payload, epoch):
+    return np.asarray([i, epoch], dtype=np.int64)
+
+
+def bench_sim(epochs=1000, n=16):
+    """Virtual-time simulator rung (ISSUE 5) — unscaled like
+    ``graftcheck``: the simulator is numpy bookkeeping whose cost does
+    not track the matmul rate, so machine calibration must never
+    inflate its estimate into a budget skip. Two legs:
+
+    * throughput — a ``n``-worker, ``epochs``-epoch seeded-lognormal
+      fleet through the REAL ``asyncmap`` on ``SimBackend``:
+      events/sec (dispatches + deliveries over wall clock) and the
+      virtual-to-wall speedup;
+    * fidelity — a small REAL ``ProcessBackend`` straggling run is
+      traced and replayed at the recorded nwait: fresh-set exact-match
+      rate and epoch-wall drift (coordinator/pickle overhead the
+      injected delays cannot carry).
+
+    Compact digest (benchmarks/README.md):
+    ``<kev/s>kev/s/x<speedup>/f<fresh_rate>/d<drift_ms>ms``.
+    """
+    from mpistragglers_jl_tpu import (
+        AsyncPool, ProcessBackend, SimBackend, asyncmap, waitall,
+    )
+    from mpistragglers_jl_tpu.sim import ReplayTrace, compare, replay
+    from mpistragglers_jl_tpu.utils import EpochTracer, faults
+
+    # -- throughput leg --------------------------------------------------
+    be = SimBackend(
+        _sim_bench_work, n,
+        delay_fn=faults.seeded_lognormal(0.01, 1.0, seed=3),
+    )
+    pool = AsyncPool(n)
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        asyncmap(pool, np.zeros(1), be, nwait=(3 * n) // 4)
+    waitall(pool, be)
+    wall = time.perf_counter() - t0
+    events = be.n_dispatched + be.n_delivered
+    ev_per_s = events / wall
+    speedup = be.clock.now() / wall  # virtual seconds per wall second
+
+    # -- fidelity leg ----------------------------------------------------
+    backend = ProcessBackend(_sim_bench_work, 4,
+                             delay_fn=_SimBenchDelays())
+    tracer = EpochTracer()
+    rpool = AsyncPool(4)
+    t1 = time.perf_counter()
+    try:
+        for _ in range(4):
+            asyncmap(rpool, np.zeros(1), backend, nwait=3, tracer=tracer)
+        waitall(rpool, backend, tracer=tracer, timeout=30.0)
+    finally:
+        backend.shutdown()
+    real_wall = time.perf_counter() - t1
+    trace = ReplayTrace.from_tracer(tracer)
+    drift = compare(trace, replay(trace))
+
+    return {
+        "sim_epochs": epochs,
+        "sim_workers": n,
+        "events": events,
+        "events_per_s": round(ev_per_s),
+        "virtual_s": round(be.clock.now(), 3),
+        "wall_s": round(wall, 3),
+        "virtual_speedup": round(speedup, 1),
+        "replay_epochs": drift["epochs"],
+        "replay_fresh_exact_rate": drift["fresh_exact_rate"],
+        "replay_wall_drift_ms": round(
+            drift["wall_drift_mean_s"] * 1e3, 2
+        ),
+        "replay_real_wall_s": round(real_wall, 3),
+        "digest": (
+            f"{ev_per_s/1e3:.0f}kev/s/x{speedup:.0f}"
+            f"/f{drift['fresh_exact_rate']:.2f}"
+            f"/d{drift['wall_drift_mean_s']*1e3:.0f}ms"
+        ),
+    }
 
 
 def bench_rateless_overhead(m=2048, ncols=256, n=8, k=8, seeds=(0, 1, 2)):

@@ -1,0 +1,119 @@
+"""Record a straggling run -> replay it under other policies -> tune.
+
+The sim/ plane end to end, numpy-only (no jax, no devices):
+
+1. a REAL thread-backend pool runs 8 epochs with one designated hard
+   straggler, traced by an EpochTracer (the same recording any
+   production run can make);
+2. the trace replays through SimBackend — first at the recorded nwait
+   (validating the simulator: fresh sets must reproduce exactly), then
+   under two counterfactual policies, pricing each in virtual seconds
+   without a single real sleep;
+3. the autotuner sweeps every decodable nwait against the recorded
+   incident AND against a latency model fitted from it, cross-checked
+   with PoolLatencyModel.optimal_nwait.
+
+Usage: python examples/policy_tuning.py [output_dir]
+"""
+
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+from mpistragglers_jl_tpu import AsyncPool, asyncmap, waitall
+from mpistragglers_jl_tpu.backends.local import LocalBackend
+from mpistragglers_jl_tpu.sim import (
+    ReplayTrace,
+    compare,
+    recommend_nwait,
+    replay,
+    sweep_nwait,
+)
+from mpistragglers_jl_tpu.utils import EpochTracer, faults
+from mpistragglers_jl_tpu.utils.straggle import PoolLatencyModel
+
+N, K, EPOCHS = 6, 4, 8
+
+
+def work(i, payload, epoch):
+    return np.asarray([i, epoch], dtype=np.int64)
+
+
+def main(out_dir: Path) -> None:
+    # -- 1. record a real straggling run --------------------------------
+    # four tight fast ranks, one 4x-slower rank, one hard straggler:
+    # the nwait=4 boundary (rank 3 at 65 ms vs rank 4 at 250 ms) is
+    # far beyond thread-scheduling jitter — recorded fresh sets are
+    # stable even on a loaded box — and the utility landscape peaks
+    # decisively at 4, so every estimator below lands on the same
+    # recommendation instead of coin-flipping a near-tie
+    delays = faults.compose(
+        faults.per_worker([0.05, 0.055, 0.06, 0.065, 0.25, 0.0]),
+        faults.straggler(5, 0.5),  # rank 5: the hard straggler
+    )
+    backend = LocalBackend(work, N, delay_fn=delays)
+    tracer = EpochTracer()
+    pool = AsyncPool(N)
+    try:
+        for _ in range(EPOCHS):
+            asyncmap(pool, np.zeros(1), backend, nwait=K, tracer=tracer)
+        waitall(pool, backend, tracer=tracer)
+    finally:
+        backend.shutdown()
+    trace_path = out_dir / "straggling_run.jsonl"
+    tracer.dump_jsonl(trace_path)
+    s = tracer.summary()
+    print(
+        f"recorded {s['epochs']} epochs on the thread backend "
+        f"(nwait={K}, straggler_rate {s['straggler_rate']:.2f}) "
+        f"-> {trace_path}"
+    )
+
+    # -- 2. replay: validate, then ask counterfactuals ------------------
+    trace = ReplayTrace.from_jsonl(trace_path)
+    baseline = replay(trace)  # recorded policy
+    drift = compare(trace, baseline)
+    print(
+        f"replay @ recorded nwait: fresh sets reproduced "
+        f"{drift['fresh_exact_rate']:.0%} of epochs, wall drift "
+        f"{drift['wall_drift_mean_s']*1e3:.1f} ms"
+    )
+    assert drift["fresh_exact_rate"] == 1.0
+    for nw in (K - 1, K, N):
+        res = replay(trace, nwait=nw)
+        summ = res.summary()
+        tag = " (recorded)" if nw == K else ""
+        print(
+            f"counterfactual nwait={nw}{tag}: mean epoch "
+            f"{summ['wall_mean_s']*1e3:7.1f} ms, "
+            f"stale harvests {summ['n_stale']}"
+        )
+
+    # -- 3. tune: sweep the incident + cross-check the model ------------
+    sweep = sweep_nwait(trace, epochs=40, floor=K - 1)
+    print(f"sweep over the recorded incident (floor {K - 1}):")
+    print(sweep.table())
+    print(f"tuner recommends nwait={sweep.best}")
+
+    model = PoolLatencyModel(N, seed=0)
+    fn = trace.delay_fn()
+    for e in range(1, EPOCHS + 1):
+        for i in range(N):
+            model.observe(i, fn(i, e))
+    rec = recommend_nwait(model, floor=K - 1, epochs=150)
+    print(
+        f"model optimal_nwait={rec['model_nwait']}, sim cross-check "
+        f"nwait={rec['sim_nwait']} "
+        f"({'agree' if rec['agree'] else 'DISAGREE'})"
+    )
+    print("policy tuning ok")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:
+        main(Path(sys.argv[1]))
+    else:
+        with tempfile.TemporaryDirectory() as d:
+            main(Path(d))

@@ -23,6 +23,8 @@ __all__ = [
     "NativeProcessBackend",
     "XLADeviceBackend",
     "WorkerFailure",
+    "SimBackend",
+    "VirtualClock",
 ]
 
 def _version() -> str:
@@ -78,4 +80,12 @@ def __getattr__(name):
         from .backends.native import NativeProcessBackend
 
         return NativeProcessBackend
+    if name in ("SimBackend", "VirtualClock"):
+        # lazy: the sim plane is stdlib+numpy but pulls the whole
+        # replay/tune surface (and utils) with it — a LocalBackend-only
+        # import should stay as light as before ISSUE 5. GC001 proves
+        # sim/ accelerator-free via its own hermetic-root walk.
+        from . import sim
+
+        return getattr(sim, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

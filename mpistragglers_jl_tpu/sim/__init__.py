@@ -1,0 +1,52 @@
+# graftcheck: hermetic-root  (GC001 walks this subpackage's closure as
+# its own root: everything sim/ reaches must stay jax-/accelerator-free
+# even if a future refactor detaches it from the package root's walk)
+"""Virtual-time straggler simulation: the *decide* plane.
+
+obs/ observes a fleet, graftcheck verifies the code that runs it; this
+package closes the loop by making policy decisions cheap to evaluate:
+a :class:`VirtualClock` (event-heap time) under a :class:`SimBackend`
+(the full :class:`~..backends.base.Backend` protocol) lets the REAL
+``asyncmap``/``waitall``, ``HedgedServer``, and anything else written
+against the Backend contract run on virtual time — a 10k-epoch
+straggling run completes in milliseconds, bit-reproducibly. On top:
+
+* :mod:`.replay` — recorded :class:`~..utils.trace.EpochTracer` /
+  obs-plane traces become counterfactual testbeds ("what would that
+  incident have cost under nwait=5?");
+* :mod:`.tune` — sweep (nwait, hedge width, code rate) against a
+  trace, a fitted :class:`~..utils.straggle.PoolLatencyModel`, or any
+  :mod:`..utils.faults` schedule, honoring the decodability floor and
+  cross-checking ``PoolLatencyModel.optimal_nwait``.
+
+stdlib + numpy only, like the package root: simulating a TPU fleet
+must never require a TPU (or jax) — tests/test_no_compiler.py and
+graftcheck GC001 both pin it.
+"""
+
+from .backend import SimBackend, SimEvent, model_delay_fn
+from .clock import VirtualClock
+from .replay import ReplayResult, ReplayTrace, compare, replay
+from .tune import (
+    NwaitSweep,
+    recommend_nwait,
+    sweep_code_rate,
+    sweep_hedge,
+    sweep_nwait,
+)
+
+__all__ = [
+    "VirtualClock",
+    "SimBackend",
+    "SimEvent",
+    "model_delay_fn",
+    "ReplayTrace",
+    "ReplayResult",
+    "replay",
+    "compare",
+    "NwaitSweep",
+    "sweep_nwait",
+    "sweep_code_rate",
+    "sweep_hedge",
+    "recommend_nwait",
+]

@@ -1,0 +1,305 @@
+"""Policy autotuning: sweep (nwait, hedge width, code rate) on virtual time.
+
+The paper's entire value proposition is one knob — return after the
+``nwait`` fastest workers — and until now the only ways to price a
+setting were live runs with injected sleeps (wall-clock, flaky) or
+:meth:`~..utils.straggle.PoolLatencyModel.optimal_nwait`'s closed-form
+Monte Carlo (fast, but it models an epoch as one order statistic and
+never exercises the real pool's stale-harvest/re-task machinery).
+This module is the third estimator: run the REAL ``asyncmap`` loop on a
+:class:`~.backend.SimBackend` for every candidate policy and measure
+virtual wall clock — the full pool semantics at simulator speed,
+against either a recorded trace (:class:`~.replay.ReplayTrace`), a
+fitted latency model (:func:`~.backend.model_delay_fn`), or any
+:mod:`..utils.faults` schedule.
+
+Every sweep respects the decodability floor: for an (n, k) code, fewer
+than k fresh shards cannot decode, so candidates below ``floor`` are
+never evaluated (the same ``kmin`` contract as
+``PoolLatencyModel.optimal_nwait`` and ``AdaptiveNwait``), and
+:func:`recommend_nwait` cross-checks the sim sweep against the model's
+analytic pick so the two estimators keep each other honest.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from ..backends.base import DelayFn
+from ..pool import AsyncPool, asyncmap, waitall
+from ..utils.hedge import HedgedServer
+from ..utils.trace import EpochTracer
+from .backend import SimBackend, model_delay_fn
+from .clock import VirtualClock
+from .replay import ReplayTrace
+
+__all__ = [
+    "NwaitSweep",
+    "sweep_nwait",
+    "sweep_hedge",
+    "sweep_code_rate",
+    "recommend_nwait",
+]
+
+
+def _echo(i, payload, epoch):
+    return payload
+
+
+def _resolve_delay(source, *, seed: int) -> tuple[DelayFn, int | None]:
+    """(delay_fn, n_workers hint) from a trace / model / DelayFn."""
+    if isinstance(source, ReplayTrace):
+        return source.delay_fn(), source.n_workers
+    if hasattr(source, "workers") and hasattr(source, "observe_pool"):
+        return model_delay_fn(source, seed=seed), source.n_workers
+    if callable(source):
+        return source, None
+    raise TypeError(
+        "latency source must be a ReplayTrace, a PoolLatencyModel, or "
+        f"a DelayFn callable, got {type(source)}"
+    )
+
+
+class NwaitSweep:
+    """Result table of one policy sweep.
+
+    ``entries`` rows: ``nwait``, ``mean_epoch_s`` / ``p95_epoch_s``
+    (virtual), ``utility_per_s`` (``utility(k) / mean_epoch_s`` — the
+    ``optimal_nwait`` objective, default utility ``k`` = fresh results
+    per epoch), ``n_stale`` harvested over the run. ``best`` is the
+    recommended nwait (argmax utility-per-second, never below the
+    floor by construction).
+    """
+
+    def __init__(self, entries: list[dict], floor: int):
+        if not entries:
+            raise ValueError("empty sweep: no candidate policies ran")
+        self.entries = entries
+        self.floor = int(floor)
+        self.best = int(
+            max(entries, key=lambda r: r["utility_per_s"])["nwait"]
+        )
+
+    def entry(self, nwait: int) -> dict:
+        for r in self.entries:
+            if r["nwait"] == nwait:
+                return r
+        raise KeyError(f"nwait={nwait} was not swept")
+
+    def table(self) -> str:
+        """Human-readable sweep table (examples/policy_tuning.py)."""
+        lines = [
+            f"{'nwait':>6} {'mean epoch':>12} {'p95 epoch':>12} "
+            f"{'util/s':>10} {'stale':>6}"
+        ]
+        for r in self.entries:
+            mark = " <- best" if r["nwait"] == self.best else ""
+            lines.append(
+                f"{r['nwait']:>6} {r['mean_epoch_s']*1e3:>9.3f} ms "
+                f"{r['p95_epoch_s']*1e3:>9.3f} ms "
+                f"{r['utility_per_s']:>10.1f} {r['n_stale']:>6}{mark}"
+            )
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:
+        return (
+            f"NwaitSweep(best={self.best}, floor={self.floor}, "
+            f"{len(self.entries)} candidates)"
+        )
+
+
+def sweep_nwait(
+    source,
+    *,
+    n_workers: int | None = None,
+    epochs: int = 100,
+    floor: int = 1,
+    nwait_values: Sequence[int] | None = None,
+    utility: Callable[[int], float] | None = None,
+    work_fn=None,
+    payload=None,
+    seed: int = 0,
+    registry=None,
+    spans=None,
+) -> NwaitSweep:
+    """Price every candidate ``nwait`` by running the real pool loop on
+    virtual time.
+
+    ``source`` supplies the fleet's latency behavior: a
+    :class:`~.replay.ReplayTrace` (recorded incident), a
+    :class:`~..utils.straggle.PoolLatencyModel` (fitted fleet), or a
+    raw :data:`~..backends.base.DelayFn` (synthetic scenario —
+    ``n_workers`` required then). Candidates default to
+    ``floor..n_workers``; anything below ``floor`` (the code's
+    decodability k) is refused rather than silently clamped.
+    """
+    delay_fn, n_hint = _resolve_delay(source, seed=seed)
+    n = int(n_workers if n_workers is not None else (n_hint or 0))
+    if n <= 0:
+        raise ValueError(
+            "n_workers is required when the latency source does not "
+            "carry a pool size"
+        )
+    floor = int(floor)
+    if not (1 <= floor <= n):
+        raise ValueError(f"floor must be in [1, {n}], got {floor}")
+    ks = (
+        list(range(floor, n + 1)) if nwait_values is None
+        else sorted({int(k) for k in nwait_values})
+    )
+    if any(k < floor for k in ks):
+        raise ValueError(
+            f"nwait candidates {sorted(k for k in ks if k < floor)} sit "
+            f"below the decodability floor {floor}: fewer than "
+            f"{floor} fresh shards cannot decode"
+        )
+    if any(k > n for k in ks):
+        raise ValueError(f"nwait candidates must be <= n_workers={n}")
+    u = (lambda k: float(k)) if utility is None else utility
+    if work_fn is None:
+        work_fn = _echo
+    if payload is None:
+        payload = np.zeros(1, dtype=np.float64)
+    entries: list[dict] = []
+    for k in ks:
+        backend = SimBackend(
+            work_fn, n, delay_fn=delay_fn, clock=VirtualClock(),
+            registry=registry, spans=spans,
+        )
+        pool = AsyncPool(n)
+        tracer = EpochTracer()  # sim runs feed the same tracer plane
+        walls = np.empty(epochs)
+        for e in range(epochs):
+            t0 = backend.clock.now()
+            asyncmap(pool, payload, backend, nwait=k, tracer=tracer)
+            walls[e] = backend.clock.now() - t0
+        if pool.active.any():
+            waitall(pool, backend, tracer=tracer)
+        mean = float(walls.mean())
+        entries.append({
+            "nwait": k,
+            "mean_epoch_s": mean,
+            "p95_epoch_s": float(np.percentile(walls, 95)),
+            "utility_per_s": float(u(k)) / mean if mean > 0 else np.inf,
+            "n_stale": int(sum(r.n_stale for r in tracer.records)),
+        })
+    return NwaitSweep(entries, floor)
+
+
+def sweep_code_rate(
+    source,
+    *,
+    n_workers: int | None = None,
+    k_values: Sequence[int],
+    epochs: int = 100,
+    utility: Callable[[int], float] | None = None,
+    seed: int = 0,
+) -> NwaitSweep:
+    """Price (n, k) code rates: each candidate k runs at ``nwait=k``
+    (the decodability floor IS the policy — an (n, k) code returns the
+    moment k shards are fresh), utility defaulting to recovered work
+    per second (``k / E[epoch]``). Lower k dodges deeper order
+    statistics but discards more redundant compute; the sweep prices
+    that trade on the actual pool semantics."""
+    ks = sorted({int(k) for k in k_values})
+    return sweep_nwait(
+        source, n_workers=n_workers, epochs=epochs, floor=min(ks),
+        nwait_values=ks, utility=utility, seed=seed,
+    )
+
+
+def sweep_hedge(
+    source,
+    *,
+    n_workers: int | None = None,
+    widths: Sequence[int] | None = None,
+    requests: int = 40,
+    tolerance: float = 0.05,
+    seed: int = 0,
+) -> dict[str, Any]:
+    """Price hedge widths by running the REAL :class:`HedgedServer` on
+    virtual time: per width, ``requests`` sequential requests (the
+    fleet quiesced between requests so every width sees identical
+    conditions), reporting virtual first-arrival latency stats and the
+    replica-seconds each width burns. Recommended width: the narrowest
+    whose p95 is within ``tolerance`` of the best p95 — wider hedges
+    that buy no tail are pure dispatch cost."""
+    delay_fn, n_hint = _resolve_delay(source, seed=seed)
+    n = int(n_workers if n_workers is not None else (n_hint or 0))
+    if n <= 0:
+        raise ValueError(
+            "n_workers is required when the latency source does not "
+            "carry a pool size"
+        )
+    ws = list(range(1, n + 1)) if widths is None else sorted(
+        {int(w) for w in widths}
+    )
+    if any(w < 1 or w > n for w in ws):
+        raise ValueError(f"hedge widths must be in [1, {n}], got {ws}")
+    entries = []
+    for w in ws:
+        backend = SimBackend(
+            _echo, n, delay_fn=delay_fn, clock=VirtualClock()
+        )
+        srv = HedgedServer(backend)
+        lats = np.empty(requests)
+        for q in range(requests):
+            t0 = backend.clock.now()
+            srv.request(np.asarray([q], dtype=np.int64), hedge=w)
+            lats[q] = backend.clock.now() - t0
+            backend.quiesce()   # losers land before the next request
+            srv._harvest()
+        entries.append({
+            "width": w,
+            "mean_latency_s": float(lats.mean()),
+            "p95_latency_s": float(np.percentile(lats, 95)),
+            "max_latency_s": float(lats.max()),
+            "dispatches": int(backend.n_dispatched),
+        })
+    best_p95 = min(r["p95_latency_s"] for r in entries)
+    rec = next(
+        r["width"] for r in entries
+        if r["p95_latency_s"] <= best_p95 * (1.0 + tolerance)
+    )
+    return {
+        "entries": entries,
+        "recommended_width": int(rec),
+        "best_p95_s": float(best_p95),
+    }
+
+
+def recommend_nwait(
+    model,
+    *,
+    floor: int = 1,
+    kmax: int | None = None,
+    epochs: int = 300,
+    seed: int = 0,
+    utility: Callable[[int], float] | None = None,
+) -> dict[str, Any]:
+    """Cross-checked nwait recommendation from a fitted
+    :class:`~..utils.straggle.PoolLatencyModel`: the sim sweep (real
+    pool loop, virtual time, :func:`~.backend.model_delay_fn` fleet)
+    and the model's analytic ``optimal_nwait`` side by side. Agreement
+    is the expected state — both estimate argmax utility(k)/E[T_(k)]
+    over the same distributions; divergence means the pool's
+    stale-harvest dynamics (which only the sim sees) are moving the
+    optimum, and the sim's answer is the one that priced them."""
+    sweep = sweep_nwait(
+        model, epochs=epochs, floor=floor,
+        nwait_values=(
+            None if kmax is None else range(floor, int(kmax) + 1)
+        ),
+        utility=utility, seed=seed,
+    )
+    analytic = model.optimal_nwait(
+        kmin=floor, kmax=kmax, utility=utility
+    )
+    return {
+        "sim_nwait": sweep.best,
+        "model_nwait": int(analytic),
+        "agree": sweep.best == int(analytic),
+        "sweep": sweep,
+    }

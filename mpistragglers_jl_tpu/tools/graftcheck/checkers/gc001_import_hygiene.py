@@ -16,6 +16,16 @@ numpy is NOT in the forbidden set: it is the package's core hard
 dependency (the pool is numpy bookkeeping). The forbidden roots are
 the device/toolchain stacks a LocalBackend-only user must never pay
 import (or plugin registration) cost for.
+
+Beyond the top-level package roots, any package ``__init__`` carrying
+the ``# graftcheck: hermetic-root`` marker is walked as a root of its
+OWN closure (ISSUE 5: ``sim/`` — simulating a TPU fleet must never
+require jax). The marker makes the guarantee self-standing: if a
+future refactor detaches the subpackage from the package root's
+module-level imports (lazy ``__getattr__``, say), its closure keeps
+getting proven hermetic instead of silently dropping out of the walk.
+Findings reachable from several roots are reported once, under the
+first (sorted) root that reaches them.
 """
 
 from __future__ import annotations
@@ -24,6 +34,11 @@ import ast
 from typing import Iterator
 
 from ..core import Checker, Finding, ModuleInfo, register
+
+# a package __init__ carrying this marker (comment or docstring line)
+# becomes an additional GC001 closure root — its whole reachable set
+# must stay accelerator-free on its own, not merely via the top root
+HERMETIC_MARKER = "# graftcheck: hermetic-root"
 
 FORBIDDEN_ROOTS = frozenset({
     "jax",
@@ -144,15 +159,32 @@ class ImportHygiene(Checker):
         roots = sorted(
             n for n in packages if "." not in n
         )
+        # hermetic subpackages are closure roots of their own: the
+        # marker in their __init__ is the declaration (module
+        # docstring)
+        roots += sorted(
+            n for n in packages
+            if "." in n and HERMETIC_MARKER in by_name[n].source
+        )
         names = set(by_name)
         graph = {
             n: _edges(m, names, packages) for n, m in by_name.items()
         }
+        # dedup across roots keyed (path, line, imported name): the
+        # name keeps `import jax, torch` on one line as TWO findings
+        seen: set[tuple[str, int, str]] = set()
         for root in roots:
             # BFS from the package __init__, remembering one shortest
-            # chain per module for the diagnostic
+            # chain per module for the diagnostic. Importing a
+            # subpackage executes every ancestor __init__, so a
+            # hermetic root's walk starts from its whole ancestry.
             chain: dict[str, list[str]] = {root: [root]}
             queue = [root]
+            for i in range(1, root.count(".") + 1):
+                anc = root.rsplit(".", i)[0]
+                if anc in names and anc not in chain:
+                    chain[anc] = [root, anc]
+                    queue.append(anc)
             while queue:
                 cur = queue.pop(0)
                 for nxt in sorted(graph.get(cur, ())):
@@ -163,6 +195,11 @@ class ImportHygiene(Checker):
                 mod = by_name[name]
                 for node in module_level_imports(mod.tree):
                     for bad, site in _forbidden(mod, node):
+                        key = (mod.path, site.lineno, bad)
+                        if key in seen:
+                            continue  # already reported under an
+                            # earlier root's closure
+                        seen.add(key)
                         yield mod.finding(
                             self.rule,
                             site,

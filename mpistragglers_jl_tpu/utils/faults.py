@@ -169,31 +169,46 @@ class from_trace:
     def __init__(self, path, *, missing: float | None = None):
         import json
 
+        with open(path) as f:
+            records = [json.loads(line) for line in f]
+        self._init_from_records(records, missing)
+
+    @classmethod
+    def from_records(cls, records, *, missing: float | None = None):
+        """Build the schedule from already-loaded epoch records — the
+        dict form of :meth:`~.trace.EpochRecord.to_dict` (what
+        ``dump_jsonl`` writes line-by-line). The in-memory half of the
+        record -> replay loop: :mod:`..sim.replay` feeds a live
+        :class:`~.trace.EpochTracer`'s records straight in, no file
+        round-trip."""
+        self = cls.__new__(cls)
+        self._init_from_records(list(records), missing)
+        return self
+
+    def _init_from_records(self, records, missing: float | None) -> None:
         by_key: dict[tuple[int, int], float] = {}
         longest = 0.0
-        with open(path) as f:
-            for line in f:
-                rec = json.loads(line)
-                dispatched: dict[int, float] = {}
-                for ev in rec.get("events", []):
-                    w = int(ev["worker"])
-                    if ev["kind"] in ("dispatch", "retask"):
-                        dispatched[w] = float(ev["t"])
-                    elif ev["kind"] in ("arrival", "drain"):
-                        t0 = dispatched.pop(w, None)
-                        if t0 is not None:
-                            lat = float(ev["t"]) - t0
-                        else:
-                            # dispatched in an earlier record (cross-
-                            # epoch straggle): the record's latency
-                            # snapshot holds this worker's measured
-                            # round-trip (reference pool.latency field)
-                            try:
-                                lat = float(rec["latency_s"][w])
-                            except (KeyError, IndexError):
-                                continue
-                        by_key[(w, int(ev["epoch"]))] = lat
-                        longest = max(longest, lat)
+        for rec in records:
+            dispatched: dict[int, float] = {}
+            for ev in rec.get("events", []):
+                w = int(ev["worker"])
+                if ev["kind"] in ("dispatch", "retask"):
+                    dispatched[w] = float(ev["t"])
+                elif ev["kind"] in ("arrival", "drain"):
+                    t0 = dispatched.pop(w, None)
+                    if t0 is not None:
+                        lat = float(ev["t"]) - t0
+                    else:
+                        # dispatched in an earlier record (cross-
+                        # epoch straggle): the record's latency
+                        # snapshot holds this worker's measured
+                        # round-trip (reference pool.latency field)
+                        try:
+                            lat = float(rec["latency_s"][w])
+                        except (KeyError, IndexError):
+                            continue
+                    by_key[(w, int(ev["epoch"]))] = lat
+                    longest = max(longest, lat)
         self._by_key = by_key
         # per-worker typical latency: the fallback when replay dispatch
         # epochs drift from the recorded ones (e.g. A/B-ing a different

@@ -1,0 +1,4 @@
+"""GC001 hermetic-root bad fixture: the top root never imports the
+``sim`` subpackage (it would stay invisible to the top-root walk), but
+``sim/__init__.py`` declares itself a hermetic root — so its closure
+is walked on its own and the jax import inside it is a finding."""

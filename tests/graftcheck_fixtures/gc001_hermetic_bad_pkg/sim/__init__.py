@@ -1,0 +1,4 @@
+# graftcheck: hermetic-root
+"""A self-declared hermetic subpackage whose closure leaks jax."""
+
+from .core import Sim  # noqa: F401

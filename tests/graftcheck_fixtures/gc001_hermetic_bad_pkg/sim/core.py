@@ -1,0 +1,11 @@
+"""Reachable only from the hermetic subpackage root; the jax import
+below is the violation (line 6 — pinned by the fixture test)."""
+
+import numpy as np  # the sanctioned hard dependency
+
+import jax  # GC001: module-level accelerator import in a hermetic root
+
+
+class Sim:
+    def run(self, x):
+        return jax.numpy.asarray(np.asarray(x))

@@ -1,0 +1,3 @@
+"""GC001 hermetic-root good twin: the hermetic subpackage's closure is
+genuinely accelerator-free (lazy jax import inside a function is the
+sanctioned escape hatch, exactly as in the real package root)."""

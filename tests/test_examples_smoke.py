@@ -29,6 +29,19 @@ def test_iterative_example_runs_and_reports_latency():
     assert "done: latency per worker" in out.stdout
 
 
+def test_policy_tuning_example(tmp_path):
+    """The sim/ plane walkthrough: record -> replay -> tune, numpy-only
+    and fast by construction (virtual time), so it runs in tier-1."""
+    out = _run_example("policy_tuning.py", str(tmp_path))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "fresh sets reproduced 100% of epochs" in out.stdout
+    assert "counterfactual nwait=" in out.stdout
+    assert "tuner recommends nwait=" in out.stdout
+    assert "(agree)" in out.stdout  # sim cross-check == model pick
+    assert "policy tuning ok" in out.stdout
+    assert (tmp_path / "straggling_run.jsonl").exists()
+
+
 @pytest.mark.slow
 def test_straggler_aware_training_converges(tmp_path):
     out = _run_example("straggler_aware_training.py", str(tmp_path))

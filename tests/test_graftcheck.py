@@ -44,6 +44,7 @@ def _keys(findings):
     "bad,expected",
     [
         ("gc001_bad_pkg", [("GC001", 6)]),
+        ("gc001_hermetic_bad_pkg", [("GC001", 6)]),
         ("gc002_bad.py", [("GC002", 11), ("GC002", 17), ("GC002", 21)]),
         (
             "gc003_bad.py",
@@ -67,8 +68,8 @@ def test_bad_fixture_exact_findings(bad, expected):
 
 @pytest.mark.parametrize(
     "good",
-    ["gc001_good_pkg", "gc002_good.py", "gc003_good.py",
-     "gc004_good.py", "gc005_good.py"],
+    ["gc001_good_pkg", "gc001_hermetic_good_pkg", "gc002_good.py",
+     "gc003_good.py", "gc004_good.py", "gc005_good.py"],
 )
 def test_good_fixture_clean(good):
     res = _findings(good)
@@ -324,8 +325,68 @@ def test_cache_rejects_malformed_entries(tmp_path):
 
 
 # --------------------------------------------------------------------------
-# the self-run gate
+# GC001 hermetic subpackage roots (ISSUE 5: sim/ proven jax-free)
 # --------------------------------------------------------------------------
+
+
+def test_hermetic_marker_makes_subpackage_its_own_closure_root():
+    """The bad fixture's top root never imports its ``sim``
+    subpackage, so the top-root walk alone would miss the jax leak
+    entirely; the ``# graftcheck: hermetic-root`` marker in
+    ``sim/__init__.py`` is what makes it a finding — and the finding
+    names the hermetic root, not the (blind) top root."""
+    res = _findings("gc001_hermetic_bad_pkg")
+    assert _keys(res.fresh) == [("GC001", 6)]
+    (f,) = res.fresh
+    assert "gc001_hermetic_bad_pkg.sim" in f.message
+    # the package-shaped control: strip the marker and the same tree
+    # scans clean, proving the marker (not the layout) adds the root
+    import ast as _ast
+
+    from mpistragglers_jl_tpu.tools.graftcheck.checkers import (
+        gc001_import_hygiene as gc001,
+    )
+    from mpistragglers_jl_tpu.tools.graftcheck.core import load_modules
+
+    mods = load_modules([os.path.join(_FIX, "gc001_hermetic_bad_pkg")])
+    for m in mods:
+        if m.path.endswith(os.path.join("sim", "__init__.py")):
+            m.source = m.source.replace(gc001.HERMETIC_MARKER, "# x")
+    got = list(gc001.ImportHygiene().check_project(mods))
+    assert got == []
+
+
+def test_shipped_sim_subpackage_is_a_hermetic_root():
+    """The real ``sim/`` declares the marker, so its closure is proven
+    accelerator-free as a root of its own and survives any future
+    detachment from the package root's ``__init__`` walk (the
+    detection mechanics are pinned by the fixture pair; this pins that
+    the shipped tree actually opts in)."""
+    from mpistragglers_jl_tpu.tools.graftcheck.checkers import (
+        gc001_import_hygiene as gc001,
+    )
+
+    src = os.path.join(_PKG, "sim", "__init__.py")
+    with open(src) as f:
+        assert gc001.HERMETIC_MARKER in f.read()
+
+
+def test_hermetic_and_top_root_findings_deduplicate(tmp_path):
+    """A violation reachable from BOTH the top root and a hermetic
+    subroot is one finding, not two (reported under the first root
+    that reaches it) — while two DISTINCT forbidden imports sharing
+    one source line stay two findings (the dedup key includes the
+    imported name, not just the line)."""
+    pkg = tmp_path / "dualpkg"
+    (pkg / "sub").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("from . import sub\n")
+    (pkg / "sub" / "__init__.py").write_text(
+        "# graftcheck: hermetic-root\nimport jax, torch\n"
+    )
+    res = run([str(pkg)], rules=["GC001"])
+    assert len(res.fresh) == 2  # jax AND torch, once each
+    assert all(f.rule == "GC001" for f in res.fresh)
+    assert {f.line for f in res.fresh} == {2}
 
 
 def test_package_self_run_is_clean():

@@ -5,13 +5,22 @@ Deterministic delay schedules make every claim checkable: the winner is
 the fast replica, a stalled loser's rank stays out of new subsets until
 its late result is harvested, and the measured request latency tracks
 the fast replica's injected delay, not the straggler's.
-"""
+
+Re-rooted on virtual time (ISSUE 5): every test whose claim is about
+LATENCY or ARRIVAL ORDER — the flake family that needed deflaking in
+PRs 3/4 — now runs the same ``HedgedServer`` on a ``SimBackend``,
+where "the winner paid FAST, not SLOW" is an exact virtual-clock
+equality and the old ``sleep(SLOW + 0.05)`` settling margins are a
+costless ``clock.run_until``. The thread-backend tests that remain
+below exercise what sim cannot: real thread death, real deadline
+budgets, and one full real-backend request smoke."""
 
 import time
 
 import numpy as np
 import pytest
 
+from mpistragglers_jl_tpu import SimBackend
 from mpistragglers_jl_tpu.backends.local import LocalBackend
 from mpistragglers_jl_tpu.utils import HedgedServer
 
@@ -31,35 +40,40 @@ def _mk_backend(slow_ranks=(0,)):
     return LocalBackend(_work, N, delay_fn=delay)
 
 
+def _mk_sim(delay):
+    return SimBackend(_work, N, delay_fn=delay)
+
+
 def test_winner_is_fast_replica_and_latency_tracks_it():
-    backend = _mk_backend(slow_ranks=(0,))
+    """Virtual time makes the Tail-at-Scale claim exact: the hedge
+    pays the fast replica's delay to the nanosecond, and the straggler
+    costs the request nothing (formerly `lat < SLOW / 2` against the
+    wall clock — a margin bet; now an equality)."""
+    backend = _mk_sim(lambda i, e: SLOW if i == 0 else FAST)
     srv = HedgedServer(backend)
-    t0 = time.perf_counter()
-    result, rank, lat = srv.request(
+    t0 = backend.clock.now()
+    result, rank, _ = srv.request(
         np.asarray([7], np.int64), replicas=[0, 1]
     )
-    wall = time.perf_counter() - t0
     assert rank == 1  # the fast one
     assert result[0] == 1 and result[1] == 7
-    assert lat < SLOW / 2  # paid the fast delay, not the stall
-    assert wall < SLOW  # the request never waited for the straggler
+    # the request advanced virtual time by exactly the winner's delay
+    assert backend.clock.now() - t0 == pytest.approx(FAST, abs=1e-12)
+    assert backend.last_latency[rank] == pytest.approx(FAST, abs=1e-12)
+    backend.quiesce()  # let the loser land before the drain barrier
     srv.drain()
-    backend.shutdown()
 
 
 def test_loser_rank_excluded_until_harvested():
-    """Deflaked (the one pre-existing tier-1 failure, CHANGES.md): the
-    old assertion demanded the request-2 WINNER be rank 2 or 3, but
-    rank 1 — freed the moment it won request 1 — is a legitimate
-    member of the new subset, and with identical FAST delays on every
-    idle replica the winner among them is a thread-scheduling race
-    (a wall-clock coin flip on a loaded CPU box, failing on unmodified
-    HEAD). The claim this test actually pins is about SUBSET
-    membership, which is deterministic: the busy loser's rank stays
-    out of new subsets until its late result is harvested — so assert
-    the dispatched subset (and hence the winner) excludes rank 0, not
-    which of the equally-fast members won."""
-    backend = _mk_backend(slow_ranks=(0,))
+    """Deflaked in PR 3, exact since ISSUE 5: the busy loser's rank
+    stays out of new subsets until its late result is harvested. The
+    PR 3 deflake had already reduced this to the deterministic
+    subset-membership claim (the old winner-identity assertion was a
+    thread race on equally-fast replicas, failing on unmodified HEAD);
+    on virtual time even the settling sleep (`SLOW + 0.05`) becomes an
+    exact `run_until(SLOW)` — the harvest boundary is a clock value,
+    not a margin."""
+    backend = _mk_sim(lambda i, e: SLOW if i == 0 else FAST)
     srv = HedgedServer(backend)
     srv.request(np.asarray([1], np.int64), replicas=[0, 1])
     # rank 0 is still grinding its losing dispatch
@@ -69,12 +83,15 @@ def test_loser_rank_excluded_until_harvested():
     assert srv.last_hedge_width == 2  # no narrowing: 3 ranks idle
     new_subsets = [k for k in srv._pools if k != (0, 1)]
     assert new_subsets and all(0 not in k for k in new_subsets)
-    # after the stall elapses, harvest frees rank 0 for new subsets
-    time.sleep(SLOW + 0.05)
+    # one tick before the stall elapses the loser is still busy; AT
+    # the stall boundary the harvest frees it — exact, not a margin
+    backend.clock.run_until(SLOW - 1e-9)
+    srv._harvest()
+    assert srv._busy_ranks() == {0}
+    backend.clock.run_until(SLOW)
     srv._harvest()
     assert 0 not in srv._busy_ranks()
     srv.drain()
-    backend.shutdown()
 
 
 def test_round_robin_spreads_load():
@@ -168,25 +185,28 @@ def test_all_dead_raises_immediately():
 
 
 def test_tail_latency_win_under_random_stalls():
-    """The Tail-at-Scale claim, deterministically: replica r stalls on
-    requests where (q + r) % 4 == 0, so single-assignment eats a stall
-    every 4th request while hedge=2 (consecutive ranks never both
-    stall) never does."""
+    """The Tail-at-Scale claim, exactly: replica r stalls on requests
+    where (q + r) % 4 == 0, so single-assignment eats a stall every
+    4th request while hedge=2 (consecutive ranks never both stall)
+    never does. On virtual time the claim sharpens from `max(hedged)
+    < SLOW` (a wall-clock margin that lost races on loaded boxes —
+    the PR 3/4 flake family) to `every request == FAST`."""
 
     def delay(i, epoch):
         return SLOW if (epoch + i) % 4 == 0 else FAST
 
-    backend = LocalBackend(_work, N, delay_fn=delay)
+    backend = _mk_sim(delay)
     srv = HedgedServer(backend)
     hedged = []
     for q in range(8):
-        t0 = time.perf_counter()
+        t0 = backend.clock.now()
         srv.request(np.asarray([q], np.int64), hedge=2)
-        hedged.append(time.perf_counter() - t0)
+        hedged.append(backend.clock.now() - t0)
         srv.drain()  # isolate per-request timing
-    assert max(hedged) < SLOW, hedged  # no request paid a stall
+    # no request paid ANY stall (approx: virtual timestamps are exact
+    # event times, but float addition along the clock is not exact)
+    assert hedged == pytest.approx([FAST] * 8, abs=1e-12), hedged
     srv.drain()
-    backend.shutdown()
 
 
 def test_single_deadline_not_double_timeout():
@@ -225,8 +245,10 @@ def test_single_deadline_not_double_timeout():
 
 def test_hedge_width_is_observable():
     """A narrowed hedge is surfaced (ADVICE r4): width lands in
-    last_hedge_width and in the history tuple."""
-    backend = _mk_backend(slow_ranks=(0,))
+    last_hedge_width and in the history tuple. On virtual time the
+    defensive settling sleep (`SLOW + 0.05`) the thread version needed
+    before its drain is gone — `quiesce()` IS the settled state."""
+    backend = _mk_sim(lambda i, e: SLOW if i == 0 else FAST)
     srv = HedgedServer(backend)
     srv.request(np.asarray([1], np.int64), hedge=2, replicas=[0, 1])
     assert srv.last_hedge_width == 2
@@ -234,6 +256,5 @@ def test_hedge_width_is_observable():
     _, rank, _ = srv.request(np.asarray([2], np.int64), hedge=4)
     assert srv.last_hedge_width == 3
     assert srv.history[-1][2] == 3
-    time.sleep(SLOW + 0.05)
+    backend.quiesce()
     srv.drain()
-    backend.shutdown()

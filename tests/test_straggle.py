@@ -99,6 +99,62 @@ def test_proportional_shares_follow_speed_and_sum():
     assert empty.proportional_shares(9).tolist() == [3, 3, 3]
 
 
+def test_predictions_are_deterministic_pure_functions():
+    """ISSUE 5 satellite (the determinism contract FAILED and was
+    fixed): a shared generator used to advance across calls, so two
+    identical ``optimal_nwait``/``sample_latencies`` calls could
+    disagree near a utility tie — a non-reproducible nwait decision.
+    Predictions are now pure functions of (fitted state, seed)."""
+    model = PoolLatencyModel(5, seed=7)
+    rng = np.random.default_rng(0)
+    for i in range(5):
+        for x in 0.02 * (i + 1) + rng.exponential(0.03, 30):
+            model.observe(i, x)
+    assert (
+        model.sample_latencies(200) == model.sample_latencies(200)
+    ).all()
+    assert len({model.optimal_nwait() for _ in range(6)}) == 1
+    assert len({model.expected_epoch_time(3) for _ in range(6)}) == 1
+    # new samples DO change the prediction inputs (purity is in the
+    # fitted state, not a frozen cache)
+    before = model.sample_latencies(50)
+    model.observe(0, 5.0)
+    assert not (model.sample_latencies(50) == before).all()
+
+
+def test_optimal_nwait_monotonic_in_slo_and_floor_respected():
+    """ISSUE 5 satellite, seeded property test over random fleets:
+    (1) the returned nwait is monotonic non-decreasing in the SLO
+    target — loosening a latency budget can only admit deeper waits;
+    (2) it NEVER sits below the supplied decodability floor, even
+    when the SLO is unachievable at any k."""
+    rng = np.random.default_rng(123)
+    for trial in range(8):
+        n = int(rng.integers(3, 10))
+        model = PoolLatencyModel(n, seed=trial)
+        for i in range(n):
+            shift = float(rng.uniform(0.005, 0.2))
+            tail = float(rng.uniform(0.001, 0.5))
+            for x in shift + rng.exponential(tail, 25):
+                model.observe(i, x)
+        kmin = int(rng.integers(1, n + 1))
+        # SLO grid from unachievable (below every floor) to generous
+        slos = np.concatenate(
+            [[1e-6], np.geomspace(0.005, 5.0, 12), [np.inf]]
+        )
+        picks = [model.optimal_nwait(kmin=kmin, slo=s) for s in slos]
+        assert all(k >= kmin for k in picks), (trial, kmin, picks)
+        assert picks == sorted(picks), (trial, kmin, slos, picks)
+        # the unconstrained pick equals slo=inf, and a tiny SLO falls
+        # back to the floor (decodability beats the latency target)
+        assert picks[-1] == model.optimal_nwait(kmin=kmin)
+        assert picks[0] == kmin
+        # feasible picks honor the cap on the same deterministic draws
+        for s, k in zip(slos, picks):
+            if k > kmin:
+                assert model.expected_epoch_time(k) <= s
+
+
 class _Delays:
     """Deterministic: worker 3 is a 10x straggler."""
 
