@@ -561,6 +561,12 @@ def _contract_line(out: dict) -> str:
             serving.get("S8", s_mark), "aggregate_tokens_per_s"),
         "serving_int8_vs_bf16": _rung_summary(
             serving.get("S8_int8", s_mark), "vs_bf16"),
+        "paged_capacity_x_shared": _rung_summary(
+            tt_mark or tt.get("paged_capacity_rung"),
+            "capacity_x_shared"),
+        "paged_vs_slot_tok_s": _rung_summary(
+            tt_mark or tt.get("paged_capacity_rung"),
+            "paged_vs_slot_tok_s"),
         "rateless_overhead": _rung_summary(
             (out.get("rateless_overhead") or {}).get(
                 "systematic", out.get("rateless_overhead"))
@@ -844,6 +850,18 @@ def _transformer_rungs(into: dict | None = None):
     # round-6 adds the int8 kernel-vs-einsum sub-rungs at S=8 (the
     # batched decode path's driver-verifiable claim)
     tt["serving_rung"] = _try_rung(rung_serving, est=120)
+
+    def rung_paged():
+        from benchmarks.serving_bench import bench_paged_vs_slot
+
+        return bench_paged_vs_slot()
+
+    # round-11: paged KV cache — concurrent requests admitted at a
+    # FIXED cache byte budget (slot-ring arena of 8 slots), unique and
+    # shared-system-prompt scenarios, prefill skips counter-verified,
+    # plus the paged-vs-slot decode-throughput ratio (the <= 5%
+    # regression gate); format in benchmarks/README.md round-11 note
+    tt["paged_capacity_rung"] = _try_rung(rung_paged, est=40)
     tt["spec_decode_rung"] = _try_rung(bench_spec_decode, est=60)
 
     def rung_470m():

@@ -33,6 +33,9 @@ _HOME = {
     "ServingScheduler": "serving",
     "make_serving_scan": "serving",
     "serving_decode_step_dense": "serving",
+    "PagePool": "paging",
+    "PagePoolExhausted": "paging",
+    "prefix_page_digests": "paging",
     "make_prefill": "decode",
     "make_decode_step": "decode",
     "make_extend": "decode",
@@ -61,9 +64,13 @@ def clear_cached_programs() -> None:
         decode._dense_runner,
         speculative._spec_runner,
         serving._serving_scan_dense,
+        serving._serving_scan_paged,
         serving._extend_chunk_dense,
         serving._finish_admit_dense,
         serving._place_dense,
+        serving._seed_admit_paged,
+        serving._place_paged,
+        serving._copy_pages_paged,
     ):
         cache.cache_clear()
 

@@ -198,6 +198,26 @@ def _kernel_possible(cfg, quantize_kv: bool, use_kernel=_UNSET) -> bool:
     )
 
 
+def _paged_kernel_possible(cfg, quantize_kv: bool, page_tokens: int,
+                           use_kernel=_UNSET) -> bool:
+    """Could the PAGED serving tick route the int8 kernel's page-table
+    mode? ``_kernel_possible``'s cfg-static guard plus the paged-only
+    conditions the dense gather fallback does not have: the GQA group
+    must fit the kernel's 8-row tile (trace-time in the dense path,
+    cfg-static here — the serving tick fixes its routing at
+    construction) and the page size must be a streamable k-block
+    (``ops.decode_attention.paged_block_viable``). The serving
+    scheduler resolves this ONCE at construction against its slot
+    count; there is no trace-time re-gate on the paged path."""
+    if not _kernel_possible(cfg, quantize_kv, use_kernel):
+        return False
+    if cfg.n_heads // cfg.kv_heads > 8 or cfg.n_heads % cfg.kv_heads:
+        return False
+    from ..ops.decode_attention import paged_block_viable
+
+    return paged_block_viable(page_tokens)
+
+
 def _decode_kernel_interpreted(
     cfg, quantize_kv: bool, use_kernel=_UNSET
 ) -> bool:

@@ -102,9 +102,16 @@ from .decode import (
     _kernel_possible,
     _kernel_viable,
     _kv_quantize,
+    _paged_kernel_possible,
     _pick_token,
     _ring_from_cache,
     _route_kernel,
+)
+from .paging import (
+    NULL_PAGE,
+    PagePool,
+    PagePoolExhausted,
+    prefix_page_digests,
 )
 from .transformer import (
     TransformerConfig,
@@ -119,6 +126,8 @@ __all__ = [
     "ServingScheduler",
     "make_serving_scan",
     "serving_decode_step_dense",
+    "PagePool",
+    "PagePoolExhausted",
 ]
 
 
@@ -136,6 +145,27 @@ def _fresh_cache(cfg: TransformerConfig, B: int, L: int,
         if quantize_kv:
             out["k_s"] = jnp.zeros(shape[:3], jnp.float32)
             out["v_s"] = jnp.zeros(shape[:3], jnp.float32)
+        return out
+
+    return [layer() for _ in range(cfg.n_layers)]
+
+
+def _fresh_pages(cfg: TransformerConfig, n_pages: int, P: int,
+                 quantize_kv: bool = False) -> list[dict]:
+    """Zeroed per-layer PAGE POOL: K/V live in one flat
+    ``(n_pages * P, kv_heads, head_dim)`` row arena per layer (scales
+    ``(n_pages * P, kv_heads)`` when int8), shared by every slot —
+    page ``p`` owns rows ``[p*P, (p+1)*P)``. Page 0 is the reserved
+    null page (:data:`~.paging.NULL_PAGE`): rows nothing reads
+    unmasked, the landing zone for retired-but-still-ticking rows."""
+    shape = (n_pages * P, cfg.kv_heads, cfg.head_dim)
+    kvdt = jnp.int8 if quantize_kv else cfg.dtype
+
+    def layer():
+        out = {"k": jnp.zeros(shape, kvdt), "v": jnp.zeros(shape, kvdt)}
+        if quantize_kv:
+            out["k_s"] = jnp.zeros(shape[:2], jnp.float32)
+            out["v_s"] = jnp.zeros(shape[:2], jnp.float32)
         return out
 
     return [layer() for _ in range(cfg.n_layers)]
@@ -216,10 +246,99 @@ def _ring_attention_rows(q, cache_l, pos, scale, use_kernel=False):
     return o.astype(q.dtype)
 
 
+def _paged_write_rows(cache_l: dict, k, v, pt, slot, P: int):
+    """Write each row's single-token K/V through its page table:
+    ring slot ``slot[i]`` of row i lives at pool row
+    ``pt[i, slot // P] * P + slot % P``. The scheduler's pre-tick COW
+    pass guarantees every page written here is exclusively owned (or
+    the null page, for retired rows) — the device program never has to
+    know pages can be shared."""
+    rows = jnp.arange(k.shape[0])
+    phys = pt[rows, slot // P] * P + slot % P  # (S,)
+
+    def put(c, u):
+        return c.at[phys].set(u[:, 0].astype(c.dtype))
+
+    if not _is_quantized(cache_l):
+        return {"k": put(cache_l["k"], k), "v": put(cache_l["v"], v)}
+    kq, ks = _kv_quantize(k)
+    vq, vs = _kv_quantize(v)
+    return {
+        "k": put(cache_l["k"], kq),
+        "v": put(cache_l["v"], vq),
+        "k_s": put(cache_l["k_s"], ks),
+        "v_s": put(cache_l["v_s"], vs),
+    }
+
+
+def _paged_gather(cache_l: dict, pt, W: int, P: int):
+    """Materialize every slot's W-row ring view out of the page pool:
+    one PAGE-BLOCK ``jnp.take`` per leaf — ``(S, max_pages)`` indices
+    moving contiguous P-row blocks. Page p's rows are ring slots
+    ``[j*P, (j+1)*P)`` in offset order, so reshaping the block gather
+    yields EXACTLY the slot-ring layout ``(S, W, ...)`` and the einsum
+    path runs the unchanged dense ring math on it — dense and paged
+    decode are the identical math by construction, which is what the
+    CPU parity tests lean on. Speed note: this gather runs once per
+    TICK (hoisted out of the decode scan — see ``_serving_scan_paged``;
+    a per-step gather measured 0.66x the slot tick). Null page-table
+    entries resolve to page 0, whose rows are only ever reached by
+    ``kpos < 0`` (masked) slots."""
+    S = pt.shape[0]
+    flat = pt.reshape(-1)  # (S * max_pages,)
+    return {
+        kk: jnp.take(
+            a.reshape((a.shape[0] // P, P) + a.shape[1:]), flat, axis=0
+        ).reshape((S, W) + a.shape[1:])
+        for kk, a in cache_l.items()
+    }
+
+
+def _paged_scatter(cache_l: dict, view_l: dict, pt, P: int):
+    """Write a tick's updated ring views back through the page table —
+    the inverse of :func:`_paged_gather`, one page-block scatter per
+    leaf. Duplicate table entries (a prefix page shared by several
+    slots) all write the SAME bytes: any page a tick writes is
+    exclusively owned (the pre-tick COW pass), so shared pages come
+    back exactly as they went out. Null entries dump into page 0,
+    which nothing reads unmasked."""
+    flat = pt.reshape(-1)
+    out = {}
+    for kk, a in cache_l.items():
+        paged_shape = (a.shape[0] // P, P) + a.shape[1:]
+        upd = view_l[kk].astype(a.dtype).reshape(
+            (flat.shape[0],) + paged_shape[1:]
+        )
+        out[kk] = a.reshape(paged_shape).at[flat].set(upd).reshape(
+            a.shape
+        )
+    return out
+
+
+def _paged_attention_rows(q, cache_l, pt, pos, scale, P):
+    """Single-query ring attention THROUGH the page table — the Pallas
+    paged KERNEL route only (ops/decode_attention.py): the per-slot
+    page-index row rides scalar-prefetch SMEM next to the per-row
+    positions and the block index maps gather K/V pages directly, so
+    HBM traffic is the W live rows. The einsum tick never reads
+    through the table per step — ``_serving_scan_paged`` hoists the
+    gather out of the scan instead (``_paged_gather`` + the unchanged
+    dense ring math). Routing is resolved at scheduler construction
+    (``_paged_kernel_possible``); there is no trace-time re-gate."""
+    from ..ops.decode_attention import quantized_decode_attention
+
+    return quantized_decode_attention(
+        q, cache_l, pos, scale, ring=True, page_table=pt,
+        page_tokens=P,
+    )
+
+
 def _serving_layer(x, lp, cache_l, pos, cfg, *, kv_slice=None,
-                   tp_psum=False, use_kernel=False):
+                   tp_psum=False, use_kernel=False, paged=None):
     """One layer of the per-row serving step (the dense-FFN half of
-    decode.py's ``_incremental_layer`` with per-row positions)."""
+    decode.py's ``_incremental_layer`` with per-row positions).
+    ``paged`` = (page_table, W, PAGE_TOKENS) switches the cache
+    write/read to the page-pool layout; None is the slot-ring path."""
     h = _ln(x, lp["ln1_s"], lp["ln1_b"])
     q = jnp.einsum("bld,dhk->blhk", h, lp["wq"])
     k = jnp.einsum("bld,dhk->blhk", h, lp["wk"])
@@ -227,10 +346,19 @@ def _serving_layer(x, lp, cache_l, pos, cfg, *, kv_slice=None,
     if kv_slice is not None:
         k, v = kv_slice(k), kv_slice(v)
     q, k = _rope_rows(q, pos), _rope_rows(k, pos)
-    W = cache_l["k"].shape[1]
-    cache_l = _ring_write_rows(cache_l, k, v, jnp.mod(pos, W))
-    o = _ring_attention_rows(q, cache_l, pos, cfg.head_dim ** -0.5,
-                             use_kernel=use_kernel)
+    scale = cfg.head_dim ** -0.5
+    if paged is not None:
+        # kernel route only: the einsum paged tick runs THIS function
+        # with paged=None over per-tick gathered ring views instead
+        # (see _serving_scan_paged)
+        pt, W, P = paged
+        cache_l = _paged_write_rows(cache_l, k, v, pt, jnp.mod(pos, W), P)
+        o = _paged_attention_rows(q, cache_l, pt, pos, scale, P)
+    else:
+        W = cache_l["k"].shape[1]
+        cache_l = _ring_write_rows(cache_l, k, v, jnp.mod(pos, W))
+        o = _ring_attention_rows(q, cache_l, pos, scale,
+                                 use_kernel=use_kernel)
     attn_out = jnp.einsum("blhk,hkd->bld", o, lp["wo"])
     if tp_psum:
         attn_out = jax.lax.psum(attn_out, "tp")
@@ -243,13 +371,14 @@ def _serving_layer(x, lp, cache_l, pos, cfg, *, kv_slice=None,
 
 
 def _serving_forward(params, tok, pos, caches, cfg, *, kv_slice=None,
-                     tp_psum=False, use_kernel=False):
+                     tp_psum=False, use_kernel=False, paged=None):
     """(tok (S,), pos (S,), caches) -> (logits (S, V), caches)."""
     x = params["emb"][tok[:, None]]  # (S, 1, d)
     new = []
     for lp, cl in zip(params["layers"], caches):
         x, cl = _serving_layer(x, lp, cl, pos, cfg, kv_slice=kv_slice,
-                               tp_psum=tp_psum, use_kernel=use_kernel)
+                               tp_psum=tp_psum, use_kernel=use_kernel,
+                               paged=paged)
         new.append(cl)
     x = _ln(x, params["lnf_s"], params["lnf_b"])
     logits = jnp.einsum("bld,vd->blv", x, params["emb"])
@@ -285,7 +414,8 @@ def _pick_rows(lg, pos, keys, temperature, top_k, dtype):
 
 def _scan_body(params, tok, pos, done, caches, cfg, eos_id, n_inner,
                keys, *, temperature=0.0, top_k=None,
-               kv_slice=None, tp_psum=False, use_kernel=False):
+               kv_slice=None, tp_psum=False, use_kernel=False,
+               paged=None):
     """``n_inner`` decode steps for all S slots under one scan (greedy,
     or per-row keyed sampling when ``temperature > 0``; ``keys`` is
     required — a silent shared-default key would couple every
@@ -296,7 +426,7 @@ def _scan_body(params, tok, pos, done, caches, cfg, eos_id, n_inner,
         tok, pos, done, caches = carry
         lg, caches = _serving_forward(
             params, tok, pos, caches, cfg, kv_slice=kv_slice,
-            tp_psum=tp_psum, use_kernel=use_kernel,
+            tp_psum=tp_psum, use_kernel=use_kernel, paged=paged,
         )
         nxt = _pick_rows(lg, pos, keys, temperature, top_k, tok.dtype)
         nxt, done = _eos_clamp(nxt, tok, done, eos_id)
@@ -324,6 +454,143 @@ def _serving_scan_dense(cfg: TransformerConfig, n_inner: int,
         return _scan_body(params, tok, pos, done, caches, cfg, eos_id,
                           n_inner, keys, temperature=temperature,
                           top_k=top_k, use_kernel=use_kernel)
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _serving_scan_paged(cfg: TransformerConfig, n_inner: int,
+                        eos_id: int | None, temperature: float,
+                        top_k: int | None, use_kernel: bool, P: int):
+    """Jitted PAGED tick: like :func:`_serving_scan_dense` plus the
+    ``(S, max_pages)`` int32 page table (a loop-invariant input — the
+    tick writes pages, never the table; COW retargeting happens
+    host-side between ticks). The page pool is donated like the ring
+    arena; ``W = max_pages * P`` is recovered from the table shape so
+    one compiled program serves any pool size at a given (cfg, P).
+
+    ``use_kernel=True`` (the int8 route) reads pages IN PLACE every
+    step — the Pallas page-table mode's whole point. The einsum
+    fallback instead hoists the indirection OUT of the scan: the table
+    is tick-invariant, so each layer's W-row ring view gathers ONCE,
+    the unchanged dense ring scan runs on the views (the paged einsum
+    tick IS the slot-ring tick on a gathered arena — parity by
+    construction), and one scatter writes the views back through the
+    table. A per-step gather measured 0.66x the slot tick on the bench
+    box (XLA re-materializes the view every step inside the scan);
+    hoisted, the gather amortizes over ``n_inner`` steps and the tick
+    lands within the <= 5% budget. The trade is a transient
+    ``(S, W)``-row view per layer during the tick — active-slot bytes,
+    not pool bytes; the kernel route has no such transient (docs/
+    PERF.md byte model)."""
+
+    @functools.partial(jax.jit, donate_argnums=(4,))
+    def run(params, tok, pos, done, caches, keys, pt):
+        W = pt.shape[1] * P
+        if use_kernel:
+            return _scan_body(
+                params, tok, pos, done, caches, cfg, eos_id, n_inner,
+                keys, temperature=temperature, top_k=top_k,
+                use_kernel=True, paged=(pt, W, P),
+            )
+        views = [_paged_gather(cl, pt, W, P) for cl in caches]
+        tok, pos, done, views, toks = _scan_body(
+            params, tok, pos, done, views, cfg, eos_id, n_inner, keys,
+            temperature=temperature, top_k=top_k, use_kernel=False,
+        )
+        caches = [
+            _paged_scatter(cl, vw, pt, P)
+            for cl, vw in zip(caches, views)
+        ]
+        return tok, pos, done, caches, toks
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _seed_admit_paged(cfg: TransformerConfig, R: int, P: int):
+    """Seed rows ``[0, ell)`` of a transient positional prefill cache
+    from shared prefix pages: ring slot s of a within-window prefix
+    holds position s, so the page rows ARE the positional rows and
+    admission can skip recomputing them. ``R`` (static) bounds the
+    gather at ``min(W, Lmax)``; rows at and past ``ell`` stay zero —
+    exactly the arena :func:`_fresh_cache` hands to prefill. The
+    seeded bytes are the pages' bytes, which are the bytes this very
+    prefill would have produced (pinned by the paged parity tests), so
+    the oracle identity survives the skip. Cache donated; the page
+    pool is only read."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run(cache, pages, pt_row, ell):
+        s = jnp.arange(R)
+        phys = pt_row[s // P] * P + s % P
+        valid = s < ell
+
+        def seed(c, pg):
+            g = jnp.take(pg, phys, axis=0)  # (R, ...)
+            g = jnp.where(
+                valid.reshape((R,) + (1,) * (g.ndim - 1)), g, 0
+            )
+            return jax.lax.dynamic_update_slice_in_dim(
+                c, g[None].astype(c.dtype), 0, axis=1
+            )
+
+        return [
+            {kk: seed(cl[kk], pl[kk]) for kk in cl}
+            for cl, pl in zip(cache, pages)
+        ]
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _place_paged(cfg: TransformerConfig, P: int):
+    """Paged install: scatter the admitted request's W ring rows into
+    its pages and set the row state — :func:`_place_dense` with the
+    cache row write routed through the page table. Shared prefix rows
+    write bytes IDENTICAL to what the pages already hold (the seed op
+    put those very bytes into the transient cache), so the
+    unconditional scatter never perturbs a sharer; rows past the
+    request's page budget land in the null page."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 2, 3, 4))
+    def run(caches, ring, tok, pos, done, keys, pt_row, s, tok0, pos0,
+            key):
+        W = ring[0]["k"].shape[1]
+        srows = jnp.arange(W)
+        phys = pt_row[srows // P] * P + srows % P
+        caches = [
+            {kk: c[kk].at[phys].set(r[kk][0].astype(c[kk].dtype))
+             for kk in c}
+            for c, r in zip(caches, ring)
+        ]
+        return (caches, tok.at[s].set(tok0), pos.at[s].set(pos0),
+                done.at[s].set(False), keys.at[s].set(key))
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _copy_pages_paged(cfg: TransformerConfig, P: int):
+    """BATCHED COW page copies across every layer and leaf: all of a
+    tick's ``src -> dst`` pairs in ONE jitted call (one dispatch on
+    the tick's critical path however many sharers diverge at once,
+    review r11), donated so the pool updates in place. Every src block
+    is gathered BEFORE any dst block writes, so a page appearing as
+    src twice (three-way sharing, two writers in one tick) reads its
+    pre-copy bytes both times; dst pages are freshly allocated and
+    never coincide with a src. The scheduler pads the pair lists to a
+    power-of-two length with null-page self-copies (page 0 -> page 0,
+    bytes nothing reads unmasked) to bound compile count."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run(caches, src, dst):
+        def cp(a):
+            paged = a.reshape((a.shape[0] // P, P) + a.shape[1:])
+            blk = jnp.take(paged, src, axis=0)
+            return paged.at[dst].set(blk).reshape(a.shape)
+
+        return [{kk: cp(cl[kk]) for kk in cl} for cl in caches]
 
     return run
 
@@ -491,6 +758,9 @@ class _ServingObs:
         # serving_tokens_total, so the per-tick rate and the running
         # counter always cross-check)
         self._tick_toks = 0
+        # last published page-pool tallies (delta counters)
+        self._last_share = 0
+        self._last_cow = 0
         self._r = registry is not None
         if not self._r:
             return
@@ -546,6 +816,29 @@ class _ServingObs:
             help="decode ticks by resolved int8-kernel route",
             route="kernel" if sched.use_kernel else "einsum",
         )
+        # page-pool series (paged schedulers only): pool occupancy
+        # gauges plus prefix-share / COW counters published as deltas
+        # of the pool's lifetime tallies, so the registry stays
+        # monotone however often the pool is sampled
+        if sched.paged:
+            self.m_pages_free = registry.gauge(
+                "serving_cache_pages_free",
+                help="KV cache pages on the free list",
+            )
+            self.m_pages_used = registry.gauge(
+                "serving_cache_pages_used",
+                help="KV cache pages allocated to slots",
+            )
+            self.m_share = registry.counter(
+                "serving_prefix_share_hits_total",
+                help="prompt prefix pages shared at admission (each "
+                "skipped that page's prefill and residency)",
+            )
+            self.m_cow = registry.counter(
+                "serving_cow_copies_total",
+                help="copy-on-write page copies (a slot wrote a page "
+                "another slot still reads)",
+            )
 
     # -- hooks (each guards its own registry half) ----------------------
     def first_token(self, req: "Request", t: float) -> None:
@@ -589,6 +882,14 @@ class _ServingObs:
                 self.m_route.inc()
             for req in retired:
                 self.m_retired[req.reason].inc()
+            if sched.paged:
+                pool = sched.pool
+                self.m_pages_free.set(pool.free)
+                self.m_pages_used.set(pool.used)
+                self.m_share.inc(pool.share_hits - self._last_share)
+                self._last_share = pool.share_hits
+                self.m_cow.inc(pool.cow_copies - self._last_cow)
+                self._last_cow = pool.cow_copies
         sp = self.spans
         if sp is not None:
             tick = sched.tick_count
@@ -603,6 +904,8 @@ class _ServingObs:
                 sp.add("retire", t2, t3 - t2, track="scheduler")
             sp.count("queue_depth", sched.pending, t=t3)
             sp.count("active_slots", sched.active, t=t3)
+            if sched.paged:
+                sp.count("pages_used", sched.pool.used, t=t3)
 
 
 # --------------------------------------------------------------------------
@@ -648,15 +951,29 @@ class Request:
 
 class _Admitting:
     """Per-slot chunked-prefill state machine: the transient positional
-    cache plus the chunk cursor."""
+    cache plus the chunk cursor. Paged admissions additionally carry
+    the page plan: ``base`` (tokens of shared prefix whose prefill is
+    SKIPPED — chunk i runs at offset ``base + i*C``), ``pids`` (the
+    slot's full page table, installed into the device table only at
+    finish — until then the row's stale writes land in the null page),
+    ``digests``/``n_cover`` (prefix digests to register at finish) and
+    ``wraps`` (whether this request can wrap its ring — registered
+    pages are then volatile)."""
 
-    def __init__(self, req: Request, cache, padded, n_chunks: int):
+    def __init__(self, req: Request, cache, padded, n_chunks: int, *,
+                 base: int = 0, pids=None, digests=(), n_cover: int = 0,
+                 wraps: bool = False):
         self.req = req
         self.cache = cache
         self.padded = padded  # (1, n_chunks * C) int32
         self.n_chunks = n_chunks
         self.next_chunk = 0
         self.last_logits = None
+        self.base = base
+        self.pids = pids
+        self.digests = digests
+        self.n_cover = n_cover
+        self.wraps = wraps
 
 
 class ServingScheduler:
@@ -684,6 +1001,39 @@ class ServingScheduler:
     into in-flight requests (one chunk per tick); ``max_prompt`` sizes
     the transient prefill arena (one compile for all prompt lengths).
 
+    ``page_tokens=P`` switches the cache from per-slot rings to the
+    PAGED pool (docs/API.md "Paged serving cache"): per-layer K/V live
+    in ``cache_pages`` fixed-size pages of P ring slots managed by a
+    host-side :class:`PagePool` (free list + refcounts), each slot
+    reading through a ``(max_pages,)`` page-index row. Three wins over
+    the slot ring, same token streams (the oracle identity holds
+    verbatim — the paged parity tests pin it):
+
+    * **Right-sized residency.** A request holds only the pages its
+      lifetime can touch (``ceil(min(W, Tp + max_new + n_inner) / P)``)
+      instead of a full ``W``-slot arena — short requests stop
+      stranding HBM, and ``cache_pages`` (not ``slots``) becomes the
+      capacity knob. Admission defers (FIFO) when the pool cannot
+      cover a request's whole budget, so mid-decode exhaustion cannot
+      happen.
+    * **Prefix sharing.** Admission hashes the prompt's page-aligned
+      prefix (chained digests — page j's key covers ``prompt[:(j+1) *
+      P]``, the exact content determinant) and shares resident pages
+      by refcount, SKIPPING their prefill entirely: N users on one
+      system prompt pay its prefill and residency once while any
+      sharer is resident.
+    * **Copy-on-write.** Writers never touch a shared page: the
+      pre-tick pass copies any page the next ``n_inner`` steps would
+      write while its refcount > 1 (reserved at admission for
+      window-wrapping requests), so a reader's bytes are immutable.
+
+    The decode tick reads K/V through the page table: the einsum path
+    gathers each slot's W-row ring view (``jnp.take`` — identical math
+    to the slot ring, the CPU-testable fallback); int8 caches route
+    the Pallas kernel's page-table mode, where the per-slot page row
+    rides scalar-prefetch SMEM and block index maps gather pages
+    directly (no materialized ring view at all).
+
     Observability is strictly opt-in (the tracer contract): pass
     ``registry=`` (an :class:`~..obs.MetricsRegistry`) for tick/queue/
     slot/tokens-per-s series, TTFT and inter-token histograms, and
@@ -703,8 +1053,9 @@ class ServingScheduler:
                  n_inner: int = 8, eos_id: int | None = None,
                  prompt_chunk: int = 256, max_prompt: int = 2048,
                  quantize_kv: bool = False, temperature: float = 0.0,
-                 top_k: int | None = None, registry=None, spans=None,
-                 flight=None, exporter=None):
+                 top_k: int | None = None, page_tokens: int | None = None,
+                 cache_pages: int | None = None, registry=None,
+                 spans=None, flight=None, exporter=None):
         W = _check_ring_cfg(cfg)
         _check_sampling_params(temperature, top_k)
         if cfg.n_experts:
@@ -716,6 +1067,20 @@ class ServingScheduler:
             raise ValueError("slots and n_inner must be >= 1")
         if prompt_chunk > max_prompt:
             raise ValueError("prompt_chunk must be <= max_prompt")
+        self.paged = page_tokens is not None
+        if self.paged:
+            self.P = int(page_tokens)
+            if self.P < 1 or W % self.P != 0:
+                raise ValueError(
+                    f"page_tokens must divide the attention window "
+                    f"(W={W}), got {page_tokens}"
+                )
+            self.max_pages = W // self.P
+        elif cache_pages is not None:
+            raise ValueError(
+                "cache_pages without page_tokens: pass page_tokens to "
+                "enable the paged cache"
+            )
         self.params = params
         self.cfg = cfg
         self.S = int(slots)
@@ -736,19 +1101,68 @@ class ServingScheduler:
         self._pos = jnp.zeros((self.S,), jnp.int32)
         self._done = jnp.ones((self.S,), bool)  # idle rows stay done
         self._keys = jax.random.split(jax.random.key(0), self.S)
-        self._caches = _fresh_cache(cfg, self.S, W, self.quantize_kv)
+        if self.paged:
+            # page-pool arena: the capacity knob is cache_pages, not
+            # slots x W. The default matches the slot-ring footprint
+            # (every slot could hold a full window) plus the null page
+            # — opting into paging never means LESS capacity.
+            n_pages = (
+                int(cache_pages) if cache_pages is not None
+                else self.S * self.max_pages + 1
+            )
+            if n_pages < self.max_pages + 1:
+                raise ValueError(
+                    f"cache_pages {n_pages} cannot hold even one "
+                    f"window-filling request ({self.max_pages} pages "
+                    "+ the null page)"
+                )
+            self.pool = PagePool(n_pages, self.P)
+            self._caches = _fresh_pages(cfg, n_pages, self.P,
+                                        self.quantize_kv)
+            # host-authoritative page table; the device copy refreshes
+            # lazily whenever admission/COW/retirement dirties it
+            self._pt_host = np.full((self.S, self.max_pages),
+                                    NULL_PAGE, np.int32)
+            self._pt_dev = None
+            # per-slot global position mirror (the COW pass must know
+            # which ring pages the NEXT tick will write, host-side)
+            self._host_pos = [0] * self.S
+            # per-slot wrap flag: whether the resident request's
+            # lifetime can wrap the ring — its departure must drop the
+            # wrapper count on every page it holds (paging.py)
+            self._slot_wraps = [False] * self.S
+        else:
+            self.pool = None
+            self._caches = _fresh_cache(cfg, self.S, W, self.quantize_kv)
         # int8 Pallas kernel routing, resolved at construction against
         # THIS scheduler's slot count (decode.py's auto gate: the tick
         # batches all S slots into one kernel call per layer, which is
-        # what amortizes the scan boundary cost the B=1 path cannot)
-        self.use_kernel = (
-            _kernel_possible(cfg, self.quantize_kv)
-            and _route_kernel(_UNSET, self.S)
-        )
-        self._scan = _serving_scan_dense(
-            cfg, self.n_inner, eos_id, self.temperature, top_k,
-            self.use_kernel,
-        )
+        # what amortizes the scan boundary cost the B=1 path cannot).
+        # The paged tick adds the page-geometry conditions
+        # (_paged_kernel_possible) — all cfg-static, so the resolution
+        # stays a construction-time decision either way.
+        if self.paged:
+            self.use_kernel = (
+                _paged_kernel_possible(cfg, self.quantize_kv, self.P)
+                and _route_kernel(_UNSET, self.S)
+            )
+            self._scan = _serving_scan_paged(
+                cfg, self.n_inner, eos_id, self.temperature, top_k,
+                self.use_kernel, self.P,
+            )
+            self._seed = _seed_admit_paged(cfg, min(W, self.Lmax),
+                                           self.P)
+            self._place_p = _place_paged(cfg, self.P)
+            self._copy = _copy_pages_paged(cfg, self.P)
+        else:
+            self.use_kernel = (
+                _kernel_possible(cfg, self.quantize_kv)
+                and _route_kernel(_UNSET, self.S)
+            )
+            self._scan = _serving_scan_dense(
+                cfg, self.n_inner, eos_id, self.temperature, top_k,
+                self.use_kernel,
+            )
         self._extend = _extend_chunk_dense(cfg, self.C, self.Lmax)
         self._finish = _finish_admit_dense(
             cfg, self.Lmax, self.temperature, top_k
@@ -831,10 +1245,23 @@ class ServingScheduler:
 
     def _decode_scan_fetch(self) -> np.ndarray:
         """Run the jitted decode tick and fence the tokens to host."""
-        (self._tok, self._pos, self._done, self._caches,
-         toks) = self._scan(self.params, self._tok, self._pos,
-                            self._done, self._caches, self._keys)
+        if self.paged:
+            (self._tok, self._pos, self._done, self._caches,
+             toks) = self._scan(self.params, self._tok, self._pos,
+                                self._done, self._caches, self._keys,
+                                self._device_pt())
+        else:
+            (self._tok, self._pos, self._done, self._caches,
+             toks) = self._scan(self.params, self._tok, self._pos,
+                                self._done, self._caches, self._keys)
         return np.asarray(toks)  # (S, n_inner) one fetch per tick
+
+    def _device_pt(self):
+        """The device page table, refreshed from the host-authoritative
+        copy when admission/COW/retirement dirtied it."""
+        if self._pt_dev is None:
+            self._pt_dev = jnp.asarray(self._pt_host)
+        return self._pt_dev
 
     def step(self) -> list[Request]:
         """One scheduler tick; returns the requests retired in it
@@ -858,6 +1285,11 @@ class ServingScheduler:
             if r is not None and s not in self._admitting
         ]
         if decoding:
+            if self.paged:
+                # COW pass: every page the next n_inner writes touch
+                # must be exclusively owned BEFORE the jitted scan runs
+                # (the device program never sees shared pages)
+                self._prepare_tick_pages(decoding)
             if obs is None:
                 host = self._decode_scan_fetch()
             else:
@@ -866,6 +1298,9 @@ class ServingScheduler:
                 with obs.annotate("serving.decode_scan"):
                     host = self._decode_scan_fetch()
                 t2 = time.perf_counter()
+            if self.paged:
+                for s in decoding:
+                    self._host_pos[s] += self.n_inner
             for s in decoding:
                 req = self._slot_req[s]
                 n_before = len(req.tokens) if obs is not None else 0
@@ -916,22 +1351,165 @@ class ServingScheduler:
     def _admit_from_queue(self, retired: list[Request]) -> None:
         free = [s for s, r in enumerate(self._slot_req) if r is None]
         while self._queue and free:
+            if self.paged:
+                plan = self._plan_pages(self._queue[0])
+                if plan is None:
+                    # head-of-line request does not fit the page
+                    # budget: admission waits for retirements to
+                    # return pages (FIFO — no reordering, so a large
+                    # request cannot be starved by later small ones)
+                    break
             s = free.pop(0)
             req = self._queue.popleft()
             Tp = req.prompt.size
-            n_chunks = -(-Tp // self.C)
+            base = 0
+            admit_kw: dict[str, Any] = {}
+            if self.paged:
+                base, admit_kw = self._commit_pages(req, plan)
+            rem = Tp - base
+            n_chunks = -(-rem // self.C)
             padded = np.zeros((1, n_chunks * self.C), np.int32)
-            padded[0, :Tp] = req.prompt
+            padded[0, :rem] = req.prompt[base:]
             cache = _fresh_cache(self.cfg, 1, self.Lmax,
                                  self.quantize_kv)
+            if base:
+                # skip the shared prefix's prefill outright: its K/V
+                # seed the transient cache from the resident pages
+                # (identical bytes to what this prefill would compute)
+                cache = self._seed(
+                    cache, self._caches,
+                    jnp.asarray(admit_kw["pids"], jnp.int32),
+                    jnp.int32(base),
+                )
             self._slot_req[s] = req
             self._admitting[s] = _Admitting(
-                req, cache, jnp.asarray(padded), n_chunks
+                req, cache, jnp.asarray(padded), n_chunks, base=base,
+                **admit_kw,
             )
             req.admitted_tick = self.tick_count
             # first chunk runs this very tick (short prompts admit in
             # one tick and decode from the next)
             self._advance_admission(s, retired)
+
+    # -- paged admission planning --------------------------------------
+
+    def _plan_pages(self, req: Request):
+        """Page budget for ``req``: which resident prefix pages it can
+        share, how many fresh pages it needs, and how many COW
+        reservations the shares must attach (one per share that can
+        ever end in a write — the sharer wraps its ring, or the page's
+        owner does). Returns None when the pool cannot cover the plan
+        — the caller leaves the request queued.
+
+        The budget is the request's whole lifetime upper bound: ring
+        slots ``[0, min(W, Tp + max_new + n_inner))`` — prefill plus
+        every decode write including the bounded overshoot of the
+        retirement tick — so :class:`PagePoolExhausted` is unreachable
+        mid-decode (the capacity contract the fuzz tests pin)."""
+        Tp = req.prompt.size
+        W, P = self.W, self.P
+        digests: list[bytes] = []
+        shared: list[int] = []
+        if Tp <= W:
+            # within-window prompts: ring slot s == position s, so the
+            # page content is determined by the page-aligned prefix —
+            # the shareable case. (A wrapped prompt's pages hold late
+            # positions; they are neither shared nor registered.)
+            digests = prefix_page_digests(req.prompt, P, self.max_pages)
+            # cap: at least the prompt's last token must prefill (the
+            # first sampled token needs its logits)
+            for d in digests[: (Tp - 1) // P]:
+                pid = self.pool.lookup(d)
+                if pid is None:
+                    break
+                shared.append(pid)
+        m = len(shared)
+        horizon = Tp + req.max_new + self.n_inner
+        wraps = horizon > W
+        n_pages = -(-min(W, horizon) // P)
+        n_fresh = n_pages - m
+        reserve = sum(
+            1 for pid in shared
+            if self.pool.share_needs_reserve(pid, wraps)
+        )
+        if not self.pool.can_alloc(n_fresh, reserve=reserve):
+            return None
+        return (shared, digests, n_pages, wraps)
+
+    def _commit_pages(self, req: Request, plan) -> tuple[int, dict]:
+        """Execute an admission plan: take references on the shared
+        pages (attaching their COW reservations) and allocate the
+        fresh tail. Returns (base, _Admitting kwargs)."""
+        shared, digests, n_pages, wraps = plan
+        m = len(shared)
+        pids = [NULL_PAGE] * self.max_pages
+        for j, pid in enumerate(shared):
+            self.pool.share(
+                pid, reserve=self.pool.share_needs_reserve(pid, wraps),
+                wrapper=wraps,
+            )
+            pids[j] = pid
+        for j in range(m, n_pages):
+            pids[j] = self.pool.alloc()
+        # pages fully covered by the prompt hold registerable prefix
+        # content once prefill lands them (done at finish)
+        n_cover = min(req.prompt.size // self.P, self.max_pages) \
+            if req.prompt.size <= self.W else 0
+        return m * self.P, {
+            "pids": pids, "digests": tuple(digests),
+            "n_cover": n_cover, "wraps": wraps,
+        }
+
+    def _prepare_tick_pages(self, decoding: list[int]) -> None:
+        """Pre-tick COW pass: the next ``n_inner`` decode steps write
+        ring slots ``[pos, pos + n_inner)`` (mod W) of every decoding
+        row. Any touched page still shared (refcount > 1) is copied to
+        a fresh page, consuming the reservation attached to the shared
+        page at admission (``PagePool.cow_alloc``); a touched page
+        this slot owns outright but once REGISTERED as a prefix drops
+        out of the share table (its bytes are about to change). After
+        this pass the device scan only ever writes exclusively-owned
+        pages — COW is invisible to the compiled program."""
+        copies: list[tuple[int, int]] = []
+        for s in decoding:
+            pos = self._host_pos[s]
+            touched = {
+                ((pos + t) % self.W) // self.P
+                for t in range(self.n_inner)
+            }
+            for j in sorted(touched):
+                pid = int(self._pt_host[s, j])
+                if pid == NULL_PAGE:
+                    # defensive: the admission budget allocates every
+                    # touchable page eagerly, so this is unreachable
+                    # unless the budget math regressed
+                    raise PagePoolExhausted(
+                        f"slot {s} page {j} unallocated at write time "
+                        "(admission budget bug)"
+                    )
+                if self.pool.refcount(pid) > 1:
+                    new = self.pool.cow_alloc(pid)
+                    copies.append((pid, new))
+                    # the writer leaves the shared page for its copy;
+                    # only wrapping slots ever write shared pages, so
+                    # the page's wrapper count drops with it
+                    self.pool.decref(pid,
+                                     wrapper=self._slot_wraps[s])
+                    self._pt_host[s, j] = new
+                    self._pt_dev = None
+                else:
+                    self.pool.note_write(pid)
+        if copies:
+            # one device call for the whole tick's copies; pad to a
+            # power of two with null-page self-copies so the jitted
+            # program compiles O(log) distinct shapes, not one per
+            # divergence count
+            n = 1 << (len(copies) - 1).bit_length()
+            copies += [(NULL_PAGE, NULL_PAGE)] * (n - len(copies))
+            src, dst = (np.asarray(c, np.int32) for c in zip(*copies))
+            self._caches = self._copy(
+                self._caches, jnp.asarray(src), jnp.asarray(dst)
+            )
 
     def _advance_admissions(self, retired: list[Request]) -> None:
         for s in list(self._admitting):
@@ -945,7 +1523,7 @@ class ServingScheduler:
             st.padded, i * self.C, self.C, axis=1
         )
         st.last_logits, st.cache = self._extend(
-            self.params, chunk, st.cache, jnp.int32(i * self.C)
+            self.params, chunk, st.cache, jnp.int32(st.base + i * self.C)
         )
         st.next_chunk += 1
         if self._obs is not None:
@@ -957,13 +1535,35 @@ class ServingScheduler:
                 else jax.random.key(st.req.id + 1))
         tok0, ring = self._finish(
             st.cache, st.last_logits, jnp.int32(Tp),
-            jnp.int32((st.n_chunks - 1) * self.C), rkey,
+            jnp.int32(st.base + (st.n_chunks - 1) * self.C), rkey,
         )
-        (self._caches, self._tok, self._pos, self._done,
-         self._keys) = self._place(
-            self._caches, ring, self._tok, self._pos, self._done,
-            self._keys, jnp.int32(s), tok0, jnp.int32(Tp), rkey,
-        )
+        if self.paged:
+            # install the page table NOW (stale row writes landed in
+            # the null page until this point), then scatter the ring
+            # window into the pages and flip the row live
+            self._pt_host[s] = st.pids
+            self._pt_dev = None
+            self._host_pos[s] = Tp
+            self._slot_wraps[s] = st.wraps
+            (self._caches, self._tok, self._pos, self._done,
+             self._keys) = self._place_p(
+                self._caches, ring, self._tok, self._pos, self._done,
+                self._keys, jnp.asarray(self._pt_host[s]),
+                jnp.int32(s), tok0, jnp.int32(Tp), rkey,
+            )
+            # the prompt-covered pages now hold exactly the content
+            # their chained prefix digests describe — publish them for
+            # future admissions to share (first-wins; the shared ones
+            # are already registered)
+            for j in range(st.n_cover):
+                self.pool.register(st.digests[j], st.pids[j],
+                                   volatile=st.wraps)
+        else:
+            (self._caches, self._tok, self._pos, self._done,
+             self._keys) = self._place(
+                self._caches, ring, self._tok, self._pos, self._done,
+                self._keys, jnp.int32(s), tok0, jnp.int32(Tp), rkey,
+            )
         st.req.tokens.append(int(tok0))
         if self._obs is not None:
             self._obs.first_token(st.req, time.perf_counter())
@@ -1007,3 +1607,16 @@ class ServingScheduler:
         # the row keeps decoding garbage until reused — done=True makes
         # it emit EOS-clamped tokens nobody reads; admission resets it
         self._done = self._done.at[s].set(True)
+        if self.paged:
+            # return the slot's pages (shared prefixes just drop one
+            # reference; a page frees — and leaves the prefix table —
+            # only when its last reader retires) and null the row so
+            # its zombie writes land in the null page
+            for pid in self._pt_host[s]:
+                if pid != NULL_PAGE:
+                    self.pool.decref(int(pid),
+                                     wrapper=self._slot_wraps[s])
+            self._pt_host[s] = NULL_PAGE
+            self._pt_dev = None
+            self._host_pos[s] = 0
+            self._slot_wraps[s] = False

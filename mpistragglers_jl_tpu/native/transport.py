@@ -59,8 +59,12 @@ class Message:
     kind: int
     payload: "bytes | bytearray"
     # out-of-band body (shared-memory broadcasts): the codec prefix is in
-    # ``payload`` and the bytes live in a mapped region. Valid until 4
-    # newer shm payloads arrive — copy if retaining longer.
+    # ``payload`` and the bytes live in a mapped region. Holding the
+    # view PINS the region: keep-window eviction defers until the view
+    # is released (mmap.close() raises BufferError while buffers are
+    # exported — Worker._evict_shm catches it and retries on a later
+    # resolve), so the view never dangles; it just keeps the mapping
+    # resident. Release or copy when done to let the window shrink.
     body: "memoryview | None" = None
 
 
@@ -471,19 +475,30 @@ class Worker:
             finally:
                 _os.close(fd)  # mmap holds its own reference
             self._shm_regions[sid] = region
-            # bounded: evict oldest fully-released regions. A region
-            # whose views are still referenced refuses to close and is
-            # retained — payload views can never dangle.
-            extra = len(self._shm_regions) - self._shm_keep
-            if extra > 0:
-                for old_sid in list(self._shm_regions)[:extra]:
-                    old = self._shm_regions[old_sid]
-                    try:
-                        old.close()
-                    except BufferError:
-                        continue  # views alive; keep the mapping
-                    del self._shm_regions[old_sid]
+            self._evict_shm()
         return memoryview(region)[:blen]
+
+    def _evict_shm(self) -> None:
+        """Bound the region dict to the keep window, oldest first. A
+        region whose views are still referenced raises ``BufferError``
+        from ``mmap.close()`` and is RETAINED — payload views can never
+        dangle; eviction of a pinned region simply defers to a later
+        resolve (every new region triggers another sweep, so the dict
+        shrinks back to the window as soon as the views are released).
+        Pinned regions do not shield newer closable ones: the sweep
+        walks every over-window candidate, not just the first."""
+        excess = len(self._shm_regions) - self._shm_keep
+        if excess <= 0:
+            return
+        # the newest `keep` regions stay regardless; everything older
+        # is a candidate, evicted unless a live view pins it
+        for old_sid in list(self._shm_regions)[:excess]:
+            old = self._shm_regions[old_sid]
+            try:
+                old.close()
+            except BufferError:
+                continue  # views alive; keep the mapping, retry later
+            del self._shm_regions[old_sid]
 
     def recv(self) -> Message | None:
         """Block for the next frame; None means the coordinator is gone."""

@@ -41,6 +41,17 @@ kv heads (static row/lane slices, one MXU dot per head group):
   slot s holds ``kpos(s) = pos - ((pos - s) mod W)``, valid iff
   ``kpos >= 0`` — which reduces to ``s <= pos or pos >= W``, the same
   one-predicate mask models/decode.py's ring reads use).
+* the ring cache additionally supports a PAGED layout
+  (``page_table=``): K/V live in a pool of ``(page_tokens,
+  Hkv*D)``-row pages shared by every serving slot, and each row's
+  ``(max_pages,)`` int32 page-index vector rides scalar-prefetch SMEM
+  so the BLOCK INDEX MAP itself dereferences the page table — block
+  ``(b, j)`` DMAs page ``page_table[b, j]`` straight out of the pool.
+  The k-block size becomes ``page_tokens`` and the math is otherwise
+  the identical ring-mode online softmax (``W = max_pages *
+  page_tokens``), so the paged serving tick and the dense gather
+  fallback (models/serving.py ``_paged_gather`` + the einsum rows)
+  stay numerically interchangeable.
 
 Inference-only: no VJP (the cache is never differentiated through).
 Interpret mode on non-TPU backends keeps the path testable on the CI
@@ -62,7 +73,7 @@ _NEG = -1e30
 _LANE = 128
 _SUB = 8  # TPU sublane tile: each GQA group pads to this many q rows
 
-__all__ = ["quantized_decode_attention"]
+__all__ = ["quantized_decode_attention", "paged_block_viable"]
 
 
 # Scoped-VMEM budget per (block row x kv head), CALIBRATED on the
@@ -75,6 +86,30 @@ _VMEM_CAP = 12 * 2 ** 20
 # default k-block budget; the models/decode.py routing gate imports
 # THIS constant so the two call sites cannot drift
 DEFAULT_BLOCK_K = 8192
+
+
+def paged_block_viable(page_tokens: int) -> bool:
+    """Could the kernel stream ``page_tokens``-row k-blocks? Pages ride
+    the sublane axis of the ``(1, page_tokens, Hkv*D)`` block, so a
+    compiled TPU kernel needs the int8 sublane tile (32 rows); the
+    interpreter has no tiling and accepts any 8-row multiple (the CI
+    parity surface — PAGE_TOKENS=16 tests run interpreted). The
+    routing gates in models/serving.py consult THIS predicate so the
+    call sites cannot drift from the kernel's real constraint."""
+    P = int(page_tokens)
+    if P < 8 or P % 8 != 0:
+        return False
+    return _use_interpret() or P % 32 == 0
+
+
+def _paged_kernel(pos_ref, pt_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                  o_ref, acc, m_sc, l_sc, **kw):
+    """Scalar-prefetch entry: the page table is consumed ENTIRELY by
+    the block index maps (it decides which page each (b, j) step DMAs);
+    the online-softmax body is the ring-mode ``_kernel`` unchanged."""
+    del pt_ref
+    _kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+            acc, m_sc, l_sc, **kw)
 
 
 def _pick_block_128(L: int, block: int, Hkv: int = 2,
@@ -169,6 +204,7 @@ def _kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
 def quantized_decode_attention(
     q, cache_l: dict, pos, scale, window=None, *, ring: bool = False,
     block_k: int = DEFAULT_BLOCK_K, interpret: bool | None = None,
+    page_table=None, page_tokens: int | None = None,
 ):
     """Single-query grouped attention against an int8 cache layer.
 
@@ -186,6 +222,17 @@ def quantized_decode_attention(
     ``_ring_attention_rows``, so the batched serving tick and the ring
     generate scan route the exact same kernel. ``window`` must be None
     in ring mode — the ring IS the window.
+
+    ``page_table=`` (ring mode only) reads the PAGED ring layout:
+    ``cache_l`` leaves are page pools — {"k","v"} int8 ``(n_pages *
+    page_tokens, Hkv, D)`` + scales ``(n_pages * page_tokens, Hkv)``
+    shared by all rows — and ``page_table`` is the ``(B, max_pages)``
+    int32 table mapping row b's ring page j to its pool page. The
+    table rides scalar-prefetch SMEM and is dereferenced by the block
+    index maps, so each (b, j) grid step DMAs exactly the page the
+    table names — the HBM traffic of a decode step is the W live rows,
+    never the pool (see module docstring). ``W = max_pages *
+    page_tokens`` and the validity mask is ring mode's unchanged.
     """
     if interpret is None:
         interpret = _use_interpret()
@@ -194,6 +241,14 @@ def quantized_decode_attention(
             "ring mode encodes the window in the cache layout; pass "
             "window=None (the ring length IS the window)"
         )
+    if page_table is not None:
+        if not ring:
+            raise ValueError("page_table is a ring-layout feature; "
+                             "pass ring=True")
+        if page_tokens is None:
+            raise ValueError("page_table needs page_tokens")
+        return _paged_call(q, cache_l, pos, scale, page_table,
+                           int(page_tokens), interpret)
     B, T, H, D = q.shape
     if T != 1:
         raise ValueError(f"decode kernel is single-query, got T={T}")
@@ -258,4 +313,85 @@ def quantized_decode_attention(
         interpret=interpret,
     )(posv, q3, kf, ks, vf, vs)
     # (B, Hkv*SUB, D) -> drop each group's padding rows -> (B, 1, H, D)
+    return o3.reshape(B, Hkv, _SUB, D)[:, :, :g].reshape(B, 1, H, D)
+
+
+def _paged_call(q, cache_l: dict, pos, scale, page_table, P: int,
+                interpret: bool):
+    """Paged-ring pallas_call: grid (B, max_pages), k-block = one page,
+    block index maps dereference the scalar-prefetched page table."""
+    B, T, H, D = q.shape
+    if T != 1:
+        raise ValueError(f"decode kernel is single-query, got T={T}")
+    kc, vc = cache_l["k"], cache_l["v"]
+    ks, vs = cache_l["k_s"], cache_l["v_s"]
+    Nphys, Hkv = kc.shape[0], kc.shape[1]
+    g = H // Hkv
+    if g > _SUB:
+        raise ValueError(
+            f"GQA group {g} exceeds the kernel's {_SUB}-row group tile"
+        )
+    if Nphys % P != 0:
+        raise ValueError(
+            f"page pool of {Nphys} rows is not a multiple of "
+            f"page_tokens {P}"
+        )
+    npages = Nphys // P
+    max_pages = page_table.shape[1]
+
+    q3 = q.reshape(B, Hkv, g, D)
+    if g < _SUB:
+        q3 = jnp.pad(q3, ((0, 0), (0, 0), (0, _SUB - g), (0, 0)))
+    q3 = q3.reshape(B, Hkv * _SUB, D)
+    rows = Hkv * _SUB
+    # pool leaves reshaped page-major — free (the trailing dims are
+    # contiguous), and each block below is one page's rows
+    kf = kc.reshape(npages, P, Hkv * D)
+    vf = vc.reshape(npages, P, Hkv * D)
+    ksr = ks.reshape(npages, P, Hkv)
+    vsr = vs.reshape(npages, P, Hkv)
+    posv = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32).reshape(-1), (B,)
+    )
+    ptv = page_table.astype(jnp.int32)
+
+    kern = functools.partial(
+        _paged_kernel, scale=scale, window=None, bk=P, nk=max_pages,
+        Hkv=Hkv, D=D, ring=True,
+    )
+
+    def _page(b, j, pos_ref, pt_ref):
+        del pos_ref
+        return (pt_ref[b, j], 0, 0)
+
+    def _row(b, j, pos_ref, pt_ref):
+        del pos_ref, pt_ref
+        return (b, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, rows, D), _row),
+            pl.BlockSpec((1, P, Hkv * D), _page),
+            pl.BlockSpec((1, P, Hkv), _page),
+            pl.BlockSpec((1, P, Hkv * D), _page),
+            pl.BlockSpec((1, P, Hkv), _page),
+        ],
+        out_specs=pl.BlockSpec((1, rows, D), _row),
+        scratch_shapes=[
+            pltpu.VMEM((rows, D), jnp.float32),
+            pltpu.VMEM((rows, _LANE), jnp.float32),
+            pltpu.VMEM((rows, _LANE), jnp.float32),
+        ],
+    )
+    o3 = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=_sds((B, rows, D), q.dtype, q),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(posv, ptv, q3, kf, ksr, vf, vsr)
     return o3.reshape(B, Hkv, _SUB, D)[:, :, :g].reshape(B, 1, H, D)

@@ -56,3 +56,14 @@ def scrape(payload, exporter=None, flight=None):
         exporter.add_health("pool", None)
     ok = flight is not None and flight.snapshot()
     return payload if ok else None
+
+
+def page_pool_tick(pool, registry=None):
+    """The paged-cache telemetry shape with the guard: occupancy
+    gauges and share/COW counters only touch the registry inside the
+    is-not-None arm (models/serving.py _ServingObs discipline)."""
+    if registry is not None:
+        registry.gauge("serving_cache_pages_free").set(pool)
+        registry.counter("serving_prefix_share_hits_total").inc()
+        registry.counter("serving_cow_copies_total").inc(0)
+    return pool

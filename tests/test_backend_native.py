@@ -154,6 +154,87 @@ def test_transport_large_payload():
         coord.close()
 
 
+def test_shm_region_pinned_by_live_view_survives_keep_window():
+    """Regression (ISSUE 6 satellite): a worker that HOLDS a shm
+    ``Message.body`` view across more than ``_shm_keep`` newer
+    broadcasts must keep that region mapped and readable — eviction
+    raises ``BufferError`` on the pinned mmap, defers to a later
+    resolve, and catches up the moment the view is released. Before
+    the keep-window hardening this was a use-after-unmap segfault
+    scenario; the region dict must also stay bounded (keep + pinned),
+    never growing with every broadcast."""
+    import threading
+
+    coord, path = _transport_pair(2)
+    payloads = [
+        np.full(1 << 20, i, np.uint8) for i in range(8)
+    ]  # >= 1 MiB each: the shm broadcast path
+    done = threading.Event()
+    state: dict = {}
+
+    def pinned_worker():
+        w = T.Worker(path, 0)
+        keep = w._shm_keep
+        first = w.recv()
+        assert first.body is not None, "broadcast did not ride shm"
+        pinned = first.body  # LIVE view held across every broadcast
+        for i in range(1, len(payloads)):
+            msg = w.recv()
+            assert msg.body is not None
+            assert bytes(msg.body[:4]) == bytes([i] * 4)
+            del msg
+        # the pinned region is still mapped and byte-correct
+        assert bytes(pinned[:4]) == b"\x00" * 4
+        assert bytes(pinned[-4:]) == b"\x00" * 4
+        # bounded: keep-window regions + the one pinned survivor
+        state["n_regions_pinned"] = len(w._shm_regions)
+        assert len(w._shm_regions) <= keep + 1
+        del pinned, first
+        # released: the next resolve sweeps the dict back to the window
+        w.recv()
+        state["n_regions_released"] = len(w._shm_regions)
+        assert len(w._shm_regions) <= keep
+        w.recv()  # control: done
+        w.close()
+        done.set()
+
+    def drain_worker():
+        # second rank only exists so the coordinator takes the shm
+        # broadcast path (n_workers >= 2); it drains and exits
+        w = T.Worker(path, 1)
+        while True:
+            msg = w.recv()
+            if msg is None or msg.kind == T.KIND_CONTROL:
+                break
+        w.close()
+
+    threads = [
+        threading.Thread(target=pinned_worker, daemon=True),
+        threading.Thread(target=drain_worker, daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    try:
+        coord.accept(timeout=10)
+        for i, body in enumerate(payloads):
+            pl = coord.payload(body)
+            assert isinstance(pl, T.ShmPayload), "memfd unavailable?"
+            for rank in range(2):
+                assert coord.isend_shared(rank, b"hdr", pl, seq=i)
+            pl.release()
+        extra = coord.payload(payloads[0])
+        assert coord.isend_shared(0, b"hdr", extra, seq=len(payloads))
+        extra.release()
+        for rank in range(2):
+            coord.isend(rank, b"", kind=T.KIND_CONTROL)
+        assert done.wait(timeout=30), "pinned worker did not finish"
+        for t in threads:
+            t.join(timeout=10)
+        assert state["n_regions_pinned"] > state["n_regions_released"]
+    finally:
+        coord.close()
+
+
 def test_transport_dead_peer_is_sticky():
     """A disconnected worker polls ready with a death marker forever —
     the anti-hang property the reference's Waitall! lacks (SURVEY §5).

@@ -52,7 +52,8 @@ def _keys(findings):
              ("GC003", 25), ("GC003", 30)],
         ),
         ("gc004_bad.py", [("GC004", 6), ("GC004", 12), ("GC004", 17),
-                          ("GC004", 22), ("GC004", 26)]),
+                          ("GC004", 22), ("GC004", 26),
+                          ("GC004", 33)]),
         (
             "gc005_bad.py",
             [("GC005", 17), ("GC005", 18), ("GC005", 21),
@@ -108,7 +109,8 @@ def test_baseline_roundtrip(tmp_path):
     res = _findings("gc004_bad.py", baseline_path=str(bl))
     assert _keys(res.baselined) == [("GC004", 6)]
     assert _keys(res.fresh) == [("GC004", 12), ("GC004", 17),
-                                ("GC004", 22), ("GC004", 26)]
+                                ("GC004", 22), ("GC004", 26),
+                                ("GC004", 33)]
     assert res.baseline_size == 1
 
 

@@ -1,0 +1,230 @@
+"""PagePool: the host-side allocator under the paged serving cache
+(models/paging.py).
+
+The scheduler-level parity suite (tests/test_serving_paged.py) pins
+that paged serving emits oracle-identical streams; THIS suite pins the
+allocator's own contracts under churn: no page leaks (free + used ==
+total across any admit/retire interleaving), refcounts return to
+baseline, registered prefixes never outlive their pages, and the COW
+reservation accounting makes mid-decode exhaustion unreachable no
+matter which holder of a shared page writes first. Pure host — no jax.
+"""
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu.models.paging import (
+    NULL_PAGE,
+    PagePool,
+    PagePoolExhausted,
+    prefix_page_digests,
+)
+
+
+def test_basic_alloc_free_accounting():
+    pool = PagePool(8, 4)
+    assert pool.free == 7 and pool.used == 0  # page 0 reserved
+    pids = [pool.alloc() for _ in range(7)]
+    assert NULL_PAGE not in pids and len(set(pids)) == 7
+    assert pool.free == 0 and pool.used == 7
+    with pytest.raises(PagePoolExhausted):
+        pool.alloc()
+    for pid in pids:
+        assert pool.decref(pid)
+    assert pool.free == 7 and pool.used == 0
+    pool.check()
+
+
+def test_null_page_is_protected():
+    pool = PagePool(4, 2)
+    with pytest.raises(ValueError):
+        pool.incref(NULL_PAGE)
+    with pytest.raises(ValueError):
+        pool.decref(NULL_PAGE)
+    with pytest.raises(ValueError):
+        PagePool(1, 2)  # must hold null + at least one real page
+    with pytest.raises(ValueError):
+        PagePool(4, 0)
+
+
+def test_refcount_sharing_lifecycle():
+    pool = PagePool(8, 4)
+    pid = pool.alloc()
+    d = b"digest-0"
+    pool.register(d, pid)
+    assert pool.lookup(d) == pid
+    pool.share(pid, reserve=False)
+    assert pool.refcount(pid) == 2 and pool.share_hits == 1
+    assert not pool.decref(pid)  # sharer retires: page survives
+    assert pool.lookup(d) == pid
+    assert pool.decref(pid)  # owner retires: page freed + unregistered
+    assert pool.lookup(d) is None
+    pool.check()
+
+
+def test_register_is_first_wins():
+    pool = PagePool(8, 4)
+    a, b = pool.alloc(), pool.alloc()
+    pool.register(b"x", a)
+    pool.register(b"x", b)  # duplicate content: original kept
+    assert pool.lookup(b"x") == a
+    pool.register(b"y", a)  # page already keyed: original key kept
+    assert pool.lookup(b"y") is None
+    pool.check()
+
+
+def test_note_write_drops_registration():
+    pool = PagePool(8, 4)
+    pid = pool.alloc()
+    pool.register(b"x", pid, volatile=True)
+    assert pool.is_volatile(pid)
+    pool.note_write(pid)  # sole owner overwrites: digest now stale
+    assert pool.lookup(b"x") is None
+    # the wrapping owner still HOLDS the page; its wrapper count
+    # clears when it retires, not when it writes
+    assert pool.is_volatile(pid)
+    pool.decref(pid, wrapper=True)
+    pool.check()
+
+
+def test_wrapper_count_clears_when_wrapping_owner_retires():
+    """Review r11: a sticky volatile flag made every later sharer of
+    a warm prompt reserve COW pages against an owner that had already
+    retired — reservations nobody could ever consume, eroding exactly
+    the shared-capacity win. The wrapper COUNT drops with the leaving
+    holder, so sharing a page whose remaining holders are all
+    non-wrapping costs no reservation."""
+    pool = PagePool(8, 4)
+    pid = pool.alloc()
+    pool.register(b"p", pid, volatile=True)  # wrapping owner
+    pool.share(pid, reserve=True)  # short sharer pays while owner lives
+    assert pool.reserved == 1
+    pool.decref(pid, wrapper=True)  # owner retires before wrapping
+    assert not pool.is_volatile(pid)
+    assert pool.reserved == 0  # stranded reservation released too
+    assert not pool.share_needs_reserve(pid, False)
+    pool.share(pid, reserve=False)  # later sharers ride free
+    pool.decref(pid)
+    pool.decref(pid)
+    pool.check()
+    assert pool.used == 0
+
+    # symmetric: a WRAPPING sharer joins the count and leaves with it
+    pid = pool.alloc()
+    pool.register(b"q", pid)  # non-wrapping owner
+    pool.share(pid, reserve=True, wrapper=True)
+    assert pool.is_volatile(pid)
+    pool.decref(pid, wrapper=True)  # wrapping sharer retires
+    assert not pool.is_volatile(pid)
+    pool.decref(pid)
+    pool.check()
+
+
+def test_cow_reservation_consumed_by_either_holder():
+    """The reservation attaches to the PAGE, so whichever holder
+    writes first consumes it — the r11 accounting bug this design
+    replaced attributed reservations to the sharer and blew up when
+    the registering owner wrapped first."""
+    for owner_writes_first in (False, True):
+        pool = PagePool(4, 2)  # 3 usable pages
+        pid = pool.alloc()
+        pool.register(b"p", pid, volatile=True)  # owner will wrap
+        pool.share(pid, reserve=pool.share_needs_reserve(pid, False))
+        assert pool.reserved == 1
+        extra = pool.alloc()  # a third party takes the only free page
+        del owner_writes_first  # symmetric: cow_alloc is holder-blind
+        # the attached reservation still covers the COW
+        new = pool.cow_alloc(pid)
+        assert pool.reserved == 0 and new not in (pid, extra)
+        pool.decref(pid)  # writer leaves the shared page
+        for p in (pid, new, extra):
+            pool.decref(p)
+        pool.check()
+        assert pool.used == 0
+
+
+def test_unreserved_free_pages_cannot_be_stolen():
+    pool = PagePool(4, 2)
+    pid = pool.alloc()
+    pool.register(b"p", pid, volatile=True)
+    pool.share(pid, reserve=True)
+    pool.alloc()  # 1 of 2 remaining
+    with pytest.raises(PagePoolExhausted):
+        pool.alloc()  # last free page is reserved for the COW
+    assert pool.cow_alloc(pid) != NULL_PAGE  # ...and the COW gets it
+
+
+def test_stranded_reservation_releases_on_retire():
+    pool = PagePool(6, 2)
+    pid = pool.alloc()
+    pool.register(b"p", pid)
+    pool.share(pid, reserve=True)  # sharer wraps but retires unwritten
+    assert pool.reserved == 1
+    pool.decref(pid)  # sharer retires: refcount 1, 0 possible COWs
+    assert pool.reserved == 0
+    pool.decref(pid)
+    pool.check()
+
+
+def test_prefix_digests_chain_covers_whole_prefix():
+    """Page j's digest keys prompt[:(j+1)*P] — K/V at any position
+    depend on every earlier token, so two prompts differing ANYWHERE
+    before a page boundary must diverge from that page on."""
+    a = np.arange(10, dtype=np.int32)
+    b = a.copy()
+    b[1] = 99  # differs inside page 0
+    da, db = prefix_page_digests(a, 4), prefix_page_digests(b, 4)
+    assert len(da) == 2  # only fully covered pages
+    assert da[0] != db[0] and da[1] != db[1]
+    c = a.copy()
+    c[5] = 99  # differs inside page 1: page 0 still shared
+    dc = prefix_page_digests(c, 4)
+    assert da[0] == dc[0] and da[1] != dc[1]
+    assert prefix_page_digests(a, 4, max_pages=1) == da[:1]
+    assert prefix_page_digests(a[:3], 4) == []
+
+
+def test_fuzz_churn_never_leaks():
+    """Random admit/share/COW/retire interleavings: the structural
+    invariants hold at every step and the pool drains to empty."""
+    rng = np.random.default_rng(0)
+    pool = PagePool(33, 4)
+    # per-request state: (held pids, wraps) — wraps mirrors the
+    # scheduler's per-slot flag (writers are always wrappers)
+    live: list[tuple[list[int], bool]] = []
+    for step in range(2000):
+        op = rng.integers(0, 4)
+        if op == 0 and pool.can_alloc(3, reserve=0):  # admit fresh
+            wraps = bool(rng.integers(0, 2))
+            live.append(([pool.alloc() for _ in range(3)], wraps))
+            d = rng.integers(0, 6)
+            pool.register(bytes([d]), live[-1][0][0], volatile=wraps)
+        elif op == 1 and live:  # admit sharing someone's first page
+            src = live[rng.integers(0, len(live))][0][0]
+            wraps = bool(rng.integers(0, 2))
+            need = pool.share_needs_reserve(src, wraps)
+            if pool.can_alloc(1, reserve=int(need)):
+                pool.share(src, reserve=need, wrapper=wraps)
+                live.append(([src, pool.alloc()], wraps))
+        elif op == 2 and live:  # a WRAPPING holder writes its page
+            idx = rng.integers(0, len(live))
+            pids, wraps = live[idx]
+            if not wraps:
+                continue  # non-wrapping requests never write shared
+            pid = pids[0]
+            if pool.refcount(pid) > 1:
+                new = pool.cow_alloc(pid)
+                pool.decref(pid, wrapper=True)
+                pids[0] = new
+            else:
+                pool.note_write(pid)
+        elif op == 3 and live:  # retire
+            pids, wraps = live.pop(rng.integers(0, len(live)))
+            for pid in pids:
+                pool.decref(pid, wrapper=wraps)
+        pool.check()
+    for pids, wraps in live:
+        for pid in pids:
+            pool.decref(pid, wrapper=wraps)
+    pool.check()
+    assert pool.used == 0 and pool.free == 32 and pool.reserved == 0
