@@ -23,6 +23,12 @@ documents the format):
   every rung declares a cost estimate and is skipped, visibly, when
   the remaining budget cannot cover it — r05 recorded ``rc: 124`` with
   ZERO output because the contract ran open-loop into the timeout.
+* the deadline watchdog is armed BEFORE the first jax touch (round-12
+  hardening): r05's actual hang was jax backend discovery inside
+  ``_wire_compile_cache``, which the old code ran before starting the
+  watchdog. Module-level imports stay numpy-light for the same reason,
+  and the flush-partial-and-exit-0 path is regression-tested under an
+  artificially tiny budget (tests/test_bench_watchdog.py).
 * compiles land in the same persistent XLA cache the test suite uses
   (tests/.jax_cache, tests/conftest.py mechanism), so a warm driver
   run spends its budget measuring, not compiling.
@@ -41,10 +47,11 @@ import time
 
 import numpy as np
 
-from benchmarks.transformer_train_bench import (
-    _timed,
-    bench_transformer_train,
-)
+# NOTE: nothing heavier than numpy may be imported at module level —
+# the budget watchdog can only pre-empt code that runs AFTER
+# driver_contract arms it, so jax (and anything importing jax) loads
+# lazily inside the guarded region. BENCH_r05's rc 124 was a jax
+# backend-discovery hang that nothing guarded.
 
 
 def _wire_compile_cache() -> None:
@@ -351,7 +358,6 @@ def driver_contract(budget_s: float | None = None) -> dict:
     global _DEADLINE, _EST_SCALE
     import threading
 
-    _wire_compile_cache()
     if budget_s is None:
         budget_s = float(os.environ.get("BENCH_BUDGET_S", "780"))
     t0 = time.perf_counter()
@@ -407,6 +413,15 @@ def driver_contract(budget_s: float | None = None) -> dict:
     if _DEADLINE is not None:
         threading.Thread(target=_watchdog, daemon=True).start()
     try:
+        # the guard is armed BEFORE the first jax touch. BENCH_r05's rc
+        # 124 with zero output was _wire_compile_cache()'s jax import /
+        # backend discovery wedging on the driver box's experimental
+        # platform while the old code only started the watchdog AFTER
+        # it returned — nothing could pre-empt, and `timeout 870`
+        # killed the process before any contract line existed. Every
+        # potentially-hanging step (cache wiring, calibration probe,
+        # rungs) now runs under the armed watchdog.
+        _wire_compile_cache()
         rate = _probe_raw_rate()
         _EST_SCALE = max(1.0, _REF_RATE / rate)
         out["machine_calibration"] = {
@@ -425,6 +440,17 @@ def driver_contract(budget_s: float | None = None) -> dict:
         # bookkeeping + one small real ProcessBackend recording whose
         # cost is injected sleeps, not matmul rate)
         out["sim"] = _try_rung(bench_sim, est=10, scale=False)
+
+        def rung_transport():
+            from benchmarks.transport_bench import bench_transport_rung
+
+            return bench_transport_rung()
+
+        # round-12 zero-copy transport rung: pipe-pickle vs socket vs
+        # shm-ring dispatch+harvest overhead at n=8 across the payload
+        # ladder. Unscaled: process spawn + memcpy + socket throughput
+        # do not track the matmul rate the calibration measures.
+        out["transport"] = _try_rung(rung_transport, est=120, scale=False)
         # headline: never budget-skipped, loud-fail (it IS the
         # contract) — but SIZED by measurement. Each ladder step is a
         # complete config-3 bench at that cube; the next step runs only
@@ -547,6 +573,7 @@ def _contract_line(out: dict) -> str:
     rungs = {
         "graftcheck": _rung_summary(out.get("graftcheck"), "digest"),
         "sim": _rung_summary(out.get("sim"), "digest"),
+        "transport": _rung_summary(out.get("transport"), "digest"),
         "adaptive_speedup": _rung_summary(
             out.get("adaptive_nwait"), "speedup"),
         "obs_overhead_pct": _rung_summary(
@@ -586,6 +613,10 @@ def _contract_line(out: dict) -> str:
         "elapsed_s": out.get("elapsed_s"),
         "rungs": rungs,
     }
+    if out.get("watchdog"):
+        # partial contract: say so IN the driver line, not only in the
+        # full-detail dump the tail capture may truncate
+        line["watchdog"] = out["watchdog"]
     # default=str: a stray numpy scalar in a rung digest must degrade
     # to a string, not throw away the whole driver line
     s = json.dumps(line, default=str)
@@ -826,14 +857,15 @@ def _transformer_rungs(into: dict | None = None):
     sees every COMPLETED sub-rung — measurements must not vanish because
     the block as a whole was still in flight when the budget elapsed.
     """
-    tt = into if into is not None else {}
-    tt.update(bench_transformer_train())
-
     from benchmarks.transformer_train_bench import (
         bench_decode,
         bench_spec_decode,
+        bench_transformer_train,
         bench_window_decode,
     )
+
+    tt = into if into is not None else {}
+    tt.update(bench_transformer_train())
 
     tt["decode_rung"] = _try_rung(bench_decode, est=100)
     tt["window_decode_rung"] = _try_rung(bench_window_decode, est=80)
@@ -1205,6 +1237,7 @@ def bench_uncoded_gemm(m=4096, k=4096, n=4096, n_workers=4, epochs=40):
     import jax
     import jax.numpy as jnp
 
+    from benchmarks.transformer_train_bench import _timed
     from mpistragglers_jl_tpu import AsyncPool, asyncmap, waitall
     from mpistragglers_jl_tpu.ops import DistributedGemm
 
@@ -1336,6 +1369,10 @@ if __name__ == "__main__":
     elif which == "uncoded":
         print(json.dumps(bench_uncoded_gemm()))
     elif which == "transformer":
+        from benchmarks.transformer_train_bench import (
+            bench_transformer_train,
+        )
+
         print(json.dumps(bench_transformer_train()))
     else:
         sys.exit(

@@ -51,7 +51,7 @@ def _straggle_exhausted(ranks, deadline: Deadline, timeout):
 
 def _native_worker_main(
     rank: int, path: str, work_fn: WorkFn, delay_fn: DelayFn | None,
-    token: bytes, telemetry: bool = False,
+    token: bytes, telemetry: bool = False, zero_copy: bool = True,
 ) -> None:
     """Spawned-process entry: the shared worker loop (worker.py — the
     reference's receive -> stall -> compute -> send convention, SURVEY
@@ -60,7 +60,7 @@ def _native_worker_main(
 
     try:
         run_worker(path, rank, work_fn, delay_fn, token=token,
-                   telemetry=telemetry)
+                   telemetry=telemetry, zero_copy=zero_copy)
     except (KeyboardInterrupt, Exception):
         pass
 
@@ -91,6 +91,7 @@ class NativeProcessBackend(Backend):
         accept: bool = True,
         auth: bytes | str | None = None,
         on_dead: str = "error",
+        zero_copy: bool = True,
         registry=None,
         flight=None,
         exporter=None,
@@ -117,15 +118,31 @@ class NativeProcessBackend(Backend):
         the same one via ``MSGT_AUTH`` / ``--auth-file``) or bind only
         on a trusted network.
 
+        ``zero_copy`` (default True) enables the round-12 persistent
+        shared-memory paths on same-host transports: broadcast bodies
+        >= 1 MiB stage in an arena every worker maps once, and worker
+        result bodies >= 64 KiB come back through per-worker result
+        rings served as ``np.frombuffer`` views — see docs/API.md
+        "Zero-copy transport". ``False`` forces the copying socket
+        transport for everything this backend controls — the
+        coordinator's broadcast paths and any workers it SPAWNS
+        (baselines/debugging). External ``spawn=False`` workers own
+        their result-ring choice: launch them with ``--no-zero-copy``
+        for a fully copying baseline. TCP transports are copying
+        regardless.
+
         ``registry`` / ``flight`` / ``exporter`` follow the obs/
         contract (None = dark, zero cost): ``registry`` turns on
         cross-process telemetry — spawned workers run with
         ``telemetry=True`` (external ``spawn=False`` workers opt in
         with ``--telemetry``) and their frames, arriving on the
         reserved OBS tag, merge into the registry under
-        ``worker="<rank>"`` labels; ``flight`` mirrors merged worker
-        spans into the ring; ``exporter`` registers the pool health
-        check + trace sources on an :class:`~..obs.ObsServer`."""
+        ``worker="<rank>"`` labels — plus the transport's zero-copy
+        counters (bytes moved without a userspace copy, ring-full
+        stalls, pinned-slot gauge/high-water); ``flight`` mirrors
+        merged worker spans into the ring; ``exporter`` registers the
+        pool health check + trace sources on an
+        :class:`~..obs.ObsServer`."""
         if on_dead not in ("error", "straggle"):
             raise ValueError(f"on_dead must be 'error'|'straggle', got {on_dead!r}")
         self.on_dead = on_dead
@@ -172,6 +189,7 @@ class NativeProcessBackend(Backend):
             auth = secrets.token_bytes(16) if self._spawn else b""
         self._token = auth.encode() if isinstance(auth, str) else bytes(auth)
         self._mp_context = mp_context
+        self._zero_copy = bool(zero_copy)
         self.aggregator = None
         if registry is not None or flight is not None:
             from ..obs.aggregate import TelemetryAggregator
@@ -179,8 +197,47 @@ class NativeProcessBackend(Backend):
             self.aggregator = TelemetryAggregator(
                 registry, flight=flight
             )
+        # opt-in transport telemetry (obs/ contract: None = dark, the
+        # hot path pays one is-None check per dispatch)
+        self._registry = registry
+        self._tstats_last = {
+            "arena_bytes": 0, "ring_bytes": 0,
+            "arena_stalls": 0, "ring_stalls": 0,
+        }
+        if registry is not None:
+            self._m_arena_bytes = registry.counter(
+                "transport_zero_copy_bytes_total",
+                help="payload bytes served without a userspace copy",
+                path="arena",
+            )
+            self._m_ring_bytes = registry.counter(
+                "transport_zero_copy_bytes_total",
+                help="payload bytes served without a userspace copy",
+                path="ring",
+            )
+            self._m_stalls_c = registry.counter(
+                "transport_ring_full_stalls_total",
+                help="allocations that fell back to the copying "
+                "transport because every slot was pinned",
+                side="coordinator",
+            )
+            self._m_stalls_w = registry.counter(
+                "transport_ring_full_stalls_total",
+                help="allocations that fell back to the copying "
+                "transport because every slot was pinned",
+                side="worker",
+            )
+            self._m_pinned = registry.gauge(
+                "transport_pinned_slots",
+                help="zero-copy slots currently pinned by live views",
+            )
+            self._m_pinned_peak = registry.gauge(
+                "transport_pinned_slots_peak",
+                help="high-water mark of pinned zero-copy slots",
+            )
         self._coord = T.Coordinator(
-            address, self.n_workers, token=self._token
+            address, self.n_workers, token=self._token,
+            zero_copy=self._zero_copy,
         )
         self._sock_path = self._coord.address  # ephemeral port resolved
         self._procs: list = [None] * self.n_workers
@@ -218,7 +275,8 @@ class NativeProcessBackend(Backend):
         proc = ctx.Process(
             target=_native_worker_main,
             args=(i, self._sock_path, self.work_fn, self.delay_fn,
-                  self._token, self.aggregator is not None),
+                  self._token, self.aggregator is not None,
+                  self._zero_copy),
             daemon=True,
             name=f"pool-native-worker-{i}",
         )
@@ -256,12 +314,15 @@ class NativeProcessBackend(Backend):
 
         asyncmap broadcasts ONE stable sendbuf to every idle worker per
         epoch (reference src/MPIAsyncPools.jl:118-139), so inside an
-        epoch the body is snapshotted into a native SHARED payload once
-        and the n dispatches (and phase-3 re-tasks) enqueue references —
-        one memcpy per broadcast, no pickling for plain ndarrays
-        (native/codec.py). Direct Backend-API dispatches always
-        re-encode, so in-place payload mutation between dispatches is
-        always observed."""
+        epoch the body is snapshotted once — preferentially into a slot
+        of the PERSISTENT broadcast arena (round 12: one memcpy, fd-less
+        control frames to workers that already map the arena), falling
+        back to a one-shot shared payload when the arena does not apply
+        or every slot is still pinned — and the n dispatches (and
+        phase-3 re-tasks) enqueue references. No pickling for plain
+        ndarrays (native/codec.py). Direct Backend-API dispatches
+        always re-encode, so in-place payload mutation between
+        dispatches is always observed."""
         cacheable = epoch == self._pick_epoch
         if not (cacheable and sendbuf is self._pick_src):
             prefix, body = codec.encode(sendbuf)
@@ -269,7 +330,10 @@ class NativeProcessBackend(Backend):
                 self._drop_cache()
                 self._pick_src = sendbuf
                 self._pick_prefix = prefix
-                self._pick_shared = self._coord.payload(body)
+                self._pick_shared = (
+                    self._coord.arena_payload(body)
+                    or self._coord.payload(body)
+                )
                 self._pick_epoch = epoch  # _drop_cache left it intact
             else:
                 return self._coord.isend2(
@@ -305,6 +369,8 @@ class NativeProcessBackend(Backend):
                 i, self._seq_counter[i], _time.perf_counter()
             )
         ok = self._send_payload(i, sendbuf, int(epoch), int(tag))
+        if self._registry is not None:
+            self._publish_transport()
         if not ok:
             # rank already dead. "error": fail the task at the next
             # harvest instead of hanging the pool. "straggle": the task
@@ -314,6 +380,31 @@ class NativeProcessBackend(Backend):
                 self._synthetic[key] = WorkerError(
                     i, epoch, WorkerProcessDied(i)
                 )
+
+    def _publish_transport(self) -> None:
+        """Mirror the transport's zero-copy stats into the opt-in
+        registry (counter deltas; the coordinator's dict is the source
+        of truth). Callers guard on ``self._registry is not None``."""
+        s = self._coord.stats
+        last = self._tstats_last
+        d = s["arena_bytes"] - last["arena_bytes"]
+        if d:
+            self._m_arena_bytes.inc(d)
+            last["arena_bytes"] = s["arena_bytes"]
+        d = s["ring_bytes"] - last["ring_bytes"]
+        if d:
+            self._m_ring_bytes.inc(d)
+            last["ring_bytes"] = s["ring_bytes"]
+        d = s["arena_stalls"] - last["arena_stalls"]
+        if d:
+            self._m_stalls_c.inc(d)
+            last["arena_stalls"] = s["arena_stalls"]
+        d = s["ring_stalls"] - last["ring_stalls"]
+        if d:
+            self._m_stalls_w.inc(d)
+            last["ring_stalls"] = s["ring_stalls"]
+        self._m_pinned.set(self._coord.pinned_slots())
+        self._m_pinned_peak.set(s["pinned_peak"])
 
     def _consume_obs(self, j: int, msg: T.Message) -> bool:
         """Absorb a telemetry frame (the reserved OBS tag): merge it
@@ -371,7 +462,10 @@ class NativeProcessBackend(Backend):
             return WorkerError(
                 i, msg.epoch, RemoteWorkerError(exc_type, text, tb)
             )
-        return codec.decode(msg.payload)
+        # result-ring frames carry the codec prefix in-frame and the
+        # body out-of-band (a zero-copy view into the worker's ring);
+        # holding the decoded array pins the slot until released
+        return codec.decode(msg.payload, msg.body)
 
     def _route(self, j: int, msg: T.Message, want_tag: int):
         """Classify an arriving frame against channel ``(j, want_tag)``:

@@ -22,18 +22,149 @@ schedules in :mod:`..utils.faults` qualify).
 from __future__ import annotations
 
 import multiprocessing as mp
+import pickle
 import threading
 import time
 import traceback
+import weakref
 from typing import Callable
 
 import numpy as np
 
+from ..native import rings as _rings
 from .base import DelayFn, SlotBackend, WorkerError
 
 WorkFn = Callable[[int, object, int], object]
 
 __all__ = ["ProcessBackend", "RemoteWorkerError", "WorkerProcessDied"]
+
+# Round-12 zero-copy pipe transport: ndarray payloads of at least this
+# many bytes ride ``multiprocessing.shared_memory`` rings (pickle
+# protocol-5 out-of-band buffers), the pipes carrying only small
+# control frames. Below it, classic in-band pickling wins.
+PROC_RING_MIN = 1 << 16
+PROC_RING_SLOTS = 4
+
+# control-frame markers (first tuple element)
+_MARK_BCAST = "__shmb__"   # dispatch body in the shared broadcast ring
+_MARK_RESULT = "__shmr__"  # result body in the worker's result ring
+_MARK_ACK = "__ack__"      # slot-release records, either direction
+
+
+def _attach_shm(name: str):
+    """Attach an existing shared-memory segment READ-ONLY, bypassing
+    ``SharedMemory`` on the attach side: attaching via the class
+    registers the name with the (spawn-shared) resource tracker a
+    second time, which corrupts the creator's unlink accounting
+    (bpo-38119) and spews tracker KeyErrors; a plain read-only mmap of
+    the POSIX segment has no tracker interaction and gives the
+    read-only payload contract for free. Returns ``(mmap, base)`` with
+    ``base`` a read-only uint8 array over the whole segment."""
+    import mmap as _mmap
+    import os as _os
+
+    fd = _os.open(f"/dev/shm/{name}", _os.O_RDONLY)
+    try:
+        size = _os.fstat(fd).st_size
+        mm = _mmap.mmap(fd, size, _mmap.MAP_SHARED, _mmap.PROT_READ)
+    finally:
+        _os.close(fd)
+    return mm, np.frombuffer(mm, np.uint8)
+
+
+def _unlink_shm_quiet(name: str) -> None:
+    """Best-effort unlink of a POSIX shared-memory name (the parent's
+    crash-path safety net for worker result rings; the creating worker
+    unlinks on clean exit)."""
+    import os as _os
+
+    try:
+        _os.unlink(f"/dev/shm/{name}")
+    except OSError:
+        pass
+
+
+def _encode_oob(obj) -> tuple[bytes, list]:
+    """Pickle with protocol-5 out-of-band buffers: ``(data, views)``
+    where ``views`` are the raw contiguous buffer views (ndarray
+    memory) the unpickler must be handed back in order. Empty views =
+    nothing eligible (no arrays, or non-contiguous fallbacks pickled
+    in-band)."""
+    bufs: list = []
+    data = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
+    return data, [b.raw() for b in bufs]
+
+
+def _serve_slot_views(base, start: int, lens, on_release, *args):
+    """Read-only views over one ring slot's packed buffers, with a
+    counted release hook: ``on_release(*args)`` fires once, when the
+    LAST derived view dies (the unpickled arrays keep these as their
+    bases). ``base`` must be a read-only uint8 array over the whole
+    segment."""
+    views = []
+    pos = start
+    for n in lens:
+        views.append(base[pos:pos + n])
+        pos += n
+    state = {"left": len(views)}
+    lock = threading.Lock()
+
+    def _dec():
+        with lock:
+            state["left"] -= 1
+            done = state["left"] == 0
+        if done:
+            on_release(*args)
+
+    for v in views:
+        weakref.finalize(v, _dec)
+    # hand out MEMORYVIEWS of the tracked slices: np.frombuffer (which
+    # is how pickle-5 reconstructs arrays) does not keep an ndarray
+    # buffer-source object alive, only its root buffer — the finalizer
+    # would fire (and the slot recycle) under live arrays. A
+    # memoryview's managed buffer holds the slice strongly and every
+    # derived buffer shares it.
+    return [memoryview(v) for v in views]
+
+
+class _ShmRing:
+    """One SharedMemory segment divided into equal slots (producer
+    side). ``create`` returns None when shared memory is unavailable
+    (callers fall back to in-band pickling)."""
+
+    __slots__ = ("shm", "name", "slots", "slot_bytes", "view", "alloc")
+
+    def __init__(self, shm, slots: int):
+        self.shm = shm
+        self.name = shm.name
+        self.slots = int(slots)
+        self.slot_bytes = shm.size // self.slots
+        self.view = np.frombuffer(shm.buf, np.uint8)
+        self.alloc = _rings.RingAlloc(self.slots)
+
+    @classmethod
+    def create(cls, body_bytes: int, slots: int):
+        from multiprocessing import shared_memory
+
+        size = max(_rings.next_pow2(body_bytes), PROC_RING_MIN) * slots
+        try:
+            shm = shared_memory.SharedMemory(create=True, size=size)
+        except (OSError, ValueError):  # pragma: no cover - /dev/shm full
+            return None
+        return cls(shm, slots)
+
+    def destroy(self) -> None:
+        """Creator-side teardown: drop our view, close, unlink. Safe
+        against double-unlink (a hard-killed peer may have beaten us)."""
+        self.view = None
+        try:
+            self.shm.close()
+        except BufferError:  # pragma: no cover - lingering local view
+            pass
+        try:
+            self.shm.unlink()
+        except FileNotFoundError:
+            pass
 
 
 class RemoteWorkerError(RuntimeError):
@@ -55,12 +186,83 @@ class WorkerProcessDied(RuntimeError):
         super().__init__(f"worker process {worker} died")
 
 
+def _child_resolve_bcast(marker, brings, pending_acks):
+    """Reconstruct a dispatch payload from the shared broadcast ring:
+    attach the segment on first sight (mapped once, reused every
+    epoch), unpickle with the slot's read-only views handed back as
+    protocol-5 out-of-band buffers (zero copy), and register the
+    slot-release ack that fires when the payload's last view dies."""
+    _, name, slot_bytes, slots, slot, gen, lens, data = marker
+    entry = brings.get(name)
+    if entry is None:
+        brings[name] = entry = _attach_shm(name)
+        for old in [k for k in brings if k != name]:
+            del brings[old]  # superseded ring; GC closes once views die
+    views = _serve_slot_views(
+        entry[1], slot * slot_bytes, lens,
+        pending_acks.append, (name, slot, gen),
+    )
+    return pickle.loads(data, buffers=views)
+
+
+def _child_ring_result(rring_box, result):
+    """Try to stage ``result``'s array buffers in this worker's result
+    ring; returns the control marker, or None for in-band pickling
+    (small/ineligible result, ring unavailable, or every slot still
+    pinned by parent-side views)."""
+    try:
+        data, views = _encode_oob(result)
+    except Exception:
+        return None
+    if not views:
+        return None
+    total = sum(v.nbytes for v in views)
+    if total < PROC_RING_MIN:
+        return None
+    ring = rring_box[0]
+    if ring is None or ring.slot_bytes < total:
+        new = _ShmRing.create(total, PROC_RING_SLOTS)
+        if new is None:
+            return None
+        if ring is not None:
+            # parent's mapping (and served views) keep the old pages
+            # alive; the parent unlinks it as a safety net at shutdown
+            ring.view = None
+            try:
+                ring.shm.close()
+            except BufferError:  # pragma: no cover
+                pass
+        rring_box[0] = ring = new
+    got = ring.alloc.acquire(("parent",))
+    if got is None:
+        rring_box[1] += 1  # ring-full stall; socket... pipe fallback
+        return None
+    slot, gen = got
+    pos = slot * ring.slot_bytes
+    lens = []
+    for v in views:
+        n = v.nbytes
+        ring.view[pos:pos + n] = np.frombuffer(v, np.uint8)
+        lens.append(n)
+        pos += n
+    return (
+        _MARK_RESULT, ring.name, ring.slot_bytes, ring.slots, slot,
+        gen, tuple(lens), data,
+    )
+
+
 def _worker_main(
     i: int, conn, work_fn: WorkFn, delay_fn: DelayFn | None,
-    telemetry: bool = False,
+    telemetry: bool = False, shm_rings: bool = True,
 ) -> None:
     """Worker process entry: the reference's receive -> stall -> compute ->
     send loop (§3.2) over a pipe instead of MPI point-to-point.
+
+    Round 12: with ``shm_rings`` (the coordinator's default), bulk
+    ndarray payloads arrive as read-only views over a shared broadcast
+    ring (resolved from a tiny control frame) and bulk results leave
+    through this worker's own result ring — the pipe carries only
+    control frames and slot-release acks in both directions.
 
     ``telemetry=True`` (set when the coordinator was constructed with a
     ``registry``) keeps a worker-local
@@ -74,6 +276,11 @@ def _worker_main(
         from ..obs.aggregate import WorkerTelemetry
 
         tele = WorkerTelemetry(i)
+    brings: dict = {}        # attached broadcast rings, name -> segment
+    pending_acks: list = []  # broadcast-slot releases owed to the
+    # parent (view finalizers append; NEVER rebind this list — the
+    # finalizer callbacks hold it)
+    rring_box = [None, 0]    # [result _ShmRing | None, stall count]
     try:
         while True:
             msg = conn.recv()
@@ -83,6 +290,15 @@ def _worker_main(
                     # drain frame: the last inter-result telemetry
                     conn.send((-1, -1, "tele", tele.snapshot(), -1))
                 break
+            if (
+                isinstance(msg, tuple) and len(msg) == 2
+                and msg[0] == _MARK_ACK
+            ):
+                ring = rring_box[0]
+                for name, slot, gen in msg[1]:
+                    if ring is not None and ring.name == name:
+                        ring.alloc.release(slot, gen, "parent")
+                continue
             seq, payload, epoch, tag = msg
             stall = 0.0
             if delay_fn is not None:
@@ -92,7 +308,24 @@ def _worker_main(
                     time.sleep(d)
             t0 = time.perf_counter() if tele is not None else 0.0
             try:
-                out = (seq, epoch, "ok", work_fn(i, payload, epoch), tag)
+                if (
+                    isinstance(payload, tuple) and payload
+                    and payload[0] == _MARK_BCAST
+                ):
+                    # resolve INSIDE the capture: a lost segment must
+                    # ship back as an error, not kill the worker
+                    payload = _child_resolve_bcast(
+                        payload, brings, pending_acks
+                    )
+                result = work_fn(i, payload, epoch)
+                payload = None  # release the slot view promptly
+                marker = (
+                    _child_ring_result(rring_box, result)
+                    if shm_rings else None
+                )
+                if marker is not None:
+                    result = marker
+                out = (seq, epoch, "ok", result, tag)
                 failed = False
             except BaseException as e:
                 out = (
@@ -101,6 +334,13 @@ def _worker_main(
                     tag,
                 )
                 failed = True
+            if pending_acks or rring_box[1]:
+                recs = pending_acks[:]
+                del pending_acks[:len(recs)]
+                if rring_box[1]:
+                    recs.append(("", -1, rring_box[1]))  # stall report
+                    rring_box[1] = 0
+                conn.send((_MARK_ACK, recs))
             frame = None
             if tele is not None:
                 t1 = time.perf_counter()
@@ -133,6 +373,10 @@ def _worker_main(
     except (EOFError, OSError, KeyboardInterrupt):
         pass
     finally:
+        if rring_box[0] is not None:
+            rring_box[0].destroy()  # parent holds its own mapping for
+            # any still-pinned views; unlink here frees the name (the
+            # parent's shutdown unlink is the crash-path safety net)
         conn.close()
 
 
@@ -140,10 +384,23 @@ class ProcessBackend(SlotBackend):
     """n spawned worker processes computing ``work_fn(i, payload, epoch)``.
 
     The payload snapshot the reference takes via ``isendbufs[i] .= sendbuf``
-    (src/MPIAsyncPools.jl:130) happens here by construction: pickling at
-    dispatch time copies the payload, so in-flight sends survive caller
-    mutation. numpy arrays cross the pipe zero-conversion; jax arrays are
+    (src/MPIAsyncPools.jl:130) happens here by construction: the payload
+    is copied out of the caller's buffer at dispatch time (into the
+    shared ring, or by pickling), so in-flight sends survive caller
+    mutation. numpy arrays cross zero-conversion; jax arrays are
     converted to numpy at dispatch (device buffers are not picklable).
+
+    Round 12 (``shm_rings=True``, the default): ndarray payloads and
+    results of >= 64 KiB ride ``multiprocessing.shared_memory`` rings
+    as pickle protocol-5 out-of-band buffers — ONE memcpy into a ring
+    slot per broadcast (shared across all n workers), results
+    reconstructed as zero-copy views over the worker's result ring;
+    the pipes carry only small control frames and slot-release acks.
+    Consequence: bulk arrays now arrive as **read-only views** on both
+    sides (the native backend's long-standing contract) — a work_fn
+    that mutates its payload in place gets a loud ``ValueError``
+    instead of a private copy. Pass ``shm_rings=False`` for the
+    classic all-in-band pickling (and its mutable private copies).
 
     Parameters
     ----------
@@ -186,6 +443,7 @@ class ProcessBackend(SlotBackend):
         delay_fn: DelayFn | None = None,
         mp_context: str = "spawn",
         join_timeout: float = 5.0,
+        shm_rings: bool = True,
         registry=None,
         flight=None,
         exporter=None,
@@ -206,6 +464,52 @@ class ProcessBackend(SlotBackend):
             self.aggregator = TelemetryAggregator(
                 registry, flight=flight
             )
+        # round-12 zero-copy pipe transport state (see class docstring;
+        # shm_rings=False restores the classic everything-in-band
+        # pickling, including the mutable-payload-copy semantics).
+        # Linux-only: the attach side maps segments via /dev/shm (the
+        # tracker-safe path), which macOS/Windows shm does not expose —
+        # ProcessBackend is the portable fallback backend, so elsewhere
+        # it stays the classic pickling transport it always was.
+        import sys as _sys
+
+        self._shm_rings = bool(shm_rings) and _sys.platform == "linux"
+        self._ring_lock = threading.Lock()  # allocator/ring state is
+        # shared between the coordinator thread and reader threads
+        self._bring: "_ShmRing | None" = None
+        self._bring_retired: list[_ShmRing] = []
+        self._pick_epoch = None   # asyncmap epoch cache (begin_epoch)
+        self._pick_src = None
+        self._pick_marker = None
+        # per-worker: result-ack pending lists (finalizers append —
+        # cleared in place, never rebound), attached result-ring
+        # segments, and every result-ring name ever seen (crash-path
+        # unlink safety net)
+        self._rack_pending: list[list] = [[] for _ in range(self.n_workers)]
+        self._rring_maps: list[dict] = [{} for _ in range(self.n_workers)]
+        self._rring_names: list[set] = [set() for _ in range(self.n_workers)]
+        self.ring_stats = {
+            "bcast_bytes": 0, "result_bytes": 0, "stalls": 0,
+        }
+        self._registry = registry
+        self._rstats_last = dict(self.ring_stats)
+        if registry is not None:
+            self._m_bcast = registry.counter(
+                "transport_zero_copy_bytes_total",
+                help="payload bytes served without a userspace copy",
+                path="pipe_bcast",
+            )
+            self._m_result = registry.counter(
+                "transport_zero_copy_bytes_total",
+                help="payload bytes served without a userspace copy",
+                path="pipe_result",
+            )
+            self._m_stalls = registry.counter(
+                "transport_ring_full_stalls_total",
+                help="allocations that fell back to in-band pickling "
+                "because every slot was pinned",
+                side="pipe",
+            )
         self._conns = [None] * self.n_workers
         self._procs = [None] * self.n_workers
         self._readers = [None] * self.n_workers
@@ -221,7 +525,7 @@ class ProcessBackend(SlotBackend):
         proc = ctx.Process(
             target=_worker_main,
             args=(i, child, self.work_fn, self.delay_fn,
-                  self.aggregator is not None),
+                  self.aggregator is not None, self._shm_rings),
             daemon=True,
             name=f"pool-proc-worker-{i}",
         )
@@ -271,6 +575,25 @@ class ProcessBackend(SlotBackend):
                 return
             if msg is None:
                 return
+            if (
+                isinstance(msg, tuple) and len(msg) == 2
+                and msg[0] == _MARK_ACK
+            ):
+                # worker released broadcast-ring slots (or reports
+                # ring-full stalls: name "", slot -1, count in gen)
+                with self._ring_lock:
+                    for name, slot, gen in msg[1]:
+                        if slot == -1 and name == "":
+                            self.ring_stats["stalls"] += int(gen)
+                            continue
+                        for ring in (
+                            [self._bring] if self._bring is not None
+                            else []
+                        ) + self._bring_retired:
+                            if ring.name == name:
+                                ring.alloc.release(slot, gen, i)
+                    self._gc_retired_locked()
+                continue
             t_recv_c = (
                 time.perf_counter() if agg is not None else None
             )
@@ -288,7 +611,16 @@ class ProcessBackend(SlotBackend):
                 payload = WorkerError(
                     i, epoch, RemoteWorkerError(exc_type, message, tb)
                 )
+            elif (
+                isinstance(payload, tuple) and payload
+                and payload[0] == _MARK_RESULT
+            ):
+                payload = self._resolve_result(i, epoch, payload)
             self._complete(i, seq, payload, tag)
+            # opportunistic ack flush: result views released since the
+            # last dispatch go back now, not an epoch later (finalizers
+            # only append to the pending list — no lock hazards)
+            self._flush_result_acks(i)
 
     def _on_worker_death(self, i: int, conn) -> None:
         """Fail the outstanding task (if any) so waits don't hang — the
@@ -307,6 +639,16 @@ class ProcessBackend(SlotBackend):
                 for tag, slots in self._channels.items()
                 if slots[i].outstanding and not slots[i].done
             ]
+        # a dead worker never acks: reap its broadcast-slot pins so the
+        # ring drains (its own result ring died with it). Taken OUTSIDE
+        # _cond — lock order is always _ring_lock alone or _cond alone.
+        with self._ring_lock:
+            if self._bring is not None:
+                self._bring.alloc.release_holder_everywhere(i)
+            for ring in self._bring_retired:
+                ring.alloc.release_holder_everywhere(i)
+            self._gc_retired_locked()
+        del self._rack_pending[i][:]
         if not self._closed:
             for tag, seq in pending:
                 self._complete(
@@ -318,6 +660,171 @@ class ProcessBackend(SlotBackend):
         respawned) — the ``/healthz`` pool check reads this."""
         with self._cond:
             return [i for i, d in enumerate(self._dead) if d]
+
+    # -- zero-copy ring plumbing ------------------------------------------
+    def _resolve_result(self, i: int, epoch: int, marker):
+        """Reconstruct a worker's result from its result ring: attach
+        the segment on first sight, unpickle over read-only slot views
+        (zero copy), register the slot-release ack that fires when the
+        harvested arrays die."""
+        _, name, slot_bytes, slots, slot, gen, lens, data = marker
+        cache = self._rring_maps[i]
+        entry = cache.get(name)
+        if entry is None:
+            try:
+                entry = _attach_shm(name)
+            except OSError as e:
+                return WorkerError(i, epoch, WorkerProcessDied(i)) if (
+                    self._dead[i]
+                ) else WorkerError(i, epoch, e)
+            cache[name] = entry
+            with self._ring_lock:
+                self._rring_names[i].add(name)
+        views = _serve_slot_views(
+            entry[1], slot * slot_bytes, lens,
+            self._queue_result_ack, i, (name, slot, gen),
+        )
+        with self._ring_lock:
+            self.ring_stats["result_bytes"] += sum(lens)
+        return pickle.loads(data, buffers=views)
+
+    def _queue_result_ack(self, i: int, rec) -> None:
+        # finalizer callback (any thread): append only — the flush
+        # happens at safe points (dispatch / post-complete), never here
+        self._rack_pending[i].append(rec)
+
+    def _flush_result_acks(self, i: int) -> None:
+        pend = self._rack_pending[i]
+        if not pend:
+            return
+        recs = pend[:]
+        del pend[:len(recs)]
+        try:
+            with self._send_lock:
+                self._conns[i].send((_MARK_ACK, recs))
+        except (BrokenPipeError, OSError, AttributeError):
+            pass  # worker gone; its ring died with it
+
+    def _bcast_ctrl(self, i: int, sendbuf, payload, epoch: int):
+        """Stage ``payload`` in the shared broadcast ring and return
+        the control marker for worker ``i`` (or None = send in-band).
+        Inside an asyncmap epoch (begin_epoch) the encode + slot write
+        happens ONCE and later dispatches only add their rank as a
+        holder — one memcpy per broadcast, like the native arena."""
+        cacheable = self._pick_epoch == int(epoch)
+        if cacheable and self._pick_src is sendbuf and (
+            self._pick_marker is not None
+        ):
+            marker = self._pick_marker
+            with self._ring_lock:
+                ring = self._bring
+                if ring is not None and ring.name == marker[1]:
+                    ring.alloc.add_holder(marker[4], marker[5], i)
+                    return marker
+            return None  # ring replaced mid-epoch; re-encode
+        try:
+            data, views = _encode_oob(payload)
+        except Exception:
+            return None
+        if not views:
+            return None
+        total = sum(v.nbytes for v in views)
+        if total < PROC_RING_MIN:
+            return None
+        with self._ring_lock:
+            ring = self._bring
+            if ring is None or ring.slot_bytes < total:
+                new = _ShmRing.create(total, PROC_RING_SLOTS)
+                if new is None:
+                    return None
+                if ring is not None:
+                    self._bring_retired.append(ring)
+                self._bring = ring = new
+            holders = ("coord", i) if cacheable else (i,)
+            got = ring.alloc.acquire(holders)
+            if got is None:
+                self.ring_stats["stalls"] += 1
+                return None
+            slot, gen = got
+            self.ring_stats["bcast_bytes"] += total
+            self._gc_retired_locked()
+        pos = slot * ring.slot_bytes  # slot exclusively ours: write
+        lens = []                     # outside the lock
+        for v in views:
+            n = v.nbytes
+            ring.view[pos:pos + n] = np.frombuffer(v, np.uint8)
+            lens.append(n)
+            pos += n
+        marker = (
+            _MARK_BCAST, ring.name, ring.slot_bytes, ring.slots, slot,
+            gen, tuple(lens), data,
+        )
+        if cacheable:
+            with self._ring_lock:
+                # a replaced cached marker (direct dispatch of a
+                # DIFFERENT buffer at the same epoch) must release its
+                # coord pin, or the old slot strands pinned forever
+                self._release_pick_locked()
+            self._pick_src = sendbuf
+            self._pick_marker = marker
+        return marker
+
+    def _release_pick_locked(self) -> None:
+        """Release the cached marker's ``"coord"`` hold against
+        WHICHEVER ring owns it — the current ring, or a retired one
+        when the ring grew mid-epoch (caller holds ``_ring_lock``)."""
+        marker = self._pick_marker
+        if marker is None:
+            return
+        for ring in (
+            [self._bring] if self._bring is not None else []
+        ) + self._bring_retired:
+            if ring.name == marker[1]:
+                ring.alloc.release(marker[4], marker[5], "coord")
+                break
+        self._gc_retired_locked()
+
+    def _gc_retired_locked(self) -> None:
+        """Unlink superseded broadcast rings once drained. The
+        ``_locked`` suffix is the contract: EVERY caller already holds
+        ``_ring_lock`` (taking it here would self-deadlock), which is
+        what the GC005 suppression below records."""
+        still = []
+        for ring in self._bring_retired:
+            if ring.alloc.pinned == 0:
+                ring.destroy()
+            else:
+                still.append(ring)
+        self._bring_retired[:] = still  # graftcheck: disable=GC005
+
+    def _publish_ring_stats(self) -> None:
+        """Mirror ring stats into the opt-in registry (counter deltas).
+        Callers guard on ``self._registry is not None``."""
+        with self._ring_lock:
+            s = dict(self.ring_stats)
+        last = self._rstats_last
+        if s["bcast_bytes"] > last["bcast_bytes"]:
+            self._m_bcast.inc(s["bcast_bytes"] - last["bcast_bytes"])
+        if s["result_bytes"] > last["result_bytes"]:
+            self._m_result.inc(s["result_bytes"] - last["result_bytes"])
+        if s["stalls"] > last["stalls"]:
+            self._m_stalls.inc(s["stalls"] - last["stalls"])
+        self._rstats_last = s
+
+    def begin_epoch(self, epoch: int) -> None:
+        # arm the one-encode-per-broadcast cache for this asyncmap call
+        # (native backend discipline: direct Backend-API dispatches
+        # outside an epoch window always re-encode)
+        self.end_epoch()
+        self._pick_epoch = int(epoch)
+
+    def end_epoch(self) -> None:
+        if self._pick_marker is not None:
+            with self._ring_lock:
+                self._release_pick_locked()
+        self._pick_epoch = None
+        self._pick_src = None
+        self._pick_marker = None
 
     # -- SlotBackend surface ----------------------------------------------
     def _start(self, i: int, sendbuf, epoch: int, seq: int, tag: int) -> None:
@@ -331,10 +838,17 @@ class ProcessBackend(SlotBackend):
         payload = sendbuf
         if hasattr(payload, "__array__") and not isinstance(payload, np.ndarray):
             payload = np.asarray(payload)  # device arrays are not picklable
+        if self._shm_rings:
+            ctrl = self._bcast_ctrl(i, sendbuf, payload, epoch)
+            if ctrl is not None:
+                payload = ctrl
         if self.aggregator is not None:
             # half of a clock-offset sample; the worker's matching
             # stamps ride back on the result frame
             self.aggregator.note_dispatch(i, seq, time.perf_counter())
+        if self._registry is not None:
+            self._publish_ring_stats()
+        self._flush_result_acks(i)
         try:
             with self._send_lock:
                 self._conns[i].send((seq, payload, epoch, tag))
@@ -374,5 +888,19 @@ class ProcessBackend(SlotBackend):
             for reader in self._readers:
                 if reader is not None:
                     reader.join(timeout=self._join_timeout)
+        # zero-copy teardown: the coordinator owns the broadcast rings
+        # (unlink them); result rings belong to the workers, who unlink
+        # on clean exit — unlink any name still present as the
+        # crash-path safety net (hard-killed workers skip finally)
+        with self._ring_lock:
+            if self._bring is not None:
+                self._bring.destroy()
+                self._bring = None
+            for ring in self._bring_retired:
+                ring.destroy()
+            self._bring_retired = []
+        for i in range(self.n_workers):
+            for name in list(self._rring_names[i]):
+                _unlink_shm_quiet(name)
         for conn in self._conns:
             conn.close()

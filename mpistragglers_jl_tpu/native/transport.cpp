@@ -408,6 +408,13 @@ struct Peer {
   int fd = -1;
   bool dead = false;
 
+  // SCM_RIGHTS fds received from this worker (result-ring regions —
+  // the worker passes each ring's memfd once, attached to the ring's
+  // first control frame). Captured by the progress thread during
+  // pump_read, consumed by the caller thread via msgt_coord_take_fd;
+  // guarded by the coordinator mutex.
+  std::deque<int> in_fds;
+
   // inbound reassembly state machine
   Header rhdr{};
   size_t rgot = 0;       // bytes of header received so far
@@ -444,8 +451,10 @@ struct Coordinator {
     if (listen_fd >= 0) ::close(listen_fd);
     if (epfd >= 0) ::close(epfd);
     if (wake_fd >= 0) ::close(wake_fd);
-    for (auto& p : peers)
+    for (auto& p : peers) {
       if (p.fd >= 0) ::close(p.fd);
+      for (int fd : p.in_fds) ::close(fd);
+    }
     for (int fd : parked)
       if (fd >= 0) ::close(fd);
     if (!path.empty()) ::unlink(path.c_str());
@@ -487,7 +496,39 @@ void mark_dead(Coordinator* c, int rank) {
     p.fd = -1;
   }
   p.sendq.clear();
+  for (int fd : p.in_fds) ::close(fd);
+  p.in_fds.clear();
   c->cv.notify_all();
+}
+
+// recvmsg wrapper for the coordinator's inbound pump: any SCM_RIGHTS
+// fds riding the stream (worker result-ring announcements) are queued
+// per peer instead of silently discarded. Same return contract as
+// ::read.
+ssize_t coord_recv(Coordinator* c, int rank, void* buf, size_t n) {
+  Peer& p = c->peers[rank];
+  msghdr mh{};
+  iovec iov{buf, n};
+  mh.msg_iov = &iov;
+  mh.msg_iovlen = 1;
+  alignas(cmsghdr) char cbuf[CMSG_SPACE(4 * sizeof(int))];
+  mh.msg_control = cbuf;
+  mh.msg_controllen = sizeof(cbuf);
+  ssize_t r = ::recvmsg(p.fd, &mh, MSG_CMSG_CLOEXEC);
+  if (r > 0) {
+    for (cmsghdr* cm = CMSG_FIRSTHDR(&mh); cm != nullptr;
+         cm = CMSG_NXTHDR(&mh, cm)) {
+      if (cm->cmsg_level == SOL_SOCKET && cm->cmsg_type == SCM_RIGHTS) {
+        size_t nfds = (cm->cmsg_len - CMSG_LEN(0)) / sizeof(int);
+        int fds[4];
+        size_t take = nfds < size_t(4) ? nfds : size_t(4);
+        std::memcpy(fds, CMSG_DATA(cm), take * sizeof(int));
+        std::lock_guard<std::mutex> lk(c->mu);
+        for (size_t i = 0; i < take; i++) p.in_fds.push_back(fds[i]);
+      }
+    }
+  }
+  return r;
 }
 
 // Drain as many inbound bytes as available on peer `rank`; push completed
@@ -497,7 +538,7 @@ bool pump_read(Coordinator* c, int rank) {
   while (true) {
     if (!p.rin_payload) {
       auto* dst = reinterpret_cast<uint8_t*>(&p.rhdr) + p.rgot;
-      ssize_t r = ::read(p.fd, dst, sizeof(Header) - p.rgot);
+      ssize_t r = coord_recv(c, rank, dst, sizeof(Header) - p.rgot);
       if (r == 0) return false;
       if (r < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
@@ -512,8 +553,8 @@ bool pump_read(Coordinator* c, int rank) {
       p.rpayload_got = 0;
     }
     while (p.rpayload_got < p.rbuf.size()) {
-      ssize_t r = ::read(p.fd, p.rbuf.data() + p.rpayload_got,
-                         p.rbuf.size() - p.rpayload_got);
+      ssize_t r = coord_recv(c, rank, p.rbuf.data() + p.rpayload_got,
+                             p.rbuf.size() - p.rpayload_got);
       if (r == 0) return false;
       if (r < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
@@ -970,6 +1011,51 @@ int msgt_coord_isend(void* h, int rank, int64_t seq, int64_t epoch,
   uint64_t one = 1;
   (void)!::write(c->wake_fd, &one, sizeof(one));
   return 0;
+}
+
+// Non-blocking send with a file descriptor attached to the frame's
+// first byte via SCM_RIGHTS (round-12 zero-copy transport: the
+// coordinator passes a broadcast-arena memfd to each worker ONCE, on
+// the first arena frame that rank sees; subsequent arena frames are
+// tiny fd-less control frames). `fd` is dup'd — the caller keeps
+// ownership of its copy. Unix-socket transports only (SCM_RIGHTS does
+// not cross TCP; the Python layer gates on the address family).
+// Returns 0 ok, -1 dead rank, -2 fd duplication failed (caller should
+// fall back to a copying send).
+int msgt_coord_isend_fd(void* h, int rank, int64_t seq, int64_t epoch,
+                        int64_t tag, int64_t kind, const uint8_t* data,
+                        int64_t len, int fd) {
+  auto* c = static_cast<Coordinator*>(h);
+  int dupfd = ::fcntl(fd, F_DUPFD_CLOEXEC, 0);
+  if (dupfd < 0) return -2;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    Peer& p = c->peers[rank];
+    if (p.dead) {
+      ::close(dupfd);
+      return -1;
+    }
+    Frame f;
+    f.hdr = Header{len, seq, epoch, tag, kind};
+    f.payload.assign(data, data + len);
+    f.pass_fd = dupfd;
+    p.sendq.push_back(std::move(f));
+  }
+  uint64_t one = 1;
+  (void)!::write(c->wake_fd, &one, sizeof(one));
+  return 0;
+}
+
+// Pop the next SCM_RIGHTS fd received from `rank` (worker result-ring
+// announcements), -1 if none. Python owns the mapping and its lifetime.
+int msgt_coord_take_fd(void* h, int rank) {
+  auto* c = static_cast<Coordinator*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  Peer& p = c->peers[rank];
+  if (p.in_fds.empty()) return -1;
+  int fd = p.in_fds.front();
+  p.in_fds.pop_front();
+  return fd;
 }
 
 // Two-buffer non-blocking send: `pre` (a small codec header) and `body`
@@ -1457,6 +1543,52 @@ int msgt_worker_send2(void* h, int64_t seq, int64_t epoch, int64_t tag,
     return -1;
   if (body_len > 0 &&
       !write_full(w->fd, body, static_cast<size_t>(body_len)))
+    return -1;
+  return 0;
+}
+
+// Blocking send of one frame with a file descriptor attached to the
+// header's first byte via SCM_RIGHTS (round-12 result rings: the
+// worker passes its ring memfd to the coordinator ONCE, on the ring's
+// first control frame). The fd is not dup'd — the blocking send
+// completes before return and the kernel holds its own reference for
+// the in-flight message; the caller keeps its copy. Unix sockets only.
+int msgt_worker_send_fd(void* h, int64_t seq, int64_t epoch, int64_t tag,
+                        int64_t kind, const uint8_t* data, int64_t len,
+                        int fd) {
+  auto* w = static_cast<WorkerCtx*>(h);
+  Header hdr{len, seq, epoch, tag, kind};
+  const uint8_t* hp = reinterpret_cast<const uint8_t*>(&hdr);
+  size_t sent = 0;
+  bool fd_attached = false;
+  while (sent < sizeof(hdr)) {
+    ssize_t r;
+    if (!fd_attached) {
+      msghdr mh{};
+      iovec iov{const_cast<uint8_t*>(hp + sent), sizeof(hdr) - sent};
+      mh.msg_iov = &iov;
+      mh.msg_iovlen = 1;
+      alignas(cmsghdr) char cbuf[CMSG_SPACE(sizeof(int))];
+      std::memset(cbuf, 0, sizeof(cbuf));
+      mh.msg_control = cbuf;
+      mh.msg_controllen = sizeof(cbuf);
+      cmsghdr* cm = CMSG_FIRSTHDR(&mh);
+      cm->cmsg_level = SOL_SOCKET;
+      cm->cmsg_type = SCM_RIGHTS;
+      cm->cmsg_len = CMSG_LEN(sizeof(int));
+      std::memcpy(CMSG_DATA(cm), &fd, sizeof(int));
+      r = ::sendmsg(w->fd, &mh, 0);
+      if (r > 0) fd_attached = true;
+    } else {
+      r = ::write(w->fd, hp + sent, sizeof(hdr) - sent);
+    }
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    sent += static_cast<size_t>(r);
+  }
+  if (len > 0 && !write_full(w->fd, data, static_cast<size_t>(len)))
     return -1;
   return 0;
 }

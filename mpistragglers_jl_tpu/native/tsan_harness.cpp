@@ -17,7 +17,16 @@
 //   4. shared + shm broadcast payload paths (payload handles are
 //      created/released by the caller thread while the progress thread
 //      writes frames referencing them);
-//   5. clean shutdown (control frames, worker exits, destroy).
+//   5. clean shutdown (control frames, worker exits, destroy);
+//   6. round-12 ring phase: a second coordinator + 2 producer workers
+//      exercising the persistent result-ring protocol end to end —
+//      memfd ring announced once via SCM_RIGHTS (msgt_worker_send_fd
+//      -> recvmsg capture -> msgt_coord_take_fd), concurrent
+//      producer writes / consumer reads on the SAME mapped pages (the
+//      producer-address read makes a protocol violation a TSAN race,
+//      not just a byte mismatch), ack-frame slot reclamation, and a
+//      deliberately PINNED slot whose ack is withheld while the
+//      producer wraps the ring — reuse-before-ack is caught both ways.
 //
 // Any data race TSAN finds aborts the process non-zero
 // (halt_on_error=1 is set by the pytest driver); exit 0 means the run
@@ -26,14 +35,18 @@
 // the whole address space, which it cannot do as a .so loaded into a
 // non-TSAN interpreter.
 
+#include <sys/mman.h>
 #include <unistd.h>
 
+#include <array>
 #include <atomic>
 #include <chrono>
 #include <cstdio>
 #include <cstring>
+#include <deque>
 #include <string>
 #include <thread>
+#include <utility>
 #include <vector>
 
 // The transport's C ABI (declared here rather than a header; the .cpp
@@ -71,7 +84,11 @@ int msgt_worker_recv_hdr(void* h, Hdr* out);
 int msgt_worker_recv_payload(void* h, uint8_t* buf, int64_t len);
 int msgt_worker_send(void* h, int64_t seq, int64_t epoch, int64_t tag,
                      int64_t kind, const uint8_t* data, int64_t len);
+int msgt_worker_send_fd(void* h, int64_t seq, int64_t epoch, int64_t tag,
+                        int64_t kind, const uint8_t* data, int64_t len,
+                        int fd);
 int msgt_worker_take_fd(void* h);
+int msgt_coord_take_fd(void* h, int rank);
 void msgt_worker_close(void* h);
 }
 
@@ -113,6 +130,203 @@ void worker_main(const std::string& path, int rank, int die_after) {
     if (die_after > 0 && served >= die_after) break;  // simulated crash
   }
   msgt_worker_close(w);
+}
+
+constexpr int64_t KIND_RING = 7;
+constexpr int64_t KIND_ACK = 8;
+constexpr int kRingSlots = 4;
+constexpr size_t kSlotBytes = 4096;
+constexpr int kRingRounds = 40;
+
+// Ring producer: the worker half of the round-12 result-ring protocol.
+// Creates a memfd ring, publishes its base pointer for the consumer's
+// same-address reads (TSAN visibility), writes each round's pattern
+// into a free slot, announces the fd once (msgt_worker_send_fd on the
+// first control frame), and reuses a slot only after the
+// coordinator's KIND_ACK releases it — blocking on acks when all four
+// slots are outstanding (the ring-full path).
+void ring_worker(const std::string& path, int rank,
+                 std::atomic<uint8_t*>* base_out) {
+  void* w = msgt_worker_connect(path.c_str(), rank, kToken, kTokenLen);
+  if (!w) {
+    std::fprintf(stderr, "ring worker %d: connect failed\n", rank);
+    std::abort();
+  }
+  int fd = ::memfd_create("tsan-ring", MFD_CLOEXEC);
+  if (fd < 0 || ::ftruncate(fd, kRingSlots * kSlotBytes) != 0) std::abort();
+  auto* base = static_cast<uint8_t*>(
+      ::mmap(nullptr, kRingSlots * kSlotBytes, PROT_READ | PROT_WRITE,
+             MAP_SHARED, fd, 0));
+  if (base == MAP_FAILED) std::abort();
+  base_out->store(base, std::memory_order_release);
+  bool announced = false;
+  bool busy[kRingSlots] = {false, false, false, false};
+  auto drain_one = [&]() -> bool {  // one frame; false = shutdown/EOF
+    Hdr h{};
+    if (msgt_worker_recv_hdr(w, &h) != 0) return false;
+    std::vector<uint8_t> p(h.len > 0 ? h.len : 1);
+    if (h.len > 0 && msgt_worker_recv_payload(w, p.data(), h.len) != 0)
+      return false;
+    if (h.kind == KIND_CONTROL) return false;
+    if (h.kind == KIND_ACK && h.len >= 24) {
+      int64_t rec[3];
+      std::memcpy(rec, p.data(), 24);
+      if (rec[1] >= 0 && rec[1] < kRingSlots) busy[rec[1]] = false;
+    }
+    return true;
+  };
+  int64_t gen = 0;
+  bool alive = true;
+  for (int r = 0; alive && r < kRingRounds; r++) {
+    int slot = -1;
+    while (slot < 0) {
+      for (int s = 0; s < kRingSlots; s++)
+        if (!busy[s]) {
+          slot = s;
+          break;
+        }
+      if (slot < 0 && !(alive = drain_one())) break;  // ring full: wait
+    }
+    if (!alive) break;
+    ++gen;
+    // the write the pinned-view discipline protects: only ever into a
+    // slot the consumer has acked (or never seen)
+    std::memset(base + slot * kSlotBytes, static_cast<uint8_t>(gen),
+                kSlotBytes);
+    int64_t meta[3] = {slot, gen, static_cast<int64_t>(kSlotBytes)};
+    int rc;
+    if (!announced) {
+      rc = msgt_worker_send_fd(w, gen, r, 0, KIND_RING,
+                               reinterpret_cast<uint8_t*>(meta), 24, fd);
+      announced = true;
+    } else {
+      rc = msgt_worker_send(w, gen, r, 0, KIND_RING,
+                            reinterpret_cast<uint8_t*>(meta), 24);
+    }
+    if (rc != 0) break;
+    busy[slot] = true;
+  }
+  while (alive) alive = drain_one();  // until the control broadcast
+  msgt_worker_close(w);
+  base_out->store(nullptr, std::memory_order_release);
+  ::munmap(base, kRingSlots * kSlotBytes);
+  ::close(fd);
+}
+
+// Coordinator half of the ring phase. Returns true on success.
+bool run_ring_phase(const std::string& path) {
+  constexpr int NR = 2;
+  void* c = msgt_coord_create(path.c_str(), NR, kToken, kTokenLen);
+  if (!c) return false;
+  std::atomic<uint8_t*> bases[NR];
+  for (auto& b : bases) b.store(nullptr);
+  std::vector<std::thread> workers;
+  for (int r = 0; r < NR; r++)
+    workers.emplace_back(ring_worker, path, r, &bases[r]);
+  bool ok = msgt_coord_accept(c, 10000) == 0;
+  // concurrent prober (phase-1 discipline): non-blocking polls racing
+  // the progress engine and the harvester's takes
+  std::atomic<bool> stop{false};
+  std::thread prober([&] {
+    Hdr hdr{};
+    while (!stop.load(std::memory_order_relaxed)) {
+      for (int r = 0; r < NR; r++) (void)msgt_coord_poll(c, r, &hdr);
+      std::this_thread::sleep_for(std::chrono::microseconds(100));
+    }
+  });
+  uint8_t* maps[NR] = {nullptr, nullptr};
+  // the deliberately pinned slot: its ack is withheld across several
+  // harvests while the producer keeps wrapping the other slots
+  std::deque<std::pair<int, std::array<int64_t, 3>>> pinned;
+  int expect = kRingRounds * NR, got = 0;
+  while (ok && got < expect) {
+    int32_t ranks[NR] = {0, 1};
+    int r = msgt_coord_waitany(c, ranks, NR, 10000);
+    if (r < 0) {
+      std::fprintf(stderr, "ring waitany timeout\n");
+      ok = false;
+      break;
+    }
+    Hdr h{};
+    if (!msgt_coord_poll(c, r, &h)) continue;  // prober peeked
+    uint8_t buf[64];
+    if (msgt_coord_take(c, r, buf, sizeof buf) < 0) continue;
+    if (h.kind == 3) {  // KIND_DEATH: a producer crashed
+      std::fprintf(stderr, "ring worker %d died\n", r);
+      ok = false;
+      break;
+    }
+    if (h.kind != KIND_RING) continue;
+    int64_t meta[3];
+    std::memcpy(meta, buf, 24);
+    if (!maps[r]) {
+      int fd = msgt_coord_take_fd(c, r);
+      if (fd < 0) {
+        std::fprintf(stderr, "ring announce carried no fd\n");
+        ok = false;
+        break;
+      }
+      maps[r] = static_cast<uint8_t*>(::mmap(
+          nullptr, kRingSlots * kSlotBytes, PROT_READ, MAP_SHARED, fd, 0));
+      ::close(fd);
+      if (maps[r] == MAP_FAILED) {
+        ok = false;
+        break;
+      }
+    }
+    auto want = static_cast<uint8_t>(meta[1]);
+    const uint8_t* slot_p = maps[r] + meta[0] * kSlotBytes;
+    for (size_t k = 0; k < kSlotBytes; k += 512)
+      if (slot_p[k] != want) {
+        std::fprintf(stderr, "ring slot bytes torn\n");
+        ok = false;
+      }
+    // same-address read through the PRODUCER's mapping: if the
+    // protocol ever let the producer reuse this slot early, TSAN sees
+    // a racing write/read pair here, not only a byte mismatch
+    uint8_t* shared = bases[r].load(std::memory_order_acquire);
+    if (shared && shared[meta[0] * kSlotBytes] != want) {
+      std::fprintf(stderr, "ring shared view torn\n");
+      ok = false;
+    }
+    got++;
+    int64_t rec[3] = {0, meta[0], meta[1]};
+    if (r == 0 && pinned.empty()) {
+      // hold this slot's ack: the producer must wrap around it
+      pinned.push_back({r, {rec[0], rec[1], rec[2]}});
+    } else {
+      msgt_coord_isend(c, r, 0, 0, 0, KIND_ACK,
+                       reinterpret_cast<uint8_t*>(rec), 24);
+    }
+    if (!pinned.empty() && got % 8 == 0) {
+      auto pr = pinned.front();
+      pinned.pop_front();
+      // the pinned slot must still hold ITS generation right up to the
+      // release (reclaim-vs-pinned-view)
+      if (maps[pr.first] &&
+          maps[pr.first][pr.second[1] * kSlotBytes] !=
+              static_cast<uint8_t>(pr.second[2])) {
+        std::fprintf(stderr, "pinned ring slot reused before ack\n");
+        ok = false;
+      }
+      msgt_coord_isend(c, pr.first, 0, 0, 0, KIND_ACK,
+                       reinterpret_cast<uint8_t*>(pr.second.data()), 24);
+    }
+  }
+  // release any ack still withheld so producers drain, then shut down
+  for (auto& pr : pinned)
+    msgt_coord_isend(c, pr.first, 0, 0, 0, KIND_ACK,
+                     reinterpret_cast<uint8_t*>(pr.second.data()), 24);
+  uint8_t z[1] = {0};
+  for (int r = 0; r < NR; r++)
+    msgt_coord_isend(c, r, 0, 0, 0, KIND_CONTROL, z, 0);
+  for (auto& t : workers) t.join();
+  stop.store(true);
+  prober.join();
+  for (auto* m : maps)
+    if (m) ::munmap(m, kRingSlots * kSlotBytes);
+  msgt_coord_destroy(c);
+  return ok;
 }
 
 }  // namespace
@@ -215,5 +429,14 @@ int main() {
   std::this_thread::sleep_for(std::chrono::milliseconds(200));
   msgt_coord_destroy(c);
   std::printf("tsan harness: %d epochs, reaccept ok\n", EPOCHS);
+  // phase 6: persistent result-ring protocol (fresh coordinator)
+  const std::string ring_path =
+      "/tmp/msgt-tsan-ring-" + std::to_string(::getpid()) + ".sock";
+  if (!run_ring_phase(ring_path)) {
+    std::fprintf(stderr, "ring phase failed\n");
+    return 2;
+  }
+  std::printf("ring ok: %d rounds x 2 producers, pinned-slot holds\n",
+              kRingRounds);
   return 0;
 }

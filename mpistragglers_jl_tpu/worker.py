@@ -46,6 +46,7 @@ def run_worker(
     token: bytes = b"",
     connect_timeout: float = 30.0,
     telemetry: bool = False,
+    zero_copy: bool = True,
 ) -> None:
     """Connect to the coordinator and serve until shutdown.
 
@@ -54,10 +55,13 @@ def run_worker(
     way reference worker assertions die inside mpiexec (SURVEY §4).
 
     Array payloads arrive as **read-only zero-copy views** of transport
-    memory (socket frame or shared-memory region — native/codec.py);
-    copy before mutating in place. Views may be retained indefinitely:
-    a shared-memory region stays mapped for as long as any view of it
-    is alive (eviction is refused, never dangling).
+    memory (socket frame, shared-memory region, or a broadcast-arena
+    slot — native/codec.py); copy before mutating in place. Views may
+    be retained indefinitely: a shared-memory region stays mapped (and
+    an arena/ring slot stays unreclaimed) for as long as any view of it
+    is alive — eviction and slot reuse are deferred, never dangling.
+    ``zero_copy=False`` turns off both the result ring and (on the
+    coordinator side, via the backend's matching flag) the arena.
 
     The connect retries with backoff until ``connect_timeout``: a worker
     that races the coordinator's bind, or whose hello lands while the
@@ -80,7 +84,10 @@ def run_worker(
         from .obs.aggregate import OBS_TAG, WorkerTelemetry
 
         tele = WorkerTelemetry(rank)
-    w = _connect_retry(address, rank, token, connect_timeout)
+    w = _connect_retry(
+        address, rank, token, connect_timeout,
+        ring_min=T.RING_MIN if zero_copy else None,
+    )
     try:
         while True:
             msg = w.recv()
@@ -94,6 +101,9 @@ def run_worker(
             failed = False
             t0 = 0.0
             stall = 0.0
+            # routing echo saved up front so the frame itself can be
+            # dropped the moment its payload is decoded
+            seq_, epoch_, tag_ = msg.seq, msg.epoch, msg.tag
             try:
                 # decoding is inside the capture: an undecodable payload
                 # (e.g. a class not importable on this host — the common
@@ -101,14 +111,15 @@ def run_worker(
                 # kill the worker without a diagnostic. Raw ndarray
                 # payloads decode as zero-copy views (native/codec.py).
                 payload = codec.decode(msg.payload, msg.body)
+                msg = None  # the view chain roots in the payload now
                 if delay_fn is not None:
-                    d = float(delay_fn(rank, msg.epoch))
+                    d = float(delay_fn(rank, epoch_))
                     if d > 0:
                         stall = d
                         time.sleep(d)
                 t0 = time.perf_counter()
                 prefix, body = codec.encode(
-                    work_fn(rank, payload, msg.epoch)
+                    work_fn(rank, payload, epoch_)
                 )
                 kind = T.KIND_DATA
             except BaseException as e:
@@ -117,23 +128,36 @@ def run_worker(
                     (type(e).__name__, str(e), traceback.format_exc())
                 )
                 kind = T.KIND_ERROR
-            # echo seq AND tag: the coordinator routes completions to the
-            # (rank, tag) channel the dispatch was posted on; the result
-            # body is written straight from its buffer (send2, zero-copy)
-            if not w.send2(
-                prefix, body, seq=msg.seq, epoch=msg.epoch, tag=msg.tag,
+            # drop the payload view before sending: an arena slot is
+            # only reclaimable once its views die. (For an echo-style
+            # work_fn the RESULT may itself be the payload view, so the
+            # chain fully dies only at `body = None` below — either
+            # way, before the next recv, whose first act is to flush
+            # the queued release acks.)
+            payload = None
+            msg = None
+            # echo seq AND tag: the coordinator routes completions to
+            # the (rank, tag) channel the dispatch was posted on. Data
+            # results >= RING_MIN ride this worker's persistent result
+            # ring (one memcpy into shared pages; only a control frame
+            # crosses the socket); everything else is a two-buffer
+            # socket send written straight from its buffer.
+            if not w.send_result(
+                prefix, body, seq=seq_, epoch=epoch_, tag=tag_,
                 kind=kind,
             ):
                 break
+            prefix = body = None  # release NOW: the next recv's ack
+            # flush ships the slot release in this same frame boundary
             if tele is not None:
                 t1 = time.perf_counter()
                 tele.task_done(
-                    msg.epoch, t0 or t_recv_w, t1, error=failed,
+                    epoch_, t0 or t_recv_w, t1, error=failed,
                     stall=stall,
                 )
                 try:
                     p, b = codec.encode(
-                        tele.snapshot(pair=(msg.seq, t_recv_w, t1))
+                        tele.snapshot(pair=(seq_, t_recv_w, t1))
                     )
                 except Exception:
                     # span args are sanitized at record time, so this
@@ -142,7 +166,7 @@ def run_worker(
                     # task computed fine
                     continue
                 if not w.send2(
-                    p, b, seq=msg.seq, epoch=msg.epoch, tag=OBS_TAG
+                    p, b, seq=seq_, epoch=epoch_, tag=OBS_TAG
                 ):
                     break
     finally:
@@ -150,13 +174,14 @@ def run_worker(
 
 
 def _connect_retry(
-    address: str, rank: int, token: bytes, timeout: float
+    address: str, rank: int, token: bytes, timeout: float,
+    ring_min: int | None = T.RING_MIN,
 ) -> T.Worker:
     deadline = time.perf_counter() + timeout
     delay = 0.05
     while True:
         try:
-            return T.Worker(address, rank, token=token)
+            return T.Worker(address, rank, token=token, ring_min=ring_min)
         except T.TransportError:
             left = deadline - time.perf_counter()
             if left <= 0:
@@ -234,6 +259,13 @@ def main(argv=None) -> None:
         "snapshots on result frames (merged by a coordinator built "
         "with registry=; dropped harmlessly otherwise)",
     )
+    ap.add_argument(
+        "--no-zero-copy", action="store_true",
+        help="disable this worker's shared-memory result ring (the "
+        "copying socket sends only) — pair with the coordinator's "
+        "zero_copy=False for a fully copying baseline; TCP workers "
+        "are copying regardless",
+    )
     args = ap.parse_args(argv)
     ranks = parse_ranks(args.ranks)
     token = _resolve_token(args.auth_file)
@@ -242,7 +274,8 @@ def main(argv=None) -> None:
     delay_fn = resolve_callable(args.delay) if args.delay else None
     if len(ranks) == 1:
         run_worker(args.address, ranks[0], work_fn, delay_fn,
-                   token=token, telemetry=args.telemetry)
+                   token=token, telemetry=args.telemetry,
+                   zero_copy=not args.no_zero_copy)
         return
     # one OS process per rank (ranks must not share a Python process:
     # work_fn may hold the GIL, and per-rank crash isolation is the
@@ -257,7 +290,7 @@ def main(argv=None) -> None:
         ctx.Process(
             target=_spawned_rank_main,
             args=(args.address, r, args.work, args.delay, token,
-                  args.telemetry),
+                  args.telemetry, not args.no_zero_copy),
             name=f"pool-cli-worker-{r}",
         )
         for r in ranks
@@ -312,7 +345,7 @@ def _resolve_token(auth_file: str | None) -> bytes:
 
 def _spawned_rank_main(
     address: str, rank: int, work_spec: str, delay_spec: str | None,
-    token: bytes = b"", telemetry: bool = False,
+    token: bytes = b"", telemetry: bool = False, zero_copy: bool = True,
 ) -> None:
     """Child entry for multi-rank mode: resolve specs locally, serve."""
     run_worker(
@@ -322,6 +355,7 @@ def _spawned_rank_main(
         resolve_callable(delay_spec) if delay_spec else None,
         token=token,
         telemetry=telemetry,
+        zero_copy=zero_copy,
     )
 
 

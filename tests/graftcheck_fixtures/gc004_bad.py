@@ -32,3 +32,10 @@ def page_pool_tick(pool, registry=None):
     # the registry without the None guard
     registry.gauge("serving_cache_pages_free").set(pool)  # GC004 line 33
     return pool
+
+
+def harvest_ring(frame, registry=None):
+    # the round-12 zero-copy transport telemetry shape: mirroring the
+    # coordinator's ring stats into the registry without the None guard
+    registry.counter("transport_zero_copy_bytes_total").inc(frame)  # GC004 line 40
+    return frame

@@ -67,3 +67,17 @@ def page_pool_tick(pool, registry=None):
         registry.counter("serving_prefix_share_hits_total").inc()
         registry.counter("serving_cow_copies_total").inc(0)
     return pool
+
+
+def harvest_ring(frame, registry=None):
+    """The round-12 zero-copy transport telemetry shape with the
+    guard: counter deltas and the pinned-slot gauge only touch the
+    registry inside the is-not-None arm (backends/native.py
+    _publish_transport discipline)."""
+    if registry is not None:
+        registry.counter(
+            "transport_zero_copy_bytes_total", path="ring"
+        ).inc(frame)
+        registry.counter("transport_ring_full_stalls_total").inc(0)
+        registry.gauge("transport_pinned_slots").set(frame)
+    return frame

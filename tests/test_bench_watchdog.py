@@ -1,0 +1,76 @@
+"""Regression: the bench budget watchdog must pre-empt and flush.
+
+BENCH_r05 on the driver box recorded ``rc: 124, parsed: null`` — jax
+backend discovery hung inside ``_wire_compile_cache`` BEFORE the old
+watchdog thread was started, so nothing could pre-empt and ``timeout
+870`` killed bench.py with zero contract output. The round-12
+hardening arms the watchdog before the first jax touch (and keeps
+bench.py's module-level imports numpy-light so the guard covers the
+whole jax load). These tests pin the contract the driver depends on:
+under ANY budget — including one so tiny it elapses during the jax
+import — ``python bench.py`` exits 0 and its LAST stdout line is
+parseable JSON.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_tiny_budget_flushes_partial_contract_and_exits_zero():
+    """An artificially tiny budget elapses while jax is still loading
+    (mid-"rung" from the watchdog's point of view): the run must exit
+    0 with a parseable compact last line — never rc 124 / empty
+    stdout. Also covers the boot-hang shape of BENCH_r05: the budget
+    is over before the first rung even starts."""
+    env = dict(os.environ)
+    env["BENCH_BUDGET_S"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    run = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=_REPO, capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert run.returncode == 0, (
+        f"bench.py rc={run.returncode}\nstderr: {run.stderr[-3000:]}"
+    )
+    lines = [ln for ln in run.stdout.splitlines() if ln.strip()]
+    assert lines, f"no stdout at all; stderr: {run.stderr[-3000:]}"
+    last = json.loads(lines[-1])  # the driver's tail-capture contract
+    assert isinstance(last, dict) and "metric" in last
+    # a partial flush says so visibly, in the compact line itself, OR
+    # the budget somehow sufficed and a real contract printed — either
+    # way the driver parses a last line. On any realistic machine the
+    # 1 s budget elapses during the jax import and the watchdog path
+    # is what ran:
+    if "watchdog" in last:
+        assert "deadline elapsed" in str(last["watchdog"]) or (
+            "partial" in str(last["watchdog"])
+        )
+    # the compact line must survive a ~2000-char tail capture
+    assert len(lines[-1]) < 2000
+
+
+def test_contract_line_is_robust_to_minimal_and_odd_snapshots():
+    """_contract_line must produce a short JSON line from whatever the
+    watchdog snapshot holds — empty dict, partial rungs, numpy scalars
+    — because it runs at the moment things are already going wrong."""
+    import numpy as np
+
+    import bench
+
+    for snap in (
+        {},
+        {"watchdog": "deadline elapsed mid-rung; partial contract"},
+        {"metric": "m", "value": np.float32(1.5),
+         "graftcheck": {"digest": "5r/0f/b0/1.00s"},
+         "transformer_train": {"skipped": "budget"}},
+    ):
+        s = bench._contract_line(snap)
+        parsed = json.loads(s)
+        assert isinstance(parsed, dict)
+        assert len(s) < 2000
+        if snap.get("watchdog"):
+            assert parsed["watchdog"] == snap["watchdog"]

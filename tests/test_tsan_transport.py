@@ -8,7 +8,11 @@ one such race was an ADVICE finding in round 1. This test compiles
 transport.cpp together with a C++ harness under ``-fsanitize=thread``
 and drives the hot paths (auth handshake, 200 mixed-payload epochs with
 a concurrent prober thread, mid-run death + reaccept, shm fd passing,
-shutdown). TSAN runs with ``halt_on_error=1``: any detected race exits
+shutdown — plus the round-12 ring phase: the persistent result-ring
+protocol with worker->coordinator SCM_RIGHTS announces, concurrent
+producer/consumer access to one shared mapping, ack-frame slot
+reclamation, and a deliberately pinned slot the producer must wrap
+around). TSAN runs with ``halt_on_error=1``: any detected race exits
 non-zero and fails the test with the report attached.
 
 TSAN must own the whole process, so this is a standalone binary, not a
@@ -25,7 +29,43 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE = os.path.join(_REPO, "mpistragglers_jl_tpu", "native")
 
 
-def _sanitizer_usable(flag: str) -> bool:
+# The probe exercises the sync primitives the transport actually uses:
+# threads, a shared mutex, and a TIMED condition-variable wait
+# (msgt_coord_waitany's timeout path). A trivial `int main` is not
+# enough — some glibc/libtsan combinations run it cleanly yet emit a
+# bogus "double lock of a mutex" on any pthread_cond_timedwait (seen
+# on the round-12 driver box at the SEED commit, nondeterministic
+# report site), which would fail the harness without any real finding.
+_PROBE_SRC = """
+#include <condition_variable>
+#include <chrono>
+#include <mutex>
+#include <thread>
+std::mutex mu;
+std::condition_variable cv;
+bool flag = false;
+int counter = 0;
+int main() {
+  std::thread w([] {
+    for (int i = 0; i < 100; i++) {
+      std::unique_lock<std::mutex> lk(mu);
+      cv.wait_until(lk,
+          std::chrono::steady_clock::now() + std::chrono::milliseconds(1),
+          [] { return flag; });
+      counter++;
+    }
+  });
+  for (int i = 0; i < 100; i++) {
+    { std::lock_guard<std::mutex> lk(mu); counter++; }
+    cv.notify_all();
+  }
+  w.join();
+  return counter == 200 ? 0 : 1;
+}
+"""
+
+
+def _sanitizer_usable(flag: str, env_opts=None) -> bool:
     import shutil
     import tempfile
 
@@ -35,16 +75,23 @@ def _sanitizer_usable(flag: str) -> bool:
     with tempfile.TemporaryDirectory() as d:
         src = os.path.join(d, "t.cpp")
         with open(src, "w") as f:
-            f.write("int main(){return 0;}\n")
+            f.write(_PROBE_SRC)
         probe = os.path.join(d, "t")
         r = subprocess.run(
-            [gxx, flag, src, "-o", probe], capture_output=True
+            [gxx, "-std=c++17", flag, src, "-o", probe, "-lpthread"],
+            capture_output=True,
         )
         if r.returncode != 0:
             return False
-        # the runtime itself can be unusable (e.g. high-entropy ASLR
-        # kernels vs older libtsan abort at startup): require a clean RUN
-        r = subprocess.run([probe], capture_output=True, timeout=30)
+        # the runtime itself can be unusable (high-entropy ASLR kernels
+        # vs older libtsan abort at startup; timed-condvar interceptor
+        # mismatches report phantom mutex bugs): require a clean RUN of
+        # the real primitive mix under the same halt-on-error options
+        env = dict(os.environ)
+        env.update(env_opts or {})
+        r = subprocess.run(
+            [probe], capture_output=True, timeout=60, env=env
+        )
         return r.returncode == 0
 
 
@@ -76,7 +123,7 @@ def _sanitizer_usable(flag: str) -> bool:
     ids=["tsan", "asan+lsan", "asan+ubsan"],
 )
 def test_transport_under_sanitizer(tmp_path, flag, env_opts):
-    if not _sanitizer_usable(flag):
+    if not _sanitizer_usable(flag, env_opts):
         pytest.skip(f"g++ {flag} not usable on this host")
     binary = str(tmp_path / "san_harness")
     build = subprocess.run(
@@ -100,3 +147,7 @@ def test_transport_under_sanitizer(tmp_path, flag, env_opts):
         f"(rc={run.returncode}):\n{run.stderr[-4000:]}"
     )
     assert "reaccept ok" in run.stdout
+    # round-12 ring phase: fd-passing announce, concurrent
+    # producer/consumer on shared pages, ack-driven slot reclaim with
+    # a deliberately pinned slot — must have completed, not bailed
+    assert "ring ok" in run.stdout
