@@ -47,7 +47,7 @@ def _default_cache(paths: list[str]) -> str:
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="graftcheck",
-        description="project-invariant static analysis (GC001-GC005)",
+        description="project-invariant static analysis (GC001-GC009)",
     )
     ap.add_argument(
         "paths", nargs="*",

@@ -19,6 +19,20 @@ GC004  dark-path            registry/spans/tracer kwargs default None,
                             match the Prometheus grammar
 GC005  lock-discipline      cross-thread attribute writes in
                             thread/lock classes happen under a lock
+GC006  lock-order           per-class lock-acquisition graph stays
+                            acyclic; no blocking call (recv, pickle,
+                            timeout-less wait) under a held lock
+GC007  slot-lifetime        RingAlloc acquire paths None-check (the
+                            all-pinned fallback), release/register the
+                            pin, and serve tracked views only as
+                            ``memoryview(view)``
+GC008  wall-clock           sim modules never read the OS clock; no
+                            assert compares wall time to a sub-second
+                            margin (``# graftcheck: real-smoke`` marks
+                            the one sanctioned real test per family)
+GC009  protocol-drift       transport.py KIND_* table and ctypes
+                            argtypes/restype match transport.cpp's
+                            constexpr constants and msgt_* signatures
 ====== ==================== ==========================================
 """
 
@@ -28,4 +42,8 @@ from . import (  # noqa: F401  (import == register)
     gc003_tracer_leak,
     gc004_dark_path,
     gc005_lock_discipline,
+    gc006_lock_order,
+    gc007_slot_lifetime,
+    gc008_wall_clock,
+    gc009_protocol_drift,
 )

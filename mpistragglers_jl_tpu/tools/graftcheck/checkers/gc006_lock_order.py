@@ -1,0 +1,403 @@
+"""GC006: lock-order discipline — no cycles, no blocking under a lock.
+
+The round-12 transport put three lock-holding planes in one process
+(``Coordinator._zlock`` finalizer re-entry, ``ProcessBackend``'s
+``_cond``/``_ring_lock``/``_send_lock`` triple, the ``obs/`` registry
+locks, ``sim/clock.py``'s rendezvous condition) and the discipline that
+keeps them deadlock-free lives in comments ("lock order is always
+_ring_lock alone or _cond alone", process.py; "taking it here would
+self-deadlock", ``_gc_retired_locked``). This checker machine-checks
+those comments, per class, across the intra-class call graph:
+
+1. **Lock-order cycles.** Every ``with self.<lock>:`` acquisition is
+   an edge from each lock already held to the acquired one — held
+   lexically, or transitively through ``self.m()`` calls made while
+   holding (the same fixpoint closure GC005 uses for thread entries).
+   A cycle in the resulting per-class graph (``A -> B`` somewhere,
+   ``B -> A`` somewhere else) is a potential deadlock the moment two
+   threads interleave, and is flagged at each acquisition site on the
+   cycle. Re-acquiring the SAME attribute is flagged only when
+   ``__init__`` binds it to a non-reentrant ``threading.Lock`` (an
+   ``RLock`` self-edge is the documented finalizer-re-entry pattern,
+   transport.py).
+
+2. **Blocking calls held under a lock.** While a ``with self.<lock>:``
+   is lexically held: pipe/socket receives (``.recv``/``.recv_bytes``
+   /``.accept``), ``pickle.dumps``/``pickle.loads`` (serializing a
+   large body stalls every contender), ``time.sleep``, and condition
+   waits with NO timeout (``.wait()`` / ``.wait_for(pred)`` — an
+   unbounded wait turns a missed notify into a hang; waiting on the
+   with-ed condition itself still needs the timeout, which is how
+   ``backends/base.py`` and ``sim/clock.py`` already do it).
+
+Scope cuts (tripwire, not prover): only ``with self.<attr>:`` counts
+as a lock acquisition (the same dynamic-binding argument as GC005);
+cross-CLASS lock graphs are out of scope (no two classes in this
+codebase share lock objects); ``with`` on a call result is not an
+acquisition.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, Finding, ModuleInfo, dotted_path, register
+
+#: method names that block on a peer while the caller can hold a lock
+_BLOCKING_ATTRS = {"recv", "recv_bytes", "recv_bytes_into", "accept"}
+
+#: dotted callee paths that serialize/deserialize whole bodies
+_PICKLE_PATHS = {("pickle", "dumps"), ("pickle", "loads")}
+
+_WAIT_ATTRS = {"wait", "wait_for"}
+
+
+def _self_attr(expr: ast.expr) -> str | None:
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+def _wait_has_timeout(node: ast.Call, attr: str) -> bool:
+    """``.wait(t)`` / ``.wait_for(pred, t)`` / ``timeout=`` kwarg."""
+    if any(kw.arg == "timeout" for kw in node.keywords):
+        return True
+    need = 1 if attr == "wait" else 2  # wait_for's first arg is the pred
+    return len(node.args) >= need
+
+
+class _LockScan(ast.NodeVisitor):
+    """Per-method lock facts: acquisitions (with the held stack at the
+    site), self-calls (with the held stack), and blocking calls made
+    while holding."""
+
+    def __init__(self) -> None:
+        # (acquired_attr, held_stack_tuple, node)
+        self.acquires: list[tuple[str, tuple[str, ...], ast.AST]] = []
+        # (callee_method, held_stack_tuple, node)
+        self.calls: list[tuple[str, tuple[str, ...], ast.AST]] = []
+        # (message_fragment, node) for blocking-under-lock findings
+        self.blocking: list[tuple[str, ast.AST]] = []
+        # nested defs, NOT merged into this scan: they run on their
+        # own call (often thread) context, so their facts must not
+        # inherit this method's held stack or feed its edge set —
+        # the caller analyzes each as a separate pseudo-method
+        self.nested: list[ast.AST] = []
+        self._held: list[str] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None and not isinstance(
+                item.context_expr, ast.Call
+            ):
+                self.acquires.append(
+                    (attr, tuple(self._held), item.context_expr)
+                )
+                self._held.append(attr)
+                acquired.append(attr)
+        self.generic_visit(node)
+        for _ in acquired:
+            self._held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        path = dotted_path(node.func)
+        if path is not None and len(path) == 2 and path[0] == "self":
+            self.calls.append((path[1], tuple(self._held), node))
+        if self._held:
+            attr = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else None
+            )
+            held = self._held[-1]
+            if attr in _BLOCKING_ATTRS:
+                self.blocking.append(
+                    (f"blocking `.{attr}(...)` while holding "
+                     f"`self.{held}`", node)
+                )
+            elif attr in _WAIT_ATTRS and not _wait_has_timeout(
+                node, attr
+            ):
+                self.blocking.append(
+                    (f"`.{attr}(...)` with no timeout while holding "
+                     f"`self.{held}` — a missed notify becomes a hang",
+                     node)
+                )
+            elif path is not None and tuple(path[-2:]) in _PICKLE_PATHS:
+                self.blocking.append(
+                    (f"`{'.'.join(path[-2:])}(...)` while holding "
+                     f"`self.{held}` — pickling a large body stalls "
+                     "every contender", node)
+                )
+            elif path is not None and tuple(path[-2:]) == (
+                "time", "sleep",
+            ):
+                self.blocking.append(
+                    (f"`time.sleep(...)` while holding `self.{held}`",
+                     node)
+                )
+        self.generic_visit(node)
+
+    # nested defs run on their own call/lock context (often another
+    # thread): park them for a SEPARATE scan instead of merging their
+    # facts here (merging fabricated cycle edges from thread-entry
+    # closures — review finding)
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.nested.append(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _sccs(adj: dict[str, set[str]]) -> list[set[str]]:
+    """Tarjan strongly connected components (the lock graphs here are
+    a handful of nodes; recursion depth is bounded by that)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[set[str]] = []
+    counter = [0]
+
+    def strong(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(adj.get(v, ())):
+            if w not in index:
+                strong(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp: set[str] = set()
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.add(w)
+                if w == v:
+                    break
+            out.append(comp)
+
+    for v in sorted(adj):
+        if v not in index:
+            strong(v)
+    return out
+
+
+def _path_in(
+    adj: dict[str, set[str]], scc: set[str], start: str, goal: str
+) -> list[str]:
+    """Shortest edge path ``start -> ... -> goal`` inside ``scc``
+    (BFS; one must exist — both ends share the SCC)."""
+    prev: dict[str, str] = {}
+    frontier = [start]
+    seen = {start}
+    while frontier:
+        nxt = []
+        for u in frontier:
+            for v in sorted(adj.get(u, ())):
+                if v == goal:
+                    prev[v] = u
+                    path = [v]
+                    while path[-1] != start:
+                        path.append(prev[path[-1]])
+                    return list(reversed(path))
+                if v in scc and v not in seen:
+                    seen.add(v)
+                    prev[v] = u
+                    nxt.append(v)
+        frontier = nxt
+    return [start, goal]  # unreachable by SCC construction
+
+
+def _lock_ctor_types(cls: ast.ClassDef) -> dict[str, str]:
+    """attr -> 'Lock' | 'RLock' | 'Condition' | ... from ``__init__``
+    assignments ``self.x = threading.Lock()``."""
+    out: dict[str, str] = {}
+    for item in cls.body:
+        if not (
+            isinstance(item, ast.FunctionDef) and item.name == "__init__"
+        ):
+            continue
+        for node in ast.walk(item):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            path = dotted_path(node.value.func)
+            if path is None:
+                continue
+            kind = path[-1]
+            if kind not in (
+                "Lock", "RLock", "Condition", "Semaphore",
+                "BoundedSemaphore",
+            ):
+                continue
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    out[attr] = kind
+    return out
+
+
+@register
+class LockOrder(Checker):
+    rule = "GC006"
+    name = "lock-order"
+    description = (
+        "per-class lock-acquisition graph (with self.<lock>: nesting "
+        "across the intra-class call graph) stays acyclic, "
+        "non-reentrant locks are never re-acquired, and no blocking "
+        "call (recv/accept, pickle, sleep, timeout-less condition "
+        "wait) runs while a lock is held"
+    )
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        # token gate: every finding requires a `with self.<lock>:`
+        # acquisition somewhere in the class
+        if "with self." not in mod.source:
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(mod, node)
+
+    def _check_class(
+        self, mod: ModuleInfo, cls: ast.ClassDef
+    ) -> Iterator[Finding]:
+        scans: dict[str, _LockScan] = {}
+        work: list[tuple[str, ast.AST]] = [
+            (item.name, item)
+            for item in cls.body
+            if isinstance(item, ast.FunctionDef)
+        ]
+        while work:
+            name, fn = work.pop()
+            s = _LockScan()
+            for stmt in fn.body:
+                s.visit(stmt)
+            scans[name] = s
+            # nested defs become pseudo-methods of their own: their
+            # lexical nesting and blocking calls are still checked,
+            # but on an empty held stack and outside the enclosing
+            # method's edge set (they are not reachable via self.m()
+            # calls, so the may-acquire closure leaves them alone)
+            for sub in s.nested:
+                work.append((f"{name}.{sub.name}", sub))
+        if not any(
+            s.acquires for s in scans.values()
+        ):
+            return
+
+        # may_acquire: method -> locks it can take, transitively
+        # through intra-class self-calls (fixpoint, GC005-style)
+        may: dict[str, set[str]] = {
+            m: {a for a, _, _ in s.acquires} for m, s in scans.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for m, s in scans.items():
+                for callee, _, _ in s.calls:
+                    if callee in may and not may[callee] <= may[m]:
+                        may[m] |= may[callee]
+                        changed = True
+
+        # edges: held -> acquired, with a witness site + route.
+        # Re-acquiring a lock the thread ALREADY holds is not a new
+        # ordering constraint (it can never block on a re-entrant
+        # lock) — it feeds the self-edge check below instead of the
+        # graph, so an RLock re-entry under other locks does not
+        # fabricate a cycle.
+        edges: dict[tuple[str, str], tuple[ast.AST, str]] = {}
+        for mname, s in scans.items():
+            for attr, held, node in s.acquires:
+                if attr in held:
+                    edges.setdefault(
+                        (attr, attr), (node, f"`{cls.name}.{mname}`")
+                    )
+                    continue
+                for h in held:
+                    edges.setdefault(
+                        (h, attr), (node, f"`{cls.name}.{mname}`")
+                    )
+            for callee, held, node in s.calls:
+                if not held or callee not in may:
+                    continue
+                route = f"`{cls.name}.{mname}` -> self.{callee}()"
+                for a in may[callee]:
+                    if a in held:
+                        edges.setdefault((a, a), (node, route))
+                        continue
+                    for h in held:
+                        edges.setdefault((h, a), (node, route))
+
+        ctor = _lock_ctor_types(cls)
+        for (src, dst), (node, route) in sorted(
+            edges.items(), key=lambda kv: (
+                getattr(kv[1][0], "lineno", 0), kv[0]
+            )
+        ):
+            if src == dst and ctor.get(src) in (
+                "Lock", "Semaphore", "BoundedSemaphore",
+            ):
+                # self-edge: deadlock iff the lock is non-reentrant
+                yield mod.finding(
+                    self.rule, node,
+                    f"`self.{src}` (a threading.{ctor[src]}, "
+                    "non-reentrant) re-acquired while already "
+                    f"held via {route} — self-deadlock",
+                )
+
+        # cycles of ANY length: strongly connected components of the
+        # (src != dst) edge graph — a pairwise reverse-edge test would
+        # miss A -> B -> C -> A (review finding). One finding per SCC,
+        # anchored at its earliest acquisition site, naming a concrete
+        # cycle.
+        adj: dict[str, set[str]] = {}
+        for (src, dst) in edges:
+            if src != dst:
+                adj.setdefault(src, set()).add(dst)
+                adj.setdefault(dst, set())
+        for scc in _sccs(adj):
+            if len(scc) < 2:
+                continue
+            scc_edges = sorted(
+                (pair for pair in edges
+                 if pair[0] in scc and pair[1] in scc
+                 and pair[0] != pair[1]),
+                key=lambda p: (
+                    getattr(edges[p][0], "lineno", 0), p
+                ),
+            )
+            src, dst = scc_edges[0]
+            node, route = edges[(src, dst)]
+            path = _path_in(adj, scc, dst, src)  # dst ... src
+            closing = []
+            for u, v in zip(path, path[1:]):
+                n2, r2 = edges[(u, v)]
+                closing.append(
+                    f"`self.{u}` -> `self.{v}` at line "
+                    f"{getattr(n2, 'lineno', '?')} ({r2})"
+                )
+            yield mod.finding(
+                self.rule, node,
+                f"lock-order cycle: `self.{src}` -> `self.{dst}` "
+                f"here ({route}), closed by "
+                + "; ".join(closing)
+                + " — threads interleaving these orders deadlock",
+            )
+
+        for mname, s in sorted(scans.items()):
+            for msg, node in s.blocking:
+                yield mod.finding(
+                    self.rule, node, f"{msg} (in `{cls.name}.{mname}`)"
+                )

@@ -1,0 +1,329 @@
+"""GC007: slot/pin lifetime — the ``native/rings.py`` caller contract.
+
+The zero-copy transports (native/transport.py, backends/process.py)
+share one resource discipline, stated in rings.py prose and broken
+twice at test time in the round-12 PR: a slot acquired from a
+:class:`~...native.rings.RingAlloc` is pinned until released, served
+bodies must keep the TRACKED object in their base chain, and a
+producer that finds every slot pinned must fall back to the copying
+transport instead of waiting on a consumer's garbage collector. Three
+statically-checkable halves:
+
+1. **All-pinned fallback.** ``<x>.acquire(...)`` (any receiver chain
+   naming an ``alloc`` — the RingAlloc convention) returns None when
+   every slot is pinned; the enclosing function must test the result
+   against None (``if got is None``, ``while ....acquire() is
+   None``). A function that uses the result unconditionally crashes —
+   or worse, blocks — exactly when the ring is saturated.
+
+2. **Release obligation.** A function that acquires must also,
+   lexically, discharge or transfer the pin: a ``.release(...)`` /
+   ``.release_holder_everywhere(...)`` call, a ``track_release(...)``
+   registration (finalizer-driven release), an ``.add_holder(...)``
+   transfer, or an escape of the slot identity out of the function —
+   into a constructed payload object (the ``ArenaPayload(self, arena,
+   slot, gen, n)`` hand-off) or a returned control marker (the
+   ``_MARK_RESULT`` tuple ``backends/process.py`` ships to the peer
+   that will ack). A path with none of these strands the slot forever
+   — visible only as ``ring_stalls`` creep in production.
+
+3. **Base-chain integrity.** A view handed to ``track_release`` is
+   released when the LAST derived buffer dies — but
+   ``np.frombuffer(ndarray)`` keeps only the root buffer in its base
+   chain, silently dropping the intermediate (tracked) slice, so the
+   finalizer fires while the re-wrapped view is still alive (the
+   exact PR 7 serving bug). After ``track_release(v, ...)``, ``v``
+   may escape ONLY wrapped as ``memoryview(v)`` (whose managed buffer
+   holds the slice strongly); a bare ``v`` in a return, container,
+   ``body=`` kwarg or non-memoryview call is flagged, as is any
+   ``np.frombuffer(x)`` whose argument is a derived-ndarray name
+   (assigned from a slice of another ``frombuffer`` result).
+
+Scope cuts: per-function, lexical (a helper releasing on its caller's
+behalf should take the pin via ``add_holder``/constructor escape —
+both recognized); attribute READS of a tracked view (``v.nbytes``)
+are not escapes; test modules (``test_*.py``) are exempt — they
+deliberately exercise saturated and leaked states.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator
+
+from ..core import Checker, Finding, ModuleInfo, dotted_path, register
+
+_RELEASERS = {"release", "release_holder_everywhere", "add_holder"}
+
+
+def _is_alloc_acquire(node: ast.Call) -> bool:
+    """``<chain>.acquire(...)`` where the receiver chain names an
+    allocator (an ``alloc`` component or ``*alloc`` suffix)."""
+    if not (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr == "acquire"
+    ):
+        return False
+    parts: list[str] = []
+    cur = node.func.value
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    return any(p == "alloc" or p.endswith("alloc") for p in parts)
+
+
+def _is_track_release(node: ast.Call) -> bool:
+    path = dotted_path(node.func)
+    return path is not None and path[-1] == "track_release"
+
+
+def _compares_none(node: ast.Compare, name: str | None = None) -> bool:
+    if not (
+        len(node.ops) == 1
+        and isinstance(node.ops[0], (ast.Is, ast.IsNot))
+        and isinstance(node.comparators[0], ast.Constant)
+        and node.comparators[0].value is None
+    ):
+        return False
+    if name is None:
+        return True
+    return isinstance(node.left, ast.Name) and node.left.id == name
+
+
+@register
+class SlotLifetime(Checker):
+    rule = "GC007"
+    name = "slot-lifetime"
+    description = (
+        "RingAlloc discipline: acquire() results are None-checked "
+        "(all-pinned fallback), every acquiring function releases or "
+        "registers/transfers the pin, and track_release'd views "
+        "escape only as memoryview(view) — np.frombuffer over a "
+        "derived ndarray drops the tracked object from the base chain"
+    )
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if os.path.basename(mod.path).startswith("test_"):
+            return  # tests exercise saturated/leaked states on purpose
+        # token gate: every finding this rule can produce needs one of
+        # these spellings in the source — skip the per-function AST
+        # walks on the (vast) majority of modules without them
+        if not any(
+            t in mod.source
+            for t in ("acquire", "track_release", "frombuffer")
+        ):
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(mod, node)
+
+    # -- per function -----------------------------------------------------
+    def _check_fn(
+        self, mod: ModuleInfo, fn: ast.AST
+    ) -> Iterator[Finding]:
+        # this function's own nodes, nested defs excluded (they get
+        # their own visit), with parent links for context checks
+        nodes: list[ast.AST] = []
+        parent: dict[ast.AST, ast.AST] = {}
+        stack: list[ast.AST] = list(getattr(fn, "body", []))
+        while stack:
+            cur = stack.pop()
+            nodes.append(cur)
+            for child in ast.iter_child_nodes(cur):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                parent[child] = cur
+                stack.append(child)
+        nodes.sort(key=lambda n: (getattr(n, "lineno", 0),
+                                  getattr(n, "col_offset", 0)))
+
+        acquires: list[tuple[ast.Call, str | None]] = []
+        releases = False
+        escapes_ctor = False
+        tracked_at: dict[str, int] = {}  # name -> first track lineno
+        frombuffer_calls: list[ast.Call] = []
+        derived: set[str] = set()
+        acquire_names: set[str] = set()
+
+        def is_frombuffer_expr(expr: ast.expr) -> bool:
+            if isinstance(expr, ast.Subscript):
+                return is_frombuffer_expr(expr.value)
+            if isinstance(expr, ast.Call):
+                p = dotted_path(expr.func)
+                return p is not None and p[-1] == "frombuffer"
+            return False
+
+        for node in nodes:
+            if isinstance(node, ast.Assign):
+                if is_frombuffer_expr(node.value) or (
+                    isinstance(node.value, ast.Subscript)
+                    and any(
+                        isinstance(n, ast.Name) and n.id in derived
+                        for n in ast.walk(node.value)
+                    )
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            derived.add(t.id)
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_track_release(node):
+                releases = True
+                if node.args and isinstance(node.args[0], ast.Name):
+                    tracked_at.setdefault(
+                        node.args[0].id, node.lineno
+                    )
+                continue
+            if _is_alloc_acquire(node):
+                tname = None
+                par = parent.get(node)
+                if (
+                    isinstance(par, ast.Assign)
+                    and len(par.targets) == 1
+                    and isinstance(par.targets[0], ast.Name)
+                ):
+                    tname = par.targets[0].id
+                    acquire_names.add(tname)
+                elif isinstance(par, ast.NamedExpr) and isinstance(
+                    par.target, ast.Name
+                ):
+                    # `while (got := alloc.acquire(...)) is None:`
+                    tname = par.target.id
+                    acquire_names.add(tname)
+                acquires.append((node, tname))
+                continue
+            path = dotted_path(node.func)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _RELEASERS
+            ):
+                releases = True
+            if path is not None and path[-1] == "frombuffer":
+                frombuffer_calls.append(node)
+            # constructor escape: the slot handed to a payload class —
+            # CapitalizedName(...) with an acquire-derived name (or the
+            # conventional `slot`/`gen` unpack) among its args
+            if (
+                path is not None
+                and path[-1][:1].isupper()
+                and any(
+                    isinstance(a, ast.Name)
+                    and a.id in acquire_names | {"slot", "gen"}
+                    for a in node.args
+                )
+            ):
+                escapes_ctor = True
+
+        # return escape: the slot identity leaves the function (a
+        # control marker the peer acks) — the pin obligation transfers
+        # with it
+        for node in nodes:
+            if isinstance(node, ast.Return) and node.value is not None:
+                if any(
+                    isinstance(n, ast.Name)
+                    and n.id in acquire_names | {"slot", "gen"}
+                    for n in ast.walk(node.value)
+                ):
+                    escapes_ctor = True
+
+        # 1 + 2: acquire discipline
+        for call, tname in acquires:
+            par = parent.get(call)
+            if isinstance(par, ast.NamedExpr):
+                par = parent.get(par)  # the walrus sits inside the test
+            checked = isinstance(par, ast.Compare) and _compares_none(
+                par
+            )
+            if not checked and tname is not None:
+                checked = any(
+                    isinstance(n, ast.Compare)
+                    and _compares_none(n, tname)
+                    for n in nodes
+                )
+            if not checked:
+                yield mod.finding(
+                    self.rule, call,
+                    "`.acquire(...)` result never tested against None "
+                    "— when every slot is pinned the allocator returns "
+                    "None and this path must fall back to the copying "
+                    "transport, not crash or wait on the consumer's GC",
+                )
+            if not (releases or escapes_ctor):
+                yield mod.finding(
+                    self.rule, call,
+                    "allocation path neither releases nor registers: "
+                    "no `.release(...)`/`.add_holder(...)` call, no "
+                    "`track_release(...)` registration, and the slot "
+                    "never escapes into a payload object — an error "
+                    "path here pins the slot forever",
+                )
+
+        # 3: tracked views escape only as memoryview(view)
+        for node in nodes:
+            if not (
+                isinstance(node, ast.Name)
+                and node.id in tracked_at
+                and node.lineno > tracked_at[node.id]
+            ):
+                continue
+            par = parent.get(node)
+            if isinstance(par, ast.Attribute):
+                continue  # reads (v.nbytes) don't extend lifetime
+            if isinstance(par, ast.Call):
+                if _is_track_release(par):
+                    continue
+                path = dotted_path(par.func)
+                if path is not None and path[-1] == "memoryview":
+                    continue
+                if path is not None and path[-1] == "frombuffer":
+                    yield mod.finding(
+                        self.rule, node,
+                        f"`np.frombuffer({node.id})` re-wraps the "
+                        "tracked slice: frombuffer keeps only the ROOT "
+                        "buffer in the base chain, so the release "
+                        "finalizer fires while this view is still "
+                        f"alive — serve `memoryview({node.id})`",
+                    )
+                    continue
+                yield mod.finding(
+                    self.rule, node,
+                    f"tracked view `{node.id}` escapes bare into "
+                    f"`{'.'.join(path) if path else '<call>'}(...)` — "
+                    "a consumer re-wrapping it drops it from the base "
+                    "chain and the slot recycles under a live view; "
+                    f"escape only as `memoryview({node.id})`",
+                )
+            elif isinstance(
+                par,
+                (ast.Return, ast.Tuple, ast.List, ast.Dict,
+                 ast.keyword, ast.Assign, ast.Yield, ast.Starred),
+            ):
+                yield mod.finding(
+                    self.rule, node,
+                    f"tracked view `{node.id}` escapes bare "
+                    f"({type(par).__name__.lower()}) after "
+                    "track_release — the served body must be "
+                    f"`memoryview({node.id})` so every derived buffer "
+                    "holds the tracked slice",
+                )
+
+        # derived-ndarray frombuffer, independent of tracking
+        for call in frombuffer_calls:
+            if (
+                call.args
+                and isinstance(call.args[0], ast.Name)
+                and call.args[0].id in derived
+                and call.args[0].id not in tracked_at
+            ):
+                yield mod.finding(
+                    self.rule, call,
+                    f"`np.frombuffer({call.args[0].id})` over a "
+                    "derived ndarray: the base chain keeps only the "
+                    "root buffer, dropping the intermediate slice any "
+                    "finalizer or keep-window pin is registered on",
+                )

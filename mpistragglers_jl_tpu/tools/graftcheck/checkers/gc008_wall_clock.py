@@ -1,0 +1,311 @@
+"""GC008: wall-clock discipline — virtual time stays virtual, and
+tests never assert sub-second wall-clock margins.
+
+Four consecutive PRs each hand-deflaked a timing-margin test (the
+0.25 s -> 1.5 s straggler-margin creep chronicled in sim/clock.py's
+docstring); PR 5's fix was structural — re-root the claim on
+:class:`~...sim.clock.VirtualClock`, where it is EXACT. This checker
+pins both halves of that fix so the family cannot regrow:
+
+1. **sim purity.** Modules under a ``sim`` package component (the
+   virtual-time plane and any future hermetic sim tree) must not
+   touch the OS clock at all: ``time.time`` / ``time.perf_counter``
+   / ``time.monotonic`` / ``time.sleep`` (any import alias),
+   ``from time import ...`` of those names, and ``datetime.now`` are
+   flagged at each use site. Virtual time that secretly reads the
+   wall clock is non-reproducible in exactly the way sim/ exists to
+   prevent.
+
+2. **sleep-margin assertions.** In any module, an ``assert`` that
+   compares a wall-clock-derived quantity against a sub-second
+   numeric literal (``assert perf_counter() - t0 < 0.04``, ``assert
+   np.median(errs) < 5e-3`` where ``errs`` accumulated clock deltas)
+   is the recurring flake family: it races the OS scheduler on every
+   loaded CI box. Taint starts at clock calls, propagates through
+   assignments and ``x.append(...)``, and the lint fires when a
+   tainted expression is compared against a constant ``0 < |C| < 1``.
+   Margins of a second or more (gross-failure ceilings) and
+   relative comparisons (``guard_s <= 0.05 * tick_s``) pass.
+
+**The sanctioned escape — ``# graftcheck: real-smoke``.** Each flake
+family keeps ONE real-thread smoke test; marking the test function
+(on the ``def`` line, a decorator line, or the line directly above)
+exempts the whole function from both halves. The marker is a
+declaration reviewers can grep, unlike an ad-hoc ``disable=`` per
+assert. Line-level ``# graftcheck: disable=GC008`` still works for
+single sites.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..core import Checker, Finding, ModuleInfo, dotted_path, register
+
+REAL_SMOKE_MARKER = "# graftcheck: real-smoke"
+
+_MARKER_RE = re.compile(r"#\s*graftcheck:\s*real-smoke")
+
+#: attribute names that read the OS clock regardless of import alias
+_CLOCK_ATTRS = {"perf_counter", "monotonic"}
+
+#: exact dotted suffixes that read or spend wall time
+_WALL_SUFFIXES = {
+    ("time", "time"),
+    ("time", "sleep"),
+    ("time", "perf_counter"),
+    ("time", "monotonic"),
+    ("time", "perf_counter_ns"),
+    ("time", "monotonic_ns"),
+    ("datetime", "now"),
+}
+
+#: `from time import X` names, and the members matched through any
+#: module alias
+_TIME_MEMBERS = _FROM_TIME_NAMES = frozenset({
+    "time", "sleep", "perf_counter", "monotonic", "perf_counter_ns",
+    "monotonic_ns",
+})
+
+
+# alias-proof matching (review finding: `import time as t;
+# t.sleep(...)` evaded the literal suffix match): check_module collects
+# every name the module binds to the time module and hands it down as
+# `time_aliases`
+
+
+def _is_wall_path(
+    path: tuple[str, ...], time_aliases: set[str] = frozenset()
+) -> bool:
+    if path[-1] in _CLOCK_ATTRS:
+        return True
+    if len(path) >= 2 and tuple(path[-2:]) in _WALL_SUFFIXES:
+        return True
+    return (
+        len(path) == 2
+        and path[0] in time_aliases
+        and path[1] in _TIME_MEMBERS
+    )
+
+
+def _contains_clock_call(
+    expr: ast.expr, time_aliases: set[str] = frozenset()
+) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            path = dotted_path(node.func)
+            if path is None:
+                continue
+            if len(path) >= 2 and _is_wall_path(path, time_aliases):
+                return True
+            # `from time import perf_counter` style bare calls: the
+            # clock names are distinctive enough to match unqualified
+            if len(path) == 1 and path[0] in (
+                _CLOCK_ATTRS | {"perf_counter_ns", "monotonic_ns"}
+            ):
+                return True
+    return False
+
+
+def _marked_real_smoke(mod: ModuleInfo, fn: ast.AST) -> bool:
+    """Marker on the def line, any decorator line, or the line
+    directly above the first of those."""
+    start = getattr(fn, "lineno", 1)
+    for dec in getattr(fn, "decorator_list", []):
+        start = min(start, dec.lineno)
+    first_stmt = fn.body[0].lineno if getattr(fn, "body", None) else (
+        getattr(fn, "lineno", 1)
+    )
+    lo = max(start - 1, 1)
+    hi = min(first_stmt - 1, len(mod.lines))
+    hi = max(hi, min(getattr(fn, "lineno", 1), len(mod.lines)))
+    return any(
+        _MARKER_RE.search(mod.lines[ln - 1]) for ln in range(lo, hi + 1)
+    )
+
+
+def _is_sim_module(mod: ModuleInfo) -> bool:
+    """The virtual-time plane: any ``sim`` package component, plus the
+    ``test_sim*`` virtual-time test family."""
+    parts = mod.name.split(".")
+    return "sim" in parts or any(
+        p.startswith("test_sim") for p in parts
+    )
+
+
+@register
+class WallClock(Checker):
+    rule = "GC008"
+    name = "wall-clock"
+    description = (
+        "sim-package modules never read the OS clock "
+        "(time.time/perf_counter/monotonic/sleep, datetime.now); no "
+        "assert compares a wall-clock-derived value against a "
+        "sub-second margin — port the claim to "
+        "SimBackend/VirtualClock or mark the one sanctioned "
+        "real-thread test per family `# graftcheck: real-smoke`"
+    )
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        sim = _is_sim_module(mod)
+        # token gate: every clock spelling this rule can flag reaches
+        # the clock through a `time`/`datetime` import, so a module
+        # whose SOURCE never says "time" cannot produce a finding —
+        # skip the AST walks entirely (the scan is dominated by this
+        # checker without the gate). Sim modules stay un-gated: they
+        # are few, and purity is their whole contract.
+        if not sim and "time" not in mod.source:
+            return
+        # ONE tree walk collects everything module-shaped: the
+        # functions, the real-smoke-exempt ranges, and the time-module
+        # aliases (this checker dominates the scan's cost; the walks
+        # are the cost)
+        functions: list[ast.AST] = []
+        aliases: set[str] = set()
+        exempt: list[tuple[int, int]] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                functions.append(node)
+                if _marked_real_smoke(mod, node):
+                    exempt.append(
+                        (node.lineno,
+                         getattr(node, "end_lineno", node.lineno))
+                    )
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        aliases.add(a.asname or "time")
+
+        def exempted(node: ast.AST) -> bool:
+            ln = getattr(node, "lineno", 0)
+            return any(a <= ln <= b for a, b in exempt)
+
+        if sim:
+            yield from (
+                f for f in self._check_sim_purity(mod, aliases)
+                if not exempted_line(f, exempt)
+            )
+        for fn in functions:
+            if exempted(fn):
+                continue
+            yield from self._check_margins(mod, fn, aliases)
+
+    # -- half 1: sim purity ----------------------------------------------
+    def _check_sim_purity(
+        self, mod: ModuleInfo, aliases: set[str]
+    ) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module == "time" and any(
+                    a.name in _FROM_TIME_NAMES for a in node.names
+                ):
+                    yield mod.finding(
+                        self.rule, node,
+                        "sim module imports OS-clock names from "
+                        "`time` — virtual time must not read the "
+                        "wall clock (sim/clock.py is the only clock)",
+                    )
+            elif isinstance(node, ast.Attribute):
+                path = dotted_path(node)
+                if path is not None and len(path) >= 2 and (
+                    _is_wall_path(path, aliases)
+                ):
+                    yield mod.finding(
+                        self.rule, node,
+                        f"`{'.'.join(path)}` in a sim module — the "
+                        "virtual-time plane must stay wall-clock-free "
+                        "(bit-reproducibility is the whole contract); "
+                        "take the VirtualClock instead",
+                    )
+
+    # -- half 2: sub-second margin asserts --------------------------------
+    def _check_margins(
+        self, mod: ModuleInfo, fn: ast.AST, aliases: set[str]
+    ) -> Iterator[Finding]:
+        tainted: set[str] = set()
+
+        def taints(expr: ast.expr) -> bool:
+            if _contains_clock_call(expr, aliases):
+                return True
+            return any(
+                isinstance(n, ast.Name) and n.id in tainted
+                for n in ast.walk(expr)
+            )
+
+        # straight-line taint pass over this function's own statements
+        # (source order; nested defs excluded — they are visited on
+        # their own and rarely share locals)
+        stmts: list[ast.stmt] = []
+        stack: list[ast.AST] = list(fn.body)
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(cur, ast.stmt):
+                stmts.append(cur)
+            for child in ast.iter_child_nodes(cur):
+                stack.append(child)
+        stmts.sort(key=lambda n: (n.lineno, n.col_offset))
+
+        asserts: list[ast.Assert] = []
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign) and taints(stmt.value):
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+            elif isinstance(stmt, ast.AugAssign) and taints(stmt.value):
+                if isinstance(stmt.target, ast.Name):
+                    tainted.add(stmt.target.id)
+            elif isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Call
+            ):
+                # errs.append(<tainted>) taints errs
+                call = stmt.value
+                if (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr in ("append", "extend", "add")
+                    and isinstance(call.func.value, ast.Name)
+                    and any(taints(a) for a in call.args)
+                ):
+                    tainted.add(call.func.value.id)
+            elif isinstance(stmt, ast.Assert):
+                asserts.append(stmt)
+
+        for stmt in asserts:
+            test = stmt.test
+            if not isinstance(test, ast.Compare):
+                continue
+            sides = [test.left] + list(test.comparators)
+            margins = [
+                s.value for s in sides
+                if isinstance(s, ast.Constant)
+                and isinstance(s.value, (int, float))
+                and not isinstance(s.value, bool)
+                and 0 < abs(s.value) < 1.0
+            ]
+            if not margins:
+                continue
+            if any(
+                taints(s) for s in sides
+                if not isinstance(s, ast.Constant)
+            ):
+                yield mod.finding(
+                    self.rule, stmt,
+                    f"asserts a sub-second wall-clock margin "
+                    f"({margins[0]!r}) — the recurring flake family: "
+                    "every loaded CI box races this; port the claim "
+                    "onto SimBackend/VirtualClock where it is exact, "
+                    "or mark the function's one sanctioned real-"
+                    "thread smoke `# graftcheck: real-smoke`",
+                )
+
+
+def exempted_line(
+    f, exempt: list[tuple[int, int]]
+) -> bool:
+    return any(a <= f.line <= b for a, b in exempt)

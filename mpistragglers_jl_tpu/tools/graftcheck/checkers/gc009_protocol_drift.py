@@ -1,0 +1,313 @@
+"""GC009: cross-language protocol drift — transport.py vs transport.cpp.
+
+The wire protocol lives twice: ``native/transport.cpp`` defines the
+``constexpr`` kind constants and the exported ``msgt_*`` C ABI, and
+``native/transport.py`` re-states both — the ``KIND_*`` table and the
+ctypes ``argtypes``/``restype`` declarations in ``_configure``. A
+mismatch is silent memory corruption (a 32-bit int marshalled into a
+64-bit parameter reads a neighbor's stack slot), detectable only under
+the TSAN/ASan harness IF the drifted path happens to execute there.
+This checker diffs the two statements of the protocol on every run:
+
+* **Kind constants.** Every ``constexpr … KIND_X = n`` in the .cpp
+  must appear in the .py with the same value, and vice versa — except
+  ``KIND_ARENA`` / ``KIND_RING`` / ``KIND_ACK`` (6-8), which are
+  Python-internal: the native layer never special-cases them (they
+  resolve to ``KIND_DATA`` messages with out-of-band bodies), so they
+  legitimately have no C++ twin — but their values must not collide
+  with any C++-defined kind, or a wire frame would alias a
+  transport-internal meaning.
+* **ABI signatures.** For every exported ``msgt_*`` function: the .py
+  must configure it, the arity must match, the return type must
+  match by width (``void``/``int``/``int64_t``/pointers), and each
+  parameter must match by width class — ``int`` only ``c_int``,
+  ``int64_t`` only ``c_int64``, any C pointer any ctypes pointer
+  flavor (``c_void_p``/``c_char_p``/``POINTER(...)`` are equally
+  valid marshals, chosen per call site for copy-avoidance — see the
+  isend2 comment in transport.py). A .py-configured function the
+  .cpp no longer exports is equally a finding (it would segfault at
+  first call).
+
+Project-wide checker (never cached — its verdict depends on a .cpp
+the per-file sha cache cannot key) that activates for any scanned
+module named ``transport.py`` with a sibling ``transport.cpp``;
+findings anchor at the Python line that disagrees, since the .py is
+the statement the analyzer can point into.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from ..core import Checker, Finding, ModuleInfo, dotted_path, register
+
+#: kinds the Python layer defines with no C++ twin by design
+PY_INTERNAL_KINDS = {"KIND_ARENA", "KIND_RING", "KIND_ACK"}
+
+_CPP_KIND_RE = re.compile(
+    r"^\s*constexpr\s+[\w:]+\s+(KIND_\w+)\s*=\s*(\d+)\s*;",
+    re.M,
+)
+
+# an exported function: return type + msgt_ name + parenthesized
+# params + opening brace (params may span lines)
+_CPP_FN_RE = re.compile(
+    r"^\s*((?:const\s+)?[\w:]+\s*\*?)\s+(msgt_\w+)\s*\(([^)]*)\)\s*\{",
+    re.M | re.S,
+)
+
+# width classes
+_VOID, _I32, _I64, _PTR = "void", "int32", "int64", "ptr"
+
+
+def _cpp_type_class(t: str) -> str:
+    t = re.sub(r"\bconst\b", "", t).strip()
+    if t.endswith("*"):
+        return _PTR
+    t = t.strip()
+    if t == "void":
+        return _VOID
+    if t in ("int64_t", "uint64_t", "size_t", "ssize_t", "long"):
+        return _I64
+    return _I32  # int, int32_t, uint32_t, char, bool...
+
+
+def _parse_cpp(text: str):
+    kinds = {
+        m.group(1): int(m.group(2))
+        for m in _CPP_KIND_RE.finditer(text)
+    }
+    fns: dict[str, tuple[str, list[str]]] = {}
+    for m in _CPP_FN_RE.finditer(text):
+        ret, name, params = m.groups()
+        params = params.strip()
+        if params in ("", "void"):
+            args: list[str] = []
+        else:
+            args = []
+            for p in params.split(","):
+                p = p.strip()
+                # strip the parameter name: the type is everything up
+                # to the last identifier (pointers bind to the type)
+                pm = re.match(r"(.*?)(\w+)\s*$", p, re.S)
+                args.append(
+                    _cpp_type_class(pm.group(1) if pm else p)
+                )
+        fns[name] = (_cpp_type_class(ret), args)
+    return kinds, fns
+
+
+def _ctypes_class(expr: ast.expr) -> str | None:
+    """Width class of a ctypes argtype/restype expression, or None
+    for shapes this checker does not model."""
+    if isinstance(expr, ast.Constant) and expr.value is None:
+        return _VOID
+    path = dotted_path(expr)
+    if path is not None:
+        leaf = path[-1]
+        if leaf in ("c_void_p", "c_char_p", "c_wchar_p"):
+            return _PTR
+        if leaf in ("c_int64", "c_uint64", "c_longlong",
+                    "c_ulonglong", "c_ssize_t", "c_size_t"):
+            return _I64
+        if leaf in ("c_int", "c_uint", "c_int32", "c_uint32",
+                    "c_bool"):
+            return _I32
+        return None
+    if isinstance(expr, ast.Call):
+        cpath = dotted_path(expr.func)
+        if cpath is not None and cpath[-1] in ("POINTER", "byref"):
+            return _PTR
+    return None
+
+
+class _PyConfig:
+    """argtypes/restype statements harvested from ``_configure``."""
+
+    def __init__(self) -> None:
+        # name -> ("argtypes"|"restype", node, parsed)
+        self.argtypes: dict[str, tuple[ast.AST, list[str | None]]] = {}
+        self.restype: dict[str, tuple[ast.AST, str | None]] = {}
+
+
+def _parse_py(tree: ast.Module):
+    kinds: dict[str, tuple[int, ast.AST]] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id.startswith("KIND_")
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, int)
+        ):
+            kinds[node.targets[0].id] = (node.value.value, node)
+    cfg = _PyConfig()
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Attribute)
+        ):
+            continue
+        target = node.targets[0]
+        field = target.attr
+        if field not in ("argtypes", "restype"):
+            continue
+        fpath = dotted_path(target.value)
+        if fpath is None or not fpath[-1].startswith("msgt_"):
+            continue
+        name = fpath[-1]
+        if field == "restype":
+            cfg.restype[name] = (node, _ctypes_class(node.value))
+        elif isinstance(node.value, (ast.List, ast.Tuple)):
+            cfg.argtypes[name] = (
+                node, [_ctypes_class(e) for e in node.value.elts]
+            )
+    return kinds, cfg
+
+
+@register
+class ProtocolDrift(Checker):
+    rule = "GC009"
+    name = "protocol-drift"
+    description = (
+        "transport.py's KIND_* table and ctypes argtypes/restype "
+        "declarations match transport.cpp's constexpr constants and "
+        "exported msgt_* signatures (KIND_ARENA/KIND_RING/KIND_ACK "
+        "are Python-internal and must merely not collide)"
+    )
+    project = True  # reads a sibling .cpp the per-file cache can't key
+
+    def check_project(
+        self, mods: list[ModuleInfo]
+    ) -> Iterator[Finding]:
+        for mod in mods:
+            if os.path.basename(mod.path) != "transport.py":
+                continue
+            cpp_path = os.path.join(
+                os.path.dirname(mod.path), "transport.cpp"
+            )
+            if not os.path.exists(cpp_path):
+                continue
+            with open(cpp_path, "r", encoding="utf-8") as f:
+                cpp_text = f.read()
+            yield from self._diff(mod, cpp_text)
+
+    def _diff(
+        self, mod: ModuleInfo, cpp_text: str
+    ) -> Iterator[Finding]:
+        cpp_kinds, cpp_fns = _parse_cpp(cpp_text)
+        py_kinds, cfg = _parse_py(mod.tree)
+
+        # -- kind constants ------------------------------------------------
+        for name, value in sorted(cpp_kinds.items()):
+            if name not in py_kinds:
+                yield mod.finding(
+                    self.rule, mod.tree,
+                    f"transport.cpp defines {name} = {value} but "
+                    "transport.py has no such constant — the Python "
+                    "layer cannot recognize this wire kind",
+                )
+            elif py_kinds[name][0] != value:
+                yield mod.finding(
+                    self.rule, py_kinds[name][1],
+                    f"{name} drifted: transport.py says "
+                    f"{py_kinds[name][0]}, transport.cpp says {value} "
+                    "— frames of this kind will be misrouted",
+                )
+        cpp_values = {v: k for k, v in cpp_kinds.items()}
+        for name, (value, node) in sorted(py_kinds.items()):
+            if name in cpp_kinds:
+                continue
+            if name not in PY_INTERNAL_KINDS:
+                yield mod.finding(
+                    self.rule, node,
+                    f"{name} = {value} exists only in transport.py — "
+                    "either add the constexpr twin to transport.cpp "
+                    "or document it as Python-internal "
+                    "(KIND_ARENA/KIND_RING/KIND_ACK are the current "
+                    "set)",
+                )
+            elif value in cpp_values:
+                yield mod.finding(
+                    self.rule, node,
+                    f"Python-internal {name} = {value} collides with "
+                    f"transport.cpp's {cpp_values[value]} = {value} — "
+                    "internal kinds must not alias wire kinds",
+                )
+
+        # -- ABI signatures ------------------------------------------------
+        for name, (ret_cls, arg_cls) in sorted(cpp_fns.items()):
+            if name not in cfg.argtypes and name not in cfg.restype:
+                yield mod.finding(
+                    self.rule, mod.tree,
+                    f"transport.cpp exports `{name}` but _configure "
+                    "declares neither argtypes nor restype for it — "
+                    "an unconfigured call marshals everything as "
+                    "c_int and truncates 64-bit arguments",
+                )
+                continue
+            if name in cfg.restype:
+                node, py_ret = cfg.restype[name]
+                if py_ret is not None and py_ret != ret_cls:
+                    yield mod.finding(
+                        self.rule, node,
+                        f"`{name}` restype drifted: transport.py "
+                        f"declares {py_ret}, transport.cpp returns "
+                        f"{ret_cls}",
+                    )
+            elif ret_cls in (_I64, _PTR):
+                # argtypes configured but restype forgotten: ctypes
+                # defaults the return to c_int, silently truncating a
+                # 64-bit value / pointer — the drift class this rule
+                # exists to catch (review finding)
+                yield mod.finding(
+                    self.rule, cfg.argtypes[name][0],
+                    f"`{name}` declares argtypes but no restype: "
+                    f"transport.cpp returns {ret_cls} and ctypes "
+                    "defaults the return to c_int — the high half is "
+                    "silently truncated",
+                )
+            if name in cfg.argtypes:
+                node, py_args = cfg.argtypes[name]
+                if len(py_args) != len(arg_cls):
+                    yield mod.finding(
+                        self.rule, node,
+                        f"`{name}` arity drifted: transport.py "
+                        f"declares {len(py_args)} argtypes, "
+                        f"transport.cpp takes {len(arg_cls)} "
+                        "parameters",
+                    )
+                    continue
+                for i, (py_a, cpp_a) in enumerate(
+                    zip(py_args, arg_cls)
+                ):
+                    if py_a is None:
+                        continue  # unmodeled ctypes shape
+                    if py_a != cpp_a:
+                        yield mod.finding(
+                            self.rule, node,
+                            f"`{name}` argument {i} drifted: "
+                            f"transport.py marshals {py_a}, "
+                            f"transport.cpp expects {cpp_a} — a "
+                            "width mismatch reads a neighbor's "
+                            "stack slot",
+                        )
+        for name in sorted(
+            set(cfg.argtypes) | set(cfg.restype)
+        ):
+            if name not in cpp_fns:
+                node = (
+                    cfg.argtypes.get(name) or cfg.restype.get(name)
+                )[0]
+                yield mod.finding(
+                    self.rule, node,
+                    f"_configure declares `{name}` but transport.cpp "
+                    "exports no such function — the first call "
+                    "raises AttributeError (or segfaults on a stale "
+                    ".so)",
+                )

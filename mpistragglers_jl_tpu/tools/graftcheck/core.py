@@ -209,9 +209,18 @@ def load_modules(paths: Iterable[str]) -> list[ModuleInfo]:
         else:
             files = []
             for dirpath, dirnames, filenames in os.walk(top):
+                # a directory holding a `.graftcheck-skip` marker file
+                # is pruned from RECURSIVE scans (the fixture corpus of
+                # deliberately-bad files under tests/); naming it as an
+                # explicit scan root still analyzes it — the fixture
+                # tests do exactly that
                 dirnames[:] = sorted(
                     d for d in dirnames
-                    if d != "__pycache__" and not d.startswith(".")
+                    if d != "__pycache__"
+                    and not d.startswith(".")
+                    and not os.path.exists(
+                        os.path.join(dirpath, d, ".graftcheck-skip")
+                    )
                 )
                 files += [
                     os.path.join(dirpath, f)
@@ -485,6 +494,12 @@ class _Cache:
             return
         tmp = self.path + ".tmp"
         try:
+            # a cache path in a not-yet-existing directory (CI hands us
+            # `.graftcheck-cache/pkg.json` before any run has created
+            # it) must create the directory, not silently never persist
+            parent = os.path.dirname(self.path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(
                     {"fingerprint": self.fingerprint,

@@ -1,0 +1,73 @@
+"""GC006 bad fixture: a lock-order cycle (one lexical, one through an
+intra-class call), a non-reentrant self-re-acquisition, and three
+blocking-under-lock shapes. Violation lines pinned by the fixture
+test."""
+
+import pickle
+import threading
+import time
+
+
+class Pump:
+    def __init__(self, conn, cond):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._conn = conn
+        self._cond = cond
+
+    def forward(self):
+        with self._a:
+            with self._b:  # GC006 line 20: a->b, but reap takes b->a
+                return self._drain()  # GC006 line 21: _a is a Lock
+                # (non-reentrant) and _drain re-acquires it while held
+
+    def reap(self):
+        with self._b:
+            self._take_a()  # the b->a edge rides the call graph
+
+    def _take_a(self):
+        with self._a:
+            pass
+
+    def _drain(self):
+        with self._a:
+            return None
+
+    def pull(self):
+        with self._a:
+            return self._conn.recv()  # GC006 line 38: recv under lock
+
+    def park(self):
+        with self._b:
+            self._cond.wait()  # GC006 line 42: wait with no timeout
+
+    def snapshot(self, obj):
+        with self._b:
+            data = pickle.dumps(obj)  # GC006 line 46: pickle under lock
+            time.sleep(0.01)  # GC006 line 47: sleep under lock
+        return data
+
+
+class ThreeWay:
+    """A 3-lock cycle no pairwise reverse-edge test can see: a->b,
+    b->c, c->a — three threads interleaving these deadlock."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:  # GC006 line 62: the a->b leg of a 3-cycle
+                pass
+
+    def bc(self):
+        with self._b:
+            with self._c:
+                pass
+
+    def ca(self):
+        with self._c:
+            with self._a:
+                pass

@@ -1,0 +1,73 @@
+"""GC006 good fixture: the same shapes, disciplined — one global lock
+order (always ``_a`` before ``_b``), the re-acquired lock is an RLock,
+and every blocking call happens outside the critical section (or
+carries a timeout)."""
+
+import pickle
+import threading
+import time
+
+
+class Pump:
+    def __init__(self, conn, cond):
+        self._a = threading.RLock()
+        self._b = threading.Lock()
+        self._conn = conn
+        self._cond = cond
+
+    def forward(self):
+        with self._a:
+            with self._b:  # a -> b, the one sanctioned order
+                return self._drain()
+
+    def reap(self):
+        with self._a:
+            with self._b:  # same order as forward: no cycle
+                pass
+
+    def _drain(self):
+        with self._a:  # RLock: re-entry from forward is legal
+            return None
+
+    def pull(self):
+        with self._a:
+            pending = True
+        if pending:
+            return self._conn.recv()  # blocking AFTER the lock drops
+
+    def park(self):
+        with self._b:
+            self._cond.wait(timeout=1.0)  # bounded: a missed notify
+            # surfaces as a timeout, not a hang
+
+    def snapshot(self, obj):
+        data = pickle.dumps(obj)  # serialize outside the lock
+        time.sleep(0.01)
+        with self._b:
+            self._last = data
+        return data
+
+
+class Spawner:
+    """Thread-entry closure: `worker` runs on its OWN thread holding
+    nothing, so its `_b` acquisition must not merge into `start`'s
+    held stack — merging would fabricate an a->b edge and, with
+    `reorder`'s b->a, a phantom cycle."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def start(self):
+        with self._a:
+            def worker():
+                with self._b:
+                    pass
+            t = threading.Thread(target=worker)
+            t.start()
+            return t
+
+    def reorder(self):
+        with self._b:
+            with self._a:  # b->a: a cycle only if start really did a->b
+                pass

@@ -1,0 +1,52 @@
+"""GC007 bad fixture: every slot-lifetime violation shape — an
+unchecked acquire, a leaked pin, a tracked view escaping bare, a
+frombuffer re-wrap of a tracked view, and a frombuffer over a derived
+ndarray. Violation lines pinned by the fixture test."""
+
+import numpy as np
+
+from . import track_release  # fixture stub; never imported at check time
+
+
+class Producer:
+    def __init__(self, ring):
+        self.ring = ring
+
+    def stage_unchecked(self, u8):
+        slot, gen = self.ring.alloc.acquire(("coord",))  # GC007: no
+        # None check — crashes exactly when every slot is pinned
+        self.ring.view[slot:slot + u8.nbytes] = u8
+        self.ring.alloc.release(slot, gen, "coord")
+        return slot
+
+    def stage_leaky(self, u8):
+        got = self.ring.alloc.acquire(("coord",))  # GC007: no release,
+        # no registration, no escape — the slot pins forever
+        if got is None:
+            return False
+        self.ring.view[0:u8.nbytes] = u8
+        return True
+
+
+class Server:
+    def __init__(self, mm, ring):
+        self.mm = mm
+        self.ring = ring
+
+    def serve_bare(self, slot, gen, blen):
+        view = np.frombuffer(self.mm, np.uint8)[:blen]
+        track_release(view, self.ring.alloc.release, slot, gen, "c")
+        return view  # GC007: bare escape — a consumer re-wrap drops
+        # the tracked slice and the slot recycles under a live view
+
+    def serve_rewrapped(self, slot, gen, blen):
+        view = np.frombuffer(self.mm, np.uint8)[:blen]
+        track_release(view, self.ring.alloc.release, slot, gen, "c")
+        return np.frombuffer(view, np.uint8)  # GC007: frombuffer
+        # keeps only the ROOT buffer; the finalizer fires early
+
+    def serve_derived(self, blen):
+        base = np.frombuffer(self.mm, np.uint8)
+        sliced = base[:blen]
+        return np.frombuffer(sliced, np.uint8)  # GC007: derived
+        # ndarray — the intermediate slice drops out of the base chain

@@ -1,0 +1,64 @@
+"""GC007 good fixture: the same shapes, disciplined — acquires
+None-checked with copying fallbacks, pins released / transferred
+(constructor and return-marker escapes), tracked views served only as
+``memoryview(view)``."""
+
+import numpy as np
+
+from . import track_release  # fixture stub; never imported at check time
+
+
+class Payload:
+    def __init__(self, ring, slot, gen, nbytes):
+        self.ring, self.slot, self.gen, self.nbytes = (
+            ring, slot, gen, nbytes,
+        )
+
+
+class Producer:
+    def __init__(self, ring):
+        self.ring = ring
+
+    def stage(self, u8):
+        got = self.ring.alloc.acquire(("coord",))
+        if got is None:
+            return None  # all pinned: the caller's copying fallback
+        slot, gen = got
+        self.ring.view[0:u8.nbytes] = u8
+        return Payload(self.ring, slot, gen, u8.nbytes)  # pin escapes
+        # into the payload object, whose release() discharges it
+
+    def stage_marker(self, u8):
+        got = self.ring.alloc.acquire(("parent",))
+        if got is None:
+            return None
+        slot, gen = got
+        self.ring.view[0:u8.nbytes] = u8
+        return (slot, gen, u8.nbytes)  # control-marker escape: the
+        # peer that receives the marker acks the release
+
+
+class Server:
+    def __init__(self, mm, ring):
+        self.mm = mm
+        self.ring = ring
+
+    def serve(self, slot, gen, blen):
+        view = np.frombuffer(self.mm, np.uint8)[:blen]
+        track_release(view, self.ring.alloc.release, slot, gen, "c")
+        return memoryview(view)  # every derived buffer holds the slice
+
+
+class WalrusProducer:
+    """The walrus-loop acquire shape the rule's docstring sanctions:
+    `(got := ...acquire(...)) is None` IS the None test."""
+
+    def __init__(self, ring):
+        self.ring = ring
+
+    def stage_spin(self, u8, reap):
+        while (got := self.ring.alloc.acquire(("coord",))) is None:
+            reap()  # free dead holders' pins, then retry
+        slot, gen = got
+        self.ring.view[0:u8.nbytes] = u8
+        return (slot, gen)

@@ -1,0 +1,23 @@
+"""GC008 bad fixture, margin half: asserts comparing wall-clock-
+derived values against sub-second literals — the flake family.
+Violation lines pinned by the fixture test."""
+
+import time
+
+import numpy as np
+
+
+def timing_margin_direct(run):
+    t0 = time.perf_counter()
+    run()
+    assert time.perf_counter() - t0 < 0.04  # GC008: direct margin
+
+
+def timing_margin_tainted(run, latency):
+    errs = []
+    for _ in range(100):
+        t0 = time.perf_counter()
+        run()
+        delay = time.perf_counter() - t0
+        errs.append(abs(delay - latency))
+    assert float(np.median(errs)) < 5e-3  # GC008: taint via append

@@ -1,0 +1,18 @@
+"""GC008 bad fixture, sim half: a virtual-time module that secretly
+reads the OS clock. Violation lines pinned by the fixture test."""
+
+from time import perf_counter  # GC008: OS-clock import in sim
+import time
+
+
+def advance(clock, dt):
+    t0 = time.perf_counter()  # GC008: wall clock in the sim plane
+    clock.run_until(clock.now() + dt)
+    time.sleep(0.001)  # GC008: real sleep in the sim plane
+    return time.perf_counter() - t0  # GC008
+
+import time as _t
+
+
+def settle():
+    _t.sleep(0.01)  # GC008: wall sleep through an import alias

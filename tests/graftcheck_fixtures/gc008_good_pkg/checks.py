@@ -1,0 +1,33 @@
+"""GC008 good fixture, margin half: the sanctioned shapes — exact
+virtual-time claims, gross (>= 1 s) real ceilings, relative
+comparisons, and ONE marked real smoke whose sub-second margin is
+thereby sanctioned."""
+
+import time
+
+
+def exact_on_virtual_time(clock, run, latency):
+    t0 = clock.now()
+    run()
+    assert clock.now() - t0 == latency  # exact: no margin at all
+
+
+def gross_ceiling(run):
+    t0 = time.perf_counter()
+    run()
+    assert time.perf_counter() - t0 < 4.0  # >= 1 s: a failure
+    # detector, not a scheduler race
+
+
+def relative_budget(run, budget):
+    t0 = time.perf_counter()
+    run()
+    wall = time.perf_counter() - t0
+    assert wall < budget + 0.5  # relative to a caller bound: allowed
+
+
+# graftcheck: real-smoke
+def real_thread_smoke(run, latency):
+    t0 = time.perf_counter()
+    run()
+    assert abs(time.perf_counter() - t0 - latency) < 0.1  # sanctioned

@@ -1,0 +1,8 @@
+"""GC008 good fixture, sim half: the virtual-time plane reads only
+its own clock."""
+
+
+def advance(clock, dt):
+    t0 = clock.now()
+    clock.run_until(t0 + dt)
+    return clock.now() - t0  # virtual elapsed: exact, reproducible

@@ -1,0 +1,28 @@
+// GC009 bad fixture, C++ half: the protocol ground truth the sibling
+// transport.py has drifted from. Mini but shaped like the real one.
+#include <cstdint>
+
+constexpr int64_t KIND_DATA = 0;
+constexpr int64_t KIND_CONTROL = 1;
+constexpr int64_t KIND_DEATH = 2;
+
+extern "C" {
+
+void* msgt_create(const char* addr, int n) { return nullptr; }
+
+int msgt_send(void* h, int rank, int64_t seq, const uint8_t* data,
+              int64_t len) {
+  return 0;
+}
+
+int64_t msgt_take(void* h, int rank, uint8_t* buf, int64_t cap) {
+  return 0;
+}
+
+void msgt_destroy(void* h) {}
+
+}  // extern "C"
+
+extern "C" {
+int64_t msgt_count(void* h) { return 0; }
+}

@@ -1,0 +1,32 @@
+"""GC009 bad fixture, Python half: every drift shape against the
+sibling transport.cpp. Violation lines pinned by the fixture test.
+(KIND_DEATH is missing here and msgt_destroy is unconfigured — both
+anchor at line 1.)"""
+
+import ctypes
+
+KIND_DATA = 0
+KIND_CONTROL = 5  # GC009: cpp says 1
+KIND_ACK = 2  # GC009: Python-internal, but collides with KIND_DEATH
+KIND_EXTRA = 7  # GC009: exists only here, not a documented internal
+
+
+def _configure(lib):
+    lib.msgt_create.restype = ctypes.c_void_p
+    lib.msgt_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.msgt_send.restype = ctypes.c_int
+    lib.msgt_send.argtypes = [  # GC009: arg 2 is int64_t in the cpp
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.msgt_take.restype = ctypes.c_int  # GC009: cpp returns int64_t
+    lib.msgt_take.argtypes = [  # GC009: arity 3 vs the cpp's 4
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.msgt_gone.restype = None  # GC009: cpp exports no msgt_gone
+
+
+def _configure_extra(lib):
+    lib.msgt_count.argtypes = [ctypes.c_void_p]  # GC009: argtypes but
+    # no restype for an int64_t-returning export — c_int truncation

@@ -1,0 +1,23 @@
+// GC009 good fixture, C++ half: in sync with the sibling transport.py.
+#include <cstdint>
+
+constexpr int64_t KIND_DATA = 0;
+constexpr int64_t KIND_CONTROL = 1;
+constexpr int64_t KIND_DEATH = 2;
+
+extern "C" {
+
+void* msgt_create(const char* addr, int n) { return nullptr; }
+
+int msgt_send(void* h, int rank, int64_t seq, const uint8_t* data,
+              int64_t len) {
+  return 0;
+}
+
+int64_t msgt_take(void* h, int rank, uint8_t* buf, int64_t cap) {
+  return 0;
+}
+
+void msgt_destroy(void* h) {}
+
+}  // extern "C"

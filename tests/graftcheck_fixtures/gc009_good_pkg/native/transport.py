@@ -1,0 +1,29 @@
+"""GC009 good fixture, Python half: kind table and ctypes signatures
+in sync with the sibling transport.cpp. ``KIND_ACK`` is
+Python-internal (no cpp twin) at a non-colliding value, and pointer
+FLAVOR varies by call site (c_char_p vs c_void_p vs POINTER) — all
+legal marshals of a C pointer."""
+
+import ctypes
+
+KIND_DATA = 0
+KIND_CONTROL = 1
+KIND_DEATH = 2
+KIND_ACK = 8  # Python-internal: resolves to KIND_DATA on the wire
+
+
+def _configure(lib):
+    lib.msgt_create.restype = ctypes.c_void_p
+    lib.msgt_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.msgt_send.restype = ctypes.c_int
+    lib.msgt_send.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.msgt_take.restype = ctypes.c_int64
+    lib.msgt_take.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ]
+    lib.msgt_destroy.restype = None
+    lib.msgt_destroy.argtypes = [ctypes.c_void_p]

@@ -75,6 +75,11 @@ def test_fastest_k_epoch_echo_on_devices():
     backend.shutdown()
 
 
+# The device family's one sanctioned real-thread timing test: the
+# exact twin of this claim runs on SimBackend in test_pool_local.py,
+# but latency agreement THROUGH the device dispatch/callback path can
+# only be measured for real.
+# graftcheck: real-smoke
 def test_functional_nwait_on_devices():
     n = 3
     delay_fn = lambda i, e: 0.015 if i == 0 else 0.001

@@ -399,7 +399,7 @@ def test_package_self_run_is_clean():
 
     res = run([_PKG], baseline_path=DEFAULT_BASELINE)
     assert res.ok, "\n".join(f.format() for f in res.fresh)
-    assert res.n_rules == 5
+    assert res.n_rules == 9  # GC001-GC005 + the v2 set (ISSUE 8)
     assert res.n_files > 50  # the whole package, not a subset
 
 
@@ -453,7 +453,8 @@ def test_cli_exit_codes():
     assert missing.returncode == 2
     rules = cli("--list-rules")
     assert rules.returncode == 0
-    for rule in ("GC001", "GC002", "GC003", "GC004", "GC005"):
+    for rule in ("GC001", "GC002", "GC003", "GC004", "GC005",
+                 "GC006", "GC007", "GC008", "GC009"):
         assert rule in rules.stdout
 
 

@@ -28,6 +28,7 @@ from mpistragglers_jl_tpu import (
     waitall,
 )
 from mpistragglers_jl_tpu.pool import DeadWorkerError
+from mpistragglers_jl_tpu.sim import SimBackend
 
 
 def echo_worker(i, payload, epoch):
@@ -121,42 +122,49 @@ def test_waitall_quiescence():
 
 
 def test_functional_nwait_and_latency_accuracy():
-    # kmap2 scenario 3: predicate waits for worker 0 specifically; measured
-    # latency of that worker ~= wall-clock of the call (atol 1e-3 in the
-    # reference; we allow 5 ms for thread scheduling jitter). The 5 ms
-    # bound holds per-epoch on an idle box but a loaded one (the full
-    # tier-1 suite running alongside, r11) can hiccup ANY single epoch
-    # past it — so the accuracy claim is asserted on the median of the
-    # 100 discrepancies (jitter-robust, still the reference's
-    # tightness) with a loose 100 ms per-epoch sanity ceiling; the
-    # same deflake family as the PR 3-5 timing-margin repairs.
+    # kmap2 scenario 3: predicate waits for worker 0 specifically; the
+    # call's elapsed time equals that worker's round-trip (atol 1e-3
+    # wall-clock in the reference). Four PRs in a row widened this
+    # family's thread-jitter margins (0.25 s -> 1.5 s creep, then a
+    # median-of-100 compromise); per the PR 5 pattern — now enforced
+    # by GC008 — the claim is re-rooted on SimBackend, where it is
+    # EXACT: the virtual elapsed of every epoch equals worker 0's
+    # injected delay to the bit, 100/100, no margins. The real-thread
+    # twin of this claim survives as the family's one marked real
+    # smoke in test_reference_parity.py (kmap2 parity).
     n = 3
-    delay_fn = lambda i, e: 0.010 if i == 0 else 0.001
-    pool, backend = make(n, delay_fn=delay_fn)
+    # power-of-two delays: every clock sum is exactly representable,
+    # so == below is exact equality, not a tolerance in disguise
+    slow, fast = 1 / 64, 1 / 1024
+    delay_fn = lambda i, e: slow if i == 0 else fast
+    backend = SimBackend(echo_worker, n, delay_fn=delay_fn)
+    pool = AsyncPool(n)
     sendbuf = np.zeros(1)
     recvbuf = np.zeros(3 * n)
     pred = lambda epoch, repochs: repochs[0] == epoch
-    errs = []
     for epoch in range(101, 201):
         sendbuf[0] = epoch
-        t0 = time.perf_counter()
+        t0 = backend.clock.now()
         repochs = asyncmap(pool, sendbuf, backend, recvbuf, nwait=pred)
-        delay = time.perf_counter() - t0
+        elapsed = backend.clock.now() - t0
         assert repochs[0] == pool.epoch
-        errs.append(abs(delay - pool.latency[0]))
-        assert errs[-1] < 0.1  # gross-failure ceiling, load-proof
-    assert float(np.median(errs)) < 5e-3, sorted(errs)[-5:]
+        assert elapsed == slow  # exact on virtual time, every epoch
+        assert backend.last_latency[0] == slow
     waitall(pool, backend, recvbuf)
     backend.shutdown()
 
 
 def test_nwait_zero_returns_immediately():
+    # nwait=0 means dispatch-and-return: on virtual time "immediately"
+    # is exact — the clock must not advance AT ALL (the wall-clock
+    # version asserted < 40 ms and raced loaded CI boxes, GC008)
     n = 3
-    pool, backend = make(n, delay_fn=lambda i, e: 0.05)
+    backend = SimBackend(echo_worker, n, delay_fn=lambda i, e: 0.05)
+    pool = AsyncPool(n)
     recvbuf = np.zeros(3 * n)
-    t0 = time.perf_counter()
+    t0 = backend.clock.now()
     repochs = asyncmap(pool, np.zeros(1), backend, recvbuf, nwait=0)
-    assert time.perf_counter() - t0 < 0.04
+    assert backend.clock.now() == t0  # zero virtual time elapsed
     assert list(repochs) == [0] * n  # nobody has ever answered
     assert pool.active.all()
     waitall(pool, backend, recvbuf)

@@ -138,6 +138,11 @@ def test_kmap2_waitall_quiesces_100_epochs():
         backend.shutdown()
 
 
+# The latency-agreement family's one sanctioned real-thread smoke
+# (GC008): the claim is exact on SimBackend (test_pool_local.py); this
+# real-thread version stays because it pins parity with the
+# reference's own wall-clock assertion (test/kmap2.jl:71).
+# graftcheck: real-smoke
 def test_kmap2_functional_nwait_waits_for_worker_1():
     """test/kmap2.jl:63-72: nwait = (epoch, repochs) -> repochs[1] ==
     epoch waits for a SPECIFIC worker; measured pool.latency[0] matches

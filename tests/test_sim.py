@@ -561,10 +561,16 @@ class TestEndToEnd:
         raw = sweep_nwait(fleet, n_workers=n, epochs=120, floor=2)
         assert raw.best == 6
 
-    def test_1k_epochs_under_2s_wall_bit_identical(self):
+    # The virtual-to-wall speedup claim IS a wall-clock measurement
+    # (the sanctioned kind: a GROSS ceiling on how much real time the
+    # simulator may burn — the old < 2.0 s bound had ~6% headroom over
+    # the ~1.9 s baseline on a loaded dev box, i.e. it was itself the
+    # flake class GC008 exists to kill).
+    # graftcheck: real-smoke
+    def test_1k_epochs_wall_bounded_bit_identical(self):
         """Real pool.py code on the virtual clock: 1k epochs of a
-        16-worker lognormal fleet in < 2 s wall clock, repochs
-        bit-identical across two runs."""
+        16-worker lognormal fleet well inside a 10 s gross wall
+        ceiling, repochs bit-identical across two runs."""
 
         def run():
             be = SimBackend(
@@ -582,7 +588,7 @@ class TestEndToEnd:
         t0 = time.perf_counter()
         reps1, v1 = run()
         wall = time.perf_counter() - t0
-        assert wall < 2.0, f"1k sim epochs took {wall:.2f}s wall"
+        assert wall < 10.0, f"1k sim epochs took {wall:.2f}s wall"
         reps2, v2 = run()
         assert (reps1 == reps2).all()
         assert v1 == v2
