@@ -441,6 +441,20 @@ def driver_contract(budget_s: float | None = None) -> dict:
         # cost is injected sleeps, not matmul rate)
         out["sim"] = _try_rung(bench_sim, est=10, scale=False)
 
+        def rung_hier():
+            from benchmarks.hierarchical_bench import (
+                bench_hierarchical_rung,
+            )
+
+            return bench_hierarchical_rung()
+
+        # round-14 hierarchical-coding rung, right after sim (it IS a
+        # sim-fleet measurement): hier vs flat MDS at equal host-loss
+        # resilience — virtual epoch time + measured decode wall.
+        # Unscaled: virtual waits + small CPU solves do not track the
+        # matmul rate.
+        out["hierarchical"] = _try_rung(rung_hier, est=25, scale=False)
+
         def rung_transport():
             from benchmarks.transport_bench import bench_transport_rung
 
@@ -573,6 +587,10 @@ def _contract_line(out: dict) -> str:
     rungs = {
         "graftcheck": _rung_summary(out.get("graftcheck"), "digest"),
         "sim": _rung_summary(out.get("sim"), "digest"),
+        "hier_vs_flat_decode_x": _rung_summary(
+            out.get("hierarchical"), "hier_vs_flat_decode_x"),
+        "hier_hostloss_epoch_ok": _rung_summary(
+            out.get("hierarchical"), "hier_hostloss_epoch_ok"),
         "transport": _rung_summary(out.get("transport"), "digest"),
         "adaptive_speedup": _rung_summary(
             out.get("adaptive_nwait"), "speedup"),

@@ -7,6 +7,12 @@ _HOME = {
     "LTCodedGemm": "coded_gemm",
     "LTCode": "lt",
     "nwait_lt_decodable": "lt",
+    "HierarchicalCodedGemm": "hierarchical",
+    "ParityOuter": "outer_code",
+    "LTOuter": "outer_code",
+    "make_outer": "outer_code",
+    "hierarchical_nwait": "outer_code",
+    "partition_groups": "outer_code",
     "GradientCode": "gradcode",
     "PolynomialCode": "polynomial",
     "PolyCodedGemm": "polynomial",

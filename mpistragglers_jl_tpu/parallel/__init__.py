@@ -16,6 +16,7 @@ _HOME = {
     "initialize_multihost": "multihost",
     "make_multihost_mesh": "multihost",
     "local_worker_indices": "multihost",
+    "host_groups": "multihost",
     "pipeline_spmd": "pipeline",
     "pipeline_1f1b": "pipeline",
     "pipeline_circular": "pipeline",

@@ -28,6 +28,7 @@ __all__ = [
     "initialize_multihost",
     "make_multihost_mesh",
     "local_worker_indices",
+    "host_groups",
 ]
 
 _initialized = False
@@ -171,6 +172,72 @@ def make_multihost_mesh(
         return Mesh(arr, axis_names)
     arr = np.array(devices[:need]).reshape(axis_sizes)
     return Mesh(arr, axis_names)
+
+
+def host_groups(
+    n_workers: int | None = None,
+    *,
+    mesh: Mesh | None = None,
+    axis: str = "w",
+    n_hosts: int | None = None,
+) -> list[list[int]]:
+    """Partition pool worker indices into host groups — the fleet
+    layout :class:`~..ops.hierarchical.HierarchicalCodedGemm`'s outer
+    code stripes across (inner MDS on ICI within a group, cheap XOR
+    outer across groups over DCN).
+
+    With ``mesh`` (a multi-host mesh from :func:`make_multihost_mesh`),
+    positions along ``axis`` group by the process hosting their
+    devices — exactly the ownership relation
+    :func:`local_worker_indices` reports per host, assembled for every
+    host, so group g's inner code runs on one host's chips. Groups must
+    come out equal-sized (give the pool axis a per-host-uniform
+    layout); a position spanning several processes is refused — such an
+    axis cannot be a straggler-independence unit.
+
+    Without a mesh (tests, sim fleets, a single host), ``n_workers``
+    splits evenly into ``n_hosts`` contiguous groups — the same
+    partition shape, simulated.
+
+    >>> groups = host_groups(mesh=mesh)               # one per host
+    >>> hg = HierarchicalCodedGemm(A, groups=groups, k_inner=6)
+    """
+    if mesh is None:
+        if n_workers is None or n_hosts is None:
+            raise ValueError(
+                "without a mesh, host_groups needs n_workers and n_hosts"
+            )
+        # ONE even-split implementation: ops/outer_code.py owns the
+        # partition contract (numpy-only, import-safe from here)
+        from ..ops.outer_code import partition_groups
+
+        return [
+            g.tolist()
+            for g in partition_groups(int(n_workers), int(n_hosts))
+        ]
+    if axis not in mesh.axis_names:
+        raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
+    ax = mesh.axis_names.index(axis)
+    moved = np.moveaxis(mesh.devices, ax, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    by_host: dict[int, list[int]] = {}
+    for i in range(flat.shape[0]):
+        owners = {d.process_index for d in flat[i]}
+        if len(owners) != 1:
+            raise ValueError(
+                f"position {i} along {axis!r} spans processes "
+                f"{sorted(owners)}; a host group must live on one host "
+                "to be a straggler-independence unit"
+            )
+        by_host.setdefault(owners.pop(), []).append(i)
+    groups = [by_host[p] for p in sorted(by_host)]
+    if len({len(g) for g in groups}) != 1:
+        raise ValueError(
+            f"hosts own unequal worker counts "
+            f"{[len(g) for g in groups]} along {axis!r}; lay the pool "
+            "axis out per-host-uniform"
+        )
+    return groups
 
 
 def local_worker_indices(mesh: Mesh, axis: str = "w") -> list[int]:

@@ -30,8 +30,10 @@ from .replay import ReplayResult, ReplayTrace, compare, replay
 from .tune import (
     NwaitSweep,
     recommend_nwait,
+    recovered_work_per_s,
     sweep_code_rate,
     sweep_hedge,
+    sweep_hierarchical,
     sweep_nwait,
 )
 
@@ -48,5 +50,7 @@ __all__ = [
     "sweep_nwait",
     "sweep_code_rate",
     "sweep_hedge",
+    "sweep_hierarchical",
     "recommend_nwait",
+    "recovered_work_per_s",
 ]

@@ -40,12 +40,30 @@ __all__ = [
     "sweep_nwait",
     "sweep_hedge",
     "sweep_code_rate",
+    "sweep_hierarchical",
     "recommend_nwait",
+    "recovered_work_per_s",
 ]
 
 
 def _echo(i, payload, epoch):
     return payload
+
+
+def recovered_work_per_s(
+    k: float, mean_epoch_s: float,
+    *, utility: Callable[[int], float] | None = None,
+) -> float:
+    """The recovered-work-per-virtual-second objective every
+    code-rate-style sweep shares (``sweep_nwait``, ``sweep_code_rate``,
+    ``sweep_hierarchical`` — ONE implementation, not three):
+    ``utility(k) / mean_epoch_s`` with the default utility ``k`` —
+    source blocks recovered per epoch, so the default objective is
+    maximum decoded work per second. ``k`` is whatever the sweep's
+    recovery unit is (fresh shards for a flat code, ``L * inner_nwait``
+    source blocks for the hierarchical pair)."""
+    u = float(k) if utility is None else float(utility(k))
+    return u / mean_epoch_s if mean_epoch_s > 0 else float(np.inf)
 
 
 def _resolve_delay(source, *, seed: int) -> tuple[DelayFn, int | None]:
@@ -157,7 +175,6 @@ def sweep_nwait(
         )
     if any(k > n for k in ks):
         raise ValueError(f"nwait candidates must be <= n_workers={n}")
-    u = (lambda k: float(k)) if utility is None else utility
     if work_fn is None:
         work_fn = _echo
     if payload is None:
@@ -182,7 +199,7 @@ def sweep_nwait(
             "nwait": k,
             "mean_epoch_s": mean,
             "p95_epoch_s": float(np.percentile(walls, 95)),
-            "utility_per_s": float(u(k)) / mean if mean > 0 else np.inf,
+            "utility_per_s": recovered_work_per_s(k, mean, utility=utility),
             "n_stale": int(sum(r.n_stale for r in tracer.records)),
         })
     return NwaitSweep(entries, floor)
@@ -208,6 +225,194 @@ def sweep_code_rate(
         source, n_workers=n_workers, epochs=epochs, floor=min(ks),
         nwait_values=ks, utility=utility, seed=seed,
     )
+
+
+def sweep_hierarchical(
+    source,
+    *,
+    groups: int,
+    n_inner: int,
+    candidates: Sequence[tuple[float, int]],
+    inner_floor: int = 1,
+    epochs: int = 60,
+    failures=None,
+    outer_kind: str = "auto",
+    utility: Callable[[int], float] | None = None,
+    seed: int = 0,
+    model=None,
+    registry=None,
+    spans=None,
+) -> dict[str, Any]:
+    """Price ``(outer_rate, inner_nwait)`` pairs for the two-level
+    hierarchical code (:class:`~..ops.hierarchical.
+    HierarchicalCodedGemm`) by running the REAL pool loop — the real
+    ``asyncmap`` under the real :func:`~..ops.outer_code.
+    hierarchical_nwait` two-level predicate — on a :class:`~.backend.
+    SimBackend` fleet of ``groups * n_inner`` workers, per candidate.
+    This is the (outer rate, inner nwait) latency–communication
+    trade-off of arxiv 1808.06583 priced on the actual pool semantics
+    instead of a closed form.
+
+    ``source`` supplies fleet latency like every sweep here (a
+    :class:`~.replay.ReplayTrace`, a fitted
+    :class:`~..utils.straggle.PoolLatencyModel`, or a raw DelayFn);
+    ``failures`` maps group id -> kill epoch and injects whole-host
+    failures via :class:`~..utils.faults.kill_group` on top of it —
+    the scenario the outer code exists for, testable deterministically.
+
+    Candidates below EITHER decodability floor are REFUSED, never
+    clamped (the ``sweep_nwait`` contract): an ``inner_nwait`` below
+    ``inner_floor`` cannot inner-decode, an ``outer_rate`` rounding to
+    ``L < 1`` source groups cannot outer-decode, and an ``outer_rate``
+    whose ``L`` exceeds the groups surviving the scheduled failures
+    can never complete an epoch after the kill.
+
+    Utility is the shared :func:`recovered_work_per_s` objective with
+    recovery unit ``L * inner_nwait`` (source blocks decoded per
+    epoch) — sweep_code_rate's recovered-work/s, not a third copy.
+
+    The returned dict carries the ``recommend_nwait``-style inner
+    cross-check: ``inner_model`` is the analytic
+    ``PoolLatencyModel.optimal_nwait`` over ONE surviving group's
+    fitted per-worker distributions (``check_group``), and ``agree``
+    flags whether the sim's chosen inner_nwait matches it — divergence
+    means the two-level pool dynamics (which only the sim exercises)
+    moved the inner optimum.
+    """
+    # sim/ is a GC001 hermetic root: the outer-code machinery is numpy
+    # + ops/lt.py (jax-free), but ops/ is the accelerator package —
+    # keep the import lazy so the sim closure stays provably clean
+    from ..ops.outer_code import (
+        hierarchical_nwait,
+        make_outer,
+        partition_groups,
+    )
+    from ..utils import faults
+    from ..utils.straggle import PoolLatencyModel
+
+    H, ni = int(groups), int(n_inner)
+    if H < 1 or ni < 1:
+        raise ValueError(f"need groups >= 1 and n_inner >= 1, got {groups}, {n_inner}")
+    n = H * ni
+    inner_floor = int(inner_floor)
+    if not (1 <= inner_floor <= ni):
+        raise ValueError(
+            f"inner_floor must be in [1, {ni}], got {inner_floor}"
+        )
+    cands = [(float(r), int(k)) for r, k in candidates]
+    if not cands:
+        raise ValueError("empty sweep: no candidate policies given")
+    kills = {} if failures is None else {
+        int(g): int(e) for g, e in dict(failures).items()
+    }
+    # groups whose kill never fires inside the run count as survivors
+    surviving_ids = [
+        g for g in range(H) if kills.get(g, epochs + 1) > epochs
+    ]
+    # validate EVERY candidate before any runs: a refusal names the
+    # floor it sits under, it never silently clamps. The check is on
+    # the surviving group-ID SET, not its size: an LT outer whose
+    # survivors are all non-systematic shards can have |survivors| >=
+    # L and still never peel (review finding — the count check let
+    # such a candidate run and priced the 3600 s dead-stall as data).
+    outers = []
+    for rate, k in cands:
+        if k < inner_floor:
+            raise ValueError(
+                f"inner_nwait={k} sits below the inner decodability "
+                f"floor {inner_floor}: fewer than {inner_floor} fresh "
+                "shards cannot inner-decode a group"
+            )
+        if k > ni:
+            raise ValueError(
+                f"inner_nwait={k} exceeds the {ni} workers of a group"
+            )
+        outer = make_outer(H, rate=rate, kind=outer_kind, seed=seed)
+        if not outer.decodable(surviving_ids):
+            raise ValueError(
+                f"outer_rate={rate} needs L={outer.L} decodable groups "
+                f"but only groups {surviving_ids} of {H} survive the "
+                f"scheduled host failures {kills}, and that set cannot "
+                "clear the outer decodability floor after the kill"
+            )
+        outers.append(outer)
+    delay_fn, n_hint = _resolve_delay(source, seed=seed)
+    if n_hint is not None and int(n_hint) != n:
+        raise ValueError(
+            f"latency source describes {n_hint} workers but the fleet "
+            f"is groups*n_inner = {H}*{ni} = {n}"
+        )
+    part = partition_groups(n, H)
+    if kills:
+        delay_fn = faults.compose(
+            delay_fn, faults.kill_group(part, kills)
+        )
+    entries: list[dict] = []
+    for (rate, k), outer in zip(cands, outers):
+        def inner_arrived(g, fresh, _k=k):
+            return int(fresh[part[g]].sum()) >= _k
+
+        pred = hierarchical_nwait(part, inner_arrived, outer)
+        backend = SimBackend(
+            _echo, n, delay_fn=delay_fn, clock=VirtualClock(),
+            registry=registry, spans=spans,
+        )
+        pool = AsyncPool(n)
+        tracer = EpochTracer()
+        walls = np.empty(epochs)
+        for e in range(epochs):
+            t0 = backend.clock.now()
+            asyncmap(pool, np.zeros(1), backend, nwait=pred,
+                     tracer=tracer)
+            walls[e] = backend.clock.now() - t0
+        mean = float(walls.mean())
+        entries.append({
+            "outer_rate": rate,
+            "L": outer.L,
+            "inner_nwait": k,
+            "mean_epoch_s": mean,
+            "p95_epoch_s": float(np.percentile(walls, 95)),
+            "utility_per_s": recovered_work_per_s(
+                outer.L * k, mean, utility=utility
+            ),
+            "n_stale": int(sum(r.n_stale for r in tracer.records)),
+        })
+    best = max(entries, key=lambda r: r["utility_per_s"])
+    # -- recommend_nwait-style inner cross-check --------------------------
+    # the analytic side sees one SURVIVING group's fitted per-worker
+    # distributions; the sim's inner pick should match it whenever the
+    # candidate grid covers the inner optimum
+    # surviving_ids is non-empty here: every candidate proved it can
+    # clear the outer floor from the survivors (a scheduled kill whose
+    # epoch lies beyond the run leaves its group a survivor — the
+    # membership-in-kills test crashed on exactly that, review finding)
+    check_group = surviving_ids[0]
+    sub = PoolLatencyModel(ni, seed=seed)
+    if model is not None or (
+        hasattr(source, "workers") and hasattr(source, "observe_pool")
+    ):
+        src_model = model if model is not None else source
+        sub.workers = [
+            src_model.workers[int(w)] for w in part[check_group]
+        ]
+    else:
+        base_delay, _ = _resolve_delay(source, seed=seed)
+        for e in range(150):
+            for j, w in enumerate(part[check_group]):
+                sub.observe(j, base_delay(int(w), e))
+    inner_model = int(sub.optimal_nwait(
+        kmin=inner_floor, kmax=ni, utility=utility
+    ))
+    return {
+        "entries": entries,
+        "best": (best["outer_rate"], best["inner_nwait"]),
+        "best_entry": best,
+        "inner_sim": int(best["inner_nwait"]),
+        "inner_model": inner_model,
+        "agree": int(best["inner_nwait"]) == inner_model,
+        "check_group": int(check_group),
+        "surviving_groups": len(surviving_ids),
+    }
 
 
 def sweep_hedge(

@@ -39,6 +39,7 @@ __all__ = [
     "straggler",
     "intermittent",
     "dead_from",
+    "kill_group",
     "from_trace",
     "compose",
     "failing",
@@ -143,6 +144,52 @@ def dead_from(workers: int | Sequence[int], epoch: int, *, delay: float = 3600.0
     return (
         lambda worker, e: float(delay) if worker in ws and e >= epoch else 0.0
     )
+
+
+class kill_group:
+    """Scheduled whole-host failure: every worker of a group goes
+    unresponsive from its kill epoch onward.
+
+    The host-loss analog of :func:`dead_from` — death is an arbitrarily
+    long stall (same modelling: "a dead worker is indistinguishable
+    from an infinite straggler", SURVEY §5), but the unit is a *host
+    group* (one entry of the partition
+    :func:`~..parallel.multihost.host_groups` /
+    :func:`~..ops.outer_code.partition_groups` produce), which is the
+    failure mode the hierarchical outer code exists to survive and the
+    one `sweep_hierarchical` injects when pricing (outer_rate,
+    inner_nwait) pairs.
+
+    ``groups`` is the worker partition (sequence of worker-index
+    sequences); ``kills`` maps group id -> first dead epoch (several
+    groups may carry schedules; a group killed twice keeps the earliest
+    epoch). Pure in ``(worker, epoch)`` like every schedule here, so a
+    simulated host loss replays bit-identically, and a class (not a
+    closure) so it pickles into process-backend workers.
+
+    >>> sched = faults.kill_group(host_groups(32, n_hosts=4), {2: 10})
+    >>> backend = SimBackend(work, 32, delay_fn=sched)   # host 2 dies
+    """
+
+    def __init__(self, groups, kills: Mapping[int, int], *, delay: float = 3600.0):
+        table: dict[int, int] = {}
+        n_groups = len(list(groups))
+        for g, e in dict(kills).items():
+            if not 0 <= int(g) < n_groups:
+                raise ValueError(
+                    f"kill schedule names group {g}, but the partition "
+                    f"has {n_groups} groups"
+                )
+            for w in groups[int(g)]:
+                w = int(w)
+                table[w] = min(int(e), table.get(w, int(e)))
+        self._dead_from = table
+        self.delay = float(delay)
+        self.killed_groups = sorted(int(g) for g in dict(kills))
+
+    def __call__(self, worker: int, epoch: int) -> float:
+        e0 = self._dead_from.get(int(worker))
+        return self.delay if e0 is not None and epoch >= e0 else 0.0
 
 
 class from_trace:
@@ -318,6 +365,12 @@ class FaultSchedule:
     def dead_from(self, workers, epoch: int) -> "FaultSchedule":
         return self._add(
             dead_from(workers, epoch), f"dead_from({workers},epoch={epoch})"
+        )
+
+    def kill_group(self, groups, kills: Mapping[int, int]) -> "FaultSchedule":
+        return self._add(
+            kill_group(groups, kills),
+            f"kill_group({dict(kills)})",
         )
 
     @property

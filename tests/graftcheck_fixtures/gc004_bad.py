@@ -39,3 +39,11 @@ def harvest_ring(frame, registry=None):
     # coordinator's ring stats into the registry without the None guard
     registry.counter("transport_zero_copy_bytes_total").inc(frame)  # GC004 line 40
     return frame
+
+
+def hier_decode(arrived, registry=None, flight=None):
+    # the round-14 hierarchical-decode telemetry shape: counting an
+    # outer-code recovery without the None guards
+    registry.counter("hier_outer_recoveries_total").inc()  # GC004 line 47
+    flight.event("hier outer recovery")  # GC004 line 48
+    return arrived

@@ -81,3 +81,12 @@ def harvest_ring(frame, registry=None):
         registry.counter("transport_ring_full_stalls_total").inc(0)
         registry.gauge("transport_pinned_slots").set(frame)
     return frame
+
+
+def hier_decode(arrived, registry=None, flight=None):
+    """The hierarchical-decode telemetry shape, guarded: recovery
+    counters and the flight instant event behind the opt-in checks."""
+    if registry is not None:
+        registry.counter("hier_outer_recoveries_total").inc()
+    ok = flight is not None and flight.event("hier outer recovery")
+    return arrived if ok else None

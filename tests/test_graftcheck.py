@@ -53,7 +53,8 @@ def _keys(findings):
         ),
         ("gc004_bad.py", [("GC004", 6), ("GC004", 12), ("GC004", 17),
                           ("GC004", 22), ("GC004", 26),
-                          ("GC004", 33), ("GC004", 40)]),
+                          ("GC004", 33), ("GC004", 40),
+                          ("GC004", 47), ("GC004", 48)]),
         (
             "gc005_bad.py",
             [("GC005", 17), ("GC005", 18), ("GC005", 21),
@@ -110,7 +111,8 @@ def test_baseline_roundtrip(tmp_path):
     assert _keys(res.baselined) == [("GC004", 6)]
     assert _keys(res.fresh) == [("GC004", 12), ("GC004", 17),
                                 ("GC004", 22), ("GC004", 26),
-                                ("GC004", 33), ("GC004", 40)]
+                                ("GC004", 33), ("GC004", 40),
+                                ("GC004", 47), ("GC004", 48)]
     assert res.baseline_size == 1
 
 

@@ -1,0 +1,545 @@
+"""Hierarchical two-level coded GEMM (ISSUE 9): outer codes, the
+two-level predicate, decode identity under host loss, the joint
+(outer_rate, inner_nwait) sweep, and the kill-group fault.
+
+The acceptance chain: the decode identity grid (groups H in {2, 4} x
+inner MDS/LT x {0, 1} killed groups x f32/bf16, all on ``SimBackend``
+— jax-on-CPU, tier-1), a property test that the outer floor refusal
+triggers exactly below L = H*rate arrived groups, the pinned
+``sweep_hierarchical`` refusal + latency-model-agreement test, and
+bit-identical kill-one-host replays. Everything runs on virtual time;
+no wall-clock margins anywhere (GC008 discipline by construction).
+"""
+
+import itertools
+import pickle
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import AsyncPool, SimBackend, asyncmap, waitall
+from mpistragglers_jl_tpu.ops import HierarchicalCodedGemm
+from mpistragglers_jl_tpu.ops.outer_code import (
+    LTOuter,
+    ParityOuter,
+    hierarchical_nwait,
+    make_outer,
+    partition_groups,
+)
+from mpistragglers_jl_tpu.parallel import host_groups
+from mpistragglers_jl_tpu.sim import sweep_hierarchical
+from mpistragglers_jl_tpu.utils import faults
+from mpistragglers_jl_tpu.utils.straggle import PoolLatencyModel
+
+
+def _problem(dtype, m=72, kdim=16, ncols=12, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((m, kdim)).astype(np.float32)
+    B = rng.standard_normal((kdim, ncols)).astype(np.float32)
+    if dtype == "bfloat16":
+        A = A.astype(ml_dtypes.bfloat16)
+        B = B.astype(ml_dtypes.bfloat16)
+    ref = A.astype(np.float32) @ B.astype(np.float32)
+    return A, B, ref
+
+
+# --------------------------------------------------------------------------
+# outer codes (pure numpy, no pool)
+# --------------------------------------------------------------------------
+
+
+class TestOuterCodes:
+    def test_parity_decodes_from_any_single_missing_group(self):
+        rng = np.random.default_rng(1)
+        outer = ParityOuter(4)
+        src = rng.standard_normal((3, 5, 4)).astype(np.float32)
+        G = outer.generator_rows()
+        coded = np.einsum("hl,lrc->hrc", G, src)
+        for missing in range(4):
+            ids = [g for g in range(4) if g != missing]
+            assert outer.decodable(ids)
+            out = outer.decode([coded[g] for g in ids], ids)
+            np.testing.assert_allclose(out, src, rtol=1e-5, atol=1e-6)
+        assert not outer.decodable([0, 1])  # two losses: below floor
+        with pytest.raises(ValueError, match="outer decodability floor"):
+            outer.decode([coded[0], coded[1]], [0, 1])
+
+    def test_parity_select_prefers_pure_sources(self):
+        outer = ParityOuter(4)
+        assert outer.select([0, 1, 2, 3]) == [0, 1, 2]  # gather only
+        assert outer.select([0, 2, 3]) == [0, 2, 3]  # parity recovery
+        with pytest.raises(ValueError, match="outer floor"):
+            outer.select([1, 3])
+
+    def test_lt_outer_survives_multi_group_loss(self):
+        """Rate 2/4: H - L = 2 coded groups, so two simultaneous host
+        losses can still decode when the survivors peel."""
+        rng = np.random.default_rng(2)
+        outer = LTOuter(4, 2, seed=0)
+        src = rng.standard_normal((2, 5, 4)).astype(np.float32)
+        coded = np.einsum(
+            "hl,lrc->hrc", outer.generator_rows(), src
+        )
+        full = list(range(4))
+        assert outer.decodable(full)
+        survivors = [
+            ids
+            for ids in itertools.combinations(full, 2)
+            if outer.decodable(list(ids))
+        ]
+        assert survivors, "no 2-of-4 survivor set peels"
+        for ids in survivors:
+            out = outer.decode([coded[g] for g in ids], list(ids))
+            np.testing.assert_allclose(out, src, rtol=1e-5, atol=1e-6)
+
+    def test_make_outer_rates_and_refusals(self):
+        assert make_outer(4).kind == "parity"  # default (H-1)/H
+        assert make_outer(4, rate=0.5).kind == "lt"
+        assert make_outer(4, rate=0.5).L == 2
+        with pytest.raises(ValueError, match="outer decodability floor"):
+            make_outer(4, rate=0.05)  # rounds to L=0
+        with pytest.raises(ValueError, match="L=5 > H"):
+            make_outer(4, rate=1.25)
+        with pytest.raises(ValueError, match="rate \\(H-1\\)/H"):
+            make_outer(4, rate=0.5, kind="parity")
+
+    def test_partition_groups_contract(self):
+        part = partition_groups(8, 2)
+        assert [p.tolist() for p in part] == [[0, 1, 2, 3], [4, 5, 6, 7]]
+        explicit = partition_groups(4, [[2, 3], [0, 1]])
+        assert [p.tolist() for p in explicit] == [[2, 3], [0, 1]]
+        with pytest.raises(ValueError, match="evenly"):
+            partition_groups(8, 3)
+        with pytest.raises(ValueError, match="equal-sized"):
+            partition_groups(3, [[0, 1], [2]])
+        with pytest.raises(ValueError, match="exactly once"):
+            partition_groups(4, [[0, 1], [1, 2]])
+
+
+# --------------------------------------------------------------------------
+# decode identity grid: the ISSUE 9 acceptance matrix
+# --------------------------------------------------------------------------
+
+
+class TestDecodeIdentity:
+    TOL = {"float32": 1e-3, "bfloat16": 5e-2}
+
+    @pytest.mark.parametrize("H", [2, 4])
+    @pytest.mark.parametrize("inner", ["mds", "lt"])
+    @pytest.mark.parametrize("killed", [0, 1])
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_result_equals_plain_matmul(self, H, inner, killed, dtype):
+        """hierarchical result == A @ B across (groups) x (inner code)
+        x (killed groups) x dtype, on SimBackend — including the
+        outer-recovery path when a whole group is dead."""
+        A, B, ref = _problem(dtype)
+        hg = HierarchicalCodedGemm(
+            A, groups=H, n_inner=4, k_inner=3, inner=inner,
+            device_backend=False,
+        )
+        delay = faults.seeded_uniform(0.001, 0.01, seed=7)
+        if killed:
+            delay = faults.compose(
+                delay,
+                faults.kill_group(hg.group_indices, {H - 1: 1}),
+            )
+        be = SimBackend(hg.work, hg.n_workers, delay_fn=delay)
+        pool = AsyncPool(hg.n_workers)
+        scale = float(np.max(np.abs(ref)))
+        for _ in range(2):  # the kill lands on the FIRST epoch already
+            asyncmap(pool, B, be, nwait=hg.nwait)
+            C = hg.result(pool)
+            assert C.shape == ref.shape
+            err = float(np.max(np.abs(C - ref))) / scale
+            assert err < self.TOL[dtype], (H, inner, killed, dtype, err)
+        if killed:
+            assert H - 1 not in hg.arrived_groups(pool)
+
+    def test_device_backend_path(self):
+        """The default XLADeviceBackend construction (jax-on-CPU): the
+        same predicate + decode through the real device backend."""
+        A, B, ref = _problem("float32")
+        hg = HierarchicalCodedGemm(A, groups=2, n_inner=4, k_inner=3)
+        try:
+            pool = AsyncPool(hg.n_workers)
+            asyncmap(pool, B, hg.backend, nwait=hg.nwait)
+            waitall(pool, hg.backend)
+            C = hg.result(pool)
+            err = np.max(np.abs(C - ref)) / np.max(np.abs(ref))
+            assert err < 1e-3
+        finally:
+            hg.backend.shutdown()
+
+    def test_construction_refusals(self):
+        A = np.zeros((12, 4), np.float32)
+        with pytest.raises(ValueError, match="n_inner is required"):
+            HierarchicalCodedGemm(A, groups=2, k_inner=2)
+        with pytest.raises(ValueError, match="divide evenly"):
+            # L*k_inner = 3*3 = 9 does not divide the 12 rows
+            HierarchicalCodedGemm(
+                A, groups=4, n_inner=4, k_inner=3, device_backend=False
+            )
+        with pytest.raises(ValueError, match="k_inner <= n_inner"):
+            HierarchicalCodedGemm(
+                A, groups=2, n_inner=2, k_inner=3, device_backend=False
+            )
+        with pytest.raises(ValueError, match="contradict n_inner"):
+            HierarchicalCodedGemm(
+                A, groups=[[0, 1], [2, 3]], n_inner=3, k_inner=2,
+                device_backend=False,
+            )
+
+
+# --------------------------------------------------------------------------
+# the outer floor property: refusal triggers exactly below H*rate groups
+# --------------------------------------------------------------------------
+
+
+class TestOuterFloorProperty:
+    def test_predicate_fires_exactly_at_the_floor(self):
+        """Parity (H=4, L=3): over EVERY subset of groups, the
+        two-level predicate is true iff >= L groups cleared their
+        inner floor — never below, always at."""
+        A = np.zeros((36, 4), np.float32)
+        hg = HierarchicalCodedGemm(
+            A, groups=4, n_inner=4, k_inner=3, device_backend=False
+        )
+        pred = hg.nwait
+        epoch = 5
+        for r in range(5):
+            for groups_up in itertools.combinations(range(4), r):
+                repochs = np.zeros(16, dtype=np.int64)
+                for g in groups_up:
+                    # exactly k_inner fresh members clear the floor
+                    repochs[hg.group_indices[g][: hg.k_inner]] = epoch
+                assert pred(epoch, repochs) == (len(groups_up) >= hg.L)
+
+    def test_one_fresh_short_of_inner_floor_does_not_arrive(self):
+        A = np.zeros((36, 4), np.float32)
+        hg = HierarchicalCodedGemm(
+            A, groups=4, n_inner=4, k_inner=3, device_backend=False
+        )
+        repochs = np.zeros(16, dtype=np.int64)
+        for g in range(4):
+            repochs[hg.group_indices[g][: hg.k_inner - 1]] = 3
+        assert not hg.nwait(3, repochs)  # 0 groups arrived
+
+    def test_lt_outer_floor_never_fires_below_L(self):
+        A = np.zeros((24, 4), np.float32)
+        hg = HierarchicalCodedGemm(
+            A, groups=4, n_inner=4, k_inner=3, outer="lt",
+            outer_rate=0.5, device_backend=False,
+        )
+        assert hg.L == 2
+        epoch = 2
+        for r in range(hg.L):  # every subset strictly below the floor
+            for groups_up in itertools.combinations(range(4), r):
+                repochs = np.zeros(16, dtype=np.int64)
+                for g in groups_up:
+                    repochs[hg.group_indices[g][: hg.k_inner]] = epoch
+                assert not hg.nwait(epoch, repochs)
+
+    def test_result_refuses_below_floor_naming_both_floors(self):
+        A, B, _ = _problem("float32")
+        hg = HierarchicalCodedGemm(
+            A, groups=4, n_inner=4, k_inner=3, device_backend=False
+        )
+        # only 2 of 4 groups respond at all: below the L=3 outer floor
+        be = SimBackend(
+            hg.work, hg.n_workers,
+            delay_fn=faults.kill_group(
+                hg.group_indices, {2: 0, 3: 0}
+            ),
+        )
+        pool = AsyncPool(hg.n_workers)
+        with pytest.raises(Exception):
+            # unsatisfiable predicate: bound the call, harvest the error
+            asyncmap(pool, B, be, nwait=hg.nwait, timeout=5.0)
+        with pytest.raises(ValueError, match="outer floor needs 3"):
+            hg.result(pool)
+
+
+# --------------------------------------------------------------------------
+# kill_group: the scheduled whole-host fault
+# --------------------------------------------------------------------------
+
+
+class TestKillGroup:
+    def test_delay_fn_conventions(self):
+        part = [[0, 1], [2, 3]]
+        k = faults.kill_group(part, {1: 3})
+        assert k(2, 2) == 0.0 and k(2, 3) == 3600.0 and k(3, 9) == 3600.0
+        assert k(0, 100) == 0.0
+        assert k.killed_groups == [1]
+        # pure + picklable (DelayFn conventions, process workers)
+        assert pickle.loads(pickle.dumps(k))(3, 5) == 3600.0
+        # duplicate kills keep the earliest epoch
+        k2 = faults.kill_group([[0], [0]], {0: 5, 1: 2})
+        assert k2(0, 2) == 3600.0
+        with pytest.raises(ValueError, match="names group 7"):
+            faults.kill_group(part, {7: 1})
+
+    def test_schedule_builder_composes(self):
+        part = [[0, 1], [2, 3]]
+        sched = faults.FaultSchedule(seed=3).jitter(0.001, 0.002)
+        sched.kill_group(part, {0: 4})
+        assert "kill_group({0: 4})" in repr(sched)
+        assert sched.delay_fn(1, 4) > 3600.0
+
+    def test_kill_one_host_sim_run_is_bit_identical(self):
+        """The ISSUE 9 determinism acceptance: a kill-one-host run
+        completes every epoch with an exact decode, twice, with
+        bit-identical virtual walls AND decoded bytes."""
+        A, B, ref = _problem("float32")
+
+        def run():
+            hg = HierarchicalCodedGemm(
+                A, groups=4, n_inner=4, k_inner=3,
+                device_backend=False,
+            )
+            be = SimBackend(
+                hg.work, hg.n_workers,
+                delay_fn=faults.compose(
+                    faults.seeded_lognormal(0.01, 1.0, seed=5),
+                    faults.kill_group(hg.group_indices, {1: 3}),
+                ),
+            )
+            pool = AsyncPool(hg.n_workers)
+            walls, outs = [], []
+            for _ in range(6):
+                t0 = be.clock.now()
+                asyncmap(pool, B, be, nwait=hg.nwait)
+                walls.append(be.clock.now() - t0)
+                outs.append(hg.result(pool))  # every epoch decodes
+            return walls, outs
+
+        w1, o1 = run()
+        w2, o2 = run()
+        scale = float(np.max(np.abs(ref)))
+        for C in o1:  # zero lost epochs, all exact
+            assert float(np.max(np.abs(C - ref))) / scale < 1e-3
+        assert w1 == w2
+        assert all(np.array_equal(a, b) for a, b in zip(o1, o2))
+
+
+# --------------------------------------------------------------------------
+# obs: counters + the flight-recorder recovery event
+# --------------------------------------------------------------------------
+
+
+class TestHierObs:
+    def test_counters_and_flight_event_on_recovery(self):
+        from mpistragglers_jl_tpu.obs import FlightRecorder, MetricsRegistry
+
+        A, B, _ = _problem("float32")
+        reg = MetricsRegistry()
+        fl = FlightRecorder()
+        hg = HierarchicalCodedGemm(
+            A, groups=4, n_inner=4, k_inner=3, device_backend=False,
+            registry=reg, flight=fl,
+        )
+        snap = reg.snapshot()
+        assert snap["hier_groups"]["series"][0]["value"] == 4
+        assert snap["hier_outer_floor"]["series"][0]["value"] == 3
+        be = SimBackend(hg.work, hg.n_workers)
+        pool = AsyncPool(hg.n_workers)
+        # epoch 1: everyone answers -> pure source gather, no recovery
+        asyncmap(pool, B, be, nwait=16)
+        hg.result(pool)
+        snap = reg.snapshot()
+        assert snap["hier_outer_recoveries_total"]["series"][0]["value"] == 0
+        assert snap["hier_group_losses_total"]["series"][0]["value"] == 0
+        inner = {
+            s["labels"]["group"]: s["value"]
+            for s in snap["hier_inner_decode_total"]["series"]
+        }
+        # parity group 3 exists dark at 0: constructed, never consumed
+        assert inner == {"0": 1, "1": 1, "2": 1, "3": 0}
+        assert len(fl) == 0  # no recovery, no event
+        # epoch 2: group 1 dead -> outer recovery, counted + recorded
+        be2 = SimBackend(
+            hg.work, hg.n_workers,
+            delay_fn=faults.kill_group(hg.group_indices, {1: 0}),
+        )
+        pool2 = AsyncPool(hg.n_workers)
+        asyncmap(pool2, B, be2, nwait=hg.nwait)
+        hg.result(pool2)
+        snap = reg.snapshot()
+        assert snap["hier_outer_recoveries_total"]["series"][0]["value"] == 1
+        assert snap["hier_group_losses_total"]["series"][0]["value"] == 1
+        doc = fl.snapshot()
+        names = [
+            e["name"] for e in doc["traceEvents"] if e.get("ph") == "I"
+        ]
+        assert "hier outer recovery" in names
+        ev = next(
+            e for e in doc["traceEvents"]
+            if e.get("name") == "hier outer recovery"
+        )
+        assert ev["args"]["missing_groups"] == [1]
+
+    def test_dark_path_stays_dark(self):
+        A, B, _ = _problem("float32")
+        hg = HierarchicalCodedGemm(
+            A, groups=2, n_inner=4, k_inner=3, device_backend=False
+        )
+        assert hg._m is None and hg._flight is None
+        be = SimBackend(hg.work, hg.n_workers)
+        pool = AsyncPool(hg.n_workers)
+        asyncmap(pool, B, be, nwait=hg.nwait)
+        hg.result(pool)  # no registry, no flight: must not throw
+
+
+# --------------------------------------------------------------------------
+# sweep_hierarchical: refusals + the pinned latency-model agreement
+# --------------------------------------------------------------------------
+
+
+def _pinned_fleet(w, e):
+    """Per group of 8: six fast workers (10-16 ms, deterministic
+    jitter) + two 1 s stragglers — the inner optimum is sharply 6."""
+    j = w % 8
+    if j >= 6:
+        return 1.0
+    return 0.010 + 0.001 * j + 0.005 * ((w * 7 + e) % 3) / 3
+
+
+class TestSweepHierarchical:
+    def test_refuses_below_either_floor(self):
+        with pytest.raises(ValueError, match="inner decodability floor"):
+            sweep_hierarchical(
+                _pinned_fleet, groups=4, n_inner=8,
+                candidates=[(0.75, 1)], inner_floor=2, epochs=5,
+            )
+        with pytest.raises(ValueError, match="outer decodability floor"):
+            sweep_hierarchical(
+                _pinned_fleet, groups=4, n_inner=8,
+                candidates=[(0.05, 6)], epochs=5,
+            )
+        with pytest.raises(ValueError, match="survive the scheduled"):
+            sweep_hierarchical(
+                _pinned_fleet, groups=4, n_inner=8,
+                candidates=[(1.0, 6)], failures={0: 3}, epochs=5,
+            )
+        with pytest.raises(ValueError, match="exceeds the 8 workers"):
+            sweep_hierarchical(
+                _pinned_fleet, groups=4, n_inner=8,
+                candidates=[(0.75, 9)], epochs=5,
+            )
+
+    def test_refusal_checks_surviving_id_set_not_count(self):
+        """Review finding: at k=2 the LT patch distribution draws only
+        degree-2 coded shards, so survivors {2, 3} of an (H=4, L=2) LT
+        outer can never peel even though their COUNT equals L. The
+        count check let this candidate run and priced the 3600 s
+        dead-worker stall as data (mean epoch ~3000 s); it must be
+        refused like every other below-floor pair."""
+        from mpistragglers_jl_tpu.ops.outer_code import LTOuter
+
+        assert not LTOuter(4, 2, seed=0).decodable([2, 3])
+        with pytest.raises(ValueError, match="cannot\\s+clear the outer"):
+            sweep_hierarchical(
+                _pinned_fleet, groups=4, n_inner=4,
+                candidates=[(0.5, 3)], failures={0: 2, 1: 2}, epochs=6,
+            )
+
+    def test_kill_scheduled_beyond_the_run_leaves_survivors(self):
+        """Review finding: a kill epoch past the sweep's horizon never
+        fires, so those groups ARE survivors — the cross-check must
+        pick one instead of crashing on an empty candidate set."""
+        res = sweep_hierarchical(
+            _pinned_fleet, groups=2, n_inner=8,
+            candidates=[(0.5, 6)], failures={0: 1000, 1: 1000},
+            epochs=5,
+        )
+        assert res["surviving_groups"] == 2
+        assert res["check_group"] == 0
+
+    def test_pinned_recommendation_agrees_with_latency_model(self):
+        """The ISSUE 9 acceptance pin: on the seeded fleet with one
+        scheduled host kill, the sim sweep lands on (0.75, 6) —
+        highest feasible outer rate, inner nwait dodging the two
+        per-group stragglers — and the PoolLatencyModel cross-check
+        over a surviving group agrees."""
+        cands = [(r, k) for r in (0.5, 0.75) for k in (4, 6, 8)]
+        res = sweep_hierarchical(
+            _pinned_fleet, groups=4, n_inner=8, candidates=cands,
+            inner_floor=2, epochs=40, failures={2: 10}, seed=3,
+        )
+        assert res["best"] == (0.75, 6)
+        assert res["inner_sim"] == res["inner_model"] == 6
+        assert res["agree"] is True
+        assert res["check_group"] == 0  # first group NOT killed
+        assert res["surviving_groups"] == 3
+        # deep stragglers poison k=8 in every rate: pinned ordering
+        by = {(r["outer_rate"], r["inner_nwait"]): r for r in res["entries"]}
+        assert by[(0.75, 6)]["utility_per_s"] > by[(0.75, 4)]["utility_per_s"]
+        assert by[(0.75, 8)]["mean_epoch_s"] >= 1.0
+        # bit-identical across calls (virtual time, seeded fleet)
+        res2 = sweep_hierarchical(
+            _pinned_fleet, groups=4, n_inner=8, candidates=cands,
+            inner_floor=2, epochs=40, failures={2: 10}, seed=3,
+        )
+        assert res["entries"] == res2["entries"]
+
+    def test_model_source_uses_group_stats_directly(self):
+        model = PoolLatencyModel(8, seed=1)
+        rng = np.random.default_rng(4)
+        for w in range(8):
+            base = 0.01 if w % 4 != 3 else 0.5
+            for x in base + rng.exponential(0.002, 60):
+                model.observe(w, x)
+        res = sweep_hierarchical(
+            model, groups=2, n_inner=4,
+            candidates=[(0.5, 2), (0.5, 3)], epochs=15, seed=1,
+        )
+        assert res["inner_model"] == 3  # wait out all three fast ranks
+        assert res["best"][1] == 3 and res["agree"]
+
+    def test_fleet_width_mismatch_is_refused(self):
+        model = PoolLatencyModel(6)
+        with pytest.raises(ValueError, match="describes 6 workers"):
+            sweep_hierarchical(
+                model, groups=4, n_inner=8, candidates=[(0.75, 4)],
+            )
+
+
+# --------------------------------------------------------------------------
+# multihost wiring
+# --------------------------------------------------------------------------
+
+
+class TestHostGroups:
+    def test_even_split_without_a_mesh(self):
+        assert host_groups(8, n_hosts=2) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+        assert host_groups(6, n_hosts=3) == [[0, 1], [2, 3], [4, 5]]
+        with pytest.raises(ValueError, match="evenly"):
+            host_groups(8, n_hosts=3)
+        with pytest.raises(ValueError, match="needs n_workers"):
+            host_groups(8)
+
+    def test_single_process_mesh_groups_by_process(self):
+        import jax
+
+        from mpistragglers_jl_tpu.parallel import make_multihost_mesh
+
+        n = len(jax.devices())
+        mesh = make_multihost_mesh((n,), ("w",))
+        groups = host_groups(mesh=mesh)
+        # one process in tests: every position lands in its one group
+        assert sorted(sum(groups, [])) == list(range(n))
+        assert len(groups) == 1
+
+    def test_partition_feeds_hierarchical_gemm(self):
+        A, B, ref = _problem("float32")
+        groups = host_groups(8, n_hosts=2)
+        hg = HierarchicalCodedGemm(
+            A, groups=groups, k_inner=3, device_backend=False
+        )
+        assert hg.H == 2 and hg.n_inner == 4
+        be = SimBackend(hg.work, 8)
+        pool = AsyncPool(8)
+        asyncmap(pool, B, be, nwait=hg.nwait)
+        C = hg.result(pool)
+        assert np.max(np.abs(C - ref)) / np.max(np.abs(ref)) < 1e-3
