@@ -455,6 +455,18 @@ def driver_contract(budget_s: float | None = None) -> dict:
         # matmul rate.
         out["hierarchical"] = _try_rung(rung_hier, est=25, scale=False)
 
+        def rung_router():
+            from benchmarks.router_bench import bench_router_rung
+
+            return bench_router_rung()
+
+        # round-15 serving-tier router rung, sim half — unscaled like
+        # the sim rung (virtual-time bookkeeping does not track the
+        # matmul rate): the 1M-request diurnal replay + the swept
+        # policy-vs-round-robin p99 headline. The live half runs with
+        # the transformer/serving block below, where jax is warm.
+        out["router"] = _try_rung(rung_router, est=50, scale=False)
+
         def rung_transport():
             from benchmarks.transport_bench import bench_transport_rung
 
@@ -527,6 +539,24 @@ def driver_contract(budget_s: float | None = None) -> dict:
             out["transformer_train"] = tt = {}
             _transformer_rungs(into=tt)
         _release_device_memory()
+
+        def rung_router_live():
+            from benchmarks.router_bench import bench_router_live_rung
+
+            return bench_router_live_rung()
+
+        # round-15 router rung, live half (budget-guarded, scaled: it
+        # ticks real jitted schedulers): round_robin vs least_loaded
+        # p99 TTFT at ~0.8 utilization with one stalled replica, the
+        # mid-run kill/recover zero-drop leg, and the router's share
+        # of the stepping wall against the <= 5% tick budget
+        rl = _try_rung(rung_router_live, est=60)
+        if isinstance(out.get("router"), dict) and not (
+            "skipped" in out["router"] or "error" in out["router"]
+        ):
+            out["router"]["live"] = rl
+        else:
+            out["router_live"] = rl
         # systematic-LT overhead rung (VERDICT r2 item 4): real pool
         # path, one permanent straggler, systematic vs classic stream
         out["rateless_overhead"] = _try_rung(
@@ -591,6 +621,10 @@ def _contract_line(out: dict) -> str:
             out.get("hierarchical"), "hier_vs_flat_decode_x"),
         "hier_hostloss_epoch_ok": _rung_summary(
             out.get("hierarchical"), "hier_hostloss_epoch_ok"),
+        "router_p99_x": _rung_summary(
+            out.get("router"), "router_p99_x"),
+        "router_sim_Mreq_s": _rung_summary(
+            out.get("router"), "router_sim_Mreq_s"),
         "transport": _rung_summary(out.get("transport"), "digest"),
         "adaptive_speedup": _rung_summary(
             out.get("adaptive_nwait"), "speedup"),

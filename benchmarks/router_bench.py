@@ -1,0 +1,321 @@
+"""Round-15 router rung: the serving-tier router plane, sim and live.
+
+Two halves, mirroring how the router is meant to be operated:
+
+* **sim** (:func:`bench_router_rung`, unscaled like the ``sim`` rung —
+  virtual-time bookkeeping does not track the matmul rate): a
+  1M-request diurnal day over 8 straggling ``SimReplica`` schedulers
+  through the REAL :class:`~mpistragglers_jl_tpu.models.router.
+  RequestRouter` on a ``VirtualClock`` — replay throughput in
+  requests/s and events/s, a bit-identity witness (a 50k-request slice
+  run twice must produce one digest), and the policy headline: the
+  ``sweep_router_policy`` point at 0.8 load with a 1.8x straggling
+  replica, reporting the swept winner's p99-TTFT edge over round_robin
+  (``router_p99_x``, the compact-line scalar; acceptance floor 1.15).
+* **live** (:func:`bench_router_live_rung`, budget-guarded): four REAL
+  ``ServingScheduler`` replicas (one artificially stalled per tick —
+  the straggling-replica scenario) under a paced open-loop arrival
+  stream at ~0.8 utilization, round_robin vs least_loaded p99 TTFT on
+  the wall clock, a mid-run replica kill/recover leg asserting ZERO
+  dropped requests, and the router's own bookkeeping share of the
+  stepping wall (the <= 5% tick-budget gate).
+
+Compact-line scalars (bench.py): ``router_p99_x`` (sim sweep,
+round_robin p99 / winner p99) and ``router_sim_Mreq_s`` (million
+requests replayed per wall second). Format documented in
+benchmarks/README.md (round-15 note).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+
+def _fleet(clock, n=8, slots=16, n_inner=32, tick_s=0.025, sigma=0.2,
+           straggler=None):
+    from mpistragglers_jl_tpu.sim import SimReplica, lognormal_ticks
+
+    mult = straggler or {}
+    return [
+        SimReplica(
+            clock, slots=slots, n_inner=n_inner, prompt_chunk=128,
+            tick_s=lognormal_ticks(tick_s * mult.get(i, 1.0), sigma,
+                                   seed=60 + i),
+        )
+        for i in range(n)
+    ]
+
+
+def _day(requests, *, n=8, slots=16, seed=4):
+    from mpistragglers_jl_tpu.models.router import RequestRouter
+    from mpistragglers_jl_tpu.sim import (
+        VirtualClock,
+        diurnal_arrivals,
+        run_router_day,
+    )
+
+    clock = VirtualClock()
+    fleet = _fleet(clock, n=n, slots=slots)
+    router = RequestRouter(fleet, policy="least_loaded", clock=clock)
+    cap = n * slots / (2 * 0.025)  # 2 ticks per request at mean tick
+    report = run_router_day(
+        router,
+        diurnal_arrivals(0.7 * cap, n=requests, period=86_400.0,
+                         amplitude=0.8, seed=seed, prompt_len=128,
+                         max_new=32),
+    )
+    ticks = sum(r.tick_count for r in fleet)
+    return report, ticks
+
+
+def bench_router_rung(requests: int | None = None):
+    """The sim half (driver rung ``router``): 1M-request diurnal
+    replay + determinism witness + the swept policy headline."""
+    if requests is None:
+        requests = int(os.environ.get("ROUTER_BENCH_REQUESTS",
+                                      "1000000"))
+    # -- determinism witness: a 50k slice, twice, one digest ------------
+    slice_n = min(50_000, requests)
+    d1, _ = _day(slice_n, seed=11)
+    d2, _ = _day(slice_n, seed=11)
+    if d1.digest() != d2.digest():
+        raise AssertionError(
+            f"sim day not bit-identical: {d1.digest()} != {d2.digest()}"
+        )
+    # -- the 1M-request diurnal day -------------------------------------
+    t0 = time.perf_counter()
+    report, ticks = _day(requests)
+    wall = time.perf_counter() - t0
+    if report.dropped:
+        raise AssertionError(f"{report.dropped} requests dropped")
+    events = requests + ticks  # arrivals + scheduler ticks replayed
+    # -- policy headline: the sweep point the ROADMAP asks for ----------
+    from mpistragglers_jl_tpu.sim import sweep_router_policy
+
+    sweep = sweep_router_policy(
+        requests=3000, load=0.8, straggler={0: 1.8}, tick_sigma=0.25,
+        seed=4,
+        policies=("round_robin", "least_loaded", "prefix_affinity"),
+    )
+    p99x = sweep["p99_vs_round_robin"]
+    return {
+        "sim_requests": requests,
+        "sim_wall_s": round(wall, 2),
+        "req_per_s": round(requests / wall),
+        "events_per_s": round(events / wall),
+        "virtual_s": round(report.virtual_s, 1),
+        "p99_ttft_ms": round(report.p99_ttft() * 1e3, 2),
+        "digest": (
+            f"{requests/1e6:g}M/{requests/wall/1e3:.0f}kreq/s"
+            f"/x{p99x:.2f}"
+        ),
+        "deterministic": True,
+        "replay_digest": d1.digest(),
+        "sweep_best": sweep["best"],
+        "sweep_p99_ms": {
+            e["policy"]: round(e["p99_ttft_s"] * 1e3, 2)
+            for e in sweep["entries"]
+        },
+        # compact-line scalars (benchmarks/README.md round-15 note)
+        "router_p99_x": round(p99x, 2),
+        "router_sim_Mreq_s": round(requests / wall / 1e6, 3),
+    }
+
+
+class _TimedReplica:
+    """Forwarding proxy that clocks the scheduler's own step() wall, so
+    the live rung can separate router bookkeeping from scheduler ticks
+    (the <= 5% budget is on the ROUTER'S share)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.step_s = 0.0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def step(self):
+        t0 = time.perf_counter()
+        out = self.inner.step()
+        self.step_s += time.perf_counter() - t0
+        return out
+
+
+def _live_fleet(params, cfg, stall_s):
+    from mpistragglers_jl_tpu.models.serving import ServingScheduler
+
+    class Stalled(ServingScheduler):
+        """A replica rate-limited to one tick per ``stall_s`` of wall
+        clock — slow WITHOUT blocking the shared step loop (a sleeping
+        straggler would serialize every replica behind it, which is
+        exactly what independent scheduler processes do not do; the
+        gate models the slow box, not a slow loop)."""
+
+        _last_gate = 0.0
+
+        def step(self):
+            now = time.perf_counter()
+            if now - self._last_gate < stall_s:
+                return []
+            self._last_gate = now
+            return super().step()
+
+    mk = lambda cls: cls(params, cfg, slots=4, n_inner=4,  # noqa: E731
+                         prompt_chunk=32, max_prompt=64)
+    return [
+        _TimedReplica(mk(Stalled if i == 3 else ServingScheduler))
+        for i in range(4)
+    ]
+
+
+def _drive_live(router, prompts, max_new, inter_arrival_s,
+                kill_at=None, recover_at=None, min_work_s=0.0):
+    """Open-loop pacing on the wall clock: request i is due at
+    ``t0 + i * inter_arrival_s`` and EVERY due request is submitted
+    before the next step (no sleeps, and the pacing survives slow
+    iterations — a single-threaded loop must not let tick time dilute
+    the offered load); optionally mark a replica down/up at given
+    request indices (the kill/recover leg)."""
+    rrs = []
+    t0 = time.perf_counter()
+    # overhead accounting: only iterations where a scheduler actually
+    # ticked count toward the router-vs-tick share — iterations that
+    # spin on a rate-gated straggler are loop artifacts, not
+    # per-request router cost (the <= 5% budget is bookkeeping per
+    # unit of TICK work)
+    step_work = 0.0
+    sched_work = 0.0
+    sched_prev = 0.0
+    i = 0
+    while i < len(prompts) or router.in_flight:
+        now = time.perf_counter() - t0
+        while i < len(prompts) and now >= i * inter_arrival_s:
+            if kill_at is not None and i == kill_at:
+                router.mark_down(3)
+            if recover_at is not None and i == recover_at:
+                router.mark_up(3)
+            rrs.append(router.submit(prompts[i], max_new))
+            i += 1
+        s0 = time.perf_counter()
+        router.step()
+        dt = time.perf_counter() - s0
+        sched_now = sum(r.step_s for r in router.replicas)
+        if sched_now - sched_prev > min_work_s:
+            # a real tick ran (the threshold screens out iterations
+            # whose only "work" was a rate-gate check, microseconds)
+            step_work += dt
+            sched_work += sched_now - sched_prev
+        sched_prev = sched_now
+    return rrs, step_work, sched_work
+
+
+def bench_router_live_rung(requests: int = 40):
+    """The live half (driver key ``router.live``, budget-guarded):
+    real schedulers, real wall clock — the p99 margin, the kill leg,
+    and the router-overhead share."""
+    from mpistragglers_jl_tpu.models.router import RequestRouter
+    from mpistragglers_jl_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab=61, d_model=64, n_heads=8, n_kv_heads=2, n_layers=2,
+        d_ff=128, attn_window=6,
+    )
+    params = init_params(cfg, seed=3)
+    rng = np.random.default_rng(8)
+    prompts = [
+        rng.integers(1, cfg.vocab, size=24).astype(np.int32)
+        for _ in range(requests)
+    ]
+    # calibrate the warm tick: run one request to completion first
+    # (admission/tick/place programs compile there), then measure a
+    # second — compile time in tick_s would blow the pacing and the
+    # stall scale
+    fleet = _live_fleet(params, cfg, 0.0)
+    warm = fleet[0]
+    warm.submit(prompts[0], 8)
+    while warm.active or warm.pending:
+        warm.step()
+    warm.submit(prompts[1], 8)
+    t0 = time.perf_counter()
+    n0 = warm.tick_count
+    while warm.active or warm.pending:
+        warm.step()
+    tick_s = (time.perf_counter() - t0) / max(warm.tick_count - n0, 1)
+    stall_s = 4.0 * tick_s  # replica 3 ticks at 1/4 the fleet rate
+    # 0.8 utilization, calibrated EMPIRICALLY: a closed-loop burst
+    # through the real straggling fleet measures the capacity the
+    # single-threaded step loop actually delivers (a tick-math
+    # estimate overstates it — the loop serializes replica ticks — and
+    # overload on both sides would bury the policy difference under
+    # queueing)
+    fleet = _live_fleet(params, cfg, stall_s)
+    router = RequestRouter(fleet, policy="least_loaded")
+    burst = min(24, requests)
+    t0 = time.perf_counter()
+    for p in prompts[:burst]:
+        router.submit(p, 16)
+    router.drain()
+    fleet_rate = burst / (time.perf_counter() - t0)
+    inter = 1.0 / (0.8 * fleet_rate)
+    out = {"tick_ms": round(tick_s * 1e3, 2),
+           "stall_ms": round(stall_s * 1e3, 2),
+           "fleet_req_s": round(fleet_rate, 1),
+           "requests": requests}
+    p99 = {}
+    for policy in ("round_robin", "least_loaded"):
+        fleet = _live_fleet(params, cfg, stall_s)
+        router = RequestRouter(fleet, policy=policy)
+        rrs, step_work, sched_work = _drive_live(
+            router, prompts, 16, inter, min_work_s=0.1 * tick_s
+        )
+        assert all(rr.finished for rr in rrs)
+        ttfts = np.asarray([rr.ttft for rr in rrs])
+        p99[policy] = float(np.percentile(ttfts, 99))
+        out[policy] = {
+            "p50_ttft_ms": round(
+                float(np.percentile(ttfts, 50)) * 1e3, 2
+            ),
+            "p99_ttft_ms": round(p99[policy] * 1e3, 2),
+            "router_overhead_pct": round(
+                max(step_work - sched_work, 0.0) / step_work * 100, 2
+            ),
+        }
+    out["live_p99_x"] = round(p99["round_robin"] / p99["least_loaded"], 2)
+    out["p99_margin_ok"] = out["live_p99_x"] >= 1.15
+    out["overhead_ok"] = (
+        out["least_loaded"]["router_overhead_pct"] <= 5.0
+    )
+    # -- kill/recover leg: one replica dies mid-run, zero drops ---------
+    # (denser arrivals + max_new=24 keep every replica holding live
+    # requests, so the killed one actually has in-flight work to
+    # re-route when the flip lands)
+    fleet = _live_fleet(params, cfg, 0.0)
+    router = RequestRouter(fleet, policy="least_loaded")
+    n_kill = max(requests // 2, 12)
+    rrs, _, _ = _drive_live(
+        router, prompts[:n_kill], 24, inter * 0.3,
+        kill_at=8, recover_at=n_kill - 4,
+    )
+    dropped = sum(not rr.finished for rr in rrs)
+    out["kill_leg"] = {
+        "dropped": dropped,
+        "rerouted": router.n_rerouted,
+        "zero_drop_ok": dropped == 0 and router.n_rerouted > 0,
+    }
+    return out
+
+
+if __name__ == "__main__":
+    import json
+
+    out = bench_router_rung(
+        requests=int(os.environ.get("ROUTER_BENCH_REQUESTS", "200000"))
+    )
+    out["live"] = bench_router_live_rung()
+    print(json.dumps(out, default=str))

@@ -36,6 +36,9 @@ _HOME = {
     "PagePool": "paging",
     "PagePoolExhausted": "paging",
     "prefix_page_digests": "paging",
+    "RequestRouter": "router",
+    "RoutedRequest": "router",
+    "ROUTER_POLICIES": "router",
     "make_prefill": "decode",
     "make_decode_step": "decode",
     "make_extend": "decode",

@@ -1,0 +1,765 @@
+"""Request routing over replicated serving schedulers: the traffic tier.
+
+One :class:`~.serving.ServingScheduler` is a box; production is an
+open-loop arrival stream hitting a FLEET of them (ROADMAP item 1). This
+module is the admission/router layer in between: a
+:class:`RequestRouter` owns N scheduler replicas, picks one per
+arriving request under a pluggable policy, watches every routed
+request to its first token and retirement, hedges requests whose TTFT
+deadline blows (first-token-wins, loser cancelled — the serving-side
+instance of the paper's return-at-the-fastest-k primitive, priced per
+REQUEST instead of per epoch), and routes around a replica whose
+health flips — then resumes when it recovers. No admitted request is
+ever dropped: a dead replica's in-flight requests are re-routed onto
+the survivors (at-least-once — a re-routed stream restarts from its
+prompt; ``RoutedRequest.rerouted`` counts it).
+
+Policies (``policy=``):
+
+==================  ====================================================
+``round_robin``     cycle over routable replicas — the baseline every
+                    other policy is priced against
+``least_loaded``    fewest ``pending + active`` requests (the live
+                    queue-depth + active-slot gauges the ``_ServingObs``
+                    exporters publish), ties to the lowest index
+``prefix_affinity`` route by the paged cache's
+                    :func:`~.paging.prefix_page_digests` chain: the
+                    replica already holding the longest resident prefix
+                    of this prompt wins (shared system prompts land
+                    where their pages live, compounding the COW
+                    capacity win) — LOAD-BOUNDED: affinity yields to
+                    ``least_loaded`` once the affine replica is a full
+                    slot batch deeper than the least loaded, so a hot
+                    system prompt cannot melt one replica
+``hedge_p99``       ``least_loaded`` placement plus TTFT-deadline
+                    hedging: a request whose first token misses
+                    ``ttft_slo`` is re-dispatched onto a second
+                    replica via the :class:`~..utils.hedge.RequestHedge`
+                    machinery; first token wins, the loser is
+                    ``cancel()``-ed
+==================  ====================================================
+
+**Replica protocol.** Anything scheduler-shaped routes: ``submit(prompt,
+max_new, key=None) -> request`` (the request exposing ``tokens`` /
+``finished`` / ``admitted_tick``), ``step()``, ``cancel(request)``, and
+the ``pending`` / ``active`` load gauges. :class:`~.serving.
+ServingScheduler` satisfies it natively; :class:`~..sim.workload.
+SimReplica` satisfies it on virtual time, which is how router policies
+are priced offline (``sim/workload.py`` drives this very class over a
+simulated diurnal day; ``sim/tune.py::sweep_router_policy`` recommends
+a policy per (load, prefix-share) point). Optional members the router
+uses when present: ``pool``/``P``/``max_pages`` (paged prefix
+affinity), ``prefix_hits(prompt)`` (a replica-supplied affinity score,
+the sim shortcut), ``alive`` (the default health probe),
+``next_tick_at`` (virtual-time driver scheduling), ``last_tick_at``
+(the ``/healthz`` freshness detail).
+
+**Clocks.** ``clock=None`` reads the OS clock (live fleets);
+``clock=VirtualClock()`` prices the same router — same code path, same
+policies — in virtual time, bit-reproducibly. All TTFT/deadline math
+uses whichever clock was given; nothing here sleeps.
+
+**Observability** is strictly opt-in (the package-wide GC004 contract):
+``registry=`` exports ``router_requests_total{policy,replica,outcome}``,
+``router_hedge_fired_total``, ``router_replica_ejections_total``, the
+``router_queue_wait_seconds`` / ``router_ttft_seconds`` histograms, and
+a per-replica ``router_replica_depth`` gauge; ``flight=`` stamps
+instant events on hedge fires and replica ejections/restorations into
+the postmortem ring; ``exporter=`` registers the aggregate ``/healthz``
+check (per-replica status in the detail, 503 only when NO replica is
+admittable — :meth:`~..obs.export.ObsServer.register_router`). Dark,
+the hot path pays only ``is None`` checks.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from ..utils.hedge import RequestHedge
+from .paging import prefix_page_digests
+
+__all__ = ["RequestRouter", "RoutedRequest", "ROUTER_POLICIES"]
+
+ROUTER_POLICIES = (
+    "round_robin", "least_loaded", "prefix_affinity", "hedge_p99",
+)
+
+_NO_SCHEDULE = object()  # replica carries no next_tick_at attribute
+
+
+class RoutedRequest:
+    """The caller's handle on one routed request: ``tokens`` /
+    ``finished`` mirror :class:`~.serving.Request`, plus the routing
+    story — which replica serves it (``replica``), whether a hedge
+    fired (``hedged``) and which leg won (``outcome``), how often it
+    was re-routed off a dead replica (``rerouted``), and the
+    router-clock latency stamps (``t_submit`` / ``t_admitted`` /
+    ``t_first_token`` / ``t_done``; ``ttft`` and ``latency`` derived).
+
+    ``outcome`` at completion: ``"ok"`` (primary leg, no drama),
+    ``"hedge_won"`` (the hedge leg's first token beat the primary),
+    ``"hedged"`` (a hedge fired but the primary still won), or
+    ``"rerouted"`` (the request survived at least one replica death).
+    """
+
+    __slots__ = (
+        "id", "prompt", "max_new", "key", "t_submit", "t_admitted",
+        "t_first_token", "t_done", "replica", "hedge_replica",
+        "hedged", "rerouted", "finished", "outcome", "_legs",
+    )
+
+    _next_id = 0
+
+    def __init__(self, prompt, max_new: int, key, t_submit: float):
+        if max_new < 1:
+            # a 0-token request can never produce the first token the
+            # router resolves on — it would sit in the awaiting books
+            # forever (serving.Request enforces the same floor)
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        self.id = RoutedRequest._next_id
+        RoutedRequest._next_id += 1
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.key = key
+        self.t_submit = float(t_submit)
+        self.t_admitted: float | None = None
+        self.t_first_token: float | None = None
+        self.t_done: float | None = None
+        self.replica: int | None = None      # current primary replica
+        self.hedge_replica: int | None = None
+        self.hedged = False
+        self.rerouted = 0
+        self.finished = False
+        self.outcome: str | None = None
+        # (replica_idx, scheduler_request) in dispatch order; the
+        # winner leg is promoted to index 0 when first tokens resolve
+        self._legs: list[tuple[int, Any]] = []
+
+    @property
+    def tokens(self):
+        """The winning leg's token stream (the primary's until a hedge
+        resolves). Empty before the first token."""
+        return self._legs[0][1].tokens if self._legs else []
+
+    @property
+    def ttft(self) -> float | None:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    @property
+    def latency(self) -> float | None:
+        if self.t_done is None:
+            return None
+        return self.t_done - self.t_submit
+
+    def __repr__(self) -> str:
+        state = self.outcome if self.finished else "in-flight"
+        return (
+            f"RoutedRequest(id={self.id}, replica={self.replica}, "
+            f"{state})"
+        )
+
+
+class _RouterObs:
+    """Instrument bundle resolved once at construction (the
+    ``_ServingObs`` discipline): the routing path only increments.
+    Built when a registry or flight recorder is attached; a dark
+    router's submit/step do no observability work beyond ``is None``
+    checks."""
+
+    def __init__(self, router: "RequestRouter", registry, flight):
+        self.flight = flight
+        self._r = registry is not None
+        if not self._r:
+            self.registry = None
+            return
+        self.registry = registry
+        self.policy = router.policy
+        # outcome-labeled completions, series created lazily per
+        # (replica, outcome) and cached — label churn is tiny (N x 4)
+        self._done: dict[tuple[int, str], Any] = {}
+        self.m_hedge = registry.counter(
+            "router_hedge_fired_total",
+            help="TTFT-deadline hedges dispatched (hedge_p99 policy)",
+        )
+        self.m_eject = registry.counter(
+            "router_replica_ejections_total",
+            help="replicas ejected from routing on a health flip",
+        )
+        self.m_queue_wait = registry.histogram(
+            "router_queue_wait_seconds",
+            help="submit -> scheduler admission (first prefill chunk)",
+        )
+        self.m_ttft = registry.histogram(
+            "router_ttft_seconds",
+            help="submit -> first token, across hedges and re-routes",
+        )
+        self.m_depth = [
+            registry.gauge(
+                "router_replica_depth",
+                help="queued + active requests on the replica",
+                replica=str(i),
+            )
+            for i in range(len(router.replicas))
+        ]
+        self.m_routable = registry.gauge(
+            "router_routable_replicas",
+            help="replicas currently admitting traffic",
+        )
+
+    def completed(self, rr: RoutedRequest) -> None:
+        if not self._r:
+            return
+        key = (int(rr.replica), str(rr.outcome))
+        c = self._done.get(key)
+        if c is None:
+            c = self._done[key] = self.registry.counter(
+                "router_requests_total",
+                help="routed requests completed",
+                policy=self.policy, replica=str(key[0]),
+                outcome=key[1],
+            )
+        c.inc()
+        if rr.ttft is not None:
+            self.m_ttft.observe(rr.ttft)
+
+    def admitted(self, wait_s: float) -> None:
+        if self._r:
+            self.m_queue_wait.observe(wait_s)
+
+    def hedge_fired(self, rr: RoutedRequest, replica: int,
+                    t: float) -> None:
+        if self._r:
+            self.m_hedge.inc()
+        if self.flight is not None:
+            self.flight.event(
+                "hedge fired", src="router", t=t, request=rr.id,
+                primary=rr.replica, hedge=replica,
+            )
+
+    def ejected(self, i: int, t: float, rerouted: int) -> None:
+        if self._r:
+            self.m_eject.inc()
+        if self.flight is not None:
+            self.flight.event(
+                "replica ejected", src="router", t=t, replica=i,
+                rerouted=rerouted,
+            )
+
+    def restored(self, i: int, t: float) -> None:
+        if self.flight is not None:
+            self.flight.event(
+                "replica restored", src="router", t=t, replica=i
+            )
+
+    def depths(self, router: "RequestRouter") -> None:
+        if not self._r:
+            return
+        for i, r in enumerate(router.replicas):
+            self.m_depth[i].set(r.pending + r.active)
+        self.m_routable.set(len(router.routable_replicas))
+
+
+class RequestRouter:
+    """Admission router over N scheduler replicas (module docstring:
+    policies, replica protocol, clock semantics).
+
+    >>> router = RequestRouter([s0, s1, s2, s3], policy="least_loaded")
+    >>> rr = router.submit(prompt, max_new=64)     # open-loop arrivals
+    >>> while not rr.finished:
+    ...     router.step()                          # tick the fleet
+    >>> rr.tokens, rr.ttft
+
+    ``step()`` is one fleet tick: probe replica health (eject / restore
+    + re-route off the dead), tick every busy routable replica, resolve
+    first tokens and completions, and fire due TTFT hedges. The caller
+    owns the cadence — a live serving loop calls it hot, a virtual-time
+    driver (:func:`~..sim.workload.run_router_day`) advances the clock
+    to :meth:`next_event_at` between calls.
+
+    ``health_fn(replica) -> bool`` decides routability (default: the
+    replica's ``alive`` attribute, True when absent); ``mark_down`` /
+    ``mark_up`` override it manually, and an ejected replica's
+    in-flight requests are re-routed the moment the flip is seen —
+    zero dropped requests under a replica kill, pinned by
+    tests/test_router.py. ``ttft_slo`` (required for ``hedge_p99``,
+    ignored otherwise) is the per-request first-token budget in clock
+    seconds."""
+
+    def __init__(
+        self,
+        replicas: Sequence[Any],
+        *,
+        policy: str = "least_loaded",
+        ttft_slo: float | None = None,
+        clock=None,
+        health_fn: Callable[[Any], bool] | None = None,
+        registry=None,
+        flight=None,
+        exporter=None,
+    ):
+        self.replicas = list(replicas)
+        if not self.replicas:
+            raise ValueError("a router needs at least one replica")
+        if policy not in ROUTER_POLICIES:
+            raise ValueError(
+                f"unknown policy {policy!r}; choose one of "
+                f"{ROUTER_POLICIES}"
+            )
+        if policy == "hedge_p99":
+            if ttft_slo is None or ttft_slo <= 0:
+                raise ValueError(
+                    "hedge_p99 needs ttft_slo > 0: the policy IS the "
+                    "deadline (re-dispatch when the first token misses "
+                    "it)"
+                )
+        self.policy = policy
+        # inert unless hedging: the sim driver schedules wakeups off
+        # this, and a non-hedging router must not generate deadline
+        # events nothing will consume
+        self.ttft_slo = (
+            float(ttft_slo) if policy == "hedge_p99" else None
+        )
+        self.clock = clock
+        self._now = (
+            time.perf_counter if clock is None else clock.now
+        )
+        self._health_fn = health_fn  # None = the default `alive` probe
+        self._up = [True] * len(self.replicas)
+        self._routable: list[int] = list(range(len(self.replicas)))
+        self._down_manual: set[int] = set()
+        self._rr = 0
+        # in-flight request books, all insertion-ordered dicts (used as
+        # ordered sets): hash-order iteration would break bit-identical
+        # sim replays. _awaiting holds requests with no first token yet
+        # (keyed per replica leg); _streaming holds requests past first
+        # token, keyed by the winning replica.
+        self._awaiting: list[dict[RoutedRequest, None]] = [
+            {} for _ in self.replicas
+        ]
+        self._streaming: list[dict[RoutedRequest, None]] = [
+            {} for _ in self.replicas
+        ]
+        self._orphans: dict[RoutedRequest, None] = {}
+        self._hedge = RequestHedge()
+        self.n_submitted = 0
+        self.n_completed = 0
+        self.n_hedges = 0
+        self.n_rerouted = 0
+        self._obs = (
+            _RouterObs(self, registry, flight)
+            if registry is not None or flight is not None
+            else None
+        )
+        # initial health reading: a replica dead at construction must
+        # never receive the first submit (step() keeps probing after)
+        for i, r in enumerate(self.replicas):
+            self._up[i] = self._probe(r)
+        self._routable = [i for i, u in enumerate(self._up) if u]
+        if exporter is not None:
+            exporter.register_router(self)
+
+    # -- health ---------------------------------------------------------
+
+    @property
+    def routable_replicas(self) -> list[int]:
+        """Indices currently admitting traffic (healthy + not manually
+        marked down). Cached — rebuilt only on a health flip; this sits
+        on the per-event hot path of million-request sims."""
+        return self._routable
+
+    @property
+    def in_flight(self) -> int:
+        return self.n_submitted - self.n_completed
+
+    def mark_down(self, i: int) -> None:
+        """Manually eject replica ``i`` (an operator drain, a bench
+        kill): takes effect at the next :meth:`step`'s health probe."""
+        self._down_manual.add(int(i))
+
+    def mark_up(self, i: int) -> None:
+        self._down_manual.discard(int(i))
+
+    def replica_statuses(
+        self, *, max_tick_age_s: float = 30.0
+    ) -> list[tuple[bool, str]]:
+        """Per-replica (routable, detail) pairs for the aggregate
+        ``/healthz`` check — routability as the router currently sees
+        it, plus ``last_tick_at`` freshness detail where the replica
+        stamps it (wall-clock routers only: a virtual-time replica's
+        stamp is on the virtual axis and ages meaninglessly against
+        ``perf_counter``)."""
+        out = []
+        for i, r in enumerate(self.replicas):
+            if not self._up[i]:
+                out.append((False, "ejected"))
+                continue
+            last = getattr(r, "last_tick_at", None)
+            if self.clock is None and last is not None:
+                age = time.perf_counter() - last
+                busy = (r.pending + r.active) > 0
+                if busy and age > max_tick_age_s:
+                    out.append(
+                        (False, f"stale: last tick {age:.1f}s ago")
+                    )
+                    continue
+                out.append((True, f"ok, last tick {age:.1f}s ago"))
+                continue
+            out.append((True, "ok"))
+        return out
+
+    def _probe(self, r) -> bool:
+        hf = self._health_fn
+        return getattr(r, "alive", True) if hf is None else bool(hf(r))
+
+    def _probe_health(self) -> None:
+        now = None
+        hf = self._health_fn
+        dm = self._down_manual
+        for i, r in enumerate(self.replicas):
+            # default probe inlined: this loop runs once per step of a
+            # million-event sim, and a per-replica function call
+            # measured ~10% of the whole day
+            up = i not in dm and (
+                getattr(r, "alive", True) if hf is None else bool(hf(r))
+            )
+            if up == self._up[i]:
+                continue
+            if now is None:
+                now = self._now()
+            self._up[i] = up
+            self._routable = [
+                j for j, u in enumerate(self._up) if u
+            ]
+            if up:
+                if self._obs is not None:
+                    self._obs.restored(i, now)
+            else:
+                n = self._evacuate(i, now)
+                if self._obs is not None:
+                    self._obs.ejected(i, now, n)
+
+    def _evacuate(self, i: int, now: float) -> int:
+        """Replica ``i`` went down: every in-flight request with a leg
+        on it loses that leg; single-leg requests are re-routed onto
+        the survivors (or parked until one returns — zero drops either
+        way)."""
+        moved = 0
+        victims = list(self._awaiting[i]) + list(self._streaming[i])
+        self._awaiting[i].clear()
+        self._streaming[i].clear()
+        replica = self.replicas[i]
+        for rr in victims:
+            for j, leg in rr._legs:
+                if j != i:
+                    continue
+                # best-effort cancel: a DRAINED-but-alive replica (an
+                # operator mark_down, a transient health flip) must not
+                # keep decoding streams nobody reads — zombie legs
+                # occupy slots (and, paged, pool pages) for their whole
+                # budget and skew least_loaded on resume. A truly dead
+                # replica may raise or no-op; either is fine, the leg
+                # is abandoned regardless.
+                try:
+                    replica.cancel(leg)
+                except Exception:  # noqa: BLE001 — dead replica
+                    pass
+            rr._legs = [leg for leg in rr._legs if leg[0] != i]
+            if rr._legs:
+                # the surviving hedge leg carries the request alone
+                j = rr._legs[0][0]
+                if rr.t_first_token is None:
+                    rr.replica = j
+                    rr.hedge_replica = None
+                continue
+            self._hedge.disarm(rr)
+            self._reroute(rr, now)
+            moved += 1
+        return moved
+
+    def _reroute(self, rr: RoutedRequest, now: float) -> None:
+        routable = self.routable_replicas
+        rr.rerouted += 1
+        self.n_rerouted += 1
+        rr.t_first_token = None  # the stream restarts from the prompt
+        rr.t_admitted = None
+        if not routable:
+            # nobody to route to RIGHT NOW: park it; each step retries
+            # once a replica recovers — the request is never dropped
+            self._orphans[rr] = None
+            return
+        j = self._pick(rr.prompt, routable)
+        leg = self.replicas[j].submit(
+            rr.prompt, rr.max_new, key=rr.key
+        )
+        rr._legs = [(j, leg)]
+        rr.replica = j
+        rr.hedge_replica = None
+        self._awaiting[j][rr] = None
+        if self.policy == "hedge_p99":
+            self._hedge.arm(rr, now + self.ttft_slo)
+
+    # -- policy ---------------------------------------------------------
+
+    def _load(self, i: int) -> int:
+        r = self.replicas[i]
+        return r.pending + r.active
+
+    def _affinity(self, i: int, prompt) -> int:
+        """Resident-prefix score of ``prompt`` on replica ``i``: the
+        replica's own ``prefix_hits`` when it has one (the sim
+        shortcut), else the number of leading
+        :func:`~.paging.prefix_page_digests` pages already resident in
+        its paged pool — exactly the pages admission would share."""
+        r = self.replicas[i]
+        hits = getattr(r, "prefix_hits", None)
+        if hits is not None:
+            return int(hits(prompt))
+        pool = getattr(r, "pool", None)
+        if pool is None or not getattr(r, "paged", False):
+            return 0
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        digests = prefix_page_digests(p, r.P, r.max_pages)
+        n = 0
+        for d in digests[: max(p.size - 1, 0) // r.P]:
+            if pool.lookup(d) is None:
+                break
+            n += 1
+        return n
+
+    def _least_loaded(self, routable: list[int]) -> int:
+        # hand-rolled argmin: this runs once per submit in the
+        # million-request sims, where a key-lambda min measured ~3x
+        best, best_load = routable[0], None
+        for i in routable:
+            r = self.replicas[i]
+            load = r.pending + r.active
+            if best_load is None or load < best_load:
+                best, best_load = i, load
+        return best
+
+    def _pick(self, prompt, routable: list[int]) -> int:
+        if self.policy == "round_robin":
+            n = len(self.replicas)
+            for d in range(n):
+                i = (self._rr + d) % n
+                if i in routable:
+                    self._rr = (i + 1) % n
+                    return i
+        if self.policy == "prefix_affinity":
+            aff, aff_score = None, 0
+            for i in routable:
+                sc = self._affinity(i, prompt)
+                if sc > aff_score or (
+                    sc == aff_score and sc > 0
+                    and self._load(i) < self._load(aff)
+                ):
+                    aff, aff_score = i, sc
+            ll = self._least_loaded(routable)
+            if aff is None or aff_score == 0:
+                return ll
+            # BOUNDED-load affinity: the resident-prefix replica wins
+            # only while its load stays within one slot batch of the
+            # least loaded. Unbounded affinity melts a replica under a
+            # hot system prompt (a 0.7 share rate aimed 70% of the
+            # fleet's traffic at one quarter of its capacity — p99 went
+            # 100x, measured); the bound diverts the overflow to
+            # least_loaded, trading those requests' prefill skip for
+            # the fleet's tail.
+            slack = getattr(self.replicas[aff], "S", 1)
+            if self._load(aff) <= self._load(ll) + slack:
+                return aff
+            return ll
+        # least_loaded — also hedge_p99's placement policy
+        return self._least_loaded(routable)
+
+    # -- the request path -----------------------------------------------
+
+    def submit(self, prompt, max_new: int, key=None) -> RoutedRequest:
+        """Route one request; returns the live :class:`RoutedRequest`
+        whose ``tokens`` / ``finished`` the caller watches. Raises when
+        no replica is routable — the condition the aggregate
+        ``/healthz`` check reports as 503."""
+        routable = self.routable_replicas
+        if not routable:
+            raise RuntimeError(
+                f"no routable replicas (0 of {len(self.replicas)} "
+                "admittable); repair or mark_up a replica"
+            )
+        now = self._now()
+        rr = RoutedRequest(prompt, max_new, key, now)
+        i = self._pick(prompt, routable)
+        leg = self.replicas[i].submit(prompt, max_new, key=key)
+        rr._legs = [(i, leg)]
+        rr.replica = i
+        self._awaiting[i][rr] = None
+        if self.policy == "hedge_p99":
+            self._hedge.arm(rr, now + self.ttft_slo)
+        self.n_submitted += 1
+        return rr
+
+    def _fire_hedges(self, now: float) -> None:
+        if not self._hedge:
+            return
+        for rr in self._hedge.due(now):
+            taken = {i for i, _ in rr._legs}
+            cands = [
+                i for i in self.routable_replicas if i not in taken
+            ]
+            if not cands:
+                continue  # nowhere to hedge to; the primary stands
+            j = self._least_loaded(cands)
+            leg = self.replicas[j].submit(
+                rr.prompt, rr.max_new, key=rr.key
+            )
+            rr._legs.append((j, leg))
+            rr.hedge_replica = j
+            rr.hedged = True
+            self._awaiting[j][rr] = None
+            self.n_hedges += 1
+            if self._obs is not None:
+                self._obs.hedge_fired(rr, j, now)
+
+    def _resolve_first_tokens(self, now: float,
+                              ticked: Sequence[int]) -> None:
+        # only replicas that actually ticked can have produced a first
+        # token (the 1M-request sim's hot path: the books of the other
+        # N-1 replicas must not be rescanned per event); iterate a
+        # snapshot — winners mutate the books
+        for i in ticked:
+            if not self._awaiting[i]:
+                continue
+            for rr in list(self._awaiting[i]):
+                if rr not in self._awaiting[i]:
+                    continue  # resolved via its other leg this pass
+                winner = None
+                for idx, (j, leg) in enumerate(rr._legs):
+                    if rr.t_admitted is None and (
+                        getattr(leg, "admitted_tick", None) is not None
+                    ):
+                        rr.t_admitted = now
+                        if self._obs is not None:
+                            self._obs.admitted(now - rr.t_submit)
+                    if winner is None and len(leg.tokens) > 0:
+                        winner = idx
+                if winner is None:
+                    continue
+                j, leg = rr._legs[winner]
+                for k, (jj, loser) in enumerate(rr._legs):
+                    if k == winner:
+                        continue
+                    self._awaiting[jj].pop(rr, None)
+                    self.replicas[jj].cancel(loser)
+                rr._legs = [(j, leg)]
+                rr.replica = j
+                rr.t_first_token = now
+                self._hedge.disarm(rr)
+                self._awaiting[j].pop(rr, None)
+                self._streaming[j][rr] = None
+
+    def _resolve_completions(
+        self, now: float, ticked: Sequence[int]
+    ) -> list[RoutedRequest]:
+        done: list[RoutedRequest] = []
+        for j in ticked:
+            if not self._streaming[j]:
+                continue
+            for rr in list(self._streaming[j]):
+                leg = rr._legs[0][1]
+                if not leg.finished:
+                    continue
+                del self._streaming[j][rr]
+                rr.finished = True
+                rr.t_done = now
+                if rr.rerouted:
+                    rr.outcome = "rerouted"
+                elif rr.hedged:
+                    rr.outcome = (
+                        "hedge_won" if j == rr.hedge_replica else
+                        "hedged"
+                    )
+                else:
+                    rr.outcome = "ok"
+                self.n_completed += 1
+                if self._obs is not None:
+                    self._obs.completed(rr)
+                done.append(rr)
+        return done
+
+    def step(self) -> list[RoutedRequest]:
+        """One fleet tick; returns the requests completed in it."""
+        self._probe_health()
+        if self._orphans and self.routable_replicas:
+            now = self._now()
+            orphans, self._orphans = self._orphans, {}
+            for rr in orphans:
+                rr.rerouted -= 1  # _reroute recounts
+                self.n_rerouted -= 1
+                self._reroute(rr, now)
+        now = self._now()
+        ticked: list[int] = []
+        for i in self._routable:
+            r = self.replicas[i]
+            nt = getattr(r, "next_tick_at", _NO_SCHEDULE)
+            if nt is _NO_SCHEDULE:
+                # live replica (no tick schedule): step whenever busy
+                if r.pending or r.active:
+                    r.step()
+                    ticked.append(i)
+            elif nt is not None and nt <= now + 1e-12:
+                r.step()
+                ticked.append(i)
+        if self.clock is None:
+            now = self._now()  # live: replica ticks took real time
+        if ticked:
+            self._resolve_first_tokens(now, ticked)
+            done = self._resolve_completions(now, ticked)
+        else:
+            done = []
+        self._fire_hedges(now)
+        if self._obs is not None:
+            self._obs.depths(self)
+        return done
+
+    def next_event_at(self) -> float | None:
+        """The earliest virtual time anything router-visible happens: a
+        busy routable replica's next tick (replicas exposing
+        ``next_tick_at`` — the sim protocol) or a pending hedge
+        deadline. None when idle; the virtual-time driver
+        (:func:`~..sim.workload.run_router_day`) advances the clock
+        here between steps. Live replicas carry no tick schedule — a
+        wall-clock serving loop just calls :meth:`step` hot."""
+        best = None
+        reps = self.replicas
+        for i in self._routable:
+            t = getattr(reps[i], "next_tick_at", None)
+            if t is not None and (best is None or t < best):
+                best = t
+        if self._hedge:
+            d = self._hedge.next_deadline()
+            if d is not None and (best is None or d < best):
+                best = d
+        return best
+
+    def drain(self, *, max_steps: int = 1_000_000) -> None:
+        """Step until every in-flight request completes (live loops;
+        the sim driver uses :meth:`next_event_at` instead)."""
+        for _ in range(max_steps):
+            if self.in_flight == 0:
+                return
+            self.step()
+        raise RuntimeError(
+            f"not drained after {max_steps} steps: "
+            f"{self.in_flight} requests in flight"
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"RequestRouter({self.policy}, "
+            f"{len(self.routable_replicas)}/{len(self.replicas)} "
+            f"routable, {self.in_flight} in flight)"
+        )

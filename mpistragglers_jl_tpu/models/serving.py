@@ -916,8 +916,9 @@ class _ServingObs:
 class Request:
     """One generation request: ``prompt`` (1D int tokens) in,
     ``tokens`` (the generated ids, EOS kept if emitted) out.
-    ``finished`` flips at retirement; ``reason`` is ``"eos"`` or
-    ``"length"``."""
+    ``finished`` flips at retirement; ``reason`` is ``"eos"``,
+    ``"length"``, or ``"cancelled"`` (withdrawn via
+    :meth:`ServingScheduler.cancel` — the router's losing hedge leg)."""
 
     _next_id = 0
 
@@ -1334,6 +1335,46 @@ class ServingScheduler:
                     "serving_ticks_total", self.tick_count, t=now
                 )
         return retired
+
+    def cancel(self, req: Request) -> bool:
+        """Withdraw ``req`` wherever it currently is — queued, mid-
+        admission, or decoding — freeing its slot (and, paged, its
+        pages) for the next request. Returns True when the request was
+        live here and is now retired with ``reason == "cancelled"``;
+        False when it already finished or was never this scheduler's
+        (both leave it untouched). The replica hook the request ROUTER
+        leans on: a hedged request's losing leg must stop consuming
+        slot-ticks the moment the other replica's first token wins
+        (models/router.py, first-token-wins)."""
+        if req.finished:
+            return False
+        try:
+            self._queue.remove(req)
+        except ValueError:
+            pass
+        else:
+            self._retire_cancelled(req)
+            return True
+        for s, r in enumerate(self._slot_req):
+            if r is req:
+                st = self._admitting.pop(s, None)
+                if st is not None and self.paged:
+                    # mid-admission the slot's pages live in the plan
+                    # (_pt_host[s] stays NULL until finish), so
+                    # _free_slot's table walk would miss them — release
+                    # the committed plan here
+                    for pid in st.pids:
+                        if pid != NULL_PAGE:
+                            self.pool.decref(int(pid), wrapper=st.wraps)
+                self._free_slot(s)
+                self._retire_cancelled(req)
+                return True
+        return False
+
+    def _retire_cancelled(self, req: Request) -> None:
+        req.finished = True
+        req.reason = "cancelled"
+        req.retired_tick = self.tick_count
 
     def run(self, max_ticks: int = 10_000) -> None:
         """Tick until every queued and in-flight request retires."""

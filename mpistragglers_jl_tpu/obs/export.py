@@ -28,10 +28,12 @@ auth posture.
 Stdlib-only (``http.server`` + ``json``), and opt-in like everything
 else in ``obs/``: layers take ``exporter=None`` and a dark construction
 pays only the ``is None`` check (GC004). Passing ``exporter=`` to
-``ProcessBackend`` / ``ServingScheduler`` / ``HedgedServer`` registers
-the standard health checks and trace sources automatically; anything
-else uses :meth:`ObsServer.add_health` / :meth:`~ObsServer.add_recorder`
-directly.
+``ProcessBackend`` / ``ServingScheduler`` / ``HedgedServer`` /
+``RequestRouter`` registers the standard health checks and trace
+sources automatically (the router's is the aggregate fleet check:
+per-replica status in the detail, 503 only when no replica is
+admittable); anything else uses :meth:`ObsServer.add_health` /
+:meth:`~ObsServer.add_recorder` directly.
 """
 
 from __future__ import annotations
@@ -205,6 +207,41 @@ class ObsServer:
         spans = getattr(obs, "spans", None)
         if spans is not None:
             self.add_recorder(spans)
+
+    def register_router(
+        self, router, name: str = "router",
+        max_tick_age_s: float = 30.0,
+    ) -> None:
+        """Wire a :class:`~..models.router.RequestRouter` in: ONE
+        aggregate fleet check that reports every replica's status in
+        its detail (routable / ejected / tick-staleness via the
+        replica's ``last_tick_at``, the ``register_scheduler``
+        freshness signal) but goes 503 ONLY when no replica is
+        admittable — a fleet that lost one replica of four is degraded
+        detail, not an outage (the router is already routing around
+        it; per-replica 503s would page an operator for a condition
+        the system self-heals). The check name is uniquified like
+        ``register_backend``'s."""
+        name = self._unique_name(name)
+
+        def check():
+            statuses = router.replica_statuses(
+                max_tick_age_s=max_tick_age_s
+            )
+            up = sum(ok for ok, _ in statuses)
+            detail = "; ".join(
+                f"replica {i}: {d}"
+                for i, (_, d) in enumerate(statuses)
+            )
+            if up == 0:
+                return False, (
+                    f"0/{len(statuses)} replicas routable — {detail}"
+                )
+            return True, (
+                f"{up}/{len(statuses)} replicas routable — {detail}"
+            )
+
+        self.add_health(name, check)
 
     def register_hedge(self, srv, name: str = "hedge") -> None:
         """Wire a :class:`~..utils.hedge.HedgedServer` in: replica

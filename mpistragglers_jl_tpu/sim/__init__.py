@@ -35,6 +35,20 @@ from .tune import (
     sweep_hedge,
     sweep_hierarchical,
     sweep_nwait,
+    sweep_router_policy,
+)
+from .workload import (
+    Arrival,
+    SimPrompt,
+    SimReplica,
+    SimRequest,
+    WorkloadReport,
+    arrivals_from_jsonl,
+    diurnal_arrivals,
+    dump_arrivals_jsonl,
+    lognormal_ticks,
+    poisson_arrivals,
+    run_router_day,
 )
 
 __all__ = [
@@ -51,6 +65,18 @@ __all__ = [
     "sweep_code_rate",
     "sweep_hedge",
     "sweep_hierarchical",
+    "sweep_router_policy",
     "recommend_nwait",
     "recovered_work_per_s",
+    "Arrival",
+    "SimPrompt",
+    "SimRequest",
+    "SimReplica",
+    "WorkloadReport",
+    "poisson_arrivals",
+    "diurnal_arrivals",
+    "arrivals_from_jsonl",
+    "dump_arrivals_jsonl",
+    "lognormal_ticks",
+    "run_router_day",
 ]

@@ -41,6 +41,7 @@ __all__ = [
     "sweep_hedge",
     "sweep_code_rate",
     "sweep_hierarchical",
+    "sweep_router_policy",
     "recommend_nwait",
     "recovered_work_per_s",
 ]
@@ -412,6 +413,208 @@ def sweep_hierarchical(
         "agree": int(best["inner_nwait"]) == inner_model,
         "check_group": int(check_group),
         "surviving_groups": len(surviving_ids),
+    }
+
+
+def sweep_router_policy(
+    *,
+    n_replicas: int = 4,
+    slots: int = 4,
+    n_inner: int = 8,
+    tick_s: float = 0.02,
+    tick_sigma: float = 0.3,
+    straggler: dict | None = None,
+    policies: Sequence[str] | None = None,
+    load: float = 0.8,
+    prefix_share: float = 0.0,
+    requests: int = 2000,
+    prompt_len: int = 96,
+    prefix_len: int = 64,
+    n_prefix_groups: int = 4,
+    max_new: int = 32,
+    prompt_chunk: int = 64,
+    ttft_slo: float | None = None,
+    admission_slo_s: float | None = None,
+    dead: Sequence[int] = (),
+    seed: int = 0,
+) -> dict[str, Any]:
+    """Recommend a request-routing policy for ONE (``load``,
+    ``prefix_share``) operating point by running the REAL
+    :class:`~..models.router.RequestRouter` — the identical routing
+    code a live fleet runs — over :class:`~.workload.SimReplica`
+    scheduler models on virtual time, one seeded Poisson stream per
+    candidate policy (same seed, so every policy faces the identical
+    arrivals). Call it per point to map a (load, prefix-share) grid.
+
+    The fleet straggles realistically: per-tick service jitter
+    (``tick_sigma`` lognormal, seeded per replica) plus optional
+    designated stragglers (``straggler={replica: tick_multiplier}``) —
+    the imbalance ``least_loaded`` routes around, ``prefix_affinity``
+    trades against locality, and ``hedge_p99`` papers over at the
+    cost of duplicate dispatches. ``load`` is offered load as a
+    fraction of the admittable fleet's mean service capacity; ``dead``
+    replicas are killed before the run (the router must route around
+    them from the first request).
+
+    Refusals, never clamps (the ``sweep_nwait`` contract — each names
+    its floor, pinned by tests/test_sim_workload.py):
+
+    * **zero admittable replicas** — every replica dead: no admission
+      SLO is meetable by any policy;
+    * **offered load >= 1** — open-loop saturation: queues grow
+      without bound, so no routing policy can meet an admission SLO;
+    * **hedge_p99 without ttft_slo** — the deadline IS the policy;
+    * **no policy meets the admission SLO** (post-run, when
+      ``admission_slo_s`` is given and every candidate's p99 queue
+      wait exceeds it).
+
+    Returns entries per policy (p50/p99/mean TTFT, p99 queue wait,
+    hedges, re-routes, shared admissions, ``admissible``), ``best``
+    (lowest p99 TTFT among admissible policies), and
+    ``p99_vs_round_robin`` — the headline ratio the bench rung pins.
+    """
+    # lazy, like sweep_hierarchical's ops import: models/ is the
+    # accelerator package namespace (the router itself is jax-free) —
+    # keep the sim/ GC001 hermetic closure provably clean
+    from ..models.router import ROUTER_POLICIES, RequestRouter
+    from .workload import (
+        SimReplica,
+        lognormal_ticks,
+        poisson_arrivals,
+        run_router_day,
+    )
+
+    n_replicas = int(n_replicas)
+    dead_set = {int(d) for d in dead}
+    if not (dead_set <= set(range(n_replicas))):
+        raise ValueError(
+            f"dead replicas {sorted(dead_set)} outside the fleet "
+            f"[0, {n_replicas})"
+        )
+    admittable = n_replicas - len(dead_set)
+    if admittable < 1:
+        raise ValueError(
+            f"sweep refused: zero admittable replicas "
+            f"({len(dead_set)} of {n_replicas} dead) — no routing "
+            "policy can admit anything"
+        )
+    load = float(load)
+    if not (0.0 < load < 1.0):
+        raise ValueError(
+            f"sweep refused: offered load {load:.2f} must sit in "
+            "(0, 1) — at or beyond 1 the open-loop queue grows "
+            "without bound and no routing policy can meet an "
+            "admission SLO"
+        )
+    if policies is None:
+        policies = [
+            p for p in ROUTER_POLICIES
+            if p != "hedge_p99" or ttft_slo is not None
+        ]
+    policies = list(policies)
+    unknown = [p for p in policies if p not in ROUTER_POLICIES]
+    if unknown:
+        raise ValueError(
+            f"unknown router policies {unknown}; choose from "
+            f"{ROUTER_POLICIES}"
+        )
+    if "hedge_p99" in policies and ttft_slo is None:
+        raise ValueError(
+            "sweep refused: hedge_p99 without ttft_slo — the TTFT "
+            "deadline IS the policy; pass ttft_slo=<seconds>"
+        )
+    mult = {int(k): float(v) for k, v in (straggler or {}).items()}
+    # offered rate = load x the admittable fleet's mean service
+    # capacity (slot-holding ticks per request at the mean tick)
+    ticks_per_req = (
+        -(-int(prompt_len) // int(prompt_chunk))
+        + -(-max(int(max_new) - 1, 0) // int(n_inner))
+    )
+    per_slot_rate = 1.0 / (ticks_per_req * float(tick_s))
+    fleet_rate = sum(
+        int(slots) * per_slot_rate / mult.get(i, 1.0)
+        for i in range(n_replicas) if i not in dead_set
+    )
+    rate = load * fleet_rate
+    entries: list[dict] = []
+    for policy in policies:
+        clock = VirtualClock()
+        replicas = []
+        for i in range(n_replicas):
+            rep = SimReplica(
+                clock, slots=slots, n_inner=n_inner,
+                prompt_chunk=prompt_chunk,
+                tick_s=lognormal_ticks(
+                    float(tick_s) * mult.get(i, 1.0),
+                    float(tick_sigma), seed=int(seed) * 1009 + i,
+                ),
+            )
+            if i in dead_set:
+                rep.kill()
+            replicas.append(rep)
+        router = RequestRouter(
+            replicas, policy=policy, clock=clock,
+            ttft_slo=ttft_slo if policy == "hedge_p99" else None,
+        )
+        report = run_router_day(
+            router,
+            poisson_arrivals(
+                rate, n=requests, seed=seed, prompt_len=prompt_len,
+                max_new=max_new, prefix_share=prefix_share,
+                prefix_len=prefix_len,
+                n_prefix_groups=n_prefix_groups,
+            ),
+        )
+        waits = np.asarray([
+            (r.t_admitted - r.t_submit) for r in report.requests
+            if r.t_admitted is not None
+        ])
+        p99_wait = (
+            float(np.percentile(waits, 99)) if waits.size else 0.0
+        )
+        entries.append({
+            "policy": policy,
+            "p50_ttft_s": report.p50_ttft(),
+            "p99_ttft_s": report.p99_ttft(),
+            "mean_ttft_s": float(report.ttft.mean()),
+            "p99_queue_wait_s": p99_wait,
+            "completed": report.n - report.dropped,
+            "dropped": report.dropped,
+            "hedges": report.n_hedges,
+            "rerouted": report.n_rerouted,
+            "shared_admits": sum(
+                r.n_shared_admits for r in replicas
+            ),
+            "admissible": (
+                admission_slo_s is None
+                or p99_wait <= float(admission_slo_s)
+            ),
+        })
+    ok = [e for e in entries if e["admissible"]]
+    if not ok:
+        raise ValueError(
+            f"no policy meets the admission SLO: every candidate's "
+            f"p99 queue wait exceeds {admission_slo_s}s at load "
+            f"{load:.2f} (swept {[e['policy'] for e in entries]}) — "
+            "add replicas or shed load; the sweep refuses rather "
+            "than recommend a policy that cannot admit"
+        )
+    best = min(ok, key=lambda e: e["p99_ttft_s"])
+    rr = next(
+        (e for e in entries if e["policy"] == "round_robin"), None
+    )
+    return {
+        "entries": entries,
+        "best": best["policy"],
+        "best_entry": best,
+        "p99_vs_round_robin": (
+            None if rr is None
+            else rr["p99_ttft_s"] / best["p99_ttft_s"]
+        ),
+        "load": load,
+        "prefix_share": float(prefix_share),
+        "rate_req_s": rate,
+        "requests": int(requests),
     }
 
 

@@ -11,11 +11,12 @@ from .checkpoint import state_dict, load_state_dict, save, restore
 from .rs_gf256 import RSGF256
 from .straggle import AdaptiveNwait, PoolLatencyModel, WorkerStats
 from .coded_checkpoint import CodedCheckpoint, CheckpointCorrupt
-from .hedge import HedgedServer
+from .hedge import HedgedServer, RequestHedge
 
 __all__ = [
     "faults",
     "HedgedServer",
+    "RequestHedge",
     "AdaptiveNwait",
     "PoolLatencyModel",
     "WorkerStats",

@@ -28,6 +28,7 @@ winner; ``drain`` (shutdown) is the one full barrier.
 
 from __future__ import annotations
 
+import heapq
 from typing import Sequence
 
 import numpy as np
@@ -35,7 +36,91 @@ import numpy as np
 from ..backends.base import Backend, WorkerFailure
 from ..pool import AsyncPool, asyncmap, waitall
 
-__all__ = ["HedgedServer"]
+__all__ = ["HedgedServer", "RequestHedge"]
+
+
+class RequestHedge:
+    """Deadline bookkeeping for REQUEST-level hedging: the serving-tier
+    counterpart of :class:`HedgedServer`'s task-level first-wins.
+
+    A :class:`~..models.router.RequestRouter` running the ``hedge_p99``
+    policy arms one TTFT deadline per routed request; when the deadline
+    passes without a first token the router re-dispatches the request
+    onto a second scheduler replica, and whichever replica produces the
+    first token wins (the loser is cancelled). This class is the
+    bookkeeping half of that machinery — which requests are armed, which
+    are due, fire-exactly-once — kept here next to ``HedgedServer`` so
+    both hedging layers share one home and one semantics doc:
+
+    * **arm(obj, deadline)** — start watching ``obj`` (any hashable-by-
+      identity request handle) against an absolute clock time (virtual
+      or wall — the caller owns the clock, exactly like the router);
+    * **due(now)** — every armed entry whose deadline has passed, in
+      (deadline, arm-sequence) order (deterministic — never set-hash
+      order: sim replays must be bit-identical), each handed out
+      EXACTLY ONCE (firing disarms);
+    * **disarm(obj)** — the first token arrived (or the request was
+      re-routed) before the deadline: stop watching;
+    * **next_deadline()** — the earliest pending deadline, so a
+      virtual-time driver can advance straight to the next hedge fire.
+
+    Internally a (deadline, seq) heap over a liveness dict with lazy
+    tombstones: ``due``/``next_deadline`` run once per router step of a
+    million-event simulated day, and a full scan of the armed set per
+    event is O(events x in-flight) — the scaling cliff this class must
+    not have. Disarm/re-arm leave stale heap entries that the next
+    heap touch discards by seq mismatch; every armed entry is pushed
+    exactly once, so total heap work is O(arms log arms) per day.
+
+    Single-threaded by design: the router mutates it only between
+    scheduler ticks (the tick loop is the one writer), so unlike
+    ``HedgedServer`` there is no cross-thread harvest to guard.
+    """
+
+    def __init__(self):
+        # id(obj) -> (deadline, seq, obj); the heap holds
+        # (deadline, seq, id) and an entry is live iff the dict still
+        # maps its id to the SAME (deadline, seq)
+        self._armed: dict[int, tuple[float, int, object]] = {}
+        self._heap: list[tuple[float, int, int]] = []
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._armed)
+
+    def arm(self, obj, deadline: float) -> None:
+        self._seq += 1
+        key = id(obj)
+        self._armed[key] = (float(deadline), self._seq, obj)
+        heapq.heappush(self._heap, (float(deadline), self._seq, key))
+
+    def disarm(self, obj) -> None:
+        self._armed.pop(id(obj), None)  # heap entry becomes a tombstone
+
+    def _drop_tombstones(self) -> None:
+        heap, armed = self._heap, self._armed
+        while heap:
+            d, s, k = heap[0]
+            live = armed.get(k)
+            if live is not None and live[0] == d and live[1] == s:
+                return
+            heapq.heappop(heap)
+
+    def due(self, now: float) -> list:
+        """Armed entries whose deadline has passed, in (deadline,
+        arm-sequence) order; each is disarmed as it is returned (fire
+        exactly once)."""
+        out = []
+        while True:
+            self._drop_tombstones()
+            if not self._heap or self._heap[0][0] > now:
+                return out
+            _, _, k = heapq.heappop(self._heap)
+            out.append(self._armed.pop(k)[2])
+
+    def next_deadline(self) -> float | None:
+        self._drop_tombstones()
+        return self._heap[0][0] if self._heap else None
 
 
 class HedgedServer:

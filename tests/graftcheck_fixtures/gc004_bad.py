@@ -47,3 +47,11 @@ def hier_decode(arrived, registry=None, flight=None):
     registry.counter("hier_outer_recoveries_total").inc()  # GC004 line 47
     flight.event("hier outer recovery")  # GC004 line 48
     return arrived
+
+
+def route_request(replica, registry=None, flight=None):
+    # the round-15 router telemetry shape: counting a routed request
+    # and stamping the hedge-fire instant event without the None guards
+    registry.counter("router_requests_total").inc()  # GC004 line 55
+    flight.event("hedge fired", replica=replica)  # GC004 line 56
+    return replica

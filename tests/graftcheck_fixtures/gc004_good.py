@@ -58,6 +58,17 @@ def scrape(payload, exporter=None, flight=None):
     return payload if ok else None
 
 
+def route_request(replica, registry=None, flight=None):
+    """The round-15 router telemetry shape, correctly guarded: the
+    completion counter and the hedge-fire instant event each behind
+    their own None check."""
+    if registry is not None:
+        registry.counter("router_requests_total").inc()
+    if flight is not None:
+        flight.event("hedge fired", replica=replica)
+    return replica
+
+
 def page_pool_tick(pool, registry=None):
     """The paged-cache telemetry shape with the guard: occupancy
     gauges and share/COW counters only touch the registry inside the

@@ -42,6 +42,18 @@ def test_policy_tuning_example(tmp_path):
     assert (tmp_path / "straggling_run.jsonl").exists()
 
 
+def test_router_demo_example():
+    """The serving-tier router walkthrough: a seeded diurnal day priced
+    per policy on virtual time, numpy-only and seconds by construction
+    (like policy_tuning), so it runs in tier-1."""
+    out = _run_example("router_demo.py")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "winner:" in out.stdout
+    assert "better than round_robin" in out.stdout
+    assert "(bit-identical)" in out.stdout
+    assert "router demo ok" in out.stdout
+
+
 @pytest.mark.slow
 def test_straggler_aware_training_converges(tmp_path):
     out = _run_example("straggler_aware_training.py", str(tmp_path))

@@ -54,7 +54,8 @@ def _keys(findings):
         ("gc004_bad.py", [("GC004", 6), ("GC004", 12), ("GC004", 17),
                           ("GC004", 22), ("GC004", 26),
                           ("GC004", 33), ("GC004", 40),
-                          ("GC004", 47), ("GC004", 48)]),
+                          ("GC004", 47), ("GC004", 48),
+                          ("GC004", 55), ("GC004", 56)]),
         (
             "gc005_bad.py",
             [("GC005", 17), ("GC005", 18), ("GC005", 21),
@@ -112,7 +113,8 @@ def test_baseline_roundtrip(tmp_path):
     assert _keys(res.fresh) == [("GC004", 12), ("GC004", 17),
                                 ("GC004", 22), ("GC004", 26),
                                 ("GC004", 33), ("GC004", 40),
-                                ("GC004", 47), ("GC004", 48)]
+                                ("GC004", 47), ("GC004", 48),
+                                ("GC004", 55), ("GC004", 56)]
     assert res.baseline_size == 1
 
 
