@@ -467,6 +467,20 @@ def driver_contract(budget_s: float | None = None) -> dict:
         # the transformer/serving block below, where jax is warm.
         out["router"] = _try_rung(rung_router, est=50, scale=False)
 
+        def rung_disagg():
+            from benchmarks.disagg_bench import bench_disagg_rung
+
+            return bench_disagg_rung()
+
+        # round-16 disaggregation rung, sim half — unscaled like the
+        # router rung: the swept (n_prefill, n_decode) split vs the
+        # unified fleet on the mixed long-prompt/short-chat diurnal
+        # day at equal chip count (disagg_decode_p99_x >= 1.5 gate)
+        # plus the 4k-request two-tier day's bit-identity witness.
+        # The live half (real handoff + migration-ring GB/s) runs
+        # after the transformer block, where jax is warm.
+        out["disagg"] = _try_rung(rung_disagg, est=45, scale=False)
+
         def rung_transport():
             from benchmarks.transport_bench import bench_transport_rung
 
@@ -557,6 +571,23 @@ def driver_contract(budget_s: float | None = None) -> dict:
             out["router"]["live"] = rl
         else:
             out["router_live"] = rl
+
+        def rung_disagg_live():
+            from benchmarks.disagg_bench import bench_disagg_live_rung
+
+            return bench_disagg_live_rung()
+
+        # round-16 disaggregation rung, live half (budget-guarded,
+        # scaled: one real jitted prefill->decode handoff with oracle
+        # parity asserted) + the migration ring's measured two-way
+        # transfer rate (disagg_migrate_gbs)
+        dl = _try_rung(rung_disagg_live, est=30)
+        if isinstance(out.get("disagg"), dict) and not (
+            "skipped" in out["disagg"] or "error" in out["disagg"]
+        ):
+            out["disagg"]["live"] = dl
+        else:
+            out["disagg_live"] = dl
         # systematic-LT overhead rung (VERDICT r2 item 4): real pool
         # path, one permanent straggler, systematic vs classic stream
         out["rateless_overhead"] = _try_rung(
@@ -625,6 +656,14 @@ def _contract_line(out: dict) -> str:
             out.get("router"), "router_p99_x"),
         "router_sim_Mreq_s": _rung_summary(
             out.get("router"), "router_sim_Mreq_s"),
+        "disagg_decode_p99_x": _rung_summary(
+            out.get("disagg"), "disagg_decode_p99_x"),
+        "disagg_migrate_gbs": _rung_summary(
+            (out.get("disagg") or {}).get(
+                "live", out.get("disagg_live"))
+            if isinstance(out.get("disagg"), dict)
+            else out.get("disagg_live"),
+            "disagg_migrate_gbs"),
         "transport": _rung_summary(out.get("transport"), "digest"),
         "adaptive_speedup": _rung_summary(
             out.get("adaptive_nwait"), "speedup"),

@@ -1,0 +1,244 @@
+"""Round-16 disaggregation rung: prefill/decode tiers + KV-page migration.
+
+Two halves, mirroring the router rung's shape:
+
+* **sim** (:func:`bench_disagg_rung`, unscaled — virtual-time
+  bookkeeping does not track the matmul rate): a mixed long-prompt/
+  short-chat diurnal day at EQUAL chip count, unified fleet vs the
+  ``sweep_tier_split``-swept disaggregated split, headline
+  ``disagg_decode_p99_x`` = unified decode p99 / disaggregated decode
+  p99 (per-request mean inter-token gap — the tail a long-prompt burst
+  wrecks; acceptance floor 1.5), plus the 4k-request two-tier day's
+  bit-identity witness (two runs, one sha256 digest — the
+  ``run_router_day`` contract).
+* **live** (:func:`bench_disagg_live_rung`, budget-guarded): a REAL
+  ``PrefillWorker -> DecodeReplica`` migration on the jitted
+  schedulers (token-for-token parity asserted against the oracle, the
+  end-to-end handoff wall measured) and the migration ring's transfer
+  rate — payload bytes staged through ring-sized memfd frames and read
+  back through a consumer mapping, reported as ``disagg_migrate_gbs``
+  (the rate the PERF round-16 byte model prices migrations at).
+
+Compact-line scalars (bench.py): ``disagg_decode_p99_x`` and
+``disagg_migrate_gbs``. Format documented in benchmarks/README.md
+(round-16 note).
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import time
+
+import numpy as np
+
+_N_REPLICAS = 6
+_SPLITS = [(1, 5), (2, 4), (3, 3)]
+
+
+def _mixed_day_kw(n, seed):
+    return dict(
+        n=n, period=86_400.0, amplitude=0.8, seed=seed,
+        prompt_len=64, max_new=32,
+        long_share=0.15, long_prompt_len=2048, long_max_new=32,
+    )
+
+
+def _run_day(fleet_kind, n, seed, *, split=None, threshold=None):
+    from mpistragglers_jl_tpu.models.router import RequestRouter
+    from mpistragglers_jl_tpu.sim import (
+        SimReplica,
+        VirtualClock,
+        diurnal_arrivals,
+        run_router_day,
+    )
+
+    clock = VirtualClock()
+    mk = dict(slots=4, n_inner=8, prompt_chunk=64, chunk_s=0.02)
+    if fleet_kind == "unified":
+        fleet = [
+            SimReplica(clock, **mk) for _ in range(_N_REPLICAS)
+        ]
+        router = RequestRouter(fleet, policy="least_loaded",
+                               clock=clock)
+    else:
+        n_p, n_d = split
+        fleet = [
+            SimReplica(
+                clock, tier=("prefill" if i < n_p else "decode"), **mk
+            )
+            for i in range(n_p + n_d)
+        ]
+        router = RequestRouter(
+            fleet, policy="two_tier", clock=clock, migrate_gbs=5.2,
+            migrate_threshold_bytes=threshold,
+        )
+    # equal chip count, identical arrivals: one rate for every fleet
+    # shape, ~0.63 of the unified fleet's short-request capacity
+    rate = 0.28 * _N_REPLICAS * 4 / (5 * 0.02)
+    report = run_router_day(
+        router, diurnal_arrivals(rate, **_mixed_day_kw(n, seed))
+    )
+    return report, router
+
+
+def bench_disagg_rung(requests: int | None = None):
+    """The sim half (driver rung ``disagg``): swept split vs unified
+    at equal chips + the bit-identity witness."""
+    if requests is None:
+        requests = int(os.environ.get("DISAGG_BENCH_REQUESTS", "4000"))
+    from mpistragglers_jl_tpu.sim import sweep_tier_split
+
+    # -- sweep the (n_prefill, n_decode) split + threshold offline ------
+    sweep = sweep_tier_split(
+        splits=_SPLITS, requests=min(1500, requests), seed=7,
+        long_share=0.15, long_prompt_len=2048, load=0.7,
+        chunk_s=0.02, prompt_len=64, prompt_chunk=64,
+    )
+    best_split, best_thr = sweep["best"]
+    # -- the 4k-request day, bit-identity witness (two full runs) -------
+    d1, r1 = _run_day("disagg", requests, 13, split=best_split,
+                      threshold=best_thr)
+    d2, _ = _run_day("disagg", requests, 13, split=best_split,
+                     threshold=best_thr)
+    if d1.digest() != d2.digest():
+        raise AssertionError(
+            f"two-tier day not bit-identical: {d1.digest()} != "
+            f"{d2.digest()}"
+        )
+    if d1.dropped:
+        raise AssertionError(f"{d1.dropped} requests dropped")
+    # -- unified fleet, same chips, same arrivals -----------------------
+    uni, _ = _run_day("unified", requests, 13)
+    if uni.dropped:
+        raise AssertionError(f"{uni.dropped} unified requests dropped")
+    p99x = uni.p99_decode_itl() / d1.p99_decode_itl()
+    if p99x < 1.5:
+        raise AssertionError(
+            f"disagg_decode_p99_x {p99x:.2f} below the 1.5 acceptance "
+            f"floor (unified {uni.p99_decode_itl() * 1e3:.2f} ms vs "
+            f"disagg {d1.p99_decode_itl() * 1e3:.2f} ms)"
+        )
+    return {
+        "requests": requests,
+        "swept_split": list(best_split),
+        "swept_threshold_bytes": best_thr,
+        "disagg_decode_p99_x": round(p99x, 2),
+        "unified_decode_p99_ms": round(uni.p99_decode_itl() * 1e3, 3),
+        "disagg_decode_p99_ms": round(d1.p99_decode_itl() * 1e3, 3),
+        "unified_p99_ttft_s": round(uni.p99_ttft(), 3),
+        "disagg_p99_ttft_s": round(d1.p99_ttft(), 3),
+        "migrated": r1.n_migrated,
+        "kept_local": r1.n_kept_local,
+        "migrated_mb": round(r1.migrated_bytes / 1e6, 1),
+        "replay_digest": d1.digest(),
+        "deterministic": True,
+        "digest": (
+            f"x{p99x:.2f}/{best_split[0]}p{best_split[1]}d"
+            f"/{r1.n_migrated}mig"
+        ),
+    }
+
+
+def bench_disagg_live_rung():
+    """The live half: one real jitted prefill->decode handoff (parity
+    asserted) + the migration ring's measured transfer rate."""
+    import jax.numpy as jnp
+
+    from mpistragglers_jl_tpu.models.decode import generate_ring_dense
+    from mpistragglers_jl_tpu.models.disagg import (
+        DecodeReplica,
+        MigrationPlanner,
+        MigrationRing,
+        MigrationRingReader,
+        PrefillWorker,
+    )
+    from mpistragglers_jl_tpu.models.serving import ServingScheduler
+    from mpistragglers_jl_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab=61, d_model=64, n_heads=8, n_kv_heads=2, n_layers=2,
+        d_ff=128, attn_window=24,
+    )
+    params = init_params(cfg, seed=11)
+    rng = np.random.default_rng(16)
+
+    def sched():
+        return ServingScheduler(
+            params, cfg, slots=2, n_inner=2, prompt_chunk=8,
+            max_prompt=64, page_tokens=4,
+        )
+
+    planner = MigrationPlanner()
+    pw = PrefillWorker(sched(), planner=planner)
+    dr = DecodeReplica(sched(), planner=planner)
+    prompt = rng.integers(1, cfg.vocab, size=9).astype(np.int32)
+    r = pw.submit(prompt, max_new=12)
+    while not pw.ready():
+        pw.step()
+    t0 = time.perf_counter()
+    ticket = pw.migrate_out(r)
+    payload_bytes = ticket.nbytes
+    dr.adopt(ticket)
+    handoff_ms = (time.perf_counter() - t0) * 1e3
+    dr.run()
+    oracle = [
+        int(t) for t in np.asarray(
+            generate_ring_dense(params, jnp.asarray(prompt)[None], 12,
+                                cfg)
+        )[0]
+    ]
+    if r.tokens != oracle:
+        raise AssertionError("migrated stream diverged from oracle")
+    # -- ring transfer rate: bulk payload through memfd frames ----------
+    ring = MigrationRing(slot_bytes=4 << 20, slots=4)
+    if ring.region is None:  # pragma: no cover - no memfd
+        return {
+            "skipped": "memfd_create unavailable",
+            "handoff_ms": round(handoff_ms, 2),
+        }
+    reader = MigrationRingReader(ring)
+    seg = rng.integers(0, 255, size=4 << 20, dtype=np.uint8)
+    moved = 0
+    t0 = time.perf_counter()
+    for _ in range(16):
+        frames = ring.send_segment(seg)
+        got = reader.read_segment(frames)
+        # ONE-WAY payload bytes: the segment crosses once (staged by
+        # the sender, read in place by the consumer). The router
+        # prices migration delay as ticket.nbytes / (migrate_gbs*1e9)
+        # — a per-payload rate — so counting stage+read here would
+        # report a rate 2x what a migration actually achieves and
+        # halve every modeled transfer time.
+        moved += seg.nbytes
+        if got[0] != seg[0] or got[-1] != seg[-1]:
+            raise AssertionError("ring payload corrupted")
+        ring.release_frames(frames)
+        # rebinding `got` next iteration drops the view; its finalizer
+        # fires on the refcount edge (no cycles), freeing the slot —
+        # a gc.collect() here would bill collector wall to the ring
+        del got
+    wall = time.perf_counter() - t0
+    gbs = moved / wall / 1e9
+    gc.collect()
+    stalls = ring.stalls
+    ring.close()
+    return {
+        "handoff_ms": round(handoff_ms, 2),
+        "handoff_payload_bytes": payload_bytes,
+        "disagg_migrate_gbs": round(gbs, 2),
+        "ring_stalls": stalls,
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    out = bench_disagg_rung(
+        int(os.environ.get("DISAGG_BENCH_REQUESTS", "4000"))
+    )
+    out["live"] = bench_disagg_live_rung()
+    print(json.dumps(out))

@@ -39,6 +39,12 @@ _HOME = {
     "RequestRouter": "router",
     "RoutedRequest": "router",
     "ROUTER_POLICIES": "router",
+    "PrefillWorker": "disagg",
+    "DecodeReplica": "disagg",
+    "MigrationPlanner": "disagg",
+    "MigrationTicket": "disagg",
+    "MigrationRing": "disagg",
+    "MigrationRingReader": "disagg",
     "make_prefill": "decode",
     "make_decode_step": "decode",
     "make_extend": "decode",
@@ -74,6 +80,7 @@ def clear_cached_programs() -> None:
         serving._seed_admit_paged,
         serving._place_paged,
         serving._copy_pages_paged,
+        serving._gather_ring_paged,
     ):
         cache.cache_clear()
 

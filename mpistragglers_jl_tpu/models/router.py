@@ -37,6 +37,18 @@ Policies (``policy=``):
                     replica via the :class:`~..utils.hedge.RequestHedge`
                     machinery; first token wins, the loser is
                     ``cancel()``-ed
+``two_tier``        disaggregated placement (models/disagg.py): fresh
+                    requests go ``least_loaded`` to the PREFILL tier
+                    (replicas whose ``tier`` attribute is
+                    ``"prefill"``); a stream's first token triggers a
+                    KV-page migration to the DECODE tier — the
+                    residency-affine, load-bounded decode replica
+                    adopts the page set — unless its payload exceeds
+                    ``migrate_threshold_bytes`` (it then decodes where
+                    it prefilled). ``migrate_gbs`` prices the transfer
+                    on the router clock (virtual seconds in sim; None
+                    lands migrations in the same step, the live path
+                    where the adoption itself takes the wall time)
 ==================  ====================================================
 
 **Replica protocol.** Anything scheduler-shaped routes: ``submit(prompt,
@@ -85,6 +97,7 @@ __all__ = ["RequestRouter", "RoutedRequest", "ROUTER_POLICIES"]
 
 ROUTER_POLICIES = (
     "round_robin", "least_loaded", "prefix_affinity", "hedge_p99",
+    "two_tier",
 )
 
 _NO_SCHEDULE = object()  # replica carries no next_tick_at attribute
@@ -108,7 +121,8 @@ class RoutedRequest:
     __slots__ = (
         "id", "prompt", "max_new", "key", "t_submit", "t_admitted",
         "t_first_token", "t_done", "replica", "hedge_replica",
-        "hedged", "rerouted", "finished", "outcome", "_legs",
+        "hedged", "rerouted", "migrated", "finished", "outcome",
+        "_legs",
     )
 
     _next_id = 0
@@ -132,6 +146,7 @@ class RoutedRequest:
         self.hedge_replica: int | None = None
         self.hedged = False
         self.rerouted = 0
+        self.migrated = False  # the stream moved tiers (two_tier)
         self.finished = False
         self.outcome: str | None = None
         # (replica_idx, scheduler_request) in dispatch order; the
@@ -210,6 +225,33 @@ class _RouterObs:
             "router_routable_replicas",
             help="replicas currently admitting traffic",
         )
+        # disaggregation series (two_tier only): the handoff plane's
+        # whole telemetry budget lives here — ONE counting point for
+        # live tier wrappers and sim replicas alike, since every
+        # migration flows through the router's book
+        self._two_tier = router.policy == "two_tier"
+        if self._two_tier:
+            self._mig: dict[str, Any] = {}  # reason -> counter
+            self.m_mig_pages = registry.counter(
+                "disagg_migrated_pages_total",
+                help="KV pages moved prefill -> decode",
+            )
+            self.m_mig_bytes = registry.counter(
+                "disagg_migrated_bytes_total",
+                help="KV payload bytes moved prefill -> decode",
+            )
+            self.m_mig_s = registry.histogram(
+                "disagg_migration_seconds",
+                help="capture -> adoption, router clock",
+            )
+            self.m_tier_depth = {
+                t: registry.gauge(
+                    "disagg_tier_depth",
+                    help="queued + active requests on the tier",
+                    tier=t,
+                )
+                for t in ("prefill", "decode")
+            }
 
     def completed(self, rr: RoutedRequest) -> None:
         if not self._r:
@@ -256,12 +298,47 @@ class _RouterObs:
                 "replica restored", src="router", t=t, replica=i
             )
 
+    def migrated(self, rr: RoutedRequest, ticket, j: int, t: float,
+                 dur: float) -> None:
+        """One landed handoff: counters by reason, the page/byte
+        tallies the PERF byte model prices, the capture->adoption
+        latency, and the flight-recorder instant event."""
+        if self._r:
+            reason = str(getattr(ticket, "reason", "prefill_done"))
+            c = self._mig.get(reason)
+            if c is None:
+                c = self._mig[reason] = self.registry.counter(
+                    "disagg_migrations_total",
+                    help="KV-page migrations landed on the decode tier",
+                    reason=reason,
+                )
+            c.inc()
+            self.m_mig_pages.inc(int(getattr(ticket, "pages", 0)))
+            self.m_mig_bytes.inc(int(getattr(ticket, "nbytes", 0)))
+            self.m_mig_s.observe(dur)
+        if self.flight is not None:
+            self.flight.event(
+                "kv migrated", src="router", t=t, request=rr.id,
+                dest=j, pages=int(getattr(ticket, "pages", 0)),
+                nbytes=int(getattr(ticket, "nbytes", 0)),
+            )
+
     def depths(self, router: "RequestRouter") -> None:
         if not self._r:
             return
         for i, r in enumerate(router.replicas):
             self.m_depth[i].set(r.pending + r.active)
         self.m_routable.set(len(router.routable_replicas))
+        if self._two_tier:
+            for t, members in (
+                ("prefill", router._prefill_set),
+                ("decode", router._decode_set),
+            ):
+                self.m_tier_depth[t].set(sum(
+                    router.replicas[i].pending
+                    + router.replicas[i].active
+                    for i in members
+                ))
 
 
 class RequestRouter:
@@ -298,6 +375,8 @@ class RequestRouter:
         ttft_slo: float | None = None,
         clock=None,
         health_fn: Callable[[Any], bool] | None = None,
+        migrate_threshold_bytes: int | None = None,
+        migrate_gbs: float | None = None,
         registry=None,
         flight=None,
         exporter=None,
@@ -318,6 +397,37 @@ class RequestRouter:
                     "it)"
                 )
         self.policy = policy
+        # disaggregated placement: the fleet must actually be two
+        # tiers, and the router keeps the membership sets (replica
+        # `tier` attributes, models/disagg.py's wrappers and the sim's
+        # two-tier SimReplica both stamp them)
+        self._prefill_set: set[int] = set()
+        self._decode_set: set[int] = set()
+        if policy == "two_tier":
+            for i, r in enumerate(self.replicas):
+                t = getattr(r, "tier", "unified")
+                if t == "prefill":
+                    self._prefill_set.add(i)
+                elif t == "decode":
+                    self._decode_set.add(i)
+            if not self._prefill_set or not self._decode_set:
+                raise ValueError(
+                    "two_tier needs at least one replica in EACH tier "
+                    f"(got {len(self._prefill_set)} prefill, "
+                    f"{len(self._decode_set)} decode); tag replicas "
+                    "with tier='prefill'/'decode' "
+                    "(models/disagg.py wrappers, or SimReplica(tier=))"
+                )
+        self.migrate_threshold_bytes = (
+            None if migrate_threshold_bytes is None
+            else int(migrate_threshold_bytes)
+        )
+        self.migrate_gbs = (
+            None if migrate_gbs is None else float(migrate_gbs)
+        )
+        # in-flight migrations: rr -> [ticket, ready_at, t_captured]
+        # (insertion-ordered like every router book)
+        self._migrating: dict[RoutedRequest, list] = {}
         # inert unless hedging: the sim driver schedules wakeups off
         # this, and a non-hedging router must not generate deadline
         # events nothing will consume
@@ -350,6 +460,10 @@ class RequestRouter:
         self.n_completed = 0
         self.n_hedges = 0
         self.n_rerouted = 0
+        self.n_migrated = 0
+        self.n_kept_local = 0  # threshold / no-decode-replica keeps
+        self.n_bounced = 0  # captured but decode tier could never fit
+        self.migrated_bytes = 0
         self._obs = (
             _RouterObs(self, registry, flight)
             if registry is not None or flight is not None
@@ -543,6 +657,13 @@ class RequestRouter:
         return best
 
     def _pick(self, prompt, routable: list[int]) -> int:
+        if self.policy == "two_tier":
+            # fresh requests prefill-tier least_loaded; when the whole
+            # prefill tier is out, any routable replica serves
+            # (availability over tier purity — the decode wrappers are
+            # complete schedulers)
+            pre = [i for i in routable if i in self._prefill_set]
+            return self._least_loaded(pre if pre else routable)
         if self.policy == "round_robin":
             n = len(self.replicas)
             for d in range(n):
@@ -551,31 +672,36 @@ class RequestRouter:
                     self._rr = (i + 1) % n
                     return i
         if self.policy == "prefix_affinity":
-            aff, aff_score = None, 0
-            for i in routable:
-                sc = self._affinity(i, prompt)
-                if sc > aff_score or (
-                    sc == aff_score and sc > 0
-                    and self._load(i) < self._load(aff)
-                ):
-                    aff, aff_score = i, sc
-            ll = self._least_loaded(routable)
-            if aff is None or aff_score == 0:
-                return ll
-            # BOUNDED-load affinity: the resident-prefix replica wins
-            # only while its load stays within one slot batch of the
-            # least loaded. Unbounded affinity melts a replica under a
-            # hot system prompt (a 0.7 share rate aimed 70% of the
-            # fleet's traffic at one quarter of its capacity — p99 went
-            # 100x, measured); the bound diverts the overflow to
-            # least_loaded, trading those requests' prefill skip for
-            # the fleet's tail.
-            slack = getattr(self.replicas[aff], "S", 1)
-            if self._load(aff) <= self._load(ll) + slack:
-                return aff
-            return ll
+            return self._bounded_affinity(prompt, routable)
         # least_loaded — also hedge_p99's placement policy
         return self._least_loaded(routable)
+
+    def _bounded_affinity(self, prompt, cands: list[int]) -> int:
+        """The resident-prefix replica (longest registered prefix-digest
+        chain, the pages a placement would SHARE), load-bounded:
+        affinity wins only while its load stays within one slot batch
+        of the least loaded. Unbounded affinity melts a replica under a
+        hot system prompt (a 0.7 share rate aimed 70% of the fleet's
+        traffic at one quarter of its capacity — p99 went 100x,
+        measured); the bound diverts the overflow to least_loaded,
+        trading those requests' prefill skip for the fleet's tail.
+        Both the ``prefix_affinity`` submit path and two-tier decode
+        placement route here — one bound, not two copies."""
+        aff, aff_score = None, 0
+        for i in cands:
+            sc = self._affinity(i, prompt)
+            if sc > aff_score or (
+                sc == aff_score and sc > 0
+                and self._load(i) < self._load(aff)
+            ):
+                aff, aff_score = i, sc
+        ll = self._least_loaded(cands)
+        if aff is None or aff_score == 0:
+            return ll
+        slack = getattr(self.replicas[aff], "S", 1)
+        if self._load(aff) <= self._load(ll) + slack:
+            return aff
+        return ll
 
     # -- the request path -----------------------------------------------
 
@@ -659,7 +785,113 @@ class RequestRouter:
                 rr.t_first_token = now
                 self._hedge.disarm(rr)
                 self._awaiting[j].pop(rr, None)
+                if (
+                    self.policy == "two_tier"
+                    and j in self._prefill_set
+                    and not leg.finished
+                    and self._begin_migration(rr, j, leg, now)
+                ):
+                    continue  # in the migration book, not streaming
                 self._streaming[j][rr] = None
+
+    # -- two-tier migration (the disaggregation placement brain) --------
+
+    def _begin_migration(self, rr: RoutedRequest, i: int, leg,
+                         now: float) -> bool:
+        """First token just resolved on prefill replica ``i``: capture
+        the stream's KV pages for the decode tier, unless the payload
+        exceeds the migration-size threshold or no decode replica is
+        routable — it then decodes where it prefilled (the graceful
+        keep-local path, counted in ``n_kept_local``)."""
+        r = self.replicas[i]
+        migrate_out = getattr(r, "migrate_out", None)
+        if migrate_out is None or not any(
+            j in self._decode_set for j in self._routable
+        ):
+            self.n_kept_local += 1
+            return False
+        thr = self.migrate_threshold_bytes
+        if thr is not None:
+            size = getattr(r, "migration_nbytes", None)
+            if size is not None and size(leg) > thr:
+                self.n_kept_local += 1
+                return False
+        ticket = migrate_out(leg)
+        delay = (
+            ticket.nbytes / (self.migrate_gbs * 1e9)
+            if self.migrate_gbs else 0.0
+        )
+        self._migrating[rr] = [ticket, now + delay, now]
+        return True
+
+    def _pick_decode(self, rr: RoutedRequest,
+                     cands: list[int]) -> int:
+        """Adoption target: the decode replica already holding the
+        longest resident prefix of this stream's prompt (the pages the
+        adoption will SHARE instead of landing twice), load-bounded
+        exactly like ``prefix_affinity``; ``least_loaded`` otherwise."""
+        return self._bounded_affinity(rr.prompt, cands)
+
+    def _bounce_candidates(self, ticket) -> list[int]:
+        """Where a due-but-unadoptable migration may BOUNCE: empty
+        while parking is justified — some routable decode replica
+        could eventually adopt (``could_adopt``; a replica without the
+        verb is assumed feasible, the sim twin's unbounded queue) —
+        otherwise every routable replica that can adopt right now
+        (the prefill tier included: zero drops beats tier purity)."""
+        for j in self._routable:
+            if j not in self._decode_set:
+                continue
+            ce = getattr(self.replicas[j], "could_adopt", None)
+            if ce is None or ce(ticket):
+                return []
+        cands = []
+        for j in self._routable:
+            ca = getattr(self.replicas[j], "can_adopt", None)
+            if ca is None or ca(ticket):
+                cands.append(j)
+        return cands
+
+    def _land_migrations(self, now: float) -> None:
+        """Land every due migration whose decode tier can adopt it
+        right now; the rest stay booked and retry next step (capacity
+        frees as decode-tier requests retire — their ticks are the
+        events the sim driver advances to). Parking is only legal
+        while some routable decode replica could EVER adopt the
+        ticket (``could_adopt``): a dead decode tier, or one whose
+        every replica is config-incompatible with the stream, BOUNCES
+        it back onto any adoptable replica — zero drops, the
+        ``_evacuate`` contract extended to the mid-migration window."""
+        for rr in list(self._migrating):
+            ticket, ready, t0 = self._migrating[rr]
+            if ready > now + 1e-12:
+                continue
+            bounced = False
+            cands = []
+            for j in self._routable:
+                if j not in self._decode_set:
+                    continue
+                ca = getattr(self.replicas[j], "can_adopt", None)
+                if ca is None or ca(ticket):
+                    cands.append(j)
+            if not cands:
+                cands = self._bounce_candidates(ticket)
+                if not cands:
+                    continue  # parked (or nowhere at all yet)
+                bounced = True
+            j = self._pick_decode(rr, cands)
+            leg = self.replicas[j].adopt(ticket)
+            del self._migrating[rr]
+            rr._legs = [(j, leg)]
+            rr.replica = j
+            rr.migrated = True
+            self._streaming[j][rr] = None
+            self.n_migrated += 1
+            if bounced:
+                self.n_bounced += 1
+            self.migrated_bytes += int(getattr(ticket, "nbytes", 0))
+            if self._obs is not None:
+                self._obs.migrated(rr, ticket, j, now, now - t0)
 
     def _resolve_completions(
         self, now: float, ticked: Sequence[int]
@@ -682,6 +914,8 @@ class RequestRouter:
                         "hedge_won" if j == rr.hedge_replica else
                         "hedged"
                     )
+                elif rr.migrated:
+                    rr.outcome = "migrated"
                 else:
                     rr.outcome = "ok"
                 self.n_completed += 1
@@ -720,6 +954,8 @@ class RequestRouter:
             done = self._resolve_completions(now, ticked)
         else:
             done = []
+        if self._migrating:
+            self._land_migrations(now)
         self._fire_hedges(now)
         if self._obs is not None:
             self._obs.depths(self)
@@ -743,6 +979,24 @@ class RequestRouter:
             d = self._hedge.next_deadline()
             if d is not None and (best is None or d < best):
                 best = d
+        if self._migrating:
+            # still-transferring migrations are events; a DUE one
+            # parked on decode-tier capacity is not (its wake signal
+            # is the tier's next tick — capacity frees at retirement,
+            # and a past-due time here would spin the driver). A due
+            # one the next step would BOUNCE (decode tier dead or
+            # statically unfit, an adoptable replica elsewhere) IS an
+            # event — without it a day whose decode tier died with a
+            # parked ticket reads as stalled before the rescuing step
+            # ever runs.
+            now = self._now()
+            for ticket, ready, t0 in self._migrating.values():
+                if ready > now:
+                    if best is None or ready < best:
+                        best = ready
+                elif self._bounce_candidates(ticket):
+                    if best is None or now < best:
+                        best = now
         return best
 
     def drain(self, *, max_steps: int = 1_000_000) -> None:

@@ -544,6 +544,25 @@ def _seed_admit_paged(cfg: TransformerConfig, R: int, P: int):
 
 
 @functools.lru_cache(maxsize=32)
+def _gather_ring_paged(cfg: TransformerConfig, P: int):
+    """Materialize ONE slot's full W-row ring view out of the page
+    pool — the capture half of a KV-page migration (models/disagg.py):
+    the gathered leaves are fresh device buffers, so the source
+    scheduler can free (and reuse) the slot's pages the moment this
+    returns while the view stays valid for the destination's
+    :func:`_place_paged` scatter. Shapes match ``_finish_admit_dense``'s
+    ring output exactly — adoption IS a re-placement. The pool is only
+    read (no donation)."""
+
+    @jax.jit
+    def run(caches, pt_row):
+        W = pt_row.shape[0] * P
+        return [_paged_gather(cl, pt_row[None], W, P) for cl in caches]
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
 def _place_paged(cfg: TransformerConfig, P: int):
     """Paged install: scatter the admitted request's W ring rows into
     its pages and set the row state — :func:`_place_dense` with the
@@ -1155,6 +1174,7 @@ class ServingScheduler:
                                            self.P)
             self._place_p = _place_paged(cfg, self.P)
             self._copy = _copy_pages_paged(cfg, self.P)
+            self._gather = _gather_ring_paged(cfg, self.P)
         else:
             self.use_kernel = (
                 _kernel_possible(cfg, self.quantize_kv)
@@ -1375,6 +1395,262 @@ class ServingScheduler:
         req.finished = True
         req.reason = "cancelled"
         req.retired_tick = self.tick_count
+
+    # -- KV-page migration (models/disagg.py's replica hooks) -----------
+    #
+    # The disaggregation subsystem moves a DECODING request between
+    # paged schedulers: export gathers the slot's ring view out of the
+    # page pool (fresh device buffers) plus the row's sampler/position
+    # state and frees the slot; adopt re-plans pages in the destination
+    # pool (sharing resident prefix digests exactly like admission,
+    # reservations included), scatters the view back through the new
+    # table, and re-registers the prefix-digest chain so COW sharing
+    # survives the move. Between the two calls the request is resident
+    # NOWHERE — the planner (MigrationPlanner) owns that window,
+    # including its cancellation contract.
+
+    def _migration_slot(self, req: Request) -> int | None:
+        """The slot of a migratable request: resident, past admission
+        (first token emitted), not finished. None otherwise."""
+        if not self.paged or req.finished or not req.tokens:
+            return None
+        for s, r in enumerate(self._slot_req):
+            if r is req and s not in self._admitting:
+                return s
+        return None
+
+    def _page_row_bytes(self) -> int:
+        """Bytes one page carries across every layer and leaf."""
+        total = 0
+        for cl in self._caches:
+            for a in cl.values():
+                total += a.nbytes * self.P // a.shape[0]
+        return total
+
+    def migration_nbytes(self, req: Request) -> int:
+        """Payload bytes a migration of ``req`` would move —
+        ``pages_held * page_bytes`` summed over layers and leaves (the
+        PERF round-16 byte model). 0 when ``req`` is not migratable
+        here (queued, mid-admission, finished, or not this
+        scheduler's)."""
+        s = self._migration_slot(req)
+        if s is None:
+            return 0
+        n_pages = int(np.sum(self._pt_host[s] != NULL_PAGE))
+        return n_pages * self._page_row_bytes()
+
+    def export_page_state(self, req: Request) -> dict:
+        """Capture ``req``'s decode state as a portable page-layout
+        image and FREE its slot (pages decref'd — shared prefixes just
+        drop a reference). The returned dict is everything
+        :meth:`adopt_page_state` needs to continue the stream
+        token-for-token on another scheduler with the same params and
+        generation config: the gathered ``(1, W, ...)`` ring view per
+        layer (fresh device buffers — independent of this pool's
+        later reuse), the row's token/position/PRNG-key state, and the
+        prefix-digest chain for re-registration. The request object
+        itself is NOT finished or mutated — it is simply resident
+        nowhere until adopted."""
+        s = self._migration_slot(req)
+        if s is None:
+            raise ValueError(
+                "export_page_state: request must be decoding on this "
+                "paged scheduler (queued/mid-admission/finished "
+                "requests have no page image to move)"
+            )
+        pos = self._host_pos[s]
+        n_pages = int(np.sum(self._pt_host[s] != NULL_PAGE))
+        # prefix pages still hold the content their digests describe
+        # only while no ring write has wrapped past W (decode writes
+        # land at positions >= Tp; position p >= W overwrites page
+        # (p mod W) // P). The chain is a pure function of the prompt
+        # (paging.py), so it is recomputed rather than carried.
+        clean = pos <= self.W and req.prompt.size <= self.W
+        if clean:
+            digests = prefix_page_digests(req.prompt, self.P,
+                                          self.max_pages)
+            n_cover = min(req.prompt.size // self.P, self.max_pages)
+        else:
+            digests, n_cover = [], 0
+        ring = self._gather(
+            self._caches, jnp.asarray(self._pt_host[s], jnp.int32)
+        )
+        state = {
+            "request": req,
+            "prompt": req.prompt,
+            "tokens": list(req.tokens),
+            "max_new": req.max_new,
+            "tok": int(np.asarray(self._tok)[s]),
+            "pos": int(pos),
+            "key_data": np.asarray(jax.random.key_data(self._keys[s])),
+            "ring": ring,
+            "digests": tuple(digests),
+            "n_cover": int(n_cover),
+            "n_pages": n_pages,
+            "P": self.P,
+            "W": self.W,
+            "quantize_kv": self.quantize_kv,
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "eos_id": self.eos_id,
+        }
+        self._free_slot(s)
+        return state
+
+    def _check_adopt_compat(self, state: dict) -> None:
+        for k, want in (
+            ("P", self.P), ("W", self.W),
+            ("quantize_kv", self.quantize_kv),
+            ("temperature", self.temperature), ("top_k", self.top_k),
+            ("eos_id", self.eos_id),
+        ):
+            if state[k] != want:
+                raise ValueError(
+                    f"adopt_page_state: {k} mismatch (source "
+                    f"{state[k]!r}, this scheduler {want!r}) — tiers "
+                    "must share page geometry and generation config "
+                    "for the stream to continue token-for-token"
+                )
+
+    def _plan_adopt(self, state: dict):
+        """(slot, shared pids, n_pages, wraps, reserve) for adopting
+        ``state``, or None when no free slot / pool capacity covers
+        it — the same whole-lifetime budget as admission planning, so
+        PagePoolExhausted stays unreachable mid-decode."""
+        free_s = next(
+            (s for s, r in enumerate(self._slot_req)
+             if r is None and s not in self._admitting), None,
+        )
+        if free_s is None:
+            return None
+        Tp = int(state["prompt"].size)
+        horizon = Tp + state["max_new"] + self.n_inner
+        wraps = horizon > self.W
+        n_pages = -(-min(self.W, horizon) // self.P)
+        shared: list[int] = []
+        for d in state["digests"][: min(state["n_cover"], n_pages)]:
+            pid = self.pool.lookup(d)
+            if pid is None:
+                break
+            shared.append(pid)
+        reserve = sum(
+            1 for pid in shared
+            if self.pool.share_needs_reserve(pid, wraps)
+        )
+        if not self.pool.can_alloc(n_pages - len(shared),
+                                   reserve=reserve):
+            return None
+        return free_s, shared, n_pages, wraps, reserve
+
+    def can_adopt_state(self, state: dict) -> bool:
+        """Would :meth:`adopt_page_state` succeed right now? (A free
+        slot plus pool capacity for the request's whole-lifetime page
+        budget, shared resident prefixes counted.) Boolean under ALL
+        refusals — a config-mismatched state is False, not a raise, so
+        the router's adoption gate can scan a heterogeneous tier
+        without crashing the step loop."""
+        if not self.paged:
+            return False
+        try:
+            self._check_adopt_compat(state)
+        except ValueError:
+            return False
+        return self._plan_adopt(state) is not None
+
+    def could_adopt_state(self, state: dict) -> bool:
+        """Would :meth:`adopt_page_state` EVER succeed here — i.e. does
+        the whole-lifetime page budget fit this scheduler's pool even
+        when every slot and page is free? False means parking a
+        migration on this replica's capacity can never resolve (the
+        pool is statically too small or the config mismatches); the
+        two-tier router bounces such tickets back to the prefill tier
+        instead of stranding the captured stream."""
+        if not self.paged:
+            return False
+        try:
+            self._check_adopt_compat(state)
+        except ValueError:
+            return False
+        Tp = int(state["prompt"].size)
+        horizon = Tp + state["max_new"] + self.n_inner
+        n_pages = -(-min(self.W, horizon) // self.P)
+        # an empty pool has n_pages-1 usable pages (page 0 is the null
+        # page); prefix sharing could only lower the demand
+        return n_pages <= self.pool.n_pages - 1
+
+    def adopt_page_state(self, state: dict,
+                         request: Request | None = None) -> Request:
+        """Land a migrated request (:meth:`export_page_state` on the
+        source) in this scheduler: allocate its page budget (sharing
+        resident prefix-digest pages with COW reservations exactly
+        like admission), scatter the carried ring view through the new
+        page table, install the row's token/position/key state, and
+        re-register the prefix-digest chain so future admissions and
+        migrations keep sharing. Shared pages are scattered with bytes
+        identical to what they already hold (same params, same prefix
+        — the ``_place_paged`` admission argument), so sharers are
+        never perturbed. ``request``: override the continued request
+        object (cross-process adoption rebuilds one; in-process the
+        captured object rides in ``state`` and keeps streaming)."""
+        if not self.paged:
+            raise ValueError(
+                "adopt_page_state on an unpaged scheduler: migration "
+                "is a page-layout transfer (construct with "
+                "page_tokens=)"
+            )
+        self._check_adopt_compat(state)
+        plan = self._plan_adopt(state)
+        if plan is None:
+            raise PagePoolExhausted(
+                "adopt_page_state: no free slot or page capacity for "
+                "the migrated request (gate on can_adopt_state)"
+            )
+        s, shared, n_pages, wraps, _ = plan
+        req = request if request is not None else state["request"]
+        if req is None:
+            req = Request(state["prompt"], state["max_new"])
+            req.tokens = list(state["tokens"])
+            req._scanned = len(req.tokens)
+        pids = [NULL_PAGE] * self.max_pages
+        for j, pid in enumerate(shared):
+            self.pool.share(
+                pid, reserve=self.pool.share_needs_reserve(pid, wraps),
+                wrapper=wraps,
+            )
+            pids[j] = pid
+        try:
+            for j in range(len(shared), n_pages):
+                pids[j] = self.pool.alloc()
+        except PagePoolExhausted:
+            # roll back: a planned adoption must never half-commit
+            for pid in pids:
+                if pid != NULL_PAGE:
+                    self.pool.decref(int(pid), wrapper=wraps)
+            raise
+        self._pt_host[s] = pids
+        self._pt_dev = None
+        self._host_pos[s] = state["pos"]
+        self._slot_wraps[s] = wraps
+        rkey = jax.random.wrap_key_data(jnp.asarray(state["key_data"]))
+        ring = [
+            {kk: jnp.asarray(a) for kk, a in cl.items()}
+            for cl in state["ring"]
+        ]
+        (self._caches, self._tok, self._pos, self._done,
+         self._keys) = self._place_p(
+            self._caches, ring, self._tok, self._pos, self._done,
+            self._keys, jnp.asarray(self._pt_host[s]),
+            jnp.int32(s), jnp.int32(state["tok"]),
+            jnp.int32(state["pos"]), rkey,
+        )
+        n_cover = min(state["n_cover"], n_pages)
+        for j in range(n_cover):
+            self.pool.register(state["digests"][j], pids[j],
+                               volatile=wraps)
+        self._slot_req[s] = req
+        if req.admitted_tick is None:
+            req.admitted_tick = self.tick_count
+        return req
 
     def run(self, max_ticks: int = 10_000) -> None:
         """Tick until every queued and in-flight request retires."""

@@ -36,12 +36,14 @@ from .tune import (
     sweep_hierarchical,
     sweep_nwait,
     sweep_router_policy,
+    sweep_tier_split,
 )
 from .workload import (
     Arrival,
     SimPrompt,
     SimReplica,
     SimRequest,
+    SimTicket,
     WorkloadReport,
     arrivals_from_jsonl,
     diurnal_arrivals,
@@ -66,12 +68,14 @@ __all__ = [
     "sweep_hedge",
     "sweep_hierarchical",
     "sweep_router_policy",
+    "sweep_tier_split",
     "recommend_nwait",
     "recovered_work_per_s",
     "Arrival",
     "SimPrompt",
     "SimRequest",
     "SimReplica",
+    "SimTicket",
     "WorkloadReport",
     "poisson_arrivals",
     "diurnal_arrivals",

@@ -42,6 +42,7 @@ __all__ = [
     "sweep_code_rate",
     "sweep_hierarchical",
     "sweep_router_policy",
+    "sweep_tier_split",
     "recommend_nwait",
     "recovered_work_per_s",
 ]
@@ -507,11 +508,21 @@ def sweep_router_policy(
             "admission SLO"
         )
     if policies is None:
+        # two_tier is NOT a candidate here: it needs a two-tier fleet
+        # shape (and a migration byte model), which is exactly what
+        # sweep_tier_split builds and prices
         policies = [
             p for p in ROUTER_POLICIES
-            if p != "hedge_p99" or ttft_slo is not None
+            if (p != "hedge_p99" or ttft_slo is not None)
+            and p != "two_tier"
         ]
     policies = list(policies)
+    if "two_tier" in policies:
+        raise ValueError(
+            "sweep refused: two_tier is priced by sweep_tier_split "
+            "(it sweeps the (n_prefill, n_decode) fleet shape and "
+            "migration threshold, not just a policy flag)"
+        )
     unknown = [p for p in policies if p not in ROUTER_POLICIES]
     if unknown:
         raise ValueError(
@@ -614,6 +625,191 @@ def sweep_router_policy(
         "load": load,
         "prefix_share": float(prefix_share),
         "rate_req_s": rate,
+        "requests": int(requests),
+    }
+
+
+def sweep_tier_split(
+    *,
+    splits: Sequence[tuple[int, int]],
+    migration_thresholds: Sequence[int | None] = (None,),
+    slots: int = 4,
+    n_inner: int = 8,
+    tick_s: float = 0.02,
+    chunk_s: float = 0.01,
+    tick_sigma: float = 0.0,
+    load: float = 0.8,
+    requests: int = 2000,
+    prompt_len: int = 64,
+    max_new: int = 32,
+    long_share: float = 0.1,
+    long_prompt_len: int = 1024,
+    long_max_new: int | None = None,
+    prompt_chunk: int = 64,
+    kv_bytes_per_token: float = 4096.0,
+    migrate_gbs: float = 5.2,
+    decode_p99_slo_s: float | None = None,
+    seed: int = 0,
+) -> dict[str, Any]:
+    """Price ``(n_prefill, n_decode)`` tier splits and migration-size
+    thresholds for the disaggregated serving tier by running the REAL
+    :class:`~..models.router.RequestRouter` ``two_tier`` policy — the
+    identical placement/migration code a live fleet runs — over
+    two-tier :class:`~.workload.SimReplica` fleets on virtual time,
+    one seeded mixed long-prompt/short-chat Poisson stream per
+    candidate (same seed: every candidate faces identical arrivals).
+
+    Each candidate is one ``(split, threshold)`` pair from the cross
+    product; ``chunk_s`` prices prefill work into tick time (the
+    contention disaggregation removes — at ``chunk_s=0`` every split
+    ties and the sweep is meaningless), ``migrate_gbs`` prices each
+    migration's payload transfer at the measured ring rate, and the
+    headline per candidate is **decode p99** — the p99 per-request
+    mean inter-token gap (:meth:`~.workload.WorkloadReport.
+    p99_decode_itl`), the tail a long-prompt burst wrecks.
+
+    Refusals, never clamps (the ``sweep_nwait`` contract — each names
+    its floor, pinned by tests/test_disagg.py):
+
+    * **zero replicas in either tier** — a split with no prefill or no
+      decode replicas is not a two-tier fleet;
+    * **offered load >= 1** — open-loop saturation: queues grow
+      without bound and no split can meet a decode SLO;
+    * **no split meets the decode-p99 SLO** (post-run, when
+      ``decode_p99_slo_s`` is given and every candidate's decode p99
+      exceeds it).
+
+    Returns entries per candidate (decode p99, TTFT percentiles,
+    migrations landed/kept local, bytes moved), ``best`` — the
+    ``(split, threshold)`` with the lowest decode p99 among admissible
+    candidates — and ``decode_p99_vs_worst`` for quick reading."""
+    from ..models.router import RequestRouter
+    from .workload import (
+        SimReplica,
+        lognormal_ticks,
+        poisson_arrivals,
+        run_router_day,
+    )
+
+    cands = [(int(p), int(d)) for p, d in splits]
+    if not cands:
+        raise ValueError("empty sweep: no candidate splits given")
+    for p, d in cands:
+        if p < 1 or d < 1:
+            raise ValueError(
+                f"sweep refused: split ({p}, {d}) leaves a tier empty "
+                "— a two-tier fleet needs at least one prefill AND "
+                "one decode replica"
+            )
+    load = float(load)
+    if not (0.0 < load < 1.0):
+        raise ValueError(
+            f"sweep refused: offered load {load:.2f} must sit in "
+            "(0, 1) — at or beyond 1 the open-loop queue grows "
+            "without bound and no tier split can meet a decode SLO"
+        )
+    thresholds = list(migration_thresholds)
+    lmn = int(long_max_new if long_max_new is not None else max_new)
+    # offered rate: load x the fleet's bottleneck-tier capacity under
+    # the EXPECTED per-request work (the long mix in expectation).
+    # Prefill-tier work per request: its chunk count; decode-tier
+    # work: its decode ticks. Tick time approximated at the base
+    # tick_s (chunk_s stretches are what the sweep prices).
+    ls = float(long_share)
+    e_chunks = (
+        (1.0 - ls) * -(-int(prompt_len) // int(prompt_chunk))
+        + ls * -(-int(long_prompt_len) // int(prompt_chunk))
+    )
+    e_decode_ticks = (
+        (1.0 - ls) * -(-max(int(max_new) - 1, 0) // int(n_inner))
+        + ls * -(-max(lmn - 1, 0) // int(n_inner))
+    )
+    entries: list[dict] = []
+    for (n_p, n_d) in cands:
+        # a saturated prefill replica's tick stretches by one chunk_s
+        # per admitting slot (the very contention being priced), so
+        # its capacity is chunks over the STRETCHED tick; decode-tier
+        # ticks run chunk-free (adoption admits without prefill)
+        prefill_tick = tick_s + slots * chunk_s
+        cap_prefill = n_p * slots / (e_chunks * prefill_tick)
+        cap_decode = n_d * slots / (e_decode_ticks * tick_s)
+        rate = load * min(cap_prefill, cap_decode)
+        for thr in thresholds:
+            clock = VirtualClock()
+            fleet = []
+            for i in range(n_p + n_d):
+                fleet.append(SimReplica(
+                    clock, slots=slots, n_inner=n_inner,
+                    prompt_chunk=prompt_chunk,
+                    tier="prefill" if i < n_p else "decode",
+                    chunk_s=chunk_s,
+                    kv_bytes_per_token=kv_bytes_per_token,
+                    tick_s=lognormal_ticks(
+                        float(tick_s), float(tick_sigma),
+                        seed=int(seed) * 1013 + i,
+                    ),
+                ))
+            router = RequestRouter(
+                fleet, policy="two_tier", clock=clock,
+                migrate_threshold_bytes=thr,
+                migrate_gbs=migrate_gbs,
+            )
+            report = run_router_day(
+                router,
+                poisson_arrivals(
+                    rate, n=requests, seed=seed,
+                    prompt_len=prompt_len, max_new=max_new,
+                    long_share=long_share,
+                    long_prompt_len=long_prompt_len,
+                    long_max_new=long_max_new,
+                ),
+            )
+            p99d = report.p99_decode_itl()
+            entries.append({
+                "split": (n_p, n_d),
+                "threshold_bytes": thr,
+                "decode_p99_s": p99d,
+                "p50_ttft_s": report.p50_ttft(),
+                "p99_ttft_s": report.p99_ttft(),
+                "migrated": report.n_migrated,
+                "kept_local": report.n_kept_local,
+                "migrated_bytes": router.migrated_bytes,
+                "completed": report.n - report.dropped,
+                "dropped": report.dropped,
+                "rate_req_s": rate,
+                "admissible": (
+                    decode_p99_slo_s is None
+                    or p99d <= float(decode_p99_slo_s)
+                ),
+            })
+    ok = [e for e in entries if e["admissible"]]
+    if not ok:
+        raise ValueError(
+            f"no split meets the decode-p99 SLO: every candidate's "
+            f"p99 inter-token gap exceeds {decode_p99_slo_s}s at load "
+            f"{load:.2f} (swept "
+            f"{[(e['split'], e['threshold_bytes']) for e in entries]})"
+            " — add decode replicas or shed load; the sweep refuses "
+            "rather than recommend a split that cannot hold decode"
+        )
+    # decode p99 is the objective; among candidates within 5% of the
+    # best (the tiers hold decode equally well), the lowest p99 TTFT
+    # wins — a tie on the headline must not discard the prefill
+    # tier's sizing signal
+    best_d = min(e["decode_p99_s"] for e in ok)
+    near = [e for e in ok if e["decode_p99_s"] <= best_d * 1.05]
+    best = min(near, key=lambda e: e["p99_ttft_s"])
+    worst = max(entries, key=lambda e: e["decode_p99_s"])
+    return {
+        "entries": entries,
+        "best": (best["split"], best["threshold_bytes"]),
+        "best_entry": best,
+        "decode_p99_vs_worst": (
+            worst["decode_p99_s"] / best["decode_p99_s"]
+            if best["decode_p99_s"] > 0 else float(np.inf)
+        ),
+        "load": load,
+        "long_share": ls,
         "requests": int(requests),
     }
 

@@ -54,6 +54,7 @@ __all__ = [
     "SimPrompt",
     "SimRequest",
     "SimReplica",
+    "SimTicket",
     "WorkloadReport",
     "poisson_arrivals",
     "diurnal_arrivals",
@@ -115,28 +116,47 @@ class Arrival:
 
 def _default_prompt_fn(
     prompt_len: int, prefix_share: float, prefix_len: int,
-    n_prefix_groups: int,
+    n_prefix_groups: int, max_new: int,
+    long_share: float = 0.0, long_prompt_len: int | None = None,
+    long_max_new: int | None = None,
 ) -> Callable:
-    """(rng,) -> prompt: with probability ``prefix_share`` the prompt
-    opens with one of ``n_prefix_groups`` shared system prompts of
-    ``prefix_len`` tokens (the prefix-affinity / COW scenario), else it
-    is unique. One rng draw per arrival either way, so the arrival
-    times are identical at every share rate."""
+    """(u,) -> (prompt, max_new): with probability ``prefix_share``
+    the prompt opens with one of ``n_prefix_groups`` shared system
+    prompts of ``prefix_len`` tokens (the prefix-affinity / COW
+    scenario); with probability ``long_share`` it is a LONG prompt of
+    ``long_prompt_len`` tokens decoding ``long_max_new`` (default: the
+    short class's budget) — the mixed long-prompt/short-chat day the
+    disaggregation bench replays; else a unique short prompt. ONE rng
+    draw decides all of it (the two classes live in disjoint intervals
+    of ``u``), so the arrival TIMES are identical at every share and
+    mix rate — and streams with the defaults are bit-identical to
+    every pre-mix recording."""
     share = float(prefix_share)
+    lshare = float(long_share)
     if not (0.0 <= share <= 1.0):
         raise ValueError(f"prefix_share must be in [0, 1], got {share}")
+    if not (0.0 <= lshare <= 1.0) or share + lshare > 1.0:
+        raise ValueError(
+            f"long_share must be in [0, 1] with prefix_share + "
+            f"long_share <= 1, got {long_share} (+{share})"
+        )
     if share > 0.0 and not (0 < prefix_len <= prompt_len):
         raise ValueError(
             "prefix_share > 0 needs 0 < prefix_len <= prompt_len"
         )
+    if lshare > 0.0 and not (long_prompt_len or 0) > 0:
+        raise ValueError("long_share > 0 needs long_prompt_len > 0")
+    long_mn = int(long_max_new if long_max_new is not None else max_new)
 
     def fn(u: float):
         if share > 0.0 and u < share:
             g = int(u / share * n_prefix_groups)  # deterministic in u
             g = min(g, n_prefix_groups - 1)
             return SimPrompt(prompt_len, prefix=g,
-                             prefix_len=prefix_len)
-        return SimPrompt(prompt_len)
+                             prefix_len=prefix_len), max_new
+        if lshare > 0.0 and u >= 1.0 - lshare:
+            return SimPrompt(long_prompt_len), long_mn
+        return SimPrompt(prompt_len), max_new
 
     return fn
 
@@ -154,17 +174,23 @@ def poisson_arrivals(
     prefix_share: float = 0.0,
     prefix_len: int = 0,
     n_prefix_groups: int = 1,
+    long_share: float = 0.0,
+    long_prompt_len: int | None = None,
+    long_max_new: int | None = None,
 ) -> Iterator[Arrival]:
     """Seeded homogeneous Poisson arrivals: ``n`` requests at mean
     ``rate``/s from virtual ``start``. Every draw comes from one
     generator seeded on ``seed`` in a fixed chunked order, so two calls
     with the same arguments yield bit-identical streams (pinned by
-    tests/test_sim_workload.py)."""
+    tests/test_sim_workload.py). ``long_share``/``long_prompt_len``/
+    ``long_max_new`` mix in a long-prompt class on the same coin (see
+    :func:`_default_prompt_fn` — arrival times never move)."""
     if rate <= 0 or n < 1:
         raise ValueError("need rate > 0 and n >= 1")
     rng = np.random.default_rng((0x9E3779B9, int(seed)))
     fn = _default_prompt_fn(prompt_len, prefix_share, prefix_len,
-                            n_prefix_groups)
+                            n_prefix_groups, max_new, long_share,
+                            long_prompt_len, long_max_new)
     t = float(start)
     left = int(n)
     while left:
@@ -173,7 +199,8 @@ def poisson_arrivals(
         coins = rng.random(size=m)
         t = float(ts[-1])
         for tt, u in zip(ts.tolist(), coins.tolist()):
-            yield Arrival(tt, fn(u), max_new)
+            p, mn = fn(u)
+            yield Arrival(tt, p, mn)
         left -= m
 
 
@@ -190,6 +217,9 @@ def diurnal_arrivals(
     prefix_share: float = 0.0,
     prefix_len: int = 0,
     n_prefix_groups: int = 1,
+    long_share: float = 0.0,
+    long_prompt_len: int | None = None,
+    long_max_new: int | None = None,
 ) -> Iterator[Arrival]:
     """Seeded non-homogeneous Poisson arrivals on a diurnal rate
     schedule: ``rate(t) = mean_rate * (1 + amplitude * sin(2*pi*t/
@@ -198,7 +228,9 @@ def diurnal_arrivals(
     Sampled by Lewis thinning against the peak rate with every
     candidate and acceptance coin drawn from one seeded generator in
     chunked order — bit-identical across runs, like
-    :func:`poisson_arrivals`."""
+    :func:`poisson_arrivals` (whose long-prompt mix kwargs apply here
+    too: the disaggregation bench's burst day is this function with
+    ``long_share > 0``)."""
     if mean_rate <= 0 or n < 1:
         raise ValueError("need mean_rate > 0 and n >= 1")
     if not (0.0 <= amplitude < 1.0):
@@ -207,7 +239,8 @@ def diurnal_arrivals(
         )
     rng = np.random.default_rng((0x51ED2701, int(seed)))
     fn = _default_prompt_fn(prompt_len, prefix_share, prefix_len,
-                            n_prefix_groups)
+                            n_prefix_groups, max_new, long_share,
+                            long_prompt_len, long_max_new)
     peak = mean_rate * (1.0 + amplitude)
     w = 2.0 * math.pi / period
     t = float(start)
@@ -227,7 +260,8 @@ def diurnal_arrivals(
         )
         keep = accept * peak < rates
         for tt, u in zip(ts[keep].tolist(), coins[keep].tolist()):
-            yield Arrival(tt, fn(u), max_new)
+            p, mn = fn(u)
+            yield Arrival(tt, p, mn)
             out += 1
             if out == n:
                 break
@@ -313,7 +347,8 @@ class SimRequest:
     the router's replica protocol reads."""
 
     __slots__ = ("prompt", "max_new", "n_emitted", "finished",
-                 "reason", "admitted_tick", "_holds_prefix")
+                 "reason", "admitted_tick", "migrated",
+                 "_holds_prefix")
 
     def __init__(self, prompt: SimPrompt, max_new: int):
         if max_new < 1:
@@ -324,6 +359,9 @@ class SimRequest:
         self.finished = False
         self.reason = None
         self.admitted_tick = None
+        # True once adopted by another replica: admission then skips
+        # prefill entirely (the pages arrived with the request)
+        self.migrated = False
         self._holds_prefix = None
 
     @property
@@ -331,6 +369,23 @@ class SimRequest:
         # range: len() and truthiness in O(1) — the only reads the
         # router protocol makes
         return range(self.n_emitted)
+
+
+class SimTicket:
+    """The sim face of a KV-page migration ticket: the frozen request,
+    the byte/page accounting the router's threshold and transfer
+    pricing read, and the reason label the obs counters use. The
+    request object itself crosses (in-process sim), so adoption is
+    stream-continuous exactly like the live in-process fast path."""
+
+    __slots__ = ("request", "nbytes", "pages", "reason")
+
+    def __init__(self, request: SimRequest, nbytes: int, pages: int,
+                 reason: str = "prefill_done"):
+        self.request = request
+        self.nbytes = int(nbytes)
+        self.pages = int(pages)
+        self.reason = reason
 
 
 class SimReplica:
@@ -360,19 +415,51 @@ class SimReplica:
     ``kill()`` models a replica death: state is wiped, in-flight
     requests stop progressing (the router re-routes them on its next
     health probe), ``alive`` flips for the default health probe;
-    ``revive()`` brings the replica back empty."""
+    ``revive()`` brings the replica back empty.
+
+    **Two-tier mode** (the disaggregation model, models/disagg.py's
+    sim twin): ``tier`` tags the replica for the router's ``two_tier``
+    placement; ``chunk_s`` prices PREFILL work into the tick — each
+    prefill chunk advanced in a tick adds ``chunk_s`` virtual seconds
+    to it, so a long-prompt burst inflates every tick it shares a
+    replica with and the in-flight decodes' inter-token gaps blow out
+    (the real scheduler's ``_advance_admissions`` loop runs one
+    ``_extend`` program per admitting slot per tick — this is that
+    cost, modeled; ``chunk_s=0`` keeps the pre-round-16 timing
+    bit-identical). ``migrate_out`` freezes a decoding request into a
+    :class:`SimTicket` sized by the ``kv_bytes_per_token`` byte model;
+    ``adopt`` re-queues it with ``migrated=True`` — admission then
+    takes the slot WITHOUT prefill chunks (the pages came along) and
+    carries its shared-prefix residency to this replica, which is what
+    the router's residency-affine adoption compounds."""
 
     def __init__(self, clock: VirtualClock, *, slots: int = 8,
                  n_inner: int = 8, tick_s=0.02,
-                 prompt_chunk: int = 256):
+                 prompt_chunk: int = 256, tier: str = "unified",
+                 chunk_s: float = 0.0,
+                 kv_bytes_per_token: float = 4096.0,
+                 page_tokens: int = 16):
         if slots < 1 or n_inner < 1 or prompt_chunk < 1:
             raise ValueError(
                 "slots, n_inner and prompt_chunk must be >= 1"
+            )
+        if tier not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"tier must be unified/prefill/decode, got {tier!r}"
+            )
+        if chunk_s < 0.0 or kv_bytes_per_token < 0.0 or page_tokens < 1:
+            raise ValueError(
+                "chunk_s and kv_bytes_per_token must be >= 0, "
+                "page_tokens >= 1"
             )
         self.clock = clock
         self.S = int(slots)
         self.n_inner = int(n_inner)
         self.C = int(prompt_chunk)
+        self.tier = tier
+        self.chunk_s = float(chunk_s)
+        self.kv_bytes_per_token = float(kv_bytes_per_token)
+        self.page_tokens = int(page_tokens)
         self._tick_s = (
             tick_s if callable(tick_s)
             else (lambda _t, _d=float(tick_s): _d)
@@ -389,6 +476,8 @@ class SimReplica:
         self.n_retired = 0
         self.n_cancelled = 0
         self.n_shared_admits = 0
+        self.n_adopted = 0
+        self.n_migrated_out = 0
 
     # -- replica protocol -------------------------------------------------
 
@@ -445,6 +534,65 @@ class SimReplica:
                 return True
         return False
 
+    # -- KV-page migration (the two-tier router protocol) ---------------
+
+    def migration_nbytes(self, req: SimRequest) -> int:
+        """The byte model the live scheduler measures: resident KV
+        bytes for the tokens this stream has landed so far."""
+        return int(
+            (req.prompt.length + req.n_emitted)
+            * self.kv_bytes_per_token
+        )
+
+    def migrate_out(self, req: SimRequest,
+                    reason: str = "prefill_done") -> SimTicket:
+        """Freeze a decoding request into a ticket and free its slot
+        (residency drops with it — the pages leave). The request must
+        be past its first token and unfinished, the same migratability
+        contract as ``ServingScheduler.export_page_state``."""
+        if req.finished or req.n_emitted < 1:
+            raise ValueError(
+                "migrate_out: request must be decoding (first token "
+                "emitted, not finished)"
+            )
+        for s, r in enumerate(self._slots):
+            if r is req and not self._prefill[s]:
+                self._free(s)
+                self.n_migrated_out += 1
+                toks = req.prompt.length + req.n_emitted
+                return SimTicket(
+                    req, self.migration_nbytes(req),
+                    -(-toks // self.page_tokens), reason,
+                )
+        raise ValueError(
+            "migrate_out: request is not decoding in a slot here"
+        )
+
+    def can_adopt(self, ticket: SimTicket) -> bool:
+        return self.alive
+
+    def adopt(self, ticket: SimTicket) -> SimRequest:
+        """Land a migrated request: re-queued with ``migrated=True``
+        so admission takes a slot without any prefill chunks and
+        decode continues from ``n_emitted`` — the page adoption's
+        timing skeleton. Returns the SAME request object (in-process
+        stream continuity, like the live fast path)."""
+        if not self.alive:
+            raise RuntimeError(
+                "adopt on a killed SimReplica: the router must not "
+                "land migrations on an unroutable replica"
+            )
+        req = ticket.request
+        req.migrated = True
+        req._holds_prefix = None  # residency re-established at admit
+        self._queue.append(req)
+        self.n_adopted += 1
+        if self.next_tick_at is None:
+            self.next_tick_at = (
+                self.clock.now() + self._tick_s(self.tick_count)
+            )
+        return req
+
     def step(self) -> list[SimRequest]:
         """One scheduler tick, fired only when due (the router steps
         every busy replica; a not-yet-due sim replica must be a no-op
@@ -467,6 +615,7 @@ class SimReplica:
         slots = self._slots
         prefill = self._prefill
         n_inner = self.n_inner
+        n_chunks = 0  # prefill chunks advanced this tick (chunk_s)
         for s in range(self.S):
             req = slots[s]
             if req is None:
@@ -475,6 +624,21 @@ class SimReplica:
                 # admit FIFO (first chunk runs this very tick)
                 req = queue.popleft()
                 p = req.prompt
+                if req.migrated:
+                    # page adoption: NO prefill — the KV pages arrived
+                    # with the request; residency (if any) transfers
+                    # here and decode continues from n_emitted on the
+                    # next tick
+                    if p.prefix is not None:
+                        self._resident[p.prefix] = (
+                            self._resident.get(p.prefix, 0) + 1
+                        )
+                        req._holds_prefix = p.prefix
+                    slots[s] = req
+                    self._n_active += 1
+                    req.admitted_tick = self.tick_count
+                    prefill[s] = 0
+                    continue
                 skip = 0
                 if p.prefix is not None:
                     if self._resident.get(p.prefix, 0):
@@ -493,6 +657,7 @@ class SimReplica:
                 # histogram reads this
                 req.admitted_tick = self.tick_count
                 prefill[s] = chunks - 1
+                n_chunks += 1  # the first chunk's work
                 if chunks == 1:
                     req.n_emitted = 1
                     if req.max_new == 1:
@@ -502,6 +667,7 @@ class SimReplica:
             if pf:
                 # advance the admission one chunk
                 prefill[s] = pf - 1
+                n_chunks += 1
                 if pf == 1:
                     req.n_emitted = 1  # first token, last chunk
                     if req.max_new == 1:
@@ -515,7 +681,13 @@ class SimReplica:
             else:
                 req.n_emitted = ne
         if queue or self._n_active:
-            self.next_tick_at = now + self._tick_s(self.tick_count)
+            dt = self._tick_s(self.tick_count)
+            if n_chunks and self.chunk_s:
+                # prefill work stretches THIS tick: the real
+                # scheduler's per-admitting-slot _extend cost, the
+                # contention disaggregation removes
+                dt += self.chunk_s * n_chunks
+            self.next_tick_at = now + dt
         else:
             self.next_tick_at = None
         return retired
@@ -587,13 +759,35 @@ class WorkloadReport:
             self.outcomes[r.outcome] = self.outcomes.get(r.outcome, 0) + 1
         self.n_hedges = router.n_hedges
         self.n_rerouted = router.n_rerouted
+        self.n_migrated = getattr(router, "n_migrated", 0)
+        self.n_kept_local = getattr(router, "n_kept_local", 0)
         self.dropped = sum(not r.finished for r in requests)
+        # per-request mean inter-token gap (first token -> done over
+        # the decode tokens): the decode-steadiness distribution the
+        # disaggregation claim is about. NOT part of digest() — the
+        # bit-identity witness keeps its pre-round-16 definition.
+        itl = []
+        for r in requests:
+            n = len(r.tokens)
+            if (r.t_first_token is not None and r.t_done is not None
+                    and n > 1):
+                itl.append(
+                    (r.t_done - r.t_first_token) / (n - 1)
+                )
+        self.decode_itl = np.asarray(itl, np.float64)
 
     def p50_ttft(self) -> float:
         return float(np.percentile(self.ttft, 50))
 
     def p99_ttft(self) -> float:
         return float(np.percentile(self.ttft, 99))
+
+    def p99_decode_itl(self) -> float:
+        """p99 of the per-request mean inter-token gap — decode p99,
+        the tail a long-prompt burst wrecks on a unified fleet."""
+        if self.decode_itl.size == 0:
+            return 0.0
+        return float(np.percentile(self.decode_itl, 99))
 
     def digest(self) -> str:
         import hashlib

@@ -55,3 +55,11 @@ def route_request(replica, registry=None, flight=None):
     registry.counter("router_requests_total").inc()  # GC004 line 55
     flight.event("hedge fired", replica=replica)  # GC004 line 56
     return replica
+
+
+def migrate_ticket(ticket, registry=None, flight=None):
+    # the round-16 disaggregation telemetry shape: counting a landed
+    # KV-page migration without the None guards
+    registry.counter("disagg_migrations_total").inc()  # GC004 line 63
+    flight.event("kv migrated", pages=ticket)  # GC004 line 64
+    return ticket

@@ -101,3 +101,16 @@ def hier_decode(arrived, registry=None, flight=None):
         registry.counter("hier_outer_recoveries_total").inc()
     ok = flight is not None and flight.event("hier outer recovery")
     return arrived if ok else None
+
+
+def migrate_ticket(ticket, registry=None, flight=None):
+    """The round-16 disaggregation telemetry shape, guarded: the
+    migration counters and the per-handoff flight instant event only
+    fire inside the is-not-None arms (models/router.py _RouterObs
+    two-tier discipline)."""
+    if registry is not None:
+        registry.counter("disagg_migrations_total").inc()
+        registry.counter("disagg_migrated_pages_total").inc(ticket)
+        registry.histogram("disagg_migration_seconds").observe(0.0)
+    ok = flight is not None and flight.event("kv migrated")
+    return ticket if ok else None

@@ -54,6 +54,19 @@ def test_router_demo_example():
     assert "router demo ok" in out.stdout
 
 
+def test_disaggregated_demo_example():
+    """The round-16 disaggregation walkthrough: unified decode-p99
+    collapse vs two-tier stability on the same burst day, the swept
+    split, and the bit-identity witness — numpy-only virtual time, so
+    it runs in tier-1."""
+    out = _run_example("disaggregated_demo.py")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "swept split:" in out.stdout
+    assert "better than unified at equal chips" in out.stdout
+    assert "(bit-identical)" in out.stdout
+    assert "disagg demo ok" in out.stdout
+
+
 @pytest.mark.slow
 def test_straggler_aware_training_converges(tmp_path):
     out = _run_example("straggler_aware_training.py", str(tmp_path))

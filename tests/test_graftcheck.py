@@ -55,7 +55,8 @@ def _keys(findings):
                           ("GC004", 22), ("GC004", 26),
                           ("GC004", 33), ("GC004", 40),
                           ("GC004", 47), ("GC004", 48),
-                          ("GC004", 55), ("GC004", 56)]),
+                          ("GC004", 55), ("GC004", 56),
+                          ("GC004", 63), ("GC004", 64)]),
         (
             "gc005_bad.py",
             [("GC005", 17), ("GC005", 18), ("GC005", 21),
@@ -114,7 +115,8 @@ def test_baseline_roundtrip(tmp_path):
                                 ("GC004", 22), ("GC004", 26),
                                 ("GC004", 33), ("GC004", 40),
                                 ("GC004", 47), ("GC004", 48),
-                                ("GC004", 55), ("GC004", 56)]
+                                ("GC004", 55), ("GC004", 56),
+                                ("GC004", 63), ("GC004", 64)]
     assert res.baseline_size == 1
 
 

@@ -206,8 +206,11 @@ class TestLiveRouting:
             RequestRouter([_sched()]).submit(_prompt(4), max_new=0)
         assert set(ROUTER_POLICIES) == {
             "round_robin", "least_loaded", "prefix_affinity",
-            "hedge_p99",
+            "hedge_p99", "two_tier",
         }
+        # two_tier needs an actual two-tier fleet shape
+        with pytest.raises(ValueError, match="EACH tier"):
+            RequestRouter([_sched()], policy="two_tier")
 
     @pytest.mark.parametrize("policy", ["round_robin", "least_loaded"])
     def test_streams_equal_oracle_across_replicas(self, policy):
