@@ -491,6 +491,23 @@ def driver_contract(budget_s: float | None = None) -> dict:
         # ladder. Unscaled: process spawn + memcpy + socket throughput
         # do not track the matmul rate the calibration measures.
         out["transport"] = _try_rung(rung_transport, est=120, scale=False)
+
+        def rung_device_coord():
+            from benchmarks.device_coord_bench import (
+                bench_device_coord_rung,
+            )
+
+            return bench_device_coord_rung()
+
+        # round-17 device-resident coordination rung: the 1k-epoch
+        # host-loop vs fused K-window dispatch-overhead ladder
+        # (K in {1, 8, 64}) with the swept K priced by sweep_harvest_k
+        # on this box's measured host costs; FAILS below the 3x
+        # acceptance floor. Unscaled: interpreter round-trips + tiny
+        # compiled windows do not track the matmul rate.
+        out["device_coord"] = _try_rung(
+            rung_device_coord, est=45, scale=False
+        )
         # headline: never budget-skipped, loud-fail (it IS the
         # contract) — but SIZED by measurement. Each ladder step is a
         # complete config-3 bench at that cube; the next step runs only
@@ -665,6 +682,10 @@ def _contract_line(out: dict) -> str:
             else out.get("disagg_live"),
             "disagg_migrate_gbs"),
         "transport": _rung_summary(out.get("transport"), "digest"),
+        "devcoord_overhead_x": _rung_summary(
+            out.get("device_coord"), "devcoord_overhead_x"),
+        "devcoord_harvest_k": _rung_summary(
+            out.get("device_coord"), "devcoord_harvest_k"),
         "adaptive_speedup": _rung_summary(
             out.get("adaptive_nwait"), "speedup"),
         "obs_overhead_pct": _rung_summary(

@@ -1,0 +1,167 @@
+"""Round-17 driver rung: device-resident coordination dispatch overhead.
+
+The claim under measurement (ROADMAP item 4, the Amdahl item): with
+transport zero-copy and the decode batched, the interpreter IS the
+per-epoch cost — every host-loop epoch pays 2 + 3W host touches
+(dispatch, arrival bookkeeping, decode trigger), while a fused K-epoch
+window pays 2 per window (stage + harvest), 2/K per epoch amortized.
+
+The ladder runs the SAME (n=8, k=6) MDS-coded workload over the same
+per-epoch payload stream both ways on this box:
+
+* **host loop** — 1k epochs of the real ``asyncmap`` over an
+  ``XLADeviceBackend`` (dispatcher threads, mailbox completions) plus
+  the per-epoch ``result_device`` decode — the before;
+* **fused** — the identical 1k epochs through
+  :func:`~mpistragglers_jl_tpu.pool.asyncmap_fused` windows at
+  K in {1, 8, 64}: per-epoch arrival masks, fastest-k selection and
+  the MDS solve inside one compiled program per window, per-epoch
+  decode products harvested at the window edge.
+
+Both sides run a zero injected-delay schedule (pure dispatch-overhead
+measurement; straggler semantics are pinned bit-identically by
+tests/test_device_coord.py, not timed here) and per-epoch DISTINCT
+payloads, so neither side can hoist the epoch compute out of its loop.
+Decode identity vs numpy is asserted on the final window.
+
+``devcoord_harvest_k`` is the K that :func:`~mpistragglers_jl_tpu.sim.
+sweep_harvest_k` recommends when priced with THIS box's measured host
+costs (host_epoch_s from the host loop, host_harvest_s from the
+ladder) on a representative seeded-lognormal virtual fleet;
+``devcoord_overhead_x`` is the measured host/fused wall ratio at that
+K and the rung FAILS below the >= 3 acceptance floor.
+
+Standalone: ``python -m benchmarks.device_coord_bench`` (or with
+``DEVCOORD_BENCH_EPOCHS=200`` for a quick pass) prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def bench_device_coord_rung(epochs: int | None = None, n=8, k=6):
+    from mpistragglers_jl_tpu import (
+        AsyncPool,
+        asyncmap,
+        asyncmap_fused,
+        waitall,
+    )
+    from mpistragglers_jl_tpu.ops.coded_gemm import CodedGemm
+    from mpistragglers_jl_tpu.sim import sweep_harvest_k
+    from mpistragglers_jl_tpu.utils import faults
+
+    if epochs is None:
+        epochs = int(os.environ.get("DEVCOORD_BENCH_EPOCHS", "1000"))
+    ladder = [kk for kk in (1, 8, 64) if kk <= epochs]
+    rng = np.random.default_rng(17)
+    A = rng.standard_normal((k * 4, 32)).astype(np.float32)
+    # per-epoch DISTINCT payloads: neither loop may hoist the compute
+    Bs = rng.standard_normal((epochs, 32, 8)).astype(np.float32)
+
+    out: dict = {"epochs": epochs, "n": n, "k": k}
+
+    # -- host loop: the before -------------------------------------------
+    cg = CodedGemm(A, n, k)
+    try:
+        pool = AsyncPool(n)
+        asyncmap(pool, Bs[0], cg.backend, nwait=k)  # warmup compiles
+        cg.result_device(pool)
+        waitall(pool, cg.backend)
+        t0 = time.perf_counter()
+        for e in range(epochs):
+            asyncmap(pool, Bs[e], cg.backend, nwait=k)
+            dec = cg.result_device(pool)
+        dec.block_until_ready()
+        waitall(pool, cg.backend)
+        host_s = time.perf_counter() - t0
+        out["host_loop_s"] = round(host_s, 3)
+        out["host_ms_per_epoch"] = round(host_s / epochs * 1e3, 4)
+
+        # -- fused ladder: the after -------------------------------------
+        rungs: dict = {}
+        for K in ladder:
+            coord = cg.coordinator()  # zero-delay schedule
+            fpool = AsyncPool(n)
+            # warmup: compile the K-window program off the clock
+            asyncmap_fused(fpool, Bs[:K], coord, epochs=K)
+            coord.reset()
+            fpool = AsyncPool(n)
+            windows = epochs // K
+            t0 = time.perf_counter()
+            for w in range(windows):
+                hist = asyncmap_fused(
+                    fpool, Bs[w * K : (w + 1) * K], coord, epochs=K
+                )
+            fused_s = time.perf_counter() - t0
+            covered = windows * K
+            rungs[str(K)] = {
+                "fused_s": round(fused_s, 3),
+                "ms_per_epoch": round(fused_s / covered * 1e3, 4),
+                "harvest_ms": round(fused_s / windows * 1e3, 3),
+                "windows": windows,
+                "overhead_x_vs_host": round(
+                    (host_s / epochs) / (fused_s / covered), 2
+                ),
+            }
+            assert hist.shape == (K, n)
+        out["ladder"] = rungs
+        # decode identity on the final window's last epoch (the
+        # coordinator must still be DOING the coordination, not a
+        # degenerate no-op)
+        last = np.asarray(coord.last_decoded)[-1]
+        ref = A.astype(np.float64) @ Bs[covered - 1].astype(np.float64)
+        err = float(np.max(np.abs(last - ref)) / np.max(np.abs(ref)))
+        out["decode_rel_err"] = err
+        if err > 1e-3:
+            raise RuntimeError(
+                f"fused window decode diverged: rel err {err:.2e}"
+            )
+    finally:
+        cg.backend.shutdown()
+
+    # -- the swept K: the sim twin priced with THIS box's measured
+    # host costs on a representative straggling fleet ---------------------
+    best_harvest_s = min(
+        r["harvest_ms"] for r in rungs.values()
+    ) / 1e3
+    sweep = sweep_harvest_k(
+        faults.seeded_lognormal(0.02, 0.6, seed=4),
+        n_workers=n, nwait=k, epochs=min(epochs, 256),
+        k_values=tuple(ladder),
+        host_epoch_s=host_s / epochs,
+        host_harvest_s=best_harvest_s,
+    )
+    swept_k = int(sweep["best"])
+    out["sweep"] = {
+        "best_k": swept_k,
+        "host_loop_epochs_per_s": round(
+            sweep["host_loop_epochs_per_s"], 1
+        ),
+        "best_epochs_per_s": round(
+            sweep["best_entry"]["epochs_per_s"], 1
+        ),
+        "staleness_s": round(
+            sweep["best_entry"]["staleness_s"], 4
+        ),
+    }
+    out["devcoord_harvest_k"] = swept_k
+    out["devcoord_overhead_x"] = rungs[str(swept_k)][
+        "overhead_x_vs_host"
+    ]
+    if out["devcoord_overhead_x"] < 3.0:
+        raise RuntimeError(
+            f"devcoord_overhead_x {out['devcoord_overhead_x']} below "
+            "the 3x acceptance floor at the swept K="
+            f"{swept_k} (host {out['host_ms_per_epoch']} ms/epoch vs "
+            f"fused {rungs[str(swept_k)]['ms_per_epoch']} ms/epoch)"
+        )
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_device_coord_rung(), default=str))

@@ -9,12 +9,13 @@ primitive under erasure-coded GEMM and gradient-coded SGD that decode from
 any k-of-n shards.
 """
 
-from .pool import AsyncPool, asyncmap, waitall, DeadWorkerError
+from .pool import AsyncPool, asyncmap, asyncmap_fused, waitall, DeadWorkerError
 from .backends import Backend, LocalBackend, ProcessBackend, WorkerFailure
 
 __all__ = [
     "AsyncPool",
     "asyncmap",
+    "asyncmap_fused",
     "waitall",
     "DeadWorkerError",
     "Backend",

@@ -102,6 +102,25 @@ class StackedSlice:
             out = out.astype(dtype, copy=False)
         return out
 
+class WindowHandle:
+    """One asynchronously-dispatched fused multi-epoch program.
+
+    ``outputs`` is the program's raw pytree of future-like
+    ``jax.Array``s (XLA async dispatch); :meth:`harvest` is the single
+    consumption fence for the whole K-epoch window — device-side
+    failures surface there, not as per-worker completions."""
+
+    __slots__ = ("outputs", "epoch0", "epochs")
+
+    def __init__(self, outputs, epoch0: int, epochs: int):
+        self.outputs = outputs
+        self.epoch0 = int(epoch0)
+        self.epochs = int(epochs)
+
+    def harvest(self):
+        return jax.block_until_ready(self.outputs)
+
+
 # work_fn(worker_index, device_payload, epoch) -> jax.Array (device-resident)
 XLAWorkFn = Callable[[int, jax.Array, int], jax.Array]
 
@@ -303,6 +322,30 @@ class XLADeviceBackend(MailboxBackend):
             except BaseException as e:  # surfaced on harvest, not lost
                 for w, seq, _, epoch, tag in batch.items:
                     self._complete(w, seq, WorkerError(w, epoch, e), tag)
+
+    # -- multi-epoch dispatch (fused K-epoch windows) ---------------------
+    def submit_window(self, window_fn, *args, epoch0: int, epochs: int):
+        """Multi-epoch dispatch: ONE asynchronous submission covering
+        ``epochs`` epochs — the compiled K-epoch coordination program
+        (parallel/device_coord.py) — with no per-epoch ``_start`` /
+        mailbox round-trips and no dispatcher-thread arrival
+        detection: XLA's async dispatch IS the in-flight state, and
+        the returned :class:`WindowHandle`'s ``harvest()`` is the one
+        fence. The transport layer keeps what it owns — the shutdown
+        guard, and the failure envelope: a submission failure raises
+        through :class:`~.base.WorkerError` (worker ``-1``: a fused
+        window has no single owning worker) so callers see the same
+        :class:`~.base.WorkerFailure` surface as per-epoch dispatch.
+        """
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
+        from .base import WorkerError
+
+        try:
+            out = window_fn(*args)  # asynchronous: returns futures
+        except BaseException as e:
+            WorkerError(-1, int(epoch0), e).raise_()
+        return WindowHandle(out, int(epoch0), int(epochs))
 
     def begin_epoch(self, epoch: int) -> None:
         # arm the shared-payload cache for this asyncmap call

@@ -214,6 +214,19 @@ class CodedGemm:
         """Decode the full product from the first k fresh shards (host copy)."""
         return np.asarray(self.result_device(pool, epoch))
 
+    def coordinator(self, *, delay_fn=None, nwait=None, **kw):
+        """A :class:`~..parallel.device_coord.DeviceCoordinator`
+        sharing this workload's coded blocks, generator, and backend:
+        K epochs of arrival masking + fastest-``nwait`` selection +
+        this decode as ONE compiled program, harvested through
+        :func:`~..pool.asyncmap_fused` (lazy import — parallel/ sits
+        above ops/ in the layer order)."""
+        from ..parallel.device_coord import DeviceCoordinator
+
+        return DeviceCoordinator.for_coded_gemm(
+            self, delay_fn=delay_fn, nwait=nwait, **kw
+        )
+
 
 class LTCodedGemm:
     """LT/rateless-coded GEMM (BASELINE config 4).
@@ -338,3 +351,14 @@ class LTCodedGemm:
 
         blocks = _decode(G_S, shards, self.precision)
         return blocks.reshape(-1, *blocks.shape[2:])
+
+    def coordinator(self, *, delay_fn=None, nwait=None, **kw):
+        """Fused K-epoch windows for this LT window (see
+        :meth:`CodedGemm.coordinator`): the in-scan decode is masked
+        normal equations over the fresh 0/1 generator rows, exact
+        whenever the fresh set has full column rank."""
+        from ..parallel.device_coord import DeviceCoordinator
+
+        return DeviceCoordinator.for_lt_gemm(
+            self, delay_fn=delay_fn, nwait=nwait, **kw
+        )

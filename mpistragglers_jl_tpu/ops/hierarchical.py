@@ -51,7 +51,7 @@ from .gemm import _block_matmul
 from .lt import LTCode
 from .outer_code import hierarchical_nwait, make_outer, partition_groups
 
-__all__ = ["HierarchicalCodedGemm"]
+__all__ = ["HierarchicalCodedGemm", "decode_groups"]
 
 
 @jax.jit
@@ -69,6 +69,14 @@ def _decode_groups(G_S, shards):
     flat = shards.reshape(g, k, -1)
     X = jax.vmap(jax.scipy.linalg.solve)(G_S, flat)
     return X.reshape(shards.shape)
+
+
+# Public traceable alias: the fused device-coordination scan body
+# (parallel/device_coord.py) embeds this exact vmapped batch per epoch
+# — jit-inside-jit inlines, so the round-14 decode arithmetic has ONE
+# implementation whether the trigger is the host loop or a compiled
+# K-epoch window.
+decode_groups = _decode_groups
 
 
 class HierarchicalCodedGemm:

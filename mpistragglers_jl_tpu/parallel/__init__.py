@@ -5,6 +5,8 @@ _HOME = {
     "PoolMeshCodedGemm": "fused",
     "PoolMeshMatDotGemm": "fused",
     "select_coded_gemm": "fused",
+    "DeviceCoordinator": "device_coord",
+    "stage_delays": "device_coord",
     "distributed_mds_decode": "collectives",
     "masked_psum_scatter_combine": "collectives",
     "ring_allgather": "collectives",

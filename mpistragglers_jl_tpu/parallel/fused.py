@@ -341,6 +341,29 @@ class PoolMeshCodedGemm:
         out = np.asarray(decoded)  # (n, m/k, cols)
         return out[: self.k].reshape(-1, out.shape[-1])
 
+    def device_coordinator(self, *, delay_fn=None, nwait=None, **kw):
+        """The fully device-resident form of this fused workload: a
+        :class:`~.device_coord.DeviceCoordinator` running K epochs of
+        map + arrival masking + the masked ``psum_scatter`` decode as
+        ONE ``shard_map`` program over this mesh — the host stages and
+        harvests per window instead of driving ``asyncmap`` +
+        :meth:`decode_from_pool` per epoch. One worker per mesh device
+        (``fold == 1``); folded pools keep the host loop."""
+        if self.fold != 1:
+            raise ValueError(
+                f"device windows need one worker per mesh device, but "
+                f"this workload folds {self.fold} workers per device"
+            )
+        from .device_coord import DeviceCoordinator
+
+        return DeviceCoordinator(
+            np.stack([np.asarray(b) for b in self.blocks]),
+            decode="mds", G=self.code.G, k=self.k,
+            nwait=self.k if nwait is None else nwait,
+            mesh=self.mesh, axis=self.axis, delay_fn=delay_fn,
+            precision=self.precision, backend=self.backend, **kw,
+        )
+
     def shutdown(self) -> None:
         self.backend.shutdown()
 

@@ -66,7 +66,7 @@ if TYPE_CHECKING:  # runtime import would be circular (utils -> pool)
 
 NwaitArg = Union[int, Callable[[int, np.ndarray], bool]]
 
-__all__ = ["AsyncPool", "asyncmap", "waitall"]
+__all__ = ["AsyncPool", "asyncmap", "asyncmap_fused", "waitall"]
 
 
 class AsyncPool:
@@ -458,6 +458,42 @@ def asyncmap(
             # per-record delta (how much moved since the last record)
             flight.counter("pool_epochs_total", pool.epoch - pool.epoch0)
     return pool.repochs
+
+
+def asyncmap_fused(
+    pool: AsyncPool,
+    sendbuf,
+    coordinator,
+    *,
+    epochs: int,
+) -> np.ndarray:
+    """K epochs of :func:`asyncmap` as ONE compiled device program —
+    the host stages inputs and harvests every ``epochs`` epochs
+    instead of re-entering the interpreter per epoch (ROADMAP item 4;
+    the numba-mpi frame: no interpreter on the critical path).
+
+    ``coordinator`` is a :class:`~.parallel.device_coord.
+    DeviceCoordinator` (duck-typed here — this module stays jax-free,
+    GC001): it owns the fused program, the per-worker coded blocks,
+    the ``nwait`` policy, and the injected-delay schedule. ``repochs``
+    semantics are preserved exactly — the returned ``(epochs, n)``
+    HISTORY's row ``j`` is bit-for-bit what the host loop's epoch
+    ``pool.epoch + 1 + j`` call would have returned on the same
+    schedule (under x64; see parallel/device_coord.py's fidelity
+    caveats), stale workers' shards masked by the on-device arrival
+    mask exactly as this file's loop masks them, and the pool leaves
+    the window in the host loop's end state (``epoch``, ``repochs``,
+    ``sepochs``, ``active``; in-flight workers carry into the next
+    window). Unlike :func:`asyncmap` the return value does NOT alias
+    ``pool.repochs`` — the history is the caller's to keep.
+
+    Host-loop-only capabilities a compiled window cannot express —
+    ``timeout=``/``DeadWorkerError``, ``tracer=``, callable ``nwait``
+    beyond the built-in hierarchical rule, a ``recvbuf`` bit-copy per
+    epoch — stay with :func:`asyncmap`; decoded products are harvested
+    from ``coordinator.last_decoded`` instead.
+    """
+    return coordinator.run_window(pool, sendbuf, epochs=epochs)
 
 
 def waitall(

@@ -32,6 +32,7 @@ from .tune import (
     recommend_nwait,
     recovered_work_per_s,
     sweep_code_rate,
+    sweep_harvest_k,
     sweep_hedge,
     sweep_hierarchical,
     sweep_nwait,
@@ -65,6 +66,7 @@ __all__ = [
     "NwaitSweep",
     "sweep_nwait",
     "sweep_code_rate",
+    "sweep_harvest_k",
     "sweep_hedge",
     "sweep_hierarchical",
     "sweep_router_policy",

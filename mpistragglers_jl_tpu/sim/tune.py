@@ -40,6 +40,7 @@ __all__ = [
     "sweep_nwait",
     "sweep_hedge",
     "sweep_code_rate",
+    "sweep_harvest_k",
     "sweep_hierarchical",
     "sweep_router_policy",
     "sweep_tier_split",
@@ -811,6 +812,144 @@ def sweep_tier_split(
         "load": load,
         "long_share": ls,
         "requests": int(requests),
+    }
+
+
+def sweep_harvest_k(
+    source,
+    *,
+    n_workers: int | None = None,
+    nwait: int,
+    epochs: int = 200,
+    k_values: Sequence[int] = (1, 2, 4, 8, 16, 32, 64),
+    host_epoch_s: float = 2e-3,
+    host_harvest_s: float = 4e-3,
+    staleness_bound_s: float | None = None,
+    seed: int = 0,
+    registry=None,
+    spans=None,
+) -> dict[str, Any]:
+    """Price the K-epoch harvest cadence of device-resident
+    coordination (:class:`~..parallel.device_coord.DeviceCoordinator`)
+    on virtual time — the sim twin of the fused window.
+
+    The fused window's arrival recurrence is arithmetically identical
+    to the host loop over a :class:`~.backend.SimBackend` (that is the
+    ``repochs``-parity contract tests/test_device_coord.py pins), so
+    ONE real ``asyncmap`` run on virtual time yields the exact
+    per-epoch completion times every candidate K would produce; each K
+    then re-slices that timeline into ceil(epochs / K) windows. Two
+    terms trade against each other (the arxiv 1808.06583
+    latency/communication trade):
+
+    * **amortized host cost** — the host loop pays ``host_epoch_s``
+      interpreter time per epoch (2 + 3W host touches); a fused window
+      pays ``host_harvest_s`` per harvest (stage + harvest, 2/K per
+      epoch amortized). ``utility`` per K is effective epochs/second:
+      ``epochs / (virtual_s + n_harvests * host_harvest_s)``. Pass the
+      bench-measured costs for this box
+      (benchmarks/device_coord_bench.py measures both).
+    * **staleness** — a result decoded at the window's first epoch is
+      only visible to the host at the window's end; ``staleness_s``
+      per K is the maximum such age (≈ the longest window's virtual
+      span).
+
+    Refusals, never clamps (the ``sweep_nwait`` contract, each naming
+    its floor — pinned by tests/test_device_coord.py):
+
+    * **K < 1** — not a window;
+    * **K > epochs** — the run cannot fill one window;
+    * **staleness bound violated** — any candidate K whose worst
+      window holds results longer than ``staleness_bound_s`` virtual
+      seconds before the host sees them.
+
+    Returns entries per K (``window_s`` max/mean, ``staleness_s``,
+    ``epochs_per_s``, ``overhead_x`` vs the host loop), ``best`` (the
+    K maximizing effective epochs/second), and the host-loop baseline
+    rate.
+    """
+    delay_fn, n_hint = _resolve_delay(source, seed=seed)
+    n = int(n_workers if n_workers is not None else (n_hint or 0))
+    if n <= 0:
+        raise ValueError(
+            "n_workers is required when the latency source does not "
+            "carry a pool size"
+        )
+    nwait = int(nwait)
+    if not (1 <= nwait <= n):
+        raise ValueError(f"nwait must be in [1, {n}], got {nwait}")
+    epochs = int(epochs)
+    ks = sorted({int(k) for k in k_values})
+    bad = [k for k in ks if k < 1]
+    if bad:
+        raise ValueError(
+            f"sweep refused: harvest window K={bad} — a window must "
+            "cover at least 1 epoch"
+        )
+    bad = [k for k in ks if k > epochs]
+    if bad:
+        raise ValueError(
+            f"sweep refused: harvest window K={bad} exceeds the "
+            f"{epochs}-epoch run — the host would never harvest"
+        )
+    backend = SimBackend(
+        _echo, n, delay_fn=delay_fn, clock=VirtualClock(),
+        registry=registry, spans=spans,
+    )
+    pool = AsyncPool(n)
+    walls = np.empty(epochs)
+    for e in range(epochs):
+        t0 = backend.clock.now()
+        asyncmap(pool, np.zeros(1), backend, nwait=nwait)
+        walls[e] = backend.clock.now() - t0
+    virtual_s = float(walls.sum())
+    host_rate = epochs / (virtual_s + epochs * float(host_epoch_s))
+    entries: list[dict] = []
+    violations: list[tuple[int, float]] = []
+    for k in ks:
+        spans_k = [
+            float(walls[i : i + k].sum())
+            for i in range(0, epochs, k)
+        ]
+        n_harvests = len(spans_k)
+        stale = max(spans_k)
+        if (
+            staleness_bound_s is not None
+            and stale > float(staleness_bound_s)
+        ):
+            violations.append((k, stale))
+        rate = epochs / (
+            virtual_s + n_harvests * float(host_harvest_s)
+        )
+        entries.append({
+            "K": k,
+            "n_harvests": n_harvests,
+            "window_mean_s": float(np.mean(spans_k)),
+            "window_max_s": stale,
+            "staleness_s": stale,
+            "epochs_per_s": rate,
+            "overhead_x": rate / host_rate,
+        })
+    if violations:
+        worst_k, worst_s = max(violations, key=lambda v: v[1])
+        raise ValueError(
+            f"sweep refused: harvest window K="
+            f"{[k for k, _ in violations]} violates the staleness "
+            f"bound {float(staleness_bound_s):.6g}s — K={worst_k} "
+            f"holds results up to {worst_s:.6g} virtual seconds "
+            "before the host sees them; shrink K or relax the bound"
+        )
+    best = max(entries, key=lambda r: r["epochs_per_s"])
+    return {
+        "entries": entries,
+        "best": int(best["K"]),
+        "best_entry": best,
+        "virtual_s": virtual_s,
+        "host_loop_epochs_per_s": host_rate,
+        "host_epoch_s": float(host_epoch_s),
+        "host_harvest_s": float(host_harvest_s),
+        "nwait": nwait,
+        "epochs": epochs,
     }
 
 

@@ -110,7 +110,24 @@ def _collect_traced(tree: ast.Module) -> list[ast.FunctionDef]:
             call: ast.Call, env_: dict[str, ast.FunctionDef]
         ) -> None:
             path = _callee_path(call)
-            if not path or len(path) < 2:
+            if not path:
+                return
+            # jax.shard_map(f, mesh=...) (or the bare/experimental
+            # spelling): the wrapped callable is traced exactly like a
+            # lax body — the fused device-coordination windows
+            # (parallel/device_coord.py) nest their whole epoch scan
+            # inside one, so leaks there must resolve through the
+            # shard_map boundary (round-17 extension)
+            if path[-1] == "shard_map" and (
+                len(path) == 1
+                or path[-2] in ("jax", "shard_map", "experimental")
+            ):
+                if call.args and isinstance(call.args[0], ast.Name):
+                    fn = env_.get(call.args[0].id)
+                    if fn is not None:
+                        traced[id(fn)] = fn
+                return
+            if len(path) < 2:
                 return
             # jax.lax.scan / lax.scan / jax.checkpoint
             if path[-2] not in ("lax", "jax"):
@@ -146,6 +163,35 @@ def _param_names(fn: ast.FunctionDef) -> set[str]:
     if a.kwarg:
         names.append(a.kwarg.arg)
     return {n for n in names if n != "self"}
+
+
+def _visible_params(
+    tree: ast.Module, traced_ids: set[int]
+) -> dict[int, set[str]]:
+    """Traced-fn id -> parameter names that are tracers INSIDE it: its
+    own parameters plus those of every lexically ENCLOSING traced
+    function — a nested scan body closes over the enclosing jit fn's
+    tracers, and branching on a closed-over tracer is the same leak as
+    branching on an own argument (the `_walk_own` dedup checks each
+    nested body standalone, so it must see the closure's tracers). A
+    non-traced function in between shadows its own parameter names
+    (they rebind to host values)."""
+    vis: dict[int, set[str]] = {}
+
+    def walk(node: ast.AST, inherited: frozenset[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef):
+                own = frozenset(_param_names(child))
+                if id(child) in traced_ids:
+                    vis[id(child)] = set(inherited | own)
+                    walk(child, inherited | own)
+                else:
+                    walk(child, inherited - own)
+            else:
+                walk(child, inherited)
+
+    walk(tree, frozenset())
+    return vis
 
 
 def _dynamic_param_refs(
@@ -200,19 +246,44 @@ class TracerLeak(Checker):
     description = (
         "no host clocks, host RNG, .item(), float()/int()/bool() "
         "casts of traced arguments, or Python branching on traced "
-        "arguments inside jit-decorated functions or lax control-flow "
-        "bodies"
+        "arguments inside jit-decorated functions, lax control-flow "
+        "bodies, or shard_map-wrapped callables"
     )
 
     def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
-        for fn in _collect_traced(mod.tree):
-            yield from self._check_fn(mod, fn)
+        traced = _collect_traced(mod.tree)
+        ids = {id(f) for f in traced}
+        visible = _visible_params(mod.tree, ids)
+        for fn in traced:
+            yield from self._check_fn(
+                mod, fn, ids, visible.get(id(fn), _param_names(fn))
+            )
+
+    @staticmethod
+    def _walk_own(fn: ast.FunctionDef, traced_ids: set[int]):
+        """``ast.walk`` minus the bodies of NESTED traced functions —
+        a scan body defined inside a shard_map-wrapped callable is
+        checked once as itself, not re-attributed to every enclosing
+        traced region (the shard_map extension made such nesting the
+        normal case)."""
+        stack: list[ast.AST] = [fn]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if (
+                    isinstance(child, ast.FunctionDef)
+                    and id(child) in traced_ids
+                    and child is not fn
+                ):
+                    continue
+                stack.append(child)
 
     def _check_fn(
-        self, mod: ModuleInfo, fn: ast.FunctionDef
+        self, mod: ModuleInfo, fn: ast.FunctionDef,
+        traced_ids: set[int], params: set[str],
     ) -> Iterator[Finding]:
-        params = _param_names(fn)
-        for node in ast.walk(fn):
+        for node in self._walk_own(fn, traced_ids):
             if isinstance(node, ast.Call):
                 path = _callee_path(node)
                 if path:

@@ -37,3 +37,36 @@ def scanner(xs):
 def suppressed(x):
     t = time.time()  # graftcheck: disable=GC003  (pinned round-trip)
     return x, t
+
+
+def fused_window(xs, mesh):
+    # the round-17 device-coordination shape: the whole epoch scan
+    # nests inside ONE shard_map-wrapped callable, so leaks both in
+    # the wrapped fn and in the scan body it contains must resolve
+    # through the shard_map boundary
+    def window(x):
+        w0 = time.time()  # GC003 line 48: host clock in shard_map'd fn
+
+        def body(carry, t):
+            return carry + t.item(), t  # GC003 line 51: .item() in body
+
+        out = jax.lax.scan(body, jnp.zeros(()), x)
+        return out, w0
+
+    f = jax.shard_map(  # graftcheck: disable=GC002  (fixture file)
+        window, mesh=mesh, in_specs=None, out_specs=None
+    )
+    return f(xs)
+
+
+@jax.jit
+def closure_branch(xs, lo):
+    # the scan body is its own traced region under the _walk_own dedup,
+    # but `lo` is the ENCLOSING jit fn's tracer — the branch on it must
+    # still be attributed (to the body, once)
+    def body(carry, t):
+        if lo > 0:  # GC003 line 68: branch on closed-over tracer
+            carry = carry + t
+        return carry, t
+
+    return jax.lax.scan(body, jnp.zeros(()), xs)

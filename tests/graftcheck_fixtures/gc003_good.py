@@ -26,3 +26,40 @@ def host_step(xs):
 
     out = jax.lax.scan(body, jnp.zeros(()), xs)
     return out, time.perf_counter() - t0
+
+
+def fused_window(xs, mesh, payload=None):
+    # shard_map-wrapped scan using only the static allowances: shape
+    # tests, `is None` config branching, and clocks OUTSIDE the traced
+    # region
+    t0 = time.perf_counter()  # not traced: allowed
+
+    def window(x):
+        if payload is None:  # static config test: allowed
+            scale = 1
+        else:
+            scale = 2
+        if x.shape[0] > 4:  # shape is a trace-time constant: allowed
+            x = x[:4]
+
+        def body(carry, t):
+            return carry + jnp.square(t) * scale, t
+
+        return jax.lax.scan(body, jnp.zeros(()), x)
+
+    f = jax.shard_map(  # graftcheck: disable=GC002  (fixture file)
+        window, mesh=mesh, in_specs=None, out_specs=None
+    )
+    return f(xs), time.perf_counter() - t0
+
+
+@jax.jit
+def closure_static(xs, ref):
+    # closed-over enclosing tracer used only behind static accessors
+    # inside the nested scan body: allowed
+    def body(carry, t):
+        if ref.shape[0] > 4:  # shape is a trace-time constant: allowed
+            return carry + t, t
+        return carry, t
+
+    return jax.lax.scan(body, jnp.zeros(()), xs)

@@ -63,3 +63,11 @@ def migrate_ticket(ticket, registry=None, flight=None):
     registry.counter("disagg_migrations_total").inc()  # GC004 line 63
     flight.event("kv migrated", pages=ticket)  # GC004 line 64
     return ticket
+
+
+def fused_harvest(repochs, registry=None, flight=None):
+    # the round-17 device-coordination telemetry shape: counting a
+    # K-epoch window harvest without the None guards
+    registry.counter("devcoord_harvests_total").inc()  # GC004 line 71
+    flight.span("devcoord window", 0.0, 0.0)  # GC004 line 72
+    return repochs

@@ -114,3 +114,17 @@ def migrate_ticket(ticket, registry=None, flight=None):
         registry.histogram("disagg_migration_seconds").observe(0.0)
     ok = flight is not None and flight.event("kv migrated")
     return ticket if ok else None
+
+
+def fused_harvest(repochs, registry=None, flight=None):
+    """The round-17 device-coordination telemetry shape, guarded: the
+    window counters, the harvest-latency histogram, and the per-window
+    flight span only fire inside the is-not-None arms
+    (parallel/device_coord.py DeviceCoordinator discipline)."""
+    if registry is not None:
+        registry.counter("devcoord_fused_epochs_total").inc(repochs)
+        registry.counter("devcoord_harvests_total").inc()
+        registry.histogram("devcoord_harvest_seconds").observe(0.0)
+        registry.gauge("devcoord_epochs_per_harvest").set(repochs)
+    ok = flight is not None and flight.span("devcoord window", 0.0, 0.0)
+    return repochs if ok else None

@@ -1,0 +1,499 @@
+"""Device-resident coordination (round 17): fused K-epoch windows.
+
+The contract under test, in priority order:
+
+1. **Reference parity, bit-identical, per epoch**: the (K, n)
+   ``repochs`` history a fused window harvests equals — row for row,
+   bit for bit — what the host ``asyncmap`` loop produces on a
+   :class:`SimBackend` under the SAME injected-delay schedule, at
+   every K, stale workers' shards masked by the on-device arrival
+   mask exactly as the host loop masks them (in-flight state carried
+   across window boundaries included).
+2. **Decode identity**: the per-epoch on-device decode equals
+   ``A @ B`` across K x {mds, lt} x {0, 1 straggler}, including the
+   hierarchical vmapped-inner + parity-outer path under a straggling
+   host group and the mesh ``psum_scatter`` path.
+3. **sweep_harvest_k** refusals are named refusals, never clamps.
+"""
+
+import numpy as np
+import pytest
+
+from mpistragglers_jl_tpu import (
+    AsyncPool,
+    SimBackend,
+    asyncmap,
+    asyncmap_fused,
+)
+from mpistragglers_jl_tpu.obs import MetricsRegistry
+from mpistragglers_jl_tpu.ops.coded_gemm import CodedGemm, LTCodedGemm
+from mpistragglers_jl_tpu.ops.hierarchical import HierarchicalCodedGemm
+from mpistragglers_jl_tpu.parallel import make_mesh
+from mpistragglers_jl_tpu.parallel.device_coord import (
+    DeviceCoordinator,
+    stage_delays,
+)
+from mpistragglers_jl_tpu.parallel.fused import PoolMeshCodedGemm
+from mpistragglers_jl_tpu.sim import sweep_harvest_k
+from mpistragglers_jl_tpu.utils import faults
+
+N, K_CODE = 8, 6
+RNG = np.random.default_rng(7)
+A = RNG.standard_normal((K_CODE * 3, 16))
+B = RNG.standard_normal((16, 5))
+
+
+def _straggle(base, slow, extra=30.0):
+    """``base`` delays with worker ``slow`` permanently +``extra``s."""
+
+    def fn(w, e):
+        return base(w, e) + (extra if w == slow else 0.0)
+
+    return fn
+
+
+def _host_hist(delay_fn, n, nwait, epochs, payload=B):
+    """The reference: the REAL asyncmap loop on SimBackend."""
+    be = SimBackend(lambda i, p, e: p, n, delay_fn=delay_fn)
+    pool = AsyncPool(n)
+    hist = np.empty((epochs, n), dtype=np.int64)
+    for e in range(epochs):
+        hist[e] = asyncmap(pool, payload, be, nwait=nwait).copy()
+    return hist, pool
+
+
+# --------------------------------------------------------------------------
+# reference parity: bit-identical repochs, epoch for epoch, at every K
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("window", [1, 8])
+@pytest.mark.parametrize("straggler", [None, 2])
+def test_repochs_parity_bit_identical(window, straggler):
+    delay = faults.seeded_lognormal(0.01, 0.8, seed=5)
+    if straggler is not None:
+        delay = _straggle(delay, straggler)
+    epochs = 48
+    host, host_pool = _host_hist(delay, N, K_CODE, epochs)
+    cg = CodedGemm(A, N, K_CODE, dtype=np.float64)
+    try:
+        coord = cg.coordinator(delay_fn=delay)
+        pool = AsyncPool(N)
+        fused = np.concatenate([
+            asyncmap_fused(pool, B, coord, epochs=window)
+            for _ in range(epochs // window)
+        ])
+        assert np.array_equal(host, fused)
+        # the pool leaves the window in the host loop's end state —
+        # in-flight workers (the straggler) carried across boundaries
+        assert np.array_equal(host_pool.active, pool.active)
+        assert np.array_equal(host_pool.sepochs, pool.sepochs)
+        assert np.array_equal(host_pool.repochs, pool.repochs)
+        assert pool.epoch == host_pool.epoch
+        if straggler is not None:
+            assert pool.active[straggler]  # still in flight
+            assert pool.repochs[straggler] == 0  # never heard from
+    finally:
+        cg.backend.shutdown()
+
+
+def test_parity_with_stale_harvest_and_retask():
+    """A finite straggler lands mid-later-epoch: the host loop
+    stale-harvests and re-tasks it; the fused window must stamp the
+    identical stale epochs (phase-3 re-task semantics, reference
+    src/MPIAsyncPools.jl:177-184)."""
+    base = faults.seeded_lognormal(0.005, 0.3, seed=11)
+
+    def delay(w, e):
+        # worker 5 straggles ~2.5 epochs, then answers: stale stamps
+        return base(w, e) + (0.04 if w == 5 else 0.0)
+
+    epochs = 40
+    host, _ = _host_hist(delay, N, K_CODE, epochs)
+    # the schedule must actually exercise stale stamps, or this test
+    # pins nothing: some row must show worker 5 at an older epoch
+    stale_rows = np.sum(host[1:, 5] < np.arange(2, epochs + 1))
+    assert stale_rows > 0
+    cg = CodedGemm(A, N, K_CODE, dtype=np.float64)
+    try:
+        coord = cg.coordinator(delay_fn=delay)
+        pool = AsyncPool(N)
+        fused = np.concatenate([
+            asyncmap_fused(pool, B, coord, epochs=8)
+            for _ in range(epochs // 8)
+        ])
+        assert np.array_equal(host, fused)
+    finally:
+        cg.backend.shutdown()
+
+
+# --------------------------------------------------------------------------
+# decode identity: on-device decode == A @ B at every K x code x straggler
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("window", [1, 8])
+@pytest.mark.parametrize("code", ["mds", "lt"])
+@pytest.mark.parametrize("straggler", [None, 2])
+def test_decode_identity(window, code, straggler):
+    delay = faults.seeded_lognormal(0.01, 0.6, seed=3)
+    ref = np.max(np.abs(A @ B))
+    if code == "mds":
+        g = CodedGemm(A, N, K_CODE, dtype=np.float64)
+        nwait = K_CODE
+    else:
+        g = LTCodedGemm(A, N, K_CODE, seed=1, dtype=np.float64)
+        nwait = N
+    try:
+        if straggler is not None:
+            if code == "lt":
+                # an integer nwait cannot promise every subset peels:
+                # pick a straggler whose complement provably decodes
+                straggler = next(
+                    w for w in range(N)
+                    if g.code.peelable(
+                        [g.shard_ids[i] for i in range(N) if i != w]
+                    )
+                )
+            delay = _straggle(delay, straggler)
+            nwait = N - 1
+        coord = (
+            g.coordinator(delay_fn=delay, nwait=nwait)
+            if code == "mds"
+            else g.coordinator(delay_fn=delay, nwait=nwait)
+        )
+        pool = AsyncPool(N)
+        hist = asyncmap_fused(pool, B, coord, epochs=window)
+        dec = np.asarray(coord.last_decoded)
+        assert dec.shape[0] == window
+        for j in range(window):
+            assert np.max(np.abs(dec[j] - A @ B)) / ref < 1e-9
+        if straggler is not None:
+            assert np.all(hist[:, straggler] == 0)
+    finally:
+        g.backend.shutdown()
+
+
+def test_per_epoch_staged_payloads():
+    """An (epochs, d, c) payload stack stages per-epoch inputs up
+    front; each epoch decodes against ITS payload."""
+    cg = CodedGemm(A, N, K_CODE, dtype=np.float64)
+    try:
+        coord = cg.coordinator()
+        pool = AsyncPool(N)
+        Bs = RNG.standard_normal((4, 16, 5))
+        asyncmap_fused(pool, Bs, coord, epochs=4)
+        dec = np.asarray(coord.last_decoded)
+        for j in range(4):
+            ref = np.max(np.abs(A @ Bs[j]))
+            assert np.max(np.abs(dec[j] - A @ Bs[j])) / ref < 1e-9
+    finally:
+        cg.backend.shutdown()
+
+
+def test_pool_interop_after_window():
+    """Harvest leaves ``pool.results``/``repochs`` consistent enough
+    that the HOST decode path decodes the same product from the same
+    pool — the two coordination modes share one pool contract."""
+    cg = CodedGemm(A, N, K_CODE, dtype=np.float64)
+    try:
+        coord = cg.coordinator(
+            delay_fn=faults.seeded_lognormal(0.01, 0.5, seed=2)
+        )
+        pool = AsyncPool(N)
+        asyncmap_fused(pool, B, coord, epochs=8)
+        fresh = pool.fresh_indices()
+        assert fresh.size >= K_CODE
+        host_decode = cg.result(pool)
+        ref = np.max(np.abs(A @ B))
+        assert np.max(np.abs(host_decode - A @ B)) / ref < 1e-9
+    finally:
+        cg.backend.shutdown()
+
+
+# --------------------------------------------------------------------------
+# hierarchical: vmapped inner decode + parity outer, in the scan body
+# --------------------------------------------------------------------------
+
+
+def _hier_fixture(group_straggle: bool):
+    H, ni, ki = 3, 4, 3
+    n = H * ni
+    Ah = RNG.standard_normal(((H - 1) * ki * 2, 10))
+    Bh = RNG.standard_normal((10, 4))
+    base = faults.seeded_lognormal(0.005, 0.5, seed=9)
+
+    def delay(w, e):
+        slow = group_straggle and 4 <= w < 8  # host group 1
+        return base(w, e) + (50.0 if slow else 0.0)
+
+    hg = HierarchicalCodedGemm(
+        Ah, groups=H, n_inner=ni, k_inner=ki, dtype=np.float64,
+        device_backend=False,
+    )
+    return hg, Ah, Bh, delay, n
+
+
+@pytest.mark.parametrize("group_straggle", [False, True])
+def test_hierarchical_window(group_straggle):
+    """The two-level completion rule, the vmapped inner decode
+    (ops/hierarchical.decode_groups) and the parity-outer
+    reconstruction all run inside the scan: repochs parity is
+    bit-identical to the host loop under ``hg.nwait``, and the decode
+    equals A @ B even with a whole host group straggling (outer
+    reconstruction on device)."""
+    hg, Ah, Bh, delay, n = _hier_fixture(group_straggle)
+    epochs = 16
+    be = SimBackend(hg.work, n, delay_fn=delay)
+    pool_h = AsyncPool(n)
+    host = np.stack([
+        asyncmap(pool_h, Bh, be, nwait=hg.nwait).copy()
+        for _ in range(epochs)
+    ])
+    coord = DeviceCoordinator.for_hierarchical(hg, delay_fn=delay)
+    pool = AsyncPool(n)
+    fused = np.concatenate([
+        asyncmap_fused(pool, Bh, coord, epochs=8)
+        for _ in range(epochs // 8)
+    ])
+    assert np.array_equal(host, fused)
+    dec = np.asarray(coord.last_decoded)[-1]
+    ref = np.max(np.abs(Ah @ Bh))
+    assert np.max(np.abs(dec - Ah @ Bh)) / ref < 1e-9
+    if group_straggle:
+        # the straggling group never went fresh: its shards were
+        # masked and the source came back through the parity pass
+        assert np.all(fused[:, 4:8] == 0)
+
+
+def test_hierarchical_factory_refusals():
+    hg, *_ = _hier_fixture(False)
+    with pytest.raises(ValueError, match="int nwait does not apply"):
+        DeviceCoordinator(
+            np.stack([np.asarray(b) for b in hg.blocks]),
+            decode="hierarchical", groups=hg.H, k_inner=hg.k_inner,
+            inner_G=hg._inner_G, nwait=9,
+        )
+    lt_inner = HierarchicalCodedGemm(
+        A[: 2 * 3 * 2], groups=3, n_inner=4, k_inner=3, inner="lt",
+        dtype=np.float64, device_backend=False,
+    )
+    with pytest.raises(ValueError, match="MDS-inner"):
+        DeviceCoordinator.for_hierarchical(lt_inner)
+
+
+# --------------------------------------------------------------------------
+# mesh path: shard_map scan, psum_scatter decode, ppermute return ring
+# --------------------------------------------------------------------------
+
+
+def test_mesh_window():
+    base = faults.seeded_lognormal(0.01, 0.7, seed=3)
+    delay = _straggle(base, 2)
+    mesh = make_mesh(8)
+    fg = PoolMeshCodedGemm(A, mesh, K_CODE, dtype=np.float64)
+    try:
+        coord = fg.device_coordinator(delay_fn=delay)
+        pool = AsyncPool(N)
+        fused = asyncmap_fused(pool, B, coord, epochs=6)
+        host, _ = _host_hist(delay, N, K_CODE, 6)
+        assert np.array_equal(host, fused)
+        ref = np.max(np.abs(A @ B))
+        # decode output uses the collectives layout: block j on
+        # device j, blocks >= k zero
+        dec = coord.full(np.asarray(coord.last_decoded)[-1])
+        assert np.max(np.abs(dec - A @ B)) / ref < 1e-9
+        # the final epoch's product returned to every device over the
+        # ppermute ring
+        full = np.asarray(coord.last_window["last_full"])
+        assert np.max(np.abs(full - A @ B)) / ref < 1e-9
+    finally:
+        fg.shutdown()
+
+
+def test_mesh_window_refusals():
+    mesh = make_mesh(8)
+    fg = PoolMeshCodedGemm(A, mesh, K_CODE, n_workers=16,
+                           dtype=np.float64)
+    try:
+        with pytest.raises(ValueError, match="one worker per mesh"):
+            fg.device_coordinator()
+    finally:
+        fg.shutdown()
+    blocks = np.zeros((8, 3, 4))
+    with pytest.raises(ValueError, match="flat MDS"):
+        DeviceCoordinator(
+            blocks, decode="lt", G=np.ones((8, 6)), k=6, nwait=8,
+            mesh=mesh,
+        )
+
+
+# --------------------------------------------------------------------------
+# construction / staging / continuation guards
+# --------------------------------------------------------------------------
+
+
+def test_constructor_refusals():
+    blocks = np.zeros((N, 3, 4))
+    G = np.ones((N, K_CODE))
+    with pytest.raises(ValueError, match="nwait=2 must sit in"):
+        DeviceCoordinator(blocks, decode="mds", G=G, k=K_CODE, nwait=2)
+    with pytest.raises(ValueError, match="nwait=9 must sit in"):
+        DeviceCoordinator(blocks, decode="mds", G=G, k=K_CODE, nwait=9)
+    with pytest.raises(ValueError, match="unknown decode"):
+        DeviceCoordinator(blocks, decode="raptor", G=G, k=K_CODE)
+    with pytest.raises(ValueError, match="needs G and k"):
+        DeviceCoordinator(blocks, decode="mds")
+    with pytest.raises(ValueError, match="stack"):
+        DeviceCoordinator(np.zeros((N, 3)), decode="mds", G=G, k=K_CODE)
+
+
+def test_run_window_guards():
+    cg = CodedGemm(A, N, K_CODE, dtype=np.float64)
+    try:
+        coord = cg.coordinator()
+        pool = AsyncPool(N)
+        with pytest.raises(ValueError, match="epochs must be >= 1"):
+            coord.run_window(pool, B, epochs=0)
+        with pytest.raises(ValueError, match="laid out for"):
+            coord.run_window(AsyncPool(4), B, epochs=1)
+        with pytest.raises(ValueError, match="carry 3 epochs"):
+            coord.run_window(pool, np.zeros((3, 16, 5)), epochs=2)
+        # a pool with host-loop work in flight cannot enter a window
+        busy = AsyncPool(N)
+        busy.active[1] = True
+        with pytest.raises(ValueError, match="quiescent"):
+            coord.run_window(busy, B, epochs=1)
+    finally:
+        cg.backend.shutdown()
+
+
+def test_stage_delays_contract():
+    d = stage_delays(lambda w, e: -1.0 if w == 0 else w + e, 3, 5, 2)
+    assert d.shape == (2, 3)
+    assert d[0, 0] == 0.0  # clamped like SimBackend
+    assert d[0, 1] == 6.0 and d[1, 2] == 8.0
+    assert np.all(stage_delays(None, 4, 0, 3) == 0.0)
+
+
+def test_reset_forgets_in_flight_state():
+    delay = _straggle(faults.seeded_lognormal(0.01, 0.5, seed=1), 3)
+    cg = CodedGemm(A, N, K_CODE, dtype=np.float64)
+    try:
+        coord = cg.coordinator(delay_fn=delay)
+        pool = AsyncPool(N)
+        asyncmap_fused(pool, B, coord, epochs=4)
+        assert pool.active[3]
+        coord.reset()
+        for i in np.flatnonzero(pool.active):
+            pool.reset_worker(i)  # the elastic-recovery pair
+        # a quiescent pool re-enters cleanly at the next epoch
+        hist = asyncmap_fused(pool, B, coord, epochs=2)
+        assert hist.shape == (2, N)
+        assert pool.epoch == 6
+    finally:
+        cg.backend.shutdown()
+
+
+# --------------------------------------------------------------------------
+# observability (GC004 opt-in contract)
+# --------------------------------------------------------------------------
+
+
+class _SpanLog:
+    def __init__(self):
+        self.spans = []
+
+    def span(self, name, t0, dur, **kw):
+        self.spans.append((name, kw))
+
+
+def test_obs_wiring():
+    reg = MetricsRegistry()
+    fl = _SpanLog()
+    cg = CodedGemm(A, N, K_CODE, dtype=np.float64)
+    try:
+        coord = cg.coordinator(registry=reg, flight=fl)
+        pool = AsyncPool(N)
+        asyncmap_fused(pool, B, coord, epochs=8)
+        asyncmap_fused(pool, B, coord, epochs=8)
+        assert reg.counter("devcoord_fused_epochs_total").value == 16
+        assert reg.counter("devcoord_harvests_total").value == 2
+        assert reg.gauge("devcoord_epochs_per_harvest").value == 8
+        assert reg.histogram("devcoord_harvest_seconds").count == 2
+        assert len(fl.spans) == 2
+        assert fl.spans[0][1]["epochs"] == 8
+        # dark coordinator stays dark: only `is None` checks
+        dark = cg.coordinator()
+        dark_pool = AsyncPool(N)
+        asyncmap_fused(dark_pool, B, dark, epochs=2)
+        assert dark._m is None
+    finally:
+        cg.backend.shutdown()
+
+
+# --------------------------------------------------------------------------
+# sweep_harvest_k: the K sweep priced on virtual time, refusals by name
+# --------------------------------------------------------------------------
+
+
+def _sweep_delay():
+    return faults.seeded_lognormal(0.02, 0.6, seed=4)
+
+
+def test_sweep_harvest_k_prices_the_amdahl_trade():
+    out = sweep_harvest_k(
+        _sweep_delay(), n_workers=8, nwait=6, epochs=64,
+        k_values=(1, 4, 16, 64),
+        host_epoch_s=2e-3, host_harvest_s=4e-3,
+    )
+    ks = [e["K"] for e in out["entries"]]
+    assert ks == [1, 4, 16, 64]
+    # staleness grows with K (a window holds results longer) …
+    stale = [e["staleness_s"] for e in out["entries"]]
+    assert stale == sorted(stale)
+    # … while amortized host cost shrinks, so the unbounded sweep
+    # recommends the largest K and overhead_x is monotone
+    rates = [e["epochs_per_s"] for e in out["entries"]]
+    assert rates == sorted(rates)
+    assert out["best"] == 64
+    assert out["best_entry"]["overhead_x"] > 1.0
+    assert out["entries"][0]["n_harvests"] == 64
+    assert out["best_entry"]["n_harvests"] == 1
+    assert out["host_loop_epochs_per_s"] > 0
+
+
+def test_sweep_harvest_k_staleness_refusal_by_message():
+    with pytest.raises(
+        ValueError, match="violates the staleness bound"
+    ):
+        sweep_harvest_k(
+            _sweep_delay(), n_workers=8, nwait=6, epochs=64,
+            k_values=(1, 64), staleness_bound_s=0.2,
+        )
+    # a bound every candidate clears does not refuse
+    out = sweep_harvest_k(
+        _sweep_delay(), n_workers=8, nwait=6, epochs=64,
+        k_values=(1, 2), staleness_bound_s=1e6,
+    )
+    assert out["best"] == 2
+
+
+def test_sweep_harvest_k_window_refusals_by_message():
+    with pytest.raises(
+        ValueError, match="must cover at least 1 epoch"
+    ):
+        sweep_harvest_k(
+            _sweep_delay(), n_workers=8, nwait=6, epochs=16,
+            k_values=(0, 4),
+        )
+    with pytest.raises(ValueError, match="exceeds the 16-epoch run"):
+        sweep_harvest_k(
+            _sweep_delay(), n_workers=8, nwait=6, epochs=16,
+            k_values=(4, 32),
+        )
+    with pytest.raises(ValueError, match="nwait must be in"):
+        sweep_harvest_k(
+            _sweep_delay(), n_workers=8, nwait=9, epochs=16,
+            k_values=(4,),
+        )

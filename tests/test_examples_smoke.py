@@ -67,6 +67,19 @@ def test_disaggregated_demo_example():
     assert "disagg demo ok" in out.stdout
 
 
+def test_device_coord_demo_example():
+    """The round-17 device-coordination walkthrough: the host-loop vs
+    fused-K=64 overhead race plus the bit-identical straggling-fleet
+    repochs parity leg — small CPU jit programs, seconds warm (the
+    demo shares the suite's persistent compile cache), so it runs in
+    tier-1."""
+    out = _run_example("device_coord_demo.py")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "overhead multiple:" in out.stdout
+    assert "(bit-identical)" in out.stdout
+    assert "device coord demo ok" in out.stdout
+
+
 @pytest.mark.slow
 def test_straggler_aware_training_converges(tmp_path):
     out = _run_example("straggler_aware_training.py", str(tmp_path))

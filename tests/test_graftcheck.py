@@ -47,16 +47,22 @@ def _keys(findings):
         ("gc001_hermetic_bad_pkg", [("GC001", 6)]),
         ("gc002_bad.py", [("GC002", 11), ("GC002", 17), ("GC002", 21)]),
         (
+            # lines 48/51 are the round-17 shard_map extension: a host
+            # clock in the shard_map-wrapped callable itself and an
+            # .item() in the lax.scan body nested inside it — both
+            # resolve through the shard_map boundary
             "gc003_bad.py",
             [("GC003", 16), ("GC003", 17), ("GC003", 18),
-             ("GC003", 25), ("GC003", 30)],
+             ("GC003", 25), ("GC003", 30),
+             ("GC003", 48), ("GC003", 51), ("GC003", 68)],
         ),
         ("gc004_bad.py", [("GC004", 6), ("GC004", 12), ("GC004", 17),
                           ("GC004", 22), ("GC004", 26),
                           ("GC004", 33), ("GC004", 40),
                           ("GC004", 47), ("GC004", 48),
                           ("GC004", 55), ("GC004", 56),
-                          ("GC004", 63), ("GC004", 64)]),
+                          ("GC004", 63), ("GC004", 64),
+                          ("GC004", 71), ("GC004", 72)]),
         (
             "gc005_bad.py",
             [("GC005", 17), ("GC005", 18), ("GC005", 21),
@@ -116,7 +122,8 @@ def test_baseline_roundtrip(tmp_path):
                                 ("GC004", 33), ("GC004", 40),
                                 ("GC004", 47), ("GC004", 48),
                                 ("GC004", 55), ("GC004", 56),
-                                ("GC004", 63), ("GC004", 64)]
+                                ("GC004", 63), ("GC004", 64),
+                                ("GC004", 71), ("GC004", 72)]
     assert res.baseline_size == 1
 
 
@@ -395,6 +402,35 @@ def test_hermetic_and_top_root_findings_deduplicate(tmp_path):
     assert len(res.fresh) == 2  # jax AND torch, once each
     assert all(f.rule == "GC001" for f in res.fresh)
     assert {f.line for f in res.fresh} == {2}
+
+
+def test_gc003_shard_map_nested_body_single_attribution(tmp_path):
+    """The round-17 extension: GC003 collects shard_map-wrapped
+    callables as traced regions, resolves lax bodies nested inside
+    them, and attributes each leak ONCE to the innermost traced
+    function (the naive walk re-reported a nested body's leak for
+    every enclosing traced region)."""
+    p = tmp_path / "m.py"
+    p.write_text(
+        "import time\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "def outer(xs, mesh):\n"
+        "    def window(x):\n"
+        "        t0 = time.time()\n"              # line 7: window's own
+        "        def body(c, t):\n"
+        "            return c + t.item(), t\n"    # line 9: body's own
+        "        return jax.lax.scan(body, jnp.zeros(()), x), t0\n"
+        "    return jax.shard_map(window, mesh=mesh, in_specs=None,\n"
+        "                         out_specs=None)(xs)\n"
+    )
+    res = run([str(p)], rules=["GC003"])
+    assert [(f.rule, f.line) for f in res.fresh] == [
+        ("GC003", 7), ("GC003", 9)
+    ], [f.format() for f in res.fresh]
+    assert "window" in res.fresh[0].message
+    assert "body" in res.fresh[1].message
 
 
 def test_package_self_run_is_clean():
