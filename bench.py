@@ -508,6 +508,19 @@ def driver_contract(budget_s: float | None = None) -> dict:
         out["device_coord"] = _try_rung(
             rung_device_coord, est=45, scale=False
         )
+
+        def rung_fleet():
+            from benchmarks.fleet_bench import bench_fleet_rung
+
+            return bench_fleet_rung()
+
+        # round-18 elastic-fleet rung — unscaled like the other sim
+        # rungs: a 3x-diurnal-swing day on virtual time, elastic
+        # (autoscale + re-code + one coordinator kill survived with
+        # zero drops) vs static peak provisioning; FAILS below the
+        # 1.2x chip-time floor or on any dropped request, with the
+        # bit-identity witness over two killed-day replays.
+        out["fleet"] = _try_rung(rung_fleet, est=30, scale=False)
         # headline: never budget-skipped, loud-fail (it IS the
         # contract) — but SIZED by measurement. Each ladder step is a
         # complete config-3 bench at that cube; the next step runs only
@@ -686,6 +699,10 @@ def _contract_line(out: dict) -> str:
             out.get("device_coord"), "devcoord_overhead_x"),
         "devcoord_harvest_k": _rung_summary(
             out.get("device_coord"), "devcoord_harvest_k"),
+        "fleet_chip_time_x": _rung_summary(
+            out.get("fleet"), "fleet_chip_time_x"),
+        "fleet_failover_drops": _rung_summary(
+            out.get("fleet"), "fleet_failover_drops"),
         "adaptive_speedup": _rung_summary(
             out.get("adaptive_nwait"), "speedup"),
         "obs_overhead_pct": _rung_summary(

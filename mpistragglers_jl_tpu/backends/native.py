@@ -643,6 +643,41 @@ class NativeProcessBackend(Backend):
             raise RuntimeError("backend has been shut down")
         self._coord.reaccept(i, timeout=timeout)
 
+    def reap(self, i: int) -> None:
+        """Elastic shrink: deliberately retire worker process ``i`` —
+        the pair of :meth:`respawn`, and the verb the fleet
+        controller's pool scaler uses (``fleet/failover.py``). The
+        process is terminated; the transport's native progress thread
+        sees the HUP and sets the sticky dead marker, so the rank
+        reads as dead (:meth:`dead_workers`) until :meth:`respawn`
+        reconnects a fresh incarnation. Reap at an epoch boundary
+        (after ``waitall``) to retire a rank with nothing outstanding.
+        Idempotent while already dead."""
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
+        if not self._spawn:
+            raise RuntimeError(
+                "reap() needs locally spawned workers; stop external "
+                "workers out-of-band (the transport marks the rank "
+                "dead on its HUP)"
+            )
+        if self._coord.is_dead(i):
+            return
+        proc = self._procs[i]
+        if proc.is_alive():
+            proc.terminate()
+        proc.join(timeout=self._join_timeout)
+        # the native epoll thread stamps the sticky marker on the HUP;
+        # wait for it so dead_workers() is truthful on return
+        deadline = _time.monotonic() + self._join_timeout
+        while not self._coord.is_dead(i):
+            if _time.monotonic() >= deadline:  # pragma: no cover
+                raise RuntimeError(
+                    f"worker {i} terminated but the transport never "
+                    "marked the rank dead"
+                )
+            _time.sleep(0.005)
+
     def shutdown(self) -> None:
         if self._closed:
             return

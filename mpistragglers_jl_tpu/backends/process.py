@@ -563,6 +563,48 @@ class ProcessBackend(SlotBackend):
             old_reader.join(timeout=self._join_timeout)
         self._spawn_worker(i)
 
+    def reap(self, i: int) -> None:
+        """Elastic shrink: deliberately retire worker process ``i`` —
+        the pair of :meth:`respawn`, and the verb the fleet
+        controller's pool scaler uses (``fleet/failover.py``). The
+        worker gets the shutdown sentinel (clean exit, telemetry
+        drained), is terminated if it lingers, and the rank reads as
+        dead (:meth:`dead_workers`) until a later :meth:`respawn`
+        brings a fresh incarnation back. An in-flight dispatch fails
+        with ``WorkerProcessDied`` exactly like a crash would — reap
+        at an epoch boundary (after ``waitall``) to retire a rank with
+        nothing outstanding. Idempotent while already dead."""
+        if self._closed:
+            raise RuntimeError("backend has been shut down")
+        with self._cond:
+            if self._dead[i]:
+                return
+        try:
+            with self._send_lock:
+                self._conns[i].send(None)
+        except (BrokenPipeError, OSError):
+            pass
+        proc = self._procs[i]
+        proc.join(timeout=self._join_timeout)
+        if proc.is_alive():  # pragma: no cover - wedged worker
+            proc.terminate()
+            proc.join(timeout=self._join_timeout)
+        # the reader thread stamps _dead on the pipe's EOF
+        # (_on_worker_death) and fails anything outstanding; wait for
+        # the stamp so dead_workers() is truthful the moment reap
+        # returns (the cond wakes on its own timeout — no notifier
+        # needed on the nothing-outstanding path)
+        deadline = time.monotonic() + self._join_timeout
+        with self._cond:
+            while not self._dead[i] and time.monotonic() < deadline:
+                self._cond.wait(0.05)
+            if not self._dead[i]:  # pragma: no cover - wedged reader
+                raise RuntimeError(
+                    f"worker {i} terminated but its reader never "
+                    "stamped the rank dead — dead_workers() would "
+                    "lie, so reap refuses to return"
+                )
+
     # -- coordinator-side completion pump ---------------------------------
     def _reader_loop(self, i: int) -> None:
         conn = self._conns[i]

@@ -498,6 +498,42 @@ class RequestRouter:
     def mark_up(self, i: int) -> None:
         self._down_manual.discard(int(i))
 
+    def set_policy(self, policy: str) -> None:
+        """Switch the placement policy mid-run — the fleet
+        controller's re-policy hook (``fleet/controller.py`` applies
+        the ``sweep_router_policy`` winner at each resize's operating
+        point). Only the STATELESS placement policies are switchable:
+        ``hedge_p99`` and ``two_tier`` are structural (the TTFT
+        deadline / the tier membership sets are construction-time
+        contracts), so switching into or out of them is refused by
+        name, never coerced. In-flight requests are unaffected —
+        ``policy`` is read per submit."""
+        policy = str(policy)
+        if policy == self.policy:
+            return
+        if policy not in ROUTER_POLICIES:
+            raise ValueError(
+                f"unknown policy {policy!r}; choose one of "
+                f"{ROUTER_POLICIES}"
+            )
+        structural = {"hedge_p99", "two_tier"}
+        if policy in structural or self.policy in structural:
+            raise ValueError(
+                f"set_policy({policy!r}) refused: "
+                f"{(policy if policy in structural else self.policy)!r}"
+                " is structural — hedge_p99's ttft_slo and two_tier's "
+                "tier membership are construction-time contracts; "
+                "build a router with the policy instead of switching "
+                "mid-run"
+            )
+        self.policy = policy
+        if self._obs is not None and self._obs.registry is not None:
+            # completions must label the policy that ROUTED them: the
+            # obs bundle caches the label and its per-(replica,
+            # outcome) series — both roll over with the switch
+            self._obs.policy = policy
+            self._obs._done = {}
+
     def replica_statuses(
         self, *, max_tick_age_s: float = 30.0
     ) -> list[tuple[bool, str]]:

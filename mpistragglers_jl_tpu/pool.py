@@ -173,6 +173,47 @@ class AsyncPool:
         """
         self.active[int(i)] = False
 
+    def carry(self, ranks, *, nwait: int | None = None) -> "AsyncPool":
+        """Elastic-resize hook: a NEW pool over ``ranks`` carrying this
+        pool's epoch bookkeeping onto the resized rank set (the
+        fleet-controller pair of :meth:`reset_worker`).
+
+        Surviving ranks keep their ``sepochs``/``stags``/``repochs``/
+        ``active``/``latency``/``results`` — an in-flight dispatch to a
+        kept rank stays harvestable by the same backend on the same tag
+        channel. Joining ranks initialize never-heard-from (``repochs
+        == epoch0``): stale until they answer, exactly like a respawned
+        rank under ``reset_worker``. Dropped ranks' state leaves with
+        them (their worker processes are being reaped). ``nwait``
+        defaults to the old value clamped into the new pool's range —
+        pass it explicitly when the resize changes the decodability
+        floor (the fleet controller re-derives it via
+        ``sweep_hierarchical``).
+        """
+        if isinstance(ranks, (int, np.integer)):
+            ranks = list(range(int(ranks)))
+        ranks = [int(r) for r in ranks]
+        new = AsyncPool(
+            ranks,
+            epoch0=self.epoch0,
+            nwait=(
+                min(self.nwait, len(ranks)) if nwait is None else nwait
+            ),
+        )
+        new.epoch = self.epoch
+        for j, r in enumerate(new.ranks):
+            i = self._idx_of_rank.get(r)
+            if i is None:
+                continue
+            new.sepochs[j] = self.sepochs[i]
+            new.stags[j] = self.stags[i]
+            new.repochs[j] = self.repochs[i]
+            new.active[j] = self.active[i]
+            new.stimestamps[j] = self.stimestamps[i]
+            new.latency[j] = self.latency[i]
+            new.results[j] = self.results[i]
+        return new
+
     def __repr__(self) -> str:
         return (
             f"AsyncPool(n={self.n_workers}, epoch={self.epoch}, "

@@ -41,6 +41,8 @@ from .tune import (
 )
 from .workload import (
     Arrival,
+    CoordinatorKill,
+    FleetResize,
     SimPrompt,
     SimReplica,
     SimRequest,
@@ -52,6 +54,7 @@ from .workload import (
     lognormal_ticks,
     poisson_arrivals,
     run_router_day,
+    service_ticks_per_request,
 )
 
 __all__ = [
@@ -74,6 +77,8 @@ __all__ = [
     "recommend_nwait",
     "recovered_work_per_s",
     "Arrival",
+    "CoordinatorKill",
+    "FleetResize",
     "SimPrompt",
     "SimRequest",
     "SimReplica",
@@ -85,4 +90,5 @@ __all__ = [
     "dump_arrivals_jsonl",
     "lognormal_ticks",
     "run_router_day",
+    "service_ticks_per_request",
 ]

@@ -537,10 +537,13 @@ def sweep_router_policy(
         )
     mult = {int(k): float(v) for k, v in (straggler or {}).items()}
     # offered rate = load x the admittable fleet's mean service
-    # capacity (slot-holding ticks per request at the mean tick)
-    ticks_per_req = (
-        -(-int(prompt_len) // int(prompt_chunk))
-        + -(-max(int(max_new) - 1, 0) // int(n_inner))
+    # capacity (slot-holding ticks per request at the mean tick —
+    # the ONE formula, shared with fleet.signals.replica_capacity_rps)
+    from .workload import service_ticks_per_request
+
+    ticks_per_req = service_ticks_per_request(
+        prompt_len=prompt_len, prompt_chunk=prompt_chunk,
+        max_new=max_new, n_inner=n_inner,
     )
     per_slot_rate = 1.0 / (ticks_per_req * float(tick_s))
     fleet_rate = sum(

@@ -311,6 +311,85 @@ def dump_arrivals_jsonl(arrivals: Iterable[Arrival], path) -> int:
     return n
 
 
+def service_ticks_per_request(
+    *, prompt_len: int, prompt_chunk: int, max_new: int, n_inner: int,
+) -> int:
+    """Slot-holding ticks one request costs a :class:`SimReplica` (and
+    the real scheduler whose tick skeleton it models): its prefill
+    chunks plus its decode ticks. THE capacity arithmetic —
+    ``sweep_router_policy`` sizes offered load with it and the fleet
+    controller's ``replica_capacity_rps`` prices utilization with it
+    (one formula, so the controller's signal can never drift from the
+    sweep it cross-checks)."""
+    if min(prompt_len, prompt_chunk, max_new, n_inner) < 1:
+        raise ValueError(
+            "prompt_len/prompt_chunk/max_new/n_inner must be >= 1"
+        )
+    return (
+        -(-int(prompt_len) // int(prompt_chunk))
+        + -(-max(int(max_new) - 1, 0) // int(n_inner))
+    )
+
+
+class FleetResize:
+    """Control-plane event in the simulated day's event stream: at
+    virtual time ``t``, an operator forces the fleet to ``target``
+    replicas through the attached controller (``run_router_day``'s
+    ``controller=``). The controller's range contract still applies —
+    a target outside its elastic band is refused by name, never
+    clamped — and the resize re-derives (code pair, policy) exactly
+    like a hysteresis-triggered one."""
+
+    __slots__ = ("t", "target", "reason")
+
+    def __init__(self, t: float, target: int, reason: str = "operator"):
+        self.t = float(t)
+        self.target = int(target)
+        self.reason = str(reason)
+
+    def fire(self, router, controller) -> None:
+        if controller is None:
+            raise ValueError(
+                "FleetResize event with no controller attached: pass "
+                "controller= to run_router_day — there is nothing to "
+                "resize"
+            )
+        controller.resize_to(self.target, reason=self.reason)
+
+    def __repr__(self) -> str:
+        return (
+            f"FleetResize(t={self.t:.3f}, target={self.target}, "
+            f"{self.reason!r})"
+        )
+
+
+class CoordinatorKill:
+    """Control-plane event: at virtual time ``t`` the active
+    coordinator dies. The data plane (router, replicas) keeps serving;
+    decisions stop until the standby adopts the last coded checkpoint
+    (:class:`~..fleet.failover.ControllerSupervisor` semantics) — the
+    zero-drop failover scenario, replayed bit-identically."""
+
+    __slots__ = ("t",)
+
+    def __init__(self, t: float):
+        self.t = float(t)
+
+    def fire(self, router, controller) -> None:
+        kill = getattr(controller, "kill", None)
+        if kill is None:
+            raise ValueError(
+                "CoordinatorKill event needs a supervised controller "
+                "(fleet.ControllerSupervisor as run_router_day's "
+                "controller=): killing an unsupervised coordinator "
+                "would end the day, not fail it over"
+            )
+        kill()
+
+    def __repr__(self) -> str:
+        return f"CoordinatorKill(t={self.t:.3f})"
+
+
 class lognormal_ticks:
     """Deterministic per-tick service-time jitter:
     ``tick_s(tick) = base * exp(sigma * N(0,1))`` with the normals
@@ -746,10 +825,22 @@ class WorkloadReport:
     of the latency arrays, the one-line bit-identity witness two runs
     of the same scenario must agree on."""
 
-    def __init__(self, requests: list, virtual_s: float, router):
+    def __init__(self, requests: list, virtual_s: float, router,
+                 controller=None):
         self.requests = requests
         self.n = len(requests)
         self.virtual_s = float(virtual_s)
+        # control-plane counters (0 without a controller): how often
+        # the fleet resized and how many coordinator takeovers the day
+        # survived. NOT part of digest() — the bit-identity witness
+        # keeps its latency-array definition, so a no-event day hashes
+        # exactly as it did before the control plane existed.
+        self.n_resizes = (
+            0 if controller is None else int(controller.n_resizes)
+        )
+        self.n_failovers = (
+            0 if controller is None else int(controller.n_failovers)
+        )
         self.ttft = np.asarray([r.ttft for r in requests], np.float64)
         self.latency = np.asarray(
             [r.latency for r in requests], np.float64
@@ -807,7 +898,8 @@ class WorkloadReport:
 
 
 def run_router_day(
-    router, arrivals: Iterable[Arrival]
+    router, arrivals: Iterable[Arrival], *,
+    controller=None, events: Iterable = (),
 ) -> WorkloadReport:
     """Drive a virtual-time :class:`~..models.router.RequestRouter`
     through an arrival stream to completion: advance the clock to each
@@ -816,7 +908,20 @@ def run_router_day(
     kill/recover injections fire exactly on time), submit, then drain.
     Every submitted request completes (the router's zero-drop
     contract); the report's :meth:`~WorkloadReport.digest` is
-    bit-identical across runs of the same scenario."""
+    bit-identical across runs of the same scenario.
+
+    ``controller=`` attaches the round-18 control plane (a
+    :class:`~..fleet.FleetController`, or its
+    :class:`~..fleet.ControllerSupervisor` active/standby wrapper —
+    anything with ``observe_arrival`` / ``step`` / ``next_event_at``):
+    every arrival feeds its rate estimator, and the driver advances
+    the clock to the controller's decision/checkpoint/takeover cadence
+    exactly like replica ticks — a whole autoscaling day stays
+    bit-identical. ``events=`` interleaves control-plane events
+    (:class:`FleetResize`, :class:`CoordinatorKill`) into the stream;
+    an event due at ``t`` fires before an arrival stamped ``t``. With
+    neither, the drive loop is byte-for-byte the pre-round-18 one, so
+    recorded digests still hold."""
     clock = router.clock
     if clock is None:
         raise ValueError(
@@ -828,13 +933,18 @@ def run_router_day(
     # design): this driver is the clock's single thread, and the locked
     # clock.next_event() measured ~8% of a million-request day
     heap = clock._heap
+    ctl = controller
 
     def next_at():
         nt = router.next_event_at()
         if heap:
             ce = heap[0][0]
             if nt is None or ce < nt:
-                return ce
+                nt = ce
+        if ctl is not None:
+            ct = ctl.next_event_at()
+            if ct is not None and (nt is None or ct < nt):
+                nt = ct
         return nt
 
     submitted = []
@@ -842,20 +952,54 @@ def run_router_day(
     run_until, step = clock.run_until, router.step
     submit, replicas = router.submit, router.replicas
     slo = router.ttft_slo
+    evs = sorted(events, key=lambda e: e.t)
+    ei = 0
+    n_evs = len(evs)
     # `nt` (the next event time) is maintained INCREMENTALLY across
     # arrivals: a full next_at() per arrival measured ~25% of a
     # million-request day, and a submit can only add two event kinds —
     # its replica's (possibly fresh) tick and its own hedge deadline
+    # (the controller's cadence is monotone and re-read at every full
+    # next_at(), so the incremental path never skips past it)
     nt = next_at()
+
+    def advance_to(t):
+        # step the fleet (and the controller, when attached) at every
+        # due tick up to virtual time t, then land exactly on t
+        nonlocal nt
+        while nt is not None and nt <= t:
+            run_until(nt)
+            step()
+            if ctl is not None:
+                ctl.step()
+            nt = next_at()
+        run_until(t)
+
+    def fire_events_through(t):
+        # control-plane events due at or before t, in stream order
+        nonlocal ei, nt
+        while ei < n_evs and evs[ei].t <= t:
+            e = evs[ei]
+            advance_to(e.t)
+            e.fire(router, ctl)
+            ei += 1
+            nt = next_at()
+
     for a in arrivals:
         at = a.t
+        if ei < n_evs:
+            fire_events_through(at)
         while nt is not None and nt <= at:
             run_until(nt)
             step()
+            if ctl is not None:
+                ctl.step()
             nt = next_at()
         run_until(at)
         rr = submit(a.prompt, a.max_new)
         append(rr)
+        if ctl is not None:
+            ctl.observe_arrival(at)
         t = getattr(replicas[rr.replica], "next_tick_at", None)
         if t is not None and (nt is None or t < nt):
             nt = t
@@ -863,6 +1007,17 @@ def run_router_day(
             d = rr.t_submit + slo
             if nt is None or d < nt:
                 nt = d
+    if ei < n_evs:
+        # events past the last arrival (an end-of-day kill, a scale-in
+        # order): fire them at their times, stepping normally between
+        fire_events_through(evs[-1].t)
+    # a controller's decision cadence is ALWAYS pending, so with one
+    # attached next_at() never returns None and the no-event stall
+    # check below can't fire — count barren drain rounds instead
+    # (controller stepped, router stepped, yet no replica tick / hedge
+    # deadline / clock event appeared and nothing completed) and fail
+    # by name after a few, the same contract as the bare stall
+    barren = 0
     while router.in_flight:
         nt = next_at()
         if nt is None:
@@ -872,6 +1027,25 @@ def run_router_day(
                 "event pending (every replica down with nothing "
                 "scheduled to revive one?)"
             )
+        inflight_before = router.in_flight
         clock.run_until(nt)
         router.step()
-    return WorkloadReport(submitted, clock.now(), router)
+        if ctl is not None:
+            ctl.step()
+            if (
+                router.next_event_at() is None and not heap
+                and router.in_flight == inflight_before
+            ):
+                barren += 1
+                if barren >= 3:
+                    raise RuntimeError(
+                        f"workload stalled with {router.in_flight} "
+                        "requests in flight: 3 controller decision "
+                        "intervals passed with no replica tick, hedge "
+                        "deadline, or clock event and no completion — "
+                        "the controller cannot restore a replica it "
+                        "never drained (every replica down?)"
+                    )
+            else:
+                barren = 0
+    return WorkloadReport(submitted, clock.now(), router, ctl)

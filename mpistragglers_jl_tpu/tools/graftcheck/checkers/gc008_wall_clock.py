@@ -127,10 +127,15 @@ def _marked_real_smoke(mod: ModuleInfo, fn: ast.AST) -> bool:
 
 
 def _is_sim_module(mod: ModuleInfo) -> bool:
-    """The virtual-time plane: any ``sim`` package component, plus the
-    ``test_sim*`` virtual-time test family."""
+    """The virtual-time plane: any ``sim`` package component, the
+    ``test_sim*`` virtual-time test family, and — round 18 — any
+    ``fleet`` package component: the control plane's decision code
+    must be drivable by VirtualClock (a controller day replays
+    bit-identically in tier-1), so it reads only its injected clock;
+    wall seconds enter through the caller's ``timer=`` argument, never
+    an OS-clock import."""
     parts = mod.name.split(".")
-    return "sim" in parts or any(
+    return "sim" in parts or "fleet" in parts or any(
         p.startswith("test_sim") for p in parts
     )
 
@@ -140,9 +145,10 @@ class WallClock(Checker):
     rule = "GC008"
     name = "wall-clock"
     description = (
-        "sim-package modules never read the OS clock "
-        "(time.time/perf_counter/monotonic/sleep, datetime.now); no "
-        "assert compares a wall-clock-derived value against a "
+        "sim- and fleet-package modules never read the OS clock "
+        "(time.time/perf_counter/monotonic/sleep, datetime.now) — "
+        "virtual time and control-plane decisions stay clock-injected; "
+        "no assert compares a wall-clock-derived value against a "
         "sub-second margin — port the claim to "
         "SimBackend/VirtualClock or mark the one sanctioned "
         "real-thread test per family `# graftcheck: real-smoke`"
@@ -205,9 +211,11 @@ class WallClock(Checker):
                 ):
                     yield mod.finding(
                         self.rule, node,
-                        "sim module imports OS-clock names from "
-                        "`time` — virtual time must not read the "
-                        "wall clock (sim/clock.py is the only clock)",
+                        "virtual-time-plane module (sim/fleet) "
+                        "imports OS-clock names from `time` — it must "
+                        "not read the wall clock (sim/clock.py is the "
+                        "only clock; fleet code takes timer= from the "
+                        "call site)",
                     )
             elif isinstance(node, ast.Attribute):
                 path = dotted_path(node)
@@ -216,10 +224,11 @@ class WallClock(Checker):
                 ):
                     yield mod.finding(
                         self.rule, node,
-                        f"`{'.'.join(path)}` in a sim module — the "
-                        "virtual-time plane must stay wall-clock-free "
-                        "(bit-reproducibility is the whole contract); "
-                        "take the VirtualClock instead",
+                        f"`{'.'.join(path)}` in a virtual-time-plane "
+                        "module (sim/fleet) — it must stay "
+                        "wall-clock-free (bit-reproducibility is the "
+                        "whole contract); take the VirtualClock (or "
+                        "the injected timer=) instead",
                     )
 
     # -- half 2: sub-second margin asserts --------------------------------

@@ -71,3 +71,12 @@ def fused_harvest(repochs, registry=None, flight=None):
     registry.counter("devcoord_harvests_total").inc()  # GC004 line 71
     flight.span("devcoord window", 0.0, 0.0)  # GC004 line 72
     return repochs
+
+
+def fleet_decide(decision, registry=None, flight=None):
+    # the round-18 fleet-controller telemetry shape: counting an
+    # accepted resize and stamping the decision instant event without
+    # the None guards
+    registry.counter("fleet_resizes_total").inc()  # GC004 line 80
+    flight.event("fleet decision", seq=decision)  # GC004 line 81
+    return decision

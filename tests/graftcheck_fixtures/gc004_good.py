@@ -128,3 +128,18 @@ def fused_harvest(repochs, registry=None, flight=None):
         registry.gauge("devcoord_epochs_per_harvest").set(repochs)
     ok = flight is not None and flight.span("devcoord window", 0.0, 0.0)
     return repochs if ok else None
+
+
+def fleet_decide(decision, registry=None, flight=None):
+    """The round-18 fleet-controller telemetry shape, guarded: the
+    resize counter, sizing gauges, decision histogram, and the
+    per-decision flight instant event only fire inside the is-not-None
+    arms (fleet/controller.py _FleetObs discipline)."""
+    if registry is not None:
+        registry.counter("fleet_resizes_total").inc()
+        registry.gauge("fleet_size").set(decision)
+        registry.gauge("fleet_target_size").set(decision)
+        registry.histogram("fleet_decision_seconds").observe(0.0)
+        registry.counter("fleet_failovers_total").inc(0)
+    ok = flight is not None and flight.event("fleet decision")
+    return decision if ok else None

@@ -1,0 +1,13 @@
+"""GC008 bad fixture, fleet half: control-plane decision code that
+secretly reads the OS clock — a controller like this can never replay
+bit-identically. Violation lines pinned by the fixture test."""
+
+import time
+
+
+def decide(controller, signals):
+    t0 = time.perf_counter()  # GC008: OS clock in a decision function
+    if signals.utilization > controller.high:
+        controller.grow()
+    controller.decision_s = time.perf_counter() - t0  # GC008
+    return controller.decision_s

@@ -1,0 +1,11 @@
+"""GC008 good fixture, fleet half: decision code on the injected
+clock/timer only — the FleetController discipline (wall seconds enter
+through the call site's ``timer=``, never an OS-clock import)."""
+
+
+def decide(controller, signals):
+    t0 = controller.timer()  # injected: clock.now in sim, any live
+    if signals.utilization > controller.high:
+        controller.grow()
+    controller.decision_s = controller.timer() - t0
+    return controller.decision_s

@@ -90,6 +90,68 @@ def test_restore_without_target_returns_leaves(tmp_path):
     assert isinstance(leaves, list) and len(leaves) == 2
 
 
+# -- round 18: controller/coordinator state through the coded channel ------
+
+
+def _controller_state():
+    """A coordinator-shaped state dict: epoch counters, per-worker
+    repochs/active, and the router book summary — the payload
+    fleet.FleetCheckpointer codes across shards."""
+    return {
+        "epoch": np.int64(41),
+        "repochs": np.array([41, 41, 40, 41], np.int64),
+        "active": np.array([False, False, True, False]),
+        "provisioned": np.array([True, True, True, False]),
+        "chip_seconds": np.array([120.5, 120.5, 60.25, 0.0]),
+        "book_awaiting": np.array([2, 0, 1, 0], np.int64),
+        "book_streaming": np.array([3, 4, 0, 0], np.int64),
+        "inflight_ids": np.arange(10, dtype=np.int64),
+        "rate_count": np.float64(17.25),
+        "policy_code": np.int64(1),
+    }
+
+
+def test_controller_state_roundtrip_through_fleet_checkpointer(tmp_path):
+    """The round-18 failover payload round-trips exactly: epoch,
+    repochs, active set, router books — through the pickle-blob
+    FleetCheckpointer channel, surviving n-k lost shards."""
+    from mpistragglers_jl_tpu.fleet import FleetCheckpointer
+
+    ck = FleetCheckpointer(tmp_path, n=5, k=3)
+    state = _controller_state()
+    ck.save(state)
+    os.remove(_shard(tmp_path, 1))
+    os.remove(_shard(tmp_path, 4))  # any n-k=2 of 5 gone
+    out = ck.restore()
+    assert set(out) == set(state)
+    for k, v in state.items():
+        np.testing.assert_array_equal(np.asarray(out[k]), np.asarray(v))
+    assert out["repochs"].dtype == np.int64  # bit-exact, not value-cast
+    assert ck.n_saves == 1
+
+
+def test_controller_state_torn_write_refused_by_name(tmp_path):
+    """A torn write (truncated shard) plus too many losses is REFUSED
+    by name: CheckpointCorrupt lists each missing/corrupt shard, and
+    the standby must not adopt a partial state."""
+    from mpistragglers_jl_tpu.fleet import FleetCheckpointer
+
+    ck = FleetCheckpointer(tmp_path, n=4, k=3)
+    ck.save(_controller_state())
+    # a torn write: the shard file exists but holds half its bytes
+    p = _shard(tmp_path, 0)
+    raw = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    os.remove(_shard(tmp_path, 2))
+    with pytest.raises(CheckpointCorrupt) as e:
+        ck.restore()
+    msg = str(e.value)
+    assert e.value.have == 2 and e.value.need == 3
+    assert "shard 0" in msg and "corrupt" in msg  # the torn write, named
+    assert "shard 2" in msg  # the missing shard, named
+
+
 def test_resave_is_crash_safe_generation_swap(tmp_path):
     """A second save commits via the manifest: new-suffix shards appear,
     previous generation's shards are pruned, restore gets the new state."""

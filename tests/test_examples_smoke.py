@@ -67,6 +67,20 @@ def test_disaggregated_demo_example():
     assert "disagg demo ok" in out.stdout
 
 
+def test_elastic_fleet_demo_example():
+    """The round-18 control-plane walkthrough: the autoscaled +
+    coordinator-killed diurnal day vs static peak provisioning, with
+    the decision timeline and the bit-identity witness — numpy-only
+    virtual time, seconds by construction, so it runs in tier-1."""
+    out = _run_example("elastic_fleet_demo.py")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "decision timeline:" in out.stdout
+    assert "takeovers survived: 1" in out.stdout
+    assert "x less" in out.stdout  # the chip-time multiple
+    assert "(bit-identical)" in out.stdout
+    assert "elastic fleet demo ok" in out.stdout
+
+
 def test_device_coord_demo_example():
     """The round-17 device-coordination walkthrough: the host-loop vs
     fused-K=64 overhead race plus the bit-identical straggling-fleet

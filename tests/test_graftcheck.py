@@ -62,7 +62,8 @@ def _keys(findings):
                           ("GC004", 47), ("GC004", 48),
                           ("GC004", 55), ("GC004", 56),
                           ("GC004", 63), ("GC004", 64),
-                          ("GC004", 71), ("GC004", 72)]),
+                          ("GC004", 71), ("GC004", 72),
+                          ("GC004", 80), ("GC004", 81)]),
         (
             "gc005_bad.py",
             [("GC005", 17), ("GC005", 18), ("GC005", 21),
@@ -123,7 +124,8 @@ def test_baseline_roundtrip(tmp_path):
                                 ("GC004", 47), ("GC004", 48),
                                 ("GC004", 55), ("GC004", 56),
                                 ("GC004", 63), ("GC004", 64),
-                                ("GC004", 71), ("GC004", 72)]
+                                ("GC004", 71), ("GC004", 72),
+                                ("GC004", 80), ("GC004", 81)]
     assert res.baseline_size == 1
 
 

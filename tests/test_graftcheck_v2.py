@@ -61,8 +61,10 @@ def _keys(findings):
         ),
         (
             "gc008_bad_pkg",
-            [("GC008", 13), ("GC008", 23), ("GC008", 4),
-             ("GC008", 9), ("GC008", 11), ("GC008", 12),
+            [("GC008", 13), ("GC008", 23),
+             ("GC008", 9), ("GC008", 12),  # fleet/: OS clock in a
+             # decision function — the round-18 control-plane purity
+             ("GC008", 4), ("GC008", 9), ("GC008", 11), ("GC008", 12),
              ("GC008", 18)],  # 18: wall sleep through `import time
             # as _t` — alias-proof matching
         ),
@@ -178,6 +180,24 @@ def test_gc008_applies_to_tests_and_benchmarks_roots():
         [os.path.join(_REPO, "tests")], rules=["GC008"]
     )
     assert full.n_files < scanned + only_fix.n_files
+
+
+def test_gc008_covers_the_fleet_package():
+    """Round-18: the control plane joined the virtual-time plane — the
+    shipped fleet/ package is clean under GC008's purity half
+    (decision code reads only its injected clock; wall seconds enter
+    via the caller's timer=), and the fixture's fleet twin pins the
+    OS-clock-in-a-decision-function leak shape by line."""
+    res = run([os.path.join(_PKG, "fleet")], rules=["GC008"])
+    assert res.fresh == [], [f.format() for f in res.fresh]
+    bad = _findings("gc008_bad_pkg", rules=["GC008"])
+    fleet_hits = [
+        (f.rule, f.line) for f in bad.fresh
+        if os.sep + "fleet" + os.sep in f.path
+    ]
+    assert fleet_hits == [("GC008", 9), ("GC008", 12)], [
+        f.format() for f in bad.fresh
+    ]
 
 
 def test_skip_marker_prunes_recursive_scans_only(tmp_path):
