@@ -521,6 +521,20 @@ def driver_contract(budget_s: float | None = None) -> dict:
         # 1.2x chip-time floor or on any dropped request, with the
         # bit-identity witness over two killed-day replays.
         out["fleet"] = _try_rung(rung_fleet, est=30, scale=False)
+
+        def rung_qos():
+            from benchmarks.qos_bench import bench_qos_rung
+
+            return bench_qos_rung()
+
+        # round-19 multi-tenant QoS rung — unscaled like the other
+        # sim rungs: the 3-tenant diurnal day with tenant c flooding
+        # 10x its token budget, FIFO vs DRR+budget-door at equal chip
+        # count; FAILS when a compliant tenant's p99 TTFT moves by
+        # the pinned epsilon or more, when flood-day utilization
+        # falls under the work-conservation floor, or on digest
+        # divergence across two flooded replays.
+        out["qos"] = _try_rung(rung_qos, est=25, scale=False)
         # headline: never budget-skipped, loud-fail (it IS the
         # contract) — but SIZED by measurement. Each ladder step is a
         # complete config-3 bench at that cube; the next step runs only
@@ -703,6 +717,10 @@ def _contract_line(out: dict) -> str:
             out.get("fleet"), "fleet_chip_time_x"),
         "fleet_failover_drops": _rung_summary(
             out.get("fleet"), "fleet_failover_drops"),
+        "qos_isolation_eps": _rung_summary(
+            out.get("qos"), "qos_isolation_eps"),
+        "qos_util_floor": _rung_summary(
+            out.get("qos"), "qos_util_floor"),
         "adaptive_speedup": _rung_summary(
             out.get("adaptive_nwait"), "speedup"),
         "obs_overhead_pct": _rung_summary(

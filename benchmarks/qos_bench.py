@@ -1,0 +1,183 @@
+"""Round-19 multi-tenant QoS rung: priced isolation under a 10x flood.
+
+One leg, sim-only (unscaled in bench.py — virtual-time bookkeeping
+does not track the matmul rate): a mixed 3-tenant diurnal day over a
+4-replica fleet — tenant ``a`` (latency class, weight 4), ``b``
+(throughput, weight 4), and ``c`` (batch, weight 1, token-budgeted to
+~10% of fleet capacity) — driven four ways on identical compliant
+arrivals (``a``+``b`` ride the SAME seeded stream in every leg;
+only ``c``'s co-tenant behavior changes):
+
+* **DRR flood-free**: the QoS plane (deficit admission + budget
+  door), ``c`` at its contracted rate — the compliant baseline;
+* **DRR flood**: ``c`` floods 10x its budget; the bucket sheds the
+  sustained overload by name and the deficit rotation paces what
+  slips through — run TWICE for the bit-identity witness;
+* **FIFO flood**: the same flood with no QoS plane at equal chip
+  count — the pre-round-19 behavior the rung prices against.
+
+Headline scalars (bench.py compact line, format in
+benchmarks/README.md round-19 note):
+
+* ``qos_isolation_eps`` — the larger compliant tenant's |p99 TTFT
+  shift| between the DRR flood and flood-free days, seconds; FAILS
+  at or above the pinned 0.05 s epsilon;
+* ``qos_util_floor`` — flood-day fleet utilization (busy tick
+  seconds / replica-seconds); FAILS under the 0.85 work-conservation
+  floor (idle capacity always serves queued work; the diurnal trough
+  idles honestly once the flood sheds at the door).
+
+The FIFO leg is the context number: the identical flood moves the
+compliant p99 by ORDERS of magnitude without the QoS plane
+(``fifo_vs_drr_p99_x``). Both DRR flood days (same seed) must agree
+on the workload digest — the sim plane's bit-identity witness.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+
+_N_REP, _SLOTS, _NI, _TICK = 4, 4, 8, 0.02
+_PLEN, _CHUNK, _MNEW = 96, 64, 32
+_TOK = _PLEN + _MNEW
+_PERIOD = 60.0
+_AB_RATE, _C_RATE = 70.0, 13.0  # fleet capacity ~133 req/s
+_EPS_S = 0.05
+# the diurnal trough (amplitude 0.5) legitimately idles part of the
+# fleet once the flood sheds at the door — the floor is about never
+# idling WHILE work is queued, measured ~0.93 on the reference day
+_UTIL_FLOOR = 0.85
+
+
+def _registry():
+    from mpistragglers_jl_tpu.qos import TenantContract, TenantRegistry
+
+    return TenantRegistry([
+        TenantContract("a", cls="latency", weight=4.0, ttft_slo=0.5),
+        TenantContract("b", cls="throughput", weight=4.0),
+        TenantContract("c", cls="batch", weight=1.0,
+                       rate=_C_RATE * _TOK * 1.2,
+                       burst=_C_RATE * _TOK * 2.0),
+    ])
+
+
+def _streams(n_ab: int, flood: bool, seed: int):
+    """Compliant a+b arrivals are IDENTICAL across legs (their own
+    seeded diurnal generator); c merges in from a separate stream at
+    1x or 10x its contracted rate."""
+    from mpistragglers_jl_tpu.sim import (
+        diurnal_arrivals,
+        poisson_arrivals,
+    )
+
+    ab = diurnal_arrivals(
+        _AB_RATE, n=n_ab, period=_PERIOD, amplitude=0.5, seed=seed,
+        prompt_len=_PLEN, max_new=_MNEW,
+        tenants={"a": 0.5, "b": 0.5},
+    )
+    span = n_ab / _AB_RATE
+    c_rate = _C_RATE * (10.0 if flood else 1.0)
+    c = poisson_arrivals(
+        c_rate, n=max(int(c_rate * span), 1), seed=seed + 17,
+        prompt_len=_PLEN, max_new=_MNEW, tenants={"c": 1.0},
+    )
+    return heapq.merge(ab, c, key=lambda x: x.t)
+
+
+def _day(n_ab: int, seed: int, *, flood: bool, qos: bool):
+    from mpistragglers_jl_tpu.models.router import RequestRouter
+    from mpistragglers_jl_tpu.sim import (
+        SimReplica,
+        VirtualClock,
+        lognormal_ticks,
+        run_router_day,
+    )
+
+    reg = _registry() if qos else None
+    clock = VirtualClock()
+    reps = [
+        SimReplica(clock, slots=_SLOTS, n_inner=_NI,
+                   prompt_chunk=_CHUNK, qos=reg,
+                   tick_s=lognormal_ticks(_TICK, 0.2, seed=1009 + i))
+        for i in range(_N_REP)
+    ]
+    router = RequestRouter(reps, policy="least_loaded", clock=clock,
+                           qos=reg)
+    report = run_router_day(router, _streams(n_ab, flood, seed))
+    util = sum(r.busy_s for r in reps) / (_N_REP * report.virtual_s)
+    return report, util
+
+
+def bench_qos_rung(requests: int | None = None):
+    """The driver rung ``qos``: FIFO vs DRR under the 10x flood at
+    equal chip count, with the epsilon/floor gates and the
+    bit-identity witness over the flooded day."""
+    import os
+
+    n_ab = int(
+        requests if requests is not None
+        else os.environ.get("QOS_BENCH_REQUESTS", "3500")
+    )
+    seed = 13
+    t0 = time.perf_counter()
+    base, _ = _day(n_ab, seed, flood=False, qos=True)
+    fl1, util = _day(n_ab, seed, flood=True, qos=True)
+    fl2, _ = _day(n_ab, seed, flood=True, qos=True)
+    if fl1.digest() != fl2.digest():
+        raise AssertionError(
+            f"flooded DRR day not bit-identical: {fl1.digest()} != "
+            f"{fl2.digest()}"
+        )
+    pb, pf = base.per_tenant(), fl1.per_tenant()
+    eps = max(
+        abs(pf[t]["p99_ttft_s"] - pb[t]["p99_ttft_s"])
+        for t in ("a", "b")
+    )
+    if eps >= _EPS_S:
+        raise AssertionError(
+            f"qos_isolation_eps {eps * 1e3:.1f}ms at or above the "
+            f"pinned {_EPS_S * 1e3:.0f}ms epsilon: the 10x flood "
+            "moved a compliant tenant's p99"
+        )
+    if util < _UTIL_FLOOR:
+        raise AssertionError(
+            f"qos_util_floor {util:.3f} under the {_UTIL_FLOOR} "
+            "work-conservation floor: capacity idled while work "
+            "was queued"
+        )
+    if fl1.dropped or base.dropped:
+        raise AssertionError(
+            f"dropped requests (flood {fl1.dropped}, base "
+            f"{base.dropped}): shed is the only sanctioned loss"
+        )
+    if fl1.n_shed < 1:
+        raise AssertionError(
+            "the flood day shed nothing: the budget door never fired"
+        )
+    # FIFO contrast at equal chip count: the same flood, no QoS plane
+    fifo, _ = _day(n_ab, seed, flood=True, qos=False)
+    pfifo = fifo.per_tenant()
+    fifo_p99 = max(pfifo[t]["p99_ttft_s"] for t in ("a", "b"))
+    drr_p99 = max(pf[t]["p99_ttft_s"] for t in ("a", "b"))
+    return {
+        "requests": int(fl1.n),
+        "qos_isolation_eps": round(eps, 4),
+        "qos_util_floor": round(util, 3),
+        "fifo_vs_drr_p99_x": round(fifo_p99 / drr_p99, 1),
+        "compliant_p99_ms": {
+            t: round(pf[t]["p99_ttft_s"] * 1e3, 1) for t in ("a", "b")
+        },
+        "fifo_compliant_p99_ms": round(fifo_p99 * 1e3, 1),
+        "flood_shed": int(fl1.n_shed),
+        "flood_served_c": int(pf["c"]["served"]),
+        "virtual_day_s": round(fl1.virtual_s, 1),
+        "digest": fl1.digest(),
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(bench_qos_rung(), indent=2, default=str))

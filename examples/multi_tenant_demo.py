@@ -1,0 +1,125 @@
+"""Multi-tenant QoS walkthrough: one fleet, three contracts, one flood.
+
+Three tenants share a 4-replica virtual fleet: ``acme`` bought the
+latency tier (DRR weight 4, a 500 ms TTFT SLO), ``globex`` the
+throughput tier (weight 4), and ``initech`` a batch lane (weight 1,
+token-budgeted to ~10% of fleet capacity — and sheddable, because
+batch work retries). The demo runs the compliant day, then has
+``initech`` flood 10x its budget, and prints what the QoS plane does
+about it: the budget door sheds the overload BY NAME, the deficit
+rotation paces what slips through, and the compliant tenants' p99
+barely moves — while the same flood on a FIFO fleet multiplies their
+p99 by orders of magnitude. Everything replays bit-identically
+(digest printed twice from two runs).
+
+Numpy-only and seconds by construction (virtual time), so it runs in
+tier-1 via tests/test_examples_smoke.py.
+"""
+
+import heapq
+
+from mpistragglers_jl_tpu.models.router import RequestRouter
+from mpistragglers_jl_tpu.qos import TenantContract, TenantRegistry
+from mpistragglers_jl_tpu.sim import (
+    SimReplica,
+    VirtualClock,
+    lognormal_ticks,
+    poisson_arrivals,
+    run_router_day,
+)
+
+N_REP, SLOTS, N_INNER, TICK = 4, 4, 8, 0.02
+PLEN, CHUNK, MNEW = 96, 64, 32
+TOK = PLEN + MNEW
+AB_RATE, C_RATE = 70.0, 13.0  # fleet capacity ~133 req/s
+
+
+def registry():
+    return TenantRegistry([
+        TenantContract("acme", cls="latency", weight=4.0,
+                       ttft_slo=0.5),
+        TenantContract("globex", cls="throughput", weight=4.0),
+        TenantContract("initech", cls="batch", weight=1.0,
+                       rate=C_RATE * TOK * 1.2,
+                       burst=C_RATE * TOK * 2.0),
+    ])
+
+
+def streams(flood: bool):
+    # the compliant tenants' arrivals are the IDENTICAL seeded stream
+    # in every leg; only initech's co-tenant behavior changes
+    ab = poisson_arrivals(
+        AB_RATE, n=2100, seed=11, prompt_len=PLEN, max_new=MNEW,
+        tenants={"acme": 0.5, "globex": 0.5},
+    )
+    c = poisson_arrivals(
+        C_RATE * (10 if flood else 1), n=3000 if flood else 300,
+        seed=29, prompt_len=PLEN, max_new=MNEW,
+        tenants={"initech": 1.0},
+    )
+    return heapq.merge(ab, c, key=lambda x: x.t)
+
+
+def day(flood: bool, qos: bool = True):
+    reg = registry() if qos else None
+    clock = VirtualClock()
+    reps = [
+        SimReplica(clock, slots=SLOTS, n_inner=N_INNER,
+                   prompt_chunk=CHUNK, qos=reg,
+                   tick_s=lognormal_ticks(TICK, 0.2, seed=1009 + i))
+        for i in range(N_REP)
+    ]
+    router = RequestRouter(reps, policy="least_loaded", clock=clock,
+                           qos=reg)
+    report = run_router_day(router, streams(flood))
+    util = sum(r.busy_s for r in reps) / (N_REP * report.virtual_s)
+    return report, util
+
+
+def show(title, report):
+    print(f"\n{title}")
+    print(f"  {'tenant':<10} {'n':>6} {'served':>6} {'shed':>6} "
+          f"{'p50 ttft':>10} {'p99 ttft':>10}")
+    for t, d in sorted(report.per_tenant().items()):
+        print(f"  {t:<10} {d['n']:>6} {d['served']:>6} "
+              f"{d['shed']:>6} {d['p50_ttft_s'] * 1e3:>8.1f}ms "
+              f"{d['p99_ttft_s'] * 1e3:>8.1f}ms")
+
+
+def main():
+    base, _ = day(flood=False)
+    show("compliant day (DRR + budget door)", base)
+
+    fl, util = day(flood=True)
+    show("flood day: initech offers 10x its token budget", fl)
+    print(f"  shed by name: {fl.n_shed} requests "
+          f"(outcome == 'shed', reason 'budget')")
+    print(f"  fleet utilization: {util:.3f} "
+          "(work conservation: queued work never idles capacity)")
+
+    pb, pf = base.per_tenant(), fl.per_tenant()
+    eps = max(
+        abs(pf[t]["p99_ttft_s"] - pb[t]["p99_ttft_s"])
+        for t in ("acme", "globex")
+    )
+    print(f"  compliant p99 shift under the flood: {eps * 1e3:.1f}ms")
+
+    fifo, _ = day(flood=True, qos=False)
+    pfifo = fifo.per_tenant()
+    fifo_p99 = max(
+        pfifo[t]["p99_ttft_s"] for t in ("acme", "globex")
+    )
+    drr_p99 = max(pf[t]["p99_ttft_s"] for t in ("acme", "globex"))
+    print(f"\nthe same flood with NO QoS plane (FIFO, equal chips): "
+          f"compliant p99 {fifo_p99 * 1e3:.0f}ms "
+          f"({fifo_p99 / drr_p99:.0f}x the QoS plane's)")
+
+    fl2, _ = day(flood=True)
+    assert fl2.digest() == fl.digest()
+    print(f"\nflood day replayed bit-identically: digest "
+          f"{fl.digest()} == {fl2.digest()}")
+    print("multi-tenant qos ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -222,6 +222,14 @@ class PagePool:
         """Resident page holding this prefix digest, or None."""
         return self._digest_to_page.get(digest)
 
+    def registered(self, pid: int) -> bool:
+        """Is ``pid`` published in the prefix table? The validity
+        witness the QoS cold-page cache keys on: registration drops
+        the moment a page's bytes stop matching its digest
+        (:meth:`note_write`, COW retarget, free), so a registered
+        sole-held page is safe to keep resident for future sharers."""
+        return pid in self._page_digest
+
     def is_volatile(self, pid: int) -> bool:
         """Will a CURRENT holder eventually overwrite this page (some
         holder's request wraps its ring)? Sharing a volatile page

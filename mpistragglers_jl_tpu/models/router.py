@@ -71,6 +71,18 @@ the sim shortcut), ``alive`` (the default health probe),
 policies — in virtual time, bit-reproducibly. All TTFT/deadline math
 uses whichever clock was given; nothing here sleeps.
 
+**Multi-tenant QoS** (``qos=`` a :class:`~..qos.TenantRegistry`,
+docs/API.md "Multi-tenant QoS"): ``submit`` then requires ``tenant=``
+and becomes the budget door — the tenant's token bucket is charged
+``prompt + max_new`` tokens, an over-budget SHEDDABLE (batch-class)
+tenant gets the request back immediately with ``outcome == "shed"``
+(named, counted, never routed), an over-budget interactive tenant is
+paced by the replicas' deficit admission instead; and ``hedge_p99``
+re-dispatches draw from the tenant's own entitlement (outstanding
+hedge legs capped at the contract's ``hedges``, dues beyond it
+refused and counted) so one tenant's deadline panic cannot consume
+another's slack.
+
 **Observability** is strictly opt-in (the package-wide GC004 contract):
 ``registry=`` exports ``router_requests_total{policy,replica,outcome}``,
 ``router_hedge_fired_total``, ``router_replica_ejections_total``, the
@@ -90,6 +102,7 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
+from ..qos import TenantRegistry
 from ..utils.hedge import RequestHedge
 from .paging import prefix_page_digests
 
@@ -114,20 +127,27 @@ class RoutedRequest:
 
     ``outcome`` at completion: ``"ok"`` (primary leg, no drama),
     ``"hedge_won"`` (the hedge leg's first token beat the primary),
-    ``"hedged"`` (a hedge fired but the primary still won), or
-    ``"rerouted"`` (the request survived at least one replica death).
+    ``"hedged"`` (a hedge fired but the primary still won),
+    ``"rerouted"`` (the request survived at least one replica death),
+    or ``"shed"`` (refused at the door by name: the tenant was over
+    its token budget and its contract's class is sheddable — the
+    request never reached a replica; ``replica`` stays None).
+
+    ``tenant`` names the contract the request is billed to (the QoS
+    plane); None on routers without ``qos=``.
     """
 
     __slots__ = (
-        "id", "prompt", "max_new", "key", "t_submit", "t_admitted",
-        "t_first_token", "t_done", "replica", "hedge_replica",
-        "hedged", "rerouted", "migrated", "finished", "outcome",
-        "_legs",
+        "id", "prompt", "max_new", "key", "tenant", "t_submit",
+        "t_admitted", "t_first_token", "t_done", "replica",
+        "hedge_replica", "hedged", "rerouted", "migrated", "finished",
+        "outcome", "_legs", "_hedge_charged",
     )
 
     _next_id = 0
 
-    def __init__(self, prompt, max_new: int, key, t_submit: float):
+    def __init__(self, prompt, max_new: int, key, t_submit: float,
+                 tenant: str | None = None):
         if max_new < 1:
             # a 0-token request can never produce the first token the
             # router resolves on — it would sit in the awaiting books
@@ -138,7 +158,9 @@ class RoutedRequest:
         self.prompt = prompt
         self.max_new = int(max_new)
         self.key = key
+        self.tenant = tenant
         self.t_submit = float(t_submit)
+        self._hedge_charged = False  # holds one hedge-entitlement unit
         self.t_admitted: float | None = None
         self.t_first_token: float | None = None
         self.t_done: float | None = None
@@ -188,6 +210,9 @@ class _RouterObs:
 
     def __init__(self, router: "RequestRouter", registry, flight):
         self.flight = flight
+        # tenant-labeled series only exist on a qos= router — a
+        # tenant-less router's series keep their pre-QoS label sets
+        self._tenantful = router._qos is not None
         self._r = registry is not None
         if not self._r:
             self.registry = None
@@ -195,8 +220,13 @@ class _RouterObs:
         self.registry = registry
         self.policy = router.policy
         # outcome-labeled completions, series created lazily per
-        # (replica, outcome) and cached — label churn is tiny (N x 4)
-        self._done: dict[tuple[int, str], Any] = {}
+        # (replica, outcome[, tenant]) and cached — label churn is
+        # tiny (N x 4 x tenants)
+        self._done: dict[tuple, Any] = {}
+        if self._tenantful:
+            self._q_shed: dict[tuple[str, str], Any] = {}
+            self._q_ttft: dict[str, Any] = {}
+            self._q_hedge_ref: dict[str, Any] = {}
         self.m_hedge = registry.counter(
             "router_hedge_fired_total",
             help="TTFT-deadline hedges dispatched (hedge_p99 policy)",
@@ -256,18 +286,72 @@ class _RouterObs:
     def completed(self, rr: RoutedRequest) -> None:
         if not self._r:
             return
-        key = (int(rr.replica), str(rr.outcome))
+        # the tenant label rides router_requests_total on qos routers
+        # only — same lazy per-labelset cache, one more key element
+        labels = {"replica": str(int(rr.replica)),
+                  "outcome": str(rr.outcome)}
+        if self._tenantful:
+            labels["tenant"] = (
+                rr.tenant if rr.tenant is not None else "-"
+            )
+        key = tuple(labels.values())
         c = self._done.get(key)
         if c is None:
             c = self._done[key] = self.registry.counter(
                 "router_requests_total",
                 help="routed requests completed",
-                policy=self.policy, replica=str(key[0]),
-                outcome=key[1],
+                policy=self.policy, **labels,
             )
         c.inc()
+        if self._tenantful and rr.ttft is not None \
+                and rr.tenant is not None:
+            h = self._q_ttft.get(rr.tenant)
+            if h is None:
+                h = self._q_ttft[rr.tenant] = (
+                    self.registry.histogram(
+                        "qos_ttft_seconds",
+                        help="submit -> first token, per tenant",
+                        tenant=rr.tenant,
+                    )
+                )
+            h.observe(rr.ttft)
         if rr.ttft is not None:
             self.m_ttft.observe(rr.ttft)
+
+    def shed(self, rr: RoutedRequest, reason: str, t: float) -> None:
+        """One request refused at the door by name (over-budget
+        sheddable tenant): the per-(tenant, reason) counter plus the
+        flight-recorder instant event."""
+        if self._r:
+            key = (str(rr.tenant), str(reason))
+            c = self._q_shed.get(key)
+            if c is None:
+                c = self._q_shed[key] = self.registry.counter(
+                    "qos_shed_total",
+                    help="requests shed at the router door, by "
+                    "tenant and reason",
+                    tenant=key[0], reason=key[1],
+                )
+            c.inc()
+        if self.flight is not None:
+            self.flight.event(
+                "qos shed", src="router", t=t, request=rr.id,
+                tenant=str(rr.tenant), reason=str(reason),
+            )
+
+    def hedge_refused(self, rr: RoutedRequest, t: float) -> None:
+        if self._r:
+            c = self._q_hedge_ref.get(rr.tenant)
+            if c is None:
+                c = self._q_hedge_ref[rr.tenant] = (
+                    self.registry.counter(
+                        "qos_hedge_refused_total",
+                        help="due hedges refused: the tenant was at "
+                        "its outstanding-hedge entitlement",
+                        tenant=str(rr.tenant),
+                    )
+                )
+            c.inc()
 
     def admitted(self, wait_s: float) -> None:
         if self._r:
@@ -377,6 +461,7 @@ class RequestRouter:
         health_fn: Callable[[Any], bool] | None = None,
         migrate_threshold_bytes: int | None = None,
         migrate_gbs: float | None = None,
+        qos: TenantRegistry | None = None,
         registry=None,
         flight=None,
         exporter=None,
@@ -464,6 +549,22 @@ class RequestRouter:
         self.n_kept_local = 0  # threshold / no-decode-replica keeps
         self.n_bounced = 0  # captured but decode tier could never fit
         self.migrated_bytes = 0
+        # multi-tenant QoS (opt-in, qos/ package): token buckets
+        # charged at submit (over-budget batch work is shed by name),
+        # and per-tenant TTFT-hedge entitlements (a tenant's deadline
+        # panic draws from its OWN slack, counted and refused beyond
+        # it — module docstring "priced isolation")
+        self._qos = qos
+        if qos is not None and len(qos) == 0:
+            raise ValueError(
+                "qos= needs at least one TenantContract registered: "
+                "an empty registry can route nothing"
+            )
+        self._buckets = qos.buckets() if qos is not None else {}
+        self._hedges_out: dict[str, int] = {}
+        self.n_shed = 0
+        self.n_hedges_refused = 0
+        self.n_over_budget = 0  # non-sheddable classes: paced, not shed
         self._obs = (
             _RouterObs(self, registry, flight)
             if registry is not None or flight is not None
@@ -619,6 +720,7 @@ class RequestRouter:
                 except Exception:  # noqa: BLE001 — dead replica
                     pass
             rr._legs = [leg for leg in rr._legs if leg[0] != i]
+            self._hedge_release(rr)  # the hedge episode died with a leg
             if rr._legs:
                 # the surviving hedge leg carries the request alone
                 j = rr._legs[0][0]
@@ -643,9 +745,7 @@ class RequestRouter:
             self._orphans[rr] = None
             return
         j = self._pick(rr.prompt, routable)
-        leg = self.replicas[j].submit(
-            rr.prompt, rr.max_new, key=rr.key
-        )
+        leg = self._submit_leg(j, rr)
         rr._legs = [(j, leg)]
         rr.replica = j
         rr.hedge_replica = None
@@ -741,11 +841,48 @@ class RequestRouter:
 
     # -- the request path -----------------------------------------------
 
-    def submit(self, prompt, max_new: int, key=None) -> RoutedRequest:
+    @staticmethod
+    def _prompt_tokens(prompt) -> int:
+        """Token length of a prompt in any of the entry-door shapes:
+        a SimPrompt descriptor (``length``), a bare int (the sim
+        protocol's "a prompt of that many tokens" shorthand —
+        ``np.size`` would read it as ONE token and undercharge the
+        budget door ~100x), or a token array/list."""
+        n = getattr(prompt, "length", None)
+        if n is not None:
+            return int(n)
+        if isinstance(prompt, (int, np.integer)):
+            return int(prompt)
+        return int(np.size(prompt))
+
+    def _submit_leg(self, j: int, rr: RoutedRequest):
+        """One replica-submit with the tenant threaded through —
+        only when the request carries one, so tenant-less traffic
+        keeps the pre-QoS replica protocol verbatim."""
+        if rr.tenant is None:
+            return self.replicas[j].submit(
+                rr.prompt, rr.max_new, key=rr.key
+            )
+        return self.replicas[j].submit(
+            rr.prompt, rr.max_new, key=rr.key, tenant=rr.tenant
+        )
+
+    def submit(self, prompt, max_new: int, key=None,
+               tenant: str | None = None) -> RoutedRequest:
         """Route one request; returns the live :class:`RoutedRequest`
         whose ``tokens`` / ``finished`` the caller watches. Raises when
         no replica is routable — the condition the aggregate
-        ``/healthz`` check reports as 503."""
+        ``/healthz`` check reports as 503.
+
+        ``tenant`` is REQUIRED on a ``qos=`` router (unknown tenants
+        refused by name). The tenant's token bucket is charged
+        ``prompt + max_new`` tokens here, at the door: an over-budget
+        tenant whose class is sheddable (``batch``) gets the request
+        back immediately with ``outcome == "shed"`` — named, counted
+        (``n_shed``, ``qos_shed_total{tenant,reason}``), never routed;
+        an over-budget interactive tenant is PACED instead (the
+        request routes, and the replicas' deficit admission caps the
+        tenant at its weight — counted in ``n_over_budget``)."""
         routable = self.routable_replicas
         if not routable:
             raise RuntimeError(
@@ -753,9 +890,35 @@ class RequestRouter:
                 "admittable); repair or mark_up a replica"
             )
         now = self._now()
-        rr = RoutedRequest(prompt, max_new, key, now)
+        if self._qos is not None:
+            if tenant is None:
+                raise ValueError(
+                    "qos router needs tenant= at submit: budgets, "
+                    "shed, and hedge entitlements are per-contract "
+                    "(register a catch-all TenantContract for "
+                    "untagged traffic)"
+                )
+            contract = self._qos.get(tenant)  # unknown: named KeyError
+            bucket = self._buckets.get(tenant)
+            if bucket is not None and not bucket.take(
+                self._prompt_tokens(prompt) + int(max_new), now
+            ):
+                if contract.sheddable:
+                    rr = RoutedRequest(prompt, max_new, key, now,
+                                       tenant=tenant)
+                    rr.finished = True
+                    rr.outcome = "shed"
+                    rr.t_done = now
+                    self.n_submitted += 1
+                    self.n_completed += 1
+                    self.n_shed += 1
+                    if self._obs is not None:
+                        self._obs.shed(rr, "budget", now)
+                    return rr
+                self.n_over_budget += 1
+        rr = RoutedRequest(prompt, max_new, key, now, tenant=tenant)
         i = self._pick(prompt, routable)
-        leg = self.replicas[i].submit(prompt, max_new, key=key)
+        leg = self._submit_leg(i, rr)
         rr._legs = [(i, leg)]
         rr.replica = i
         self._awaiting[i][rr] = None
@@ -763,6 +926,39 @@ class RequestRouter:
             self._hedge.arm(rr, now + self.ttft_slo)
         self.n_submitted += 1
         return rr
+
+    def _hedge_entitled(self, rr: RoutedRequest, now: float) -> bool:
+        """May this tenant fire one more hedge? The entitlement is a
+        cap on OUTSTANDING hedge legs per tenant (contract ``hedges``;
+        None = unlimited): a tenant's deadline panic re-dispatches
+        draw from its own pool of slack, counted and refused beyond
+        it, so they can never consume another tenant's."""
+        if self._qos is None or rr.tenant is None:
+            return True
+        ent = self._qos.get(rr.tenant).hedges
+        if ent is None:
+            return True
+        out = self._hedges_out.get(rr.tenant, 0)
+        if out >= ent:
+            self.n_hedges_refused += 1
+            if self._obs is not None:
+                self._obs.hedge_refused(rr, now)
+            return False
+        self._hedges_out[rr.tenant] = out + 1
+        rr._hedge_charged = True
+        return True
+
+    def _hedge_release(self, rr: RoutedRequest) -> None:
+        """The hedge episode ended (first token resolved, or the
+        hedged request lost a leg): return the entitlement unit."""
+        if not rr._hedge_charged:
+            return
+        rr._hedge_charged = False
+        n = self._hedges_out.get(rr.tenant, 0) - 1
+        if n > 0:
+            self._hedges_out[rr.tenant] = n
+        else:
+            self._hedges_out.pop(rr.tenant, None)
 
     def _fire_hedges(self, now: float) -> None:
         if not self._hedge:
@@ -774,10 +970,10 @@ class RequestRouter:
             ]
             if not cands:
                 continue  # nowhere to hedge to; the primary stands
+            if not self._hedge_entitled(rr, now):
+                continue  # over entitlement: the primary stands
             j = self._least_loaded(cands)
-            leg = self.replicas[j].submit(
-                rr.prompt, rr.max_new, key=rr.key
-            )
+            leg = self._submit_leg(j, rr)
             rr._legs.append((j, leg))
             rr.hedge_replica = j
             rr.hedged = True
@@ -820,6 +1016,7 @@ class RequestRouter:
                 rr.replica = j
                 rr.t_first_token = now
                 self._hedge.disarm(rr)
+                self._hedge_release(rr)
                 self._awaiting[j].pop(rr, None)
                 if (
                     self.policy == "two_tier"

@@ -113,6 +113,7 @@ from .paging import (
     PagePoolExhausted,
     prefix_page_digests,
 )
+from ..qos import DeficitScheduler, TenantRegistry
 from .transformer import (
     TransformerConfig,
     _ln,
@@ -858,8 +859,59 @@ class _ServingObs:
                 help="copy-on-write page copies (a slot wrote a page "
                 "another slot still reads)",
             )
+        # QoS series (qos= schedulers only): per-tenant admission
+        # counters plus deficit / page-quota-usage gauges, series
+        # created lazily per tenant and cached (the _RouterObs
+        # per-labelset pattern — label churn is bounded by the
+        # registry's tenant count)
+        self._qos = getattr(sched, "_qos", None)
+        if self._qos is not None:
+            self._q_admit: dict[str, Any] = {}
+            self._q_deficit: dict[str, Any] = {}
+            self._q_quota: dict[str, Any] = {}
 
     # -- hooks (each guards its own registry half) ----------------------
+    def qos_admitted(self, sched: "ServingScheduler",
+                     tenant: str) -> None:
+        if not self._r or self._qos is None:
+            return
+        c = self._q_admit.get(tenant)
+        if c is None:
+            cls = (self._qos.get(tenant).cls
+                   if tenant in self._qos else "unknown")
+            c = self._q_admit[tenant] = self.registry.counter(
+                "qos_admitted_total",
+                help="requests admitted into slots, by tenant and "
+                "SLO class (DRR order)",
+                tenant=tenant, cls=cls,
+            )
+        c.inc()
+
+    def qos_gauges(self, sched: "ServingScheduler") -> None:
+        """Per-tenant deficit + quota-usage gauges, refreshed once per
+        tick (tick_done)."""
+        drr = sched._drr
+        for contract in self._qos:
+            t = contract.name
+            g = self._q_deficit.get(t)
+            if g is None:
+                g = self._q_deficit[t] = self.registry.gauge(
+                    "qos_deficit",
+                    help="carried DRR credit (tokens) per tenant",
+                    tenant=t,
+                )
+            g.set(drr.deficit(t))
+            if sched.paged:
+                q = self._q_quota.get(t)
+                if q is None:
+                    q = self._q_quota[t] = self.registry.gauge(
+                        "qos_pages_quota_used",
+                        help="KV pages attributed to the tenant "
+                        "(hot refs + cold cache) against its quota",
+                        tenant=t,
+                    )
+                q.set(sched._tenant_usage(t))
+
     def first_token(self, req: "Request", t: float) -> None:
         self._tick_toks += 1
         if self._r:
@@ -909,6 +961,8 @@ class _ServingObs:
                 self._last_share = pool.share_hits
                 self.m_cow.inc(pool.cow_copies - self._last_cow)
                 self._last_cow = pool.cow_copies
+            if self._qos is not None:
+                self.qos_gauges(sched)
         sp = self.spans
         if sp is not None:
             tick = sched.tick_count
@@ -937,15 +991,20 @@ class Request:
     ``tokens`` (the generated ids, EOS kept if emitted) out.
     ``finished`` flips at retirement; ``reason`` is ``"eos"``,
     ``"length"``, or ``"cancelled"`` (withdrawn via
-    :meth:`ServingScheduler.cancel` — the router's losing hedge leg)."""
+    :meth:`ServingScheduler.cancel` — the router's losing hedge leg).
+    ``tenant`` names the contract the request is billed to (the QoS
+    plane, ``qos/``); None = untenanted (the default on schedulers
+    without ``qos=``)."""
 
     _next_id = 0
 
-    def __init__(self, prompt, max_new: int, key=None):
+    def __init__(self, prompt, max_new: int, key=None,
+                 tenant: str | None = None):
         self.id = Request._next_id
         Request._next_id += 1
         # per-request PRNG key (sampling schedulers); None -> id-derived
         self.key = key
+        self.tenant = tenant
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("empty prompt")
@@ -1033,9 +1092,27 @@ class ServingScheduler:
       lifetime can touch (``ceil(min(W, Tp + max_new + n_inner) / P)``)
       instead of a full ``W``-slot arena — short requests stop
       stranding HBM, and ``cache_pages`` (not ``slots``) becomes the
-      capacity knob. Admission defers (FIFO) when the pool cannot
-      cover a request's whole budget, so mid-decode exhaustion cannot
-      happen.
+      capacity knob. Admission defers when the pool cannot cover a
+      request's whole budget, so mid-decode exhaustion cannot happen.
+      The DEFERRAL UNIT is the admission-order contract: FIFO (the
+      default) defers the head of the one queue — no reordering, a
+      large request cannot be starved by later small ones; under
+      ``qos=`` the deficit-round-robin hook defers only that TENANT's
+      queue while the rotation tries the next, so one tenant's
+      unplannable head never blocks another tenant's admission.
+
+    **Multi-tenant QoS** (``qos=`` a :class:`~..qos.TenantRegistry`,
+    docs/API.md "Multi-tenant QoS"): ``submit`` then requires
+    ``tenant=`` (unknown tenants refused by name) and admission order
+    comes from a :class:`~..qos.DeficitScheduler` over per-tenant
+    queues — weighted, work-conserving, deficits carried — instead of
+    FIFO. Paged schedulers additionally enforce each contract's page
+    QUOTA at plan time, with COW-aware graceful reclaim: a retiring
+    request's still-registered, refcount-1 prefix pages go COLD
+    (resident for future sharers, attributed to the tenant) instead
+    of freeing, and reclaim evicts cold pages oldest-first — an
+    over-quota tenant's first — while a page shared with any live
+    holder (refcount > 1) is never touched.
     * **Prefix sharing.** Admission hashes the prompt's page-aligned
       prefix (chained digests — page j's key covers ``prompt[:(j+1) *
       P]``, the exact content determinant) and shares resident pages
@@ -1074,7 +1151,8 @@ class ServingScheduler:
                  prompt_chunk: int = 256, max_prompt: int = 2048,
                  quantize_kv: bool = False, temperature: float = 0.0,
                  top_k: int | None = None, page_tokens: int | None = None,
-                 cache_pages: int | None = None, registry=None,
+                 cache_pages: int | None = None,
+                 qos: TenantRegistry | None = None, registry=None,
                  spans=None, flight=None, exporter=None):
         W = _check_ring_cfg(cfg)
         _check_sampling_params(temperature, top_k)
@@ -1111,6 +1189,25 @@ class ServingScheduler:
         self.Lmax = int(max_prompt)
         self.quantize_kv = bool(quantize_kv)
         self._queue: deque[Request] = deque()
+        # multi-tenant QoS (opt-in): admission order moves from the
+        # FIFO deque to a weighted deficit-round-robin scheduler over
+        # per-tenant queues, and paged admission enforces page quotas
+        # with cold-page reclaim (class docstring; qos/ package)
+        self._qos = qos
+        self._drr = DeficitScheduler(qos) if qos is not None else None
+        if qos is not None and len(qos) == 0:
+            raise ValueError(
+                "qos= needs at least one TenantContract registered: "
+                "an empty registry can admit nothing"
+            )
+        if qos is not None:
+            # per-tenant page accounting: hot refs (pages the tenant's
+            # resident slots hold) + cold pages (retired prefix pages
+            # kept resident, attributed to the tenant that landed
+            # them); quota usage is their sum
+            self._tenant_pages: dict[str, int] = {}
+            self._cold: dict[int, str] = {}  # pid -> tenant, oldest first
+            self._cold_count: dict[str, int] = {}
         self._slot_req: list[Request | None] = [None] * self.S
         self._admitting: dict[int, _Admitting] = {}  # slot -> state
         self.tick_count = 0
@@ -1226,7 +1323,8 @@ class ServingScheduler:
         — its tick-freshness health check reads the stamp."""
         self._stamp_ticks = True
 
-    def submit(self, prompt, max_new: int, key=None) -> Request:
+    def submit(self, prompt, max_new: int, key=None,
+               tenant: str | None = None) -> Request:
         """Queue a request; returns the live :class:`Request` whose
         ``tokens``/``finished`` the caller watches. Admission happens
         inside subsequent ticks — requests may arrive while others are
@@ -1234,14 +1332,25 @@ class ServingScheduler:
         request's PRNG key when the scheduler samples
         (``temperature > 0``); defaults to a request-id-derived key.
         A sampled stream equals ``generate_ring_dense(..., key=key)``
-        for the same key (tests pin it)."""
+        for the same key (tests pin it). ``tenant``: the contract the
+        request is billed to — REQUIRED on a ``qos=`` scheduler
+        (unknown tenants refused by name); on a plain scheduler the
+        tag merely rides the request."""
         if key is not None and self.temperature == 0.0:
             raise ValueError(
                 "submit(key=...) on a greedy scheduler: the key would "
                 "be silently unused — construct the scheduler with "
                 "temperature > 0 (generate_* raises the same way)"
             )
-        req = Request(prompt, max_new, key=key)
+        if self._qos is not None:
+            if tenant is None:
+                raise ValueError(
+                    "qos scheduler needs tenant= at submit: admission "
+                    "order and page quotas are per-contract (register "
+                    "a catch-all TenantContract for untagged traffic)"
+                )
+            self._qos.get(tenant)  # unknown tenant: named KeyError
+        req = Request(prompt, max_new, key=key, tenant=tenant)
         if req.prompt.size > self.Lmax:
             raise ValueError(
                 f"prompt of {req.prompt.size} tokens exceeds max_prompt "
@@ -1250,9 +1359,17 @@ class ServingScheduler:
         obs = self._obs
         if obs is not None:
             req._t_submit = time.perf_counter()
-        self._queue.append(req)
+        if self._drr is not None:
+            # DRR cost is in tokens (prompt + budget — the same unit
+            # as the contracts' rate budgets), so fairness is fair
+            # chip work, not fair request counts
+            self._drr.enqueue(
+                tenant, req, float(req.prompt.size + req.max_new)
+            )
+        else:
+            self._queue.append(req)
         if obs is not None and obs._r:
-            obs.m_queue.set(len(self._queue))
+            obs.m_queue.set(self.pending)
         return req
 
     @property
@@ -1262,7 +1379,8 @@ class ServingScheduler:
 
     @property
     def pending(self) -> int:
-        return len(self._queue)
+        return (self._drr.total if self._drr is not None
+                else len(self._queue))
 
     def _decode_scan_fetch(self) -> np.ndarray:
         """Run the jitted decode tick and fence the tokens to host."""
@@ -1368,11 +1486,15 @@ class ServingScheduler:
         (models/router.py, first-token-wins)."""
         if req.finished:
             return False
-        try:
-            self._queue.remove(req)
-        except ValueError:
-            pass
+        if self._drr is not None:
+            removed = self._drr.remove(req)
         else:
+            try:
+                self._queue.remove(req)
+                removed = True
+            except ValueError:
+                removed = False
+        if removed:
             self._retire_cancelled(req)
             return True
         for s, r in enumerate(self._slot_req):
@@ -1383,9 +1505,12 @@ class ServingScheduler:
                     # (_pt_host[s] stays NULL until finish), so
                     # _free_slot's table walk would miss them — release
                     # the committed plan here
+                    n_refs = 0
                     for pid in st.pids:
                         if pid != NULL_PAGE:
                             self.pool.decref(int(pid), wrapper=st.wraps)
+                            n_refs += 1
+                    self._tenant_debit(req.tenant, n_refs)
                 self._free_slot(s)
                 self._retire_cancelled(req)
                 return True
@@ -1512,11 +1637,18 @@ class ServingScheduler:
                     "for the stream to continue token-for-token"
                 )
 
-    def _plan_adopt(self, state: dict):
+    def _plan_adopt(self, state: dict, *, reclaim: bool = False):
         """(slot, shared pids, n_pages, wraps, reserve) for adopting
         ``state``, or None when no free slot / pool capacity covers
         it — the same whole-lifetime budget as admission planning, so
-        PagePoolExhausted stays unreachable mid-decode."""
+        PagePoolExhausted stays unreachable mid-decode. On a qos
+        scheduler, cold pages count as reclaimable headroom (cache,
+        not entitlement — the two-tier liveness contract: a stream is
+        resident NOWHERE while its migration waits): ``reclaim=True``
+        (the adopt path) actually evicts the shortfall; False (the
+        ``can_adopt_state`` predicate) only counts it, so a
+        feasibility probe never drains a replica's cold prefix cache
+        as a side effect."""
         free_s = next(
             (s for s, r in enumerate(self._slot_req)
              if r is None and s not in self._admitting), None,
@@ -1537,9 +1669,22 @@ class ServingScheduler:
             1 for pid in shared
             if self.pool.share_needs_reserve(pid, wraps)
         )
-        if not self.pool.can_alloc(n_pages - len(shared),
-                                   reserve=reserve):
-            return None
+        shortfall = (n_pages - len(shared) + reserve
+                     + self.pool.reserved - self.pool.free)
+        if shortfall > 0:
+            if self._drr is None:
+                return None
+            sset = set(shared)
+            if reclaim:
+                for _ in range(shortfall):
+                    if not self._evict_cold_page(protect=sset):
+                        return None
+            else:
+                evictable = sum(
+                    1 for pid in self._cold if pid not in sset
+                )
+                if evictable < shortfall:
+                    return None
         return free_s, shared, n_pages, wraps, reserve
 
     def can_adopt_state(self, state: dict) -> bool:
@@ -1599,7 +1744,7 @@ class ServingScheduler:
                 "page_tokens=)"
             )
         self._check_adopt_compat(state)
-        plan = self._plan_adopt(state)
+        plan = self._plan_adopt(state, reclaim=True)
         if plan is None:
             raise PagePoolExhausted(
                 "adopt_page_state: no free slot or page capacity for "
@@ -1618,6 +1763,8 @@ class ServingScheduler:
                 wrapper=wraps,
             )
             pids[j] = pid
+            if self._drr is not None and pid in self._cold:
+                self._warm_cold(pid)
         try:
             for j in range(len(shared), n_pages):
                 pids[j] = self.pool.alloc()
@@ -1627,6 +1774,15 @@ class ServingScheduler:
                 if pid != NULL_PAGE:
                     self.pool.decref(int(pid), wrapper=wraps)
             raise
+        if self._drr is not None and req is not None \
+                and getattr(req, "tenant", None) is not None:
+            # migrated streams carry their tenant: the destination's
+            # quota ledger takes the pages over (enforcement stays an
+            # admission-time decision — an in-flight stream is never
+            # evicted mid-decode)
+            self._tenant_pages[req.tenant] = (
+                self._tenant_pages.get(req.tenant, 0) + n_pages
+            )
         self._pt_host[s] = pids
         self._pt_dev = None
         self._host_pos[s] = state["pos"]
@@ -1655,7 +1811,7 @@ class ServingScheduler:
     def run(self, max_ticks: int = 10_000) -> None:
         """Tick until every queued and in-flight request retires."""
         for _ in range(max_ticks):
-            if not self._queue and self.active == 0:
+            if self.pending == 0 and self.active == 0:
                 return
             self.step()
         raise RuntimeError(
@@ -1667,46 +1823,89 @@ class ServingScheduler:
 
     def _admit_from_queue(self, retired: list[Request]) -> None:
         free = [s for s, r in enumerate(self._slot_req) if r is None]
+        if self._drr is not None:
+            self._admit_drr(free, retired)
+            return
         while self._queue and free:
+            plan = None
             if self.paged:
                 plan = self._plan_pages(self._queue[0])
                 if plan is None:
                     # head-of-line request does not fit the page
                     # budget: admission waits for retirements to
                     # return pages (FIFO — no reordering, so a large
-                    # request cannot be starved by later small ones)
+                    # request cannot be starved by later small ones;
+                    # the qos= DRR hook above is the per-TENANT
+                    # alternative, where only that tenant's queue
+                    # defers and the rotation tries the next)
                     break
             s = free.pop(0)
             req = self._queue.popleft()
-            Tp = req.prompt.size
-            base = 0
-            admit_kw: dict[str, Any] = {}
+            self._admit_into(s, req, plan, retired)
+
+    def _admit_drr(self, free: list[int],
+                   retired: list[Request]) -> None:
+        """QoS admission: free slots are filled in deficit-round-robin
+        order (:class:`~..qos.DeficitScheduler` — weighted,
+        work-conserving, deficits carried). A tenant whose head cannot
+        be PLANNED right now (page-pool pressure, or its page quota
+        even after reclaiming its own cold pages) is restored
+        unchanged and the rotation passes over that TENANT for the
+        rest of this pass — one tenant's unplannable head never blocks
+        another tenant's admission, which is the head-of-line
+        decoupling FIFO cannot give."""
+        deferred: set[str] = set()
+        while free:
+            pick = self._drr.pick(skip=deferred)
+            if pick is None:
+                return
+            tenant, req, cost = pick
+            plan = None
             if self.paged:
-                base, admit_kw = self._commit_pages(req, plan)
-            rem = Tp - base
-            n_chunks = -(-rem // self.C)
-            padded = np.zeros((1, n_chunks * self.C), np.int32)
-            padded[0, :rem] = req.prompt[base:]
-            cache = _fresh_cache(self.cfg, 1, self.Lmax,
-                                 self.quantize_kv)
-            if base:
-                # skip the shared prefix's prefill outright: its K/V
-                # seed the transient cache from the resident pages
-                # (identical bytes to what this prefill would compute)
-                cache = self._seed(
-                    cache, self._caches,
-                    jnp.asarray(admit_kw["pids"], jnp.int32),
-                    jnp.int32(base),
-                )
-            self._slot_req[s] = req
-            self._admitting[s] = _Admitting(
-                req, cache, jnp.asarray(padded), n_chunks, base=base,
-                **admit_kw,
+                plan = self._plan_pages_qos(req)
+                if plan is None:
+                    self._drr.restore(tenant, req, cost)
+                    deferred.add(tenant)
+                    continue
+            s = free.pop(0)
+            self._admit_into(s, req, plan, retired)
+
+    def _admit_into(self, s: int, req: Request, plan,
+                    retired: list[Request]) -> None:
+        """Install one dequeued request into free slot ``s`` (the
+        admission body both the FIFO and DRR paths share); ``plan`` is
+        the committed-page plan on paged schedulers, None otherwise."""
+        Tp = req.prompt.size
+        base = 0
+        admit_kw: dict[str, Any] = {}
+        if self.paged:
+            base, admit_kw = self._commit_pages(req, plan)
+        rem = Tp - base
+        n_chunks = -(-rem // self.C)
+        padded = np.zeros((1, n_chunks * self.C), np.int32)
+        padded[0, :rem] = req.prompt[base:]
+        cache = _fresh_cache(self.cfg, 1, self.Lmax,
+                             self.quantize_kv)
+        if base:
+            # skip the shared prefix's prefill outright: its K/V
+            # seed the transient cache from the resident pages
+            # (identical bytes to what this prefill would compute)
+            cache = self._seed(
+                cache, self._caches,
+                jnp.asarray(admit_kw["pids"], jnp.int32),
+                jnp.int32(base),
             )
-            req.admitted_tick = self.tick_count
-            # first chunk runs this very tick (short prompts admit in
-            # one tick and decode from the next)
-            self._advance_admission(s, retired)
+        self._slot_req[s] = req
+        self._admitting[s] = _Admitting(
+            req, cache, jnp.asarray(padded), n_chunks, base=base,
+            **admit_kw,
+        )
+        req.admitted_tick = self.tick_count
+        if self._obs is not None and req.tenant is not None:
+            self._obs.qos_admitted(self, req.tenant)
+        # first chunk runs this very tick (short prompts admit in
+        # one tick and decode from the next)
+        self._advance_admission(s, retired)
 
     # -- paged admission planning --------------------------------------
 
@@ -1723,6 +1922,18 @@ class ServingScheduler:
         every decode write including the bounded overshoot of the
         retirement tick — so :class:`PagePoolExhausted` is unreachable
         mid-decode (the capacity contract the fuzz tests pin)."""
+        shared, digests, n_pages, wraps, n_fresh, reserve = \
+            self._page_needs(req)
+        if not self.pool.can_alloc(n_fresh, reserve=reserve):
+            return None
+        return (shared, digests, n_pages, wraps)
+
+    def _page_needs(self, req: Request):
+        """The share walk + budget arithmetic both planners share:
+        (shared, digests, n_pages, wraps, n_fresh, reserve), computed
+        WITHOUT consulting pool capacity — :meth:`_plan_pages` checks
+        ``can_alloc`` and :meth:`_plan_pages_qos` turns the same
+        numbers into a reclaim shortfall instead."""
         Tp = req.prompt.size
         W, P = self.W, self.P
         digests: list[bytes] = []
@@ -1744,14 +1955,11 @@ class ServingScheduler:
         horizon = Tp + req.max_new + self.n_inner
         wraps = horizon > W
         n_pages = -(-min(W, horizon) // P)
-        n_fresh = n_pages - m
         reserve = sum(
             1 for pid in shared
             if self.pool.share_needs_reserve(pid, wraps)
         )
-        if not self.pool.can_alloc(n_fresh, reserve=reserve):
-            return None
-        return (shared, digests, n_pages, wraps)
+        return shared, digests, n_pages, wraps, n_pages - m, reserve
 
     def _commit_pages(self, req: Request, plan) -> tuple[int, dict]:
         """Execute an admission plan: take references on the shared
@@ -1766,8 +1974,16 @@ class ServingScheduler:
                 wrapper=wraps,
             )
             pids[j] = pid
+            if self._drr is not None and pid in self._cold:
+                # a cold page found its next sharer: the cache's hold
+                # transfers to the new slot (warm)
+                self._warm_cold(pid)
         for j in range(m, n_pages):
             pids[j] = self.pool.alloc()
+        if self._drr is not None and req.tenant is not None:
+            self._tenant_pages[req.tenant] = (
+                self._tenant_pages.get(req.tenant, 0) + n_pages
+            )
         # pages fully covered by the prompt hold registerable prefix
         # content once prefill lands them (done at finish)
         n_cover = min(req.prompt.size // self.P, self.max_pages) \
@@ -1776,6 +1992,138 @@ class ServingScheduler:
             "pids": pids, "digests": tuple(digests),
             "n_cover": n_cover, "wraps": wraps,
         }
+
+    # -- QoS page quotas + cold-page reclaim (qos= only) ----------------
+    #
+    # A retiring request's still-registered refcount-1 prefix pages go
+    # COLD instead of freeing: resident for future sharers (their
+    # digests stay in the pool's table, their bytes untouched in the
+    # arena — nothing writes a page no slot's table names), attributed
+    # to the departing tenant, and evictable. Reclaim is COW-aware by
+    # construction: cold pages have refcount 1 (a cold page that gains
+    # a sharer is warmed out of the cold set first), so eviction can
+    # never touch a page a live holder reads — a shared prefix page is
+    # never yanked from under a compliant co-holder.
+
+    def _tenant_usage(self, tenant: str) -> int:
+        """Pages attributed to the tenant: hot refs held by its
+        resident slots + its cold pages. The quota number."""
+        return (self._tenant_pages.get(tenant, 0)
+                + self._cold_count.get(tenant, 0))
+
+    def _tenant_debit(self, tenant: str | None, n: int) -> None:
+        if self._drr is None or tenant is None or n == 0:
+            return
+        left = self._tenant_pages.get(tenant, 0) - n
+        if left:
+            self._tenant_pages[tenant] = left
+        else:
+            self._tenant_pages.pop(tenant, None)
+
+    def _over_quota(self, tenant: str) -> bool:
+        if tenant not in self._qos:
+            return False  # adopted stream from an unregistered tenant
+        quota = self._qos.get(tenant).pages
+        return quota is not None and self._tenant_usage(tenant) > quota
+
+    def _drop_cold(self, pid: int) -> str:
+        """Remove ``pid`` from the cold set — the ONE place the cold
+        bookkeeping (set, per-tenant count, the cache's pool hold)
+        comes apart, shared by warm and evict. Returns the tenant the
+        page was attributed to."""
+        t = self._cold.pop(pid)
+        n = self._cold_count.get(t, 0) - 1
+        if n:
+            self._cold_count[t] = n
+        else:
+            self._cold_count.pop(t, None)
+        self.pool.decref(pid)
+        return t
+
+    def _warm_cold(self, pid: int) -> None:
+        """A cold page gained a holder: drop the cache's hold and the
+        tenant attribution (the new holder's refs are the page's life
+        now)."""
+        self._drop_cold(pid)
+
+    def _evict_cold_page(self, *, protect=frozenset(),
+                         tenant: str | None = None) -> bool:
+        """Evict ONE cold page — the reclaim primitive. ``tenant``
+        narrows to that tenant's cold pages (quota enforcement);
+        otherwise pool-pressure order: an OVER-QUOTA tenant's cold
+        pages first, then any (cold residency is cache, not
+        entitlement — deferring live work to preserve a cold page
+        would break work conservation). Oldest-first within each
+        class; ``protect`` pins pages the in-flight plan would share.
+        Returns False when nothing evictable remains."""
+        victim = None
+        if tenant is not None:
+            for pid, t in self._cold.items():
+                if t == tenant and pid not in protect:
+                    victim = pid
+                    break
+        else:
+            for pid, t in self._cold.items():
+                if pid not in protect and self._over_quota(t):
+                    victim = pid
+                    break
+            if victim is None:
+                for pid in self._cold:
+                    if pid not in protect:
+                        victim = pid
+                        break
+        if victim is None:
+            return False
+        t = self._drop_cold(victim)
+        if self._flight is not None:
+            self._flight.event(
+                "qos reclaim", src="scheduler", tenant=t, page=victim,
+            )
+        return True
+
+    def _plan_pages_qos(self, req: Request):
+        """:meth:`_plan_pages` under the tenant's page quota, with
+        cold-page reclaim on both pressure paths: pool exhaustion
+        evicts exactly the shortfall in cold pages (over-quota
+        tenants' first, oldest-first); quota exhaustion evicts the
+        requesting tenant's OWN cold pages. Returns None when the
+        request still cannot be planned — the DRR pass then defers
+        this tenant, not the rotation."""
+        contract = self._qos.get(req.tenant)
+        shared, digests, n_pages, wraps, n_fresh, reserve = \
+            self._page_needs(req)
+        # the plan's own shares are never reclaim victims: evicting
+        # one to make room would trade a prefill skip for a fresh
+        # page — strictly worse on both bytes and time. (A resident
+        # page the plan cannot share gives no skip and stays an
+        # honest eviction candidate.)
+        protect = set(shared)
+        # pool pressure: can_alloc is `n_fresh + reserve + reserved
+        # <= free`, and an evicted cold page (refcount 1, zero
+        # reservations by construction) frees exactly one page — so
+        # the shortfall is computed ONCE and reclaimed in one pass,
+        # never replanned (the protect set keeps the share walk
+        # valid across evictions)
+        shortfall = (n_fresh + reserve + self.pool.reserved
+                     - self.pool.free)
+        for _ in range(max(shortfall, 0)):
+            if not self._evict_cold_page(protect=protect):
+                return None
+        if contract.pages is not None:
+            own_cold_shared = sum(
+                1 for pid in shared
+                if self._cold.get(pid) == req.tenant
+            )
+            # sharing one's own cold page moves it cold -> hot: no new
+            # usage; everything else is net-new attribution
+            need = (self._tenant_usage(req.tenant) + n_pages
+                    - own_cold_shared)
+            while need > contract.pages:
+                if not self._evict_cold_page(protect=protect,
+                                             tenant=req.tenant):
+                    return None
+                need -= 1
+        return (shared, digests, n_pages, wraps)
 
     def _prepare_tick_pages(self, decoding: list[int]) -> None:
         """Pre-tick COW pass: the next ``n_inner`` decode steps write
@@ -1920,6 +2268,7 @@ class ServingScheduler:
         return True
 
     def _free_slot(self, s: int) -> None:
+        req = self._slot_req[s]
         self._slot_req[s] = None
         # the row keeps decoding garbage until reused — done=True makes
         # it emit EOS-clamped tokens nobody reads; admission resets it
@@ -1928,11 +2277,30 @@ class ServingScheduler:
             # return the slot's pages (shared prefixes just drop one
             # reference; a page frees — and leaves the prefix table —
             # only when its last reader retires) and null the row so
-            # its zombie writes land in the null page
+            # its zombie writes land in the null page. Under qos=, a
+            # sole-held page whose prefix digest is still registered
+            # goes COLD instead of freeing (the reclaim contract
+            # above): resident for future sharers, attributed to the
+            # departing tenant, evicted oldest-first under pressure.
+            tenant = (req.tenant if self._drr is not None
+                      and req is not None else None)
+            keep_cold = tenant is not None and not self._slot_wraps[s]
+            n_refs = 0
             for pid in self._pt_host[s]:
-                if pid != NULL_PAGE:
-                    self.pool.decref(int(pid),
+                if pid == NULL_PAGE:
+                    continue
+                pid = int(pid)
+                n_refs += 1
+                if (keep_cold and self.pool.refcount(pid) == 1
+                        and self.pool.registered(pid)):
+                    self._cold[pid] = tenant
+                    self._cold_count[tenant] = (
+                        self._cold_count.get(tenant, 0) + 1
+                    )
+                else:
+                    self.pool.decref(pid,
                                      wrapper=self._slot_wraps[s])
+            self._tenant_debit(tenant, n_refs)
             self._pt_host[s] = NULL_PAGE
             self._pt_dev = None
             self._host_pos[s] = 0

@@ -37,6 +37,7 @@ from .tune import (
     sweep_hierarchical,
     sweep_nwait,
     sweep_router_policy,
+    sweep_tenant_weights,
     sweep_tier_split,
 )
 from .workload import (
@@ -73,6 +74,7 @@ __all__ = [
     "sweep_hedge",
     "sweep_hierarchical",
     "sweep_router_policy",
+    "sweep_tenant_weights",
     "sweep_tier_split",
     "recommend_nwait",
     "recovered_work_per_s",

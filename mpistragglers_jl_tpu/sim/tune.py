@@ -43,6 +43,7 @@ __all__ = [
     "sweep_harvest_k",
     "sweep_hierarchical",
     "sweep_router_policy",
+    "sweep_tenant_weights",
     "sweep_tier_split",
     "recommend_nwait",
     "recovered_work_per_s",
@@ -629,6 +630,207 @@ def sweep_router_policy(
         "load": load,
         "prefix_share": float(prefix_share),
         "rate_req_s": rate,
+        "requests": int(requests),
+    }
+
+
+def sweep_tenant_weights(
+    *,
+    contracts: Sequence,
+    candidates: Sequence[dict],
+    n_replicas: int = 4,
+    slots: int = 4,
+    n_inner: int = 8,
+    tick_s: float = 0.02,
+    tick_sigma: float = 0.3,
+    load: float = 0.8,
+    requests: int = 2000,
+    prompt_len: int = 96,
+    max_new: int = 32,
+    prompt_chunk: int = 64,
+    seed: int = 0,
+) -> dict[str, Any]:
+    """Recommend DRR weights for a set of tenant contracts by running
+    the REAL QoS plane — :class:`~..models.router.RequestRouter` +
+    :class:`~..qos.DeficitScheduler` admission inside
+    :class:`~.workload.SimReplica` fleets — over one seeded
+    tenant-mixed day per candidate weight vector (same seed, so every
+    candidate faces the identical arrivals: times, prompts, AND
+    tenant labels). ``contracts`` is the fleet's
+    :class:`~..qos.TenantContract` list; each candidate in
+    ``candidates`` maps every tenant name to a weight.
+
+    Each tenant offers ``load`` of ITS OWN token budget (arrival
+    shares proportional to budgets), so the swept day measures what
+    the weights do to compliant traffic — shed/pacing behavior is the
+    bucket's job at the door, not the sweep's.
+
+    Refusals, never clamps (the ``sweep_nwait`` contract — each names
+    its floor, pinned by tests/test_qos.py):
+
+    * **infeasible contracts: aggregate budget >= capacity** — the
+      tenants' token-rate budgets sum to at least the fleet's token
+      capacity (or a tenant has NO budget, making the aggregate
+      unbounded): the contracts cannot be jointly honored by any
+      weight assignment;
+    * **latency-class tenant without a ttft_slo** — the sweep scores
+      latency tenants against their advertised deadline; a
+      latency-class contract that never states one is an error, not
+      a default;
+    * **candidate weights not covering the tenant set** — every
+      candidate must name exactly the contract tenants, weights > 0;
+    * **no candidate meets every latency-class SLO** (post-run): the
+      sweep refuses rather than recommend weights that break a
+      contract.
+
+    Returns entries per candidate (per-tenant p50/p99 TTFT via
+    :meth:`~.workload.WorkloadReport.per_tenant`, the worst
+    normalized latency-tenant p99 as ``score``), ``best`` (lowest
+    score), and the capacity numbers the feasibility check used."""
+    # lazy, the sweep_router_policy pattern: models/ is the
+    # accelerator package namespace; qos/ is stdlib-only but stays a
+    # lazy import for the same explicit-closure discipline
+    from ..models.router import RequestRouter
+    from ..qos import TenantContract, TenantRegistry
+    from .workload import (
+        SimReplica,
+        lognormal_ticks,
+        poisson_arrivals,
+        run_router_day,
+        service_ticks_per_request,
+    )
+
+    contracts = list(contracts)
+    if not contracts:
+        raise ValueError("sweep refused: no tenant contracts given")
+    names = [c.name for c in contracts]
+    for c in contracts:
+        if c.cls == "latency" and c.ttft_slo is None:
+            raise ValueError(
+                f"sweep refused: latency-class tenant {c.name!r} has "
+                "no ttft_slo — the sweep scores latency tenants "
+                "against their advertised deadline; state one in the "
+                "contract"
+            )
+        if c.rate is None:
+            raise ValueError(
+                f"sweep refused: tenant {c.name!r} has no token "
+                "budget (rate=None) — the aggregate budget is then "
+                "unbounded and can never fit capacity; give every "
+                "tenant a rate"
+            )
+    tok_per_req = int(prompt_len) + int(max_new)
+    ticks_per_req = service_ticks_per_request(
+        prompt_len=prompt_len, prompt_chunk=prompt_chunk,
+        max_new=max_new, n_inner=n_inner,
+    )
+    fleet_req_rate = (
+        int(n_replicas) * int(slots)
+        / (ticks_per_req * float(tick_s))
+    )
+    capacity_tok_s = fleet_req_rate * tok_per_req
+    aggregate = sum(c.rate for c in contracts)
+    if aggregate >= capacity_tok_s:
+        raise ValueError(
+            f"sweep refused: infeasible contracts — aggregate token "
+            f"budget {aggregate:.0f} tok/s >= fleet capacity "
+            f"{capacity_tok_s:.0f} tok/s ({n_replicas} replicas x "
+            f"{slots} slots): no weight assignment can honor them; "
+            "shrink budgets or grow the fleet"
+        )
+    candidates = [dict(cand) for cand in candidates]
+    if not candidates:
+        raise ValueError("sweep refused: no candidate weight vectors")
+    for cand in candidates:
+        if sorted(cand) != sorted(names):
+            raise ValueError(
+                f"sweep refused: candidate weights {sorted(cand)} "
+                f"must name exactly the contract tenants "
+                f"{sorted(names)}"
+            )
+        for t, w in cand.items():
+            if not w > 0:
+                raise ValueError(
+                    f"sweep refused: candidate weight {w} for tenant "
+                    f"{t!r} must be > 0"
+                )
+    # each tenant offers `load` of its own budget; shares follow
+    tenant_tok_rate = {c.name: load * c.rate for c in contracts}
+    offered_tok = sum(tenant_tok_rate.values())
+    rate = offered_tok / tok_per_req
+    shares = {t: r / offered_tok for t, r in tenant_tok_rate.items()}
+    latency_slo = {
+        c.name: c.ttft_slo for c in contracts if c.cls == "latency"
+    }
+    entries: list[dict] = []
+    for cand in candidates:
+        reg = TenantRegistry([
+            TenantContract(
+                c.name, cls=c.cls, weight=cand[c.name], rate=c.rate,
+                burst=c.burst, pages=c.pages, hedges=c.hedges,
+                ttft_slo=c.ttft_slo,
+            )
+            for c in contracts
+        ])
+        clock = VirtualClock()
+        replicas = [
+            SimReplica(
+                clock, slots=slots, n_inner=n_inner,
+                prompt_chunk=prompt_chunk, qos=reg,
+                tick_s=lognormal_ticks(
+                    float(tick_s), float(tick_sigma),
+                    seed=int(seed) * 1009 + i,
+                ),
+            )
+            for i in range(int(n_replicas))
+        ]
+        router = RequestRouter(
+            replicas, policy="least_loaded", clock=clock, qos=reg,
+        )
+        report = run_router_day(
+            router,
+            poisson_arrivals(
+                rate, n=int(requests), seed=seed,
+                prompt_len=prompt_len, max_new=max_new,
+                tenants=shares,
+            ),
+        )
+        per = report.per_tenant()
+        # score: the worst latency-class p99 normalized by its SLO
+        # (<= 1 means every latency contract held)
+        score = 0.0
+        for t, slo in latency_slo.items():
+            if t in per:
+                score = max(score, per[t]["p99_ttft_s"] / slo)
+        entries.append({
+            "weights": dict(cand),
+            "per_tenant": per,
+            "score": score,
+            "shed": report.n_shed,
+            "admissible": all(
+                per.get(t, {"p99_ttft_s": 0.0})["p99_ttft_s"] <= slo
+                for t, slo in latency_slo.items()
+            ),
+        })
+    ok = [e for e in entries if e["admissible"]]
+    if latency_slo and not ok:
+        raise ValueError(
+            f"no candidate meets every latency-class SLO "
+            f"({latency_slo}): worst normalized p99 per candidate "
+            f"{[round(e['score'], 3) for e in entries]} — the sweep "
+            "refuses rather than recommend weights that break a "
+            "contract; grow the fleet or loosen the SLOs"
+        )
+    pool = ok if ok else entries
+    best = min(pool, key=lambda e: e["score"])
+    return {
+        "entries": entries,
+        "best": best["weights"],
+        "best_entry": best,
+        "capacity_tok_s": capacity_tok_s,
+        "aggregate_budget_tok_s": aggregate,
+        "rate_req_s": rate,
+        "tenant_shares": shares,
         "requests": int(requests),
     }
 

@@ -101,17 +101,69 @@ class SimPrompt:
 class Arrival:
     """One open-loop arrival: at virtual time ``t``, a request for
     ``max_new`` tokens from ``prompt`` (a :class:`SimPrompt` here; a
-    token array when an arrival model feeds a live fleet)."""
+    token array when an arrival model feeds a live fleet).
+    ``tenant`` names the contract the request bills to (the QoS
+    plane; None = untenanted traffic)."""
 
-    __slots__ = ("t", "prompt", "max_new")
+    __slots__ = ("t", "prompt", "max_new", "tenant")
 
-    def __init__(self, t: float, prompt, max_new: int):
+    def __init__(self, t: float, prompt, max_new: int,
+                 tenant: str | None = None):
         self.t = float(t)
         self.prompt = prompt
         self.max_new = int(max_new)
+        self.tenant = tenant
 
     def __repr__(self) -> str:
         return f"Arrival(t={self.t:.6f}, max_new={self.max_new})"
+
+
+# decorrelation stride for the tenant coin: the tenant label derives
+# from the SAME per-arrival uniform draw as the prompt class (no extra
+# rng draw — arrival times and prompt mixes stay bit-identical at
+# every tenant mix, the r16 long_share pattern), but through a fixed
+# multiplicative fold so tenant intervals do not align with the
+# prefix/long-class intervals of u itself
+_TENANT_STRIDE = 9973.0
+
+
+def _tenant_fn(tenants) -> Callable[[float], str | None]:
+    """(u,) -> tenant name (or None): ``tenants`` is an ordered
+    ``{name: share}`` mapping with positive shares summing to 1 —
+    refused otherwise by name, never renormalized silently. The label
+    is a pure function of the arrival's existing coin ``u`` (module
+    comment on ``_TENANT_STRIDE``)."""
+    if tenants is None:
+        return lambda u: None
+    names = list(tenants)
+    if not names:
+        raise ValueError("tenants= needs at least one (name, share)")
+    shares = [float(tenants[n]) for n in names]
+    if any(s <= 0 for s in shares):
+        raise ValueError(
+            f"tenant shares must all be > 0, got {dict(tenants)}"
+        )
+    if abs(sum(shares) - 1.0) > 1e-9:
+        raise ValueError(
+            f"tenant shares must sum to 1 (got {sum(shares):.6f}); "
+            "shares are the arrival mix, not weights — normalize "
+            "explicitly"
+        )
+    cum = []
+    acc = 0.0
+    for s in shares:
+        acc += s
+        cum.append(acc)
+    last = len(names) - 1
+
+    def fn(u: float) -> str:
+        v = (u * _TENANT_STRIDE) % 1.0
+        for i, c in enumerate(cum):
+            if v < c:
+                return names[i]
+        return names[last]
+
+    return fn
 
 
 def _default_prompt_fn(
@@ -177,6 +229,7 @@ def poisson_arrivals(
     long_share: float = 0.0,
     long_prompt_len: int | None = None,
     long_max_new: int | None = None,
+    tenants: dict | None = None,
 ) -> Iterator[Arrival]:
     """Seeded homogeneous Poisson arrivals: ``n`` requests at mean
     ``rate``/s from virtual ``start``. Every draw comes from one
@@ -184,13 +237,18 @@ def poisson_arrivals(
     with the same arguments yield bit-identical streams (pinned by
     tests/test_sim_workload.py). ``long_share``/``long_prompt_len``/
     ``long_max_new`` mix in a long-prompt class on the same coin (see
-    :func:`_default_prompt_fn` — arrival times never move)."""
+    :func:`_default_prompt_fn` — arrival times never move).
+    ``tenants`` (``{name: share}``, shares summing to 1) labels each
+    arrival with a tenant off the SAME coin — no extra draw, so
+    arrival times and prompt classes are bit-identical at every
+    tenant mix (:func:`_tenant_fn`)."""
     if rate <= 0 or n < 1:
         raise ValueError("need rate > 0 and n >= 1")
     rng = np.random.default_rng((0x9E3779B9, int(seed)))
     fn = _default_prompt_fn(prompt_len, prefix_share, prefix_len,
                             n_prefix_groups, max_new, long_share,
                             long_prompt_len, long_max_new)
+    tfn = _tenant_fn(tenants)
     t = float(start)
     left = int(n)
     while left:
@@ -200,7 +258,7 @@ def poisson_arrivals(
         t = float(ts[-1])
         for tt, u in zip(ts.tolist(), coins.tolist()):
             p, mn = fn(u)
-            yield Arrival(tt, p, mn)
+            yield Arrival(tt, p, mn, tenant=tfn(u))
         left -= m
 
 
@@ -220,6 +278,7 @@ def diurnal_arrivals(
     long_share: float = 0.0,
     long_prompt_len: int | None = None,
     long_max_new: int | None = None,
+    tenants: dict | None = None,
 ) -> Iterator[Arrival]:
     """Seeded non-homogeneous Poisson arrivals on a diurnal rate
     schedule: ``rate(t) = mean_rate * (1 + amplitude * sin(2*pi*t/
@@ -230,7 +289,8 @@ def diurnal_arrivals(
     chunked order — bit-identical across runs, like
     :func:`poisson_arrivals` (whose long-prompt mix kwargs apply here
     too: the disaggregation bench's burst day is this function with
-    ``long_share > 0``)."""
+    ``long_share > 0``; ``tenants=`` labels arrivals off the same
+    coin without moving a single arrival time)."""
     if mean_rate <= 0 or n < 1:
         raise ValueError("need mean_rate > 0 and n >= 1")
     if not (0.0 <= amplitude < 1.0):
@@ -241,6 +301,7 @@ def diurnal_arrivals(
     fn = _default_prompt_fn(prompt_len, prefix_share, prefix_len,
                             n_prefix_groups, max_new, long_share,
                             long_prompt_len, long_max_new)
+    tfn = _tenant_fn(tenants)
     peak = mean_rate * (1.0 + amplitude)
     w = 2.0 * math.pi / period
     t = float(start)
@@ -261,7 +322,7 @@ def diurnal_arrivals(
         keep = accept * peak < rates
         for tt, u in zip(ts[keep].tolist(), coins[keep].tolist()):
             p, mn = fn(u)
-            yield Arrival(tt, p, mn)
+            yield Arrival(tt, p, mn, tenant=tfn(u))
             out += 1
             if out == n:
                 break
@@ -287,6 +348,7 @@ def arrivals_from_jsonl(path) -> list[Arrival]:
                     prefix_len=rec.get("prefix_len", 0),
                 ),
                 rec["max_new"],
+                tenant=rec.get("tenant"),
             ))
     if not out:
         raise ValueError(f"empty arrival trace: {path}")
@@ -306,6 +368,8 @@ def dump_arrivals_jsonl(arrivals: Iterable[Arrival], path) -> int:
             if a.prompt.prefix is not None:
                 rec["prefix"] = a.prompt.prefix
                 rec["prefix_len"] = a.prompt.prefix_len
+            if a.tenant is not None:
+                rec["tenant"] = a.tenant
             f.write(json.dumps(rec) + "\n")
             n += 1
     return n
@@ -425,15 +489,17 @@ class SimRequest:
     ``finished`` / ``reason`` / ``admitted_tick``, exactly the members
     the router's replica protocol reads."""
 
-    __slots__ = ("prompt", "max_new", "n_emitted", "finished",
-                 "reason", "admitted_tick", "migrated",
+    __slots__ = ("prompt", "max_new", "tenant", "n_emitted",
+                 "finished", "reason", "admitted_tick", "migrated",
                  "_holds_prefix")
 
-    def __init__(self, prompt: SimPrompt, max_new: int):
+    def __init__(self, prompt: SimPrompt, max_new: int,
+                 tenant: str | None = None):
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         self.prompt = prompt
         self.max_new = int(max_new)
+        self.tenant = tenant
         self.n_emitted = 0
         self.finished = False
         self.reason = None
@@ -517,7 +583,7 @@ class SimReplica:
                  prompt_chunk: int = 256, tier: str = "unified",
                  chunk_s: float = 0.0,
                  kv_bytes_per_token: float = 4096.0,
-                 page_tokens: int = 16):
+                 page_tokens: int = 16, qos=None):
         if slots < 1 or n_inner < 1 or prompt_chunk < 1:
             raise ValueError(
                 "slots, n_inner and prompt_chunk must be >= 1"
@@ -531,6 +597,19 @@ class SimReplica:
                 "chunk_s and kv_bytes_per_token must be >= 0, "
                 "page_tokens >= 1"
             )
+        # multi-tenant QoS (opt-in): the FIFO queue becomes the SAME
+        # weighted deficit-round-robin the real scheduler runs under
+        # qos= — the timing twin of its admission order, so the
+        # isolation claims are measured on virtual time (lazy import:
+        # the qos package is stdlib-only, but sim/ keeps its closure
+        # explicit the way tune.py's models import does)
+        self.qos = qos
+        if qos is not None:
+            from ..qos import DeficitScheduler
+
+            self._drr = DeficitScheduler(qos)
+        else:
+            self._drr = None
         self.clock = clock
         self.S = int(slots)
         self.n_inner = int(n_inner)
@@ -557,18 +636,24 @@ class SimReplica:
         self.n_shared_admits = 0
         self.n_adopted = 0
         self.n_migrated_out = 0
+        # virtual seconds this replica spent with work on board (tick
+        # intervals scheduled while busy) — the numerator of the QoS
+        # plane's work-conservation floor; NOT in any digest
+        self.busy_s = 0.0
 
     # -- replica protocol -------------------------------------------------
 
     @property
     def pending(self) -> int:
-        return len(self._queue)
+        return (self._drr.total if self._drr is not None
+                else len(self._queue))
 
     @property
     def active(self) -> int:
         return self._n_active
 
-    def submit(self, prompt, max_new: int, key=None) -> SimRequest:
+    def submit(self, prompt, max_new: int, key=None,
+               tenant: str | None = None) -> SimRequest:
         if not self.alive:
             raise RuntimeError(
                 "submit to a killed SimReplica: the router must not "
@@ -576,13 +661,29 @@ class SimReplica:
             )
         if isinstance(prompt, int):
             prompt = SimPrompt(prompt)
-        req = SimRequest(prompt, max_new)
-        self._queue.append(req)
+        req = SimRequest(prompt, max_new, tenant=tenant)
+        self._enqueue(req)
         if self.next_tick_at is None:
             self.next_tick_at = (
                 self.clock.now() + self._tick_s(self.tick_count)
             )
         return req
+
+    def _enqueue(self, req: SimRequest) -> None:
+        if self._drr is not None:
+            if req.tenant is None:
+                raise ValueError(
+                    "qos SimReplica needs tenant= at submit: "
+                    "admission order is per-contract (register a "
+                    "catch-all TenantContract for untagged traffic)"
+                )
+            # DRR cost in tokens, the real scheduler's unit
+            self._drr.enqueue(
+                req.tenant, req,
+                float(req.prompt.length + req.max_new),
+            )
+        else:
+            self._queue.append(req)
 
     def prefix_hits(self, prompt) -> int:
         """Affinity score: shared-prefill chunks this replica would
@@ -597,11 +698,15 @@ class SimReplica:
     def cancel(self, req: SimRequest) -> bool:
         if req.finished:
             return False
-        try:
-            self._queue.remove(req)
-        except ValueError:
-            pass
+        if self._drr is not None:
+            removed = self._drr.remove(req)
         else:
+            try:
+                self._queue.remove(req)
+                removed = True
+            except ValueError:
+                removed = False
+        if removed:
             req.finished, req.reason = True, "cancelled"
             self.n_cancelled += 1
             return True
@@ -664,7 +769,7 @@ class SimReplica:
         req = ticket.request
         req.migrated = True
         req._holds_prefix = None  # residency re-established at admit
-        self._queue.append(req)
+        self._enqueue(req)
         self.n_adopted += 1
         if self.next_tick_at is None:
             self.next_tick_at = (
@@ -691,6 +796,7 @@ class SimReplica:
         # newly admitted slot runs its first chunk this very tick, and
         # neither decodes until a later tick.
         queue = self._queue
+        drr = self._drr
         slots = self._slots
         prefill = self._prefill
         n_inner = self.n_inner
@@ -698,10 +804,18 @@ class SimReplica:
         for s in range(self.S):
             req = slots[s]
             if req is None:
-                if not queue:
+                # admit (first chunk runs this very tick): FIFO, or
+                # the deficit-round-robin pick under qos= — the same
+                # admission-order hook the real scheduler carries
+                if drr is not None:
+                    picked = drr.pick()
+                    if picked is None:
+                        continue
+                    req = picked[1]
+                elif queue:
+                    req = queue.popleft()
+                else:
                     continue
-                # admit FIFO (first chunk runs this very tick)
-                req = queue.popleft()
                 p = req.prompt
                 if req.migrated:
                     # page adoption: NO prefill — the KV pages arrived
@@ -759,7 +873,7 @@ class SimReplica:
                 self._retire(s, req, retired)
             else:
                 req.n_emitted = ne
-        if queue or self._n_active:
+        if queue or self._n_active or (drr is not None and drr.total):
             dt = self._tick_s(self.tick_count)
             if n_chunks and self.chunk_s:
                 # prefill work stretches THIS tick: the real
@@ -767,6 +881,7 @@ class SimReplica:
                 # contention disaggregation removes
                 dt += self.chunk_s * n_chunks
             self.next_tick_at = now + dt
+            self.busy_s += dt
         else:
             self.next_tick_at = None
         return retired
@@ -801,6 +916,8 @@ class SimReplica:
         them, which is the zero-drop contract under test)."""
         self.alive = False
         self._queue.clear()
+        if self._drr is not None:
+            self._drr.clear()
         self._slots = [None] * self.S
         self._prefill = [0] * self.S
         self._n_active = 0
@@ -841,9 +958,15 @@ class WorkloadReport:
         self.n_failovers = (
             0 if controller is None else int(controller.n_failovers)
         )
-        self.ttft = np.asarray([r.ttft for r in requests], np.float64)
+        # the latency arrays cover SERVED requests: a shed request
+        # (refused at the door, QoS plane) has no TTFT to measure and
+        # must not poison the percentile/digest arrays. A tenant-less
+        # day sheds nothing, so every pre-QoS digest is byte-for-byte
+        # unchanged.
+        served = [r for r in requests if r.outcome != "shed"]
+        self.ttft = np.asarray([r.ttft for r in served], np.float64)
         self.latency = np.asarray(
-            [r.latency for r in requests], np.float64
+            [r.latency for r in served], np.float64
         )
         self.outcomes: dict[str, int] = {}
         for r in requests:
@@ -852,6 +975,8 @@ class WorkloadReport:
         self.n_rerouted = router.n_rerouted
         self.n_migrated = getattr(router, "n_migrated", 0)
         self.n_kept_local = getattr(router, "n_kept_local", 0)
+        self.n_shed = getattr(router, "n_shed", 0)
+        self.n_hedges_refused = getattr(router, "n_hedges_refused", 0)
         self.dropped = sum(not r.finished for r in requests)
         # per-request mean inter-token gap (first token -> done over
         # the decode tokens): the decode-steadiness distribution the
@@ -879,6 +1004,39 @@ class WorkloadReport:
         if self.decode_itl.size == 0:
             return 0.0
         return float(np.percentile(self.decode_itl, 99))
+
+    def per_tenant(self) -> dict[str, dict]:
+        """Per-tenant breakdown (QoS plane): request/shed counts and
+        TTFT p50/p99 over the tenant's SERVED requests. OUTSIDE
+        :meth:`digest` — the bit-identity witness keeps its
+        latency-array definition; a tenant-free day returns ``{}``."""
+        acc: dict[str, dict] = {}
+        for r in self.requests:
+            t = getattr(r, "tenant", None)
+            if t is None:
+                continue
+            d = acc.setdefault(t, {"n": 0, "shed": 0, "_ttft": []})
+            d["n"] += 1
+            if r.outcome == "shed":
+                d["shed"] += 1
+            elif r.ttft is not None:
+                d["_ttft"].append(r.ttft)
+        out: dict[str, dict] = {}
+        for t, d in acc.items():
+            a = np.asarray(d.pop("_ttft"), np.float64)
+            out[t] = {
+                "n": d["n"],
+                "shed": d["shed"],
+                "served": int(a.size),
+                "p50_ttft_s": (
+                    float(np.percentile(a, 50)) if a.size else 0.0
+                ),
+                "p99_ttft_s": (
+                    float(np.percentile(a, 99)) if a.size else 0.0
+                ),
+                "mean_ttft_s": float(a.mean()) if a.size else 0.0,
+            }
+        return out
 
     def digest(self) -> str:
         import hashlib
@@ -996,10 +1154,12 @@ def run_router_day(
                 ctl.step()
             nt = next_at()
         run_until(at)
-        rr = submit(a.prompt, a.max_new)
+        rr = submit(a.prompt, a.max_new, tenant=a.tenant)
         append(rr)
         if ctl is not None:
             ctl.observe_arrival(at)
+        if rr.finished:
+            continue  # shed at the door: no leg, no events to add
         t = getattr(replicas[rr.replica], "next_tick_at", None)
         if t is not None and (nt is None or t < nt):
             nt = t

@@ -128,14 +128,16 @@ def _marked_real_smoke(mod: ModuleInfo, fn: ast.AST) -> bool:
 
 def _is_sim_module(mod: ModuleInfo) -> bool:
     """The virtual-time plane: any ``sim`` package component, the
-    ``test_sim*`` virtual-time test family, and — round 18 — any
-    ``fleet`` package component: the control plane's decision code
-    must be drivable by VirtualClock (a controller day replays
-    bit-identically in tier-1), so it reads only its injected clock;
-    wall seconds enter through the caller's ``timer=`` argument, never
+    ``test_sim*`` virtual-time test family, round 18's ``fleet``
+    package (the control plane's decision code must be drivable by
+    VirtualClock — a controller day replays bit-identically in
+    tier-1), and — round 19 — any ``qos`` package component: tenant
+    buckets refill and deficit rotations advance only from the ``now``
+    the caller injects, so a tenant-mixed day replays bit-identically;
+    wall seconds enter through the call site's clock argument, never
     an OS-clock import."""
     parts = mod.name.split(".")
-    return "sim" in parts or "fleet" in parts or any(
+    return "sim" in parts or "fleet" in parts or "qos" in parts or any(
         p.startswith("test_sim") for p in parts
     )
 
@@ -145,9 +147,10 @@ class WallClock(Checker):
     rule = "GC008"
     name = "wall-clock"
     description = (
-        "sim- and fleet-package modules never read the OS clock "
-        "(time.time/perf_counter/monotonic/sleep, datetime.now) — "
-        "virtual time and control-plane decisions stay clock-injected; "
+        "sim-, fleet-, and qos-package modules never read the OS "
+        "clock (time.time/perf_counter/monotonic/sleep, datetime.now) "
+        "— virtual time, control-plane decisions, and tenant budgets "
+        "stay clock-injected; "
         "no assert compares a wall-clock-derived value against a "
         "sub-second margin — port the claim to "
         "SimBackend/VirtualClock or mark the one sanctioned "
@@ -211,7 +214,7 @@ class WallClock(Checker):
                 ):
                     yield mod.finding(
                         self.rule, node,
-                        "virtual-time-plane module (sim/fleet) "
+                        "virtual-time-plane module (sim/fleet/qos) "
                         "imports OS-clock names from `time` — it must "
                         "not read the wall clock (sim/clock.py is the "
                         "only clock; fleet code takes timer= from the "
@@ -225,7 +228,7 @@ class WallClock(Checker):
                     yield mod.finding(
                         self.rule, node,
                         f"`{'.'.join(path)}` in a virtual-time-plane "
-                        "module (sim/fleet) — it must stay "
+                        "module (sim/fleet/qos) — it must stay "
                         "wall-clock-free (bit-reproducibility is the "
                         "whole contract); take the VirtualClock (or "
                         "the injected timer=) instead",

@@ -80,3 +80,12 @@ def fleet_decide(decision, registry=None, flight=None):
     registry.counter("fleet_resizes_total").inc()  # GC004 line 80
     flight.event("fleet decision", seq=decision)  # GC004 line 81
     return decision
+
+
+def qos_admit(tenant, registry=None, flight=None):
+    # the round-19 multi-tenant QoS telemetry shape: counting a DRR
+    # admission and stamping the reclaim instant event without the
+    # None guards
+    registry.counter("qos_admitted_total").inc()  # GC004 line 89
+    flight.event("qos reclaim", tenant=tenant)  # GC004 line 90
+    return tenant

@@ -143,3 +143,20 @@ def fleet_decide(decision, registry=None, flight=None):
         registry.counter("fleet_failovers_total").inc(0)
     ok = flight is not None and flight.event("fleet decision")
     return decision if ok else None
+
+
+def qos_admit(tenant, registry=None, flight=None):
+    """The round-19 multi-tenant QoS telemetry shape, guarded: the
+    per-tenant admission/shed counters, deficit and quota gauges, the
+    per-tenant TTFT histogram, and the reclaim/shed flight instant
+    events only fire inside the is-not-None arms (models/serving.py
+    _ServingObs qos hooks + models/router.py _RouterObs discipline)."""
+    if registry is not None:
+        registry.counter("qos_admitted_total").inc()
+        registry.counter("qos_shed_total").inc(0)
+        registry.counter("qos_hedge_refused_total").inc(0)
+        registry.gauge("qos_deficit").set(tenant)
+        registry.gauge("qos_pages_quota_used").set(tenant)
+        registry.histogram("qos_ttft_seconds").observe(0.0)
+    ok = flight is not None and flight.event("qos reclaim")
+    return tenant if ok else None

@@ -1,0 +1,16 @@
+"""GC008 bad fixture, qos half: tenant-budget code that secretly
+reads the OS clock — a bucket refilled from the wall can never replay
+a tenant-mixed day bit-identically. Violation lines pinned by the
+fixture test."""
+
+import time
+
+
+def refill(bucket):
+    now = time.perf_counter()  # GC008: OS clock in a budget refill
+    bucket.tokens = min(
+        bucket.burst,
+        bucket.tokens + bucket.rate * (time.monotonic() - bucket.last),  # GC008
+    )
+    bucket.last = now
+    return bucket.tokens

@@ -1,0 +1,14 @@
+"""GC008 good fixture, qos half: tenant-budget code on the injected
+clock only — the TokenBucket discipline (``now`` enters through the
+caller's clock argument, never an OS-clock import), so a tenant-mixed
+day replays bit-identically on VirtualClock."""
+
+
+def refill(bucket, now):
+    if now > bucket.last:
+        bucket.tokens = min(
+            bucket.burst,
+            bucket.tokens + bucket.rate * (now - bucket.last),
+        )
+        bucket.last = now
+    return bucket.tokens

@@ -81,6 +81,20 @@ def test_elastic_fleet_demo_example():
     assert "elastic fleet demo ok" in out.stdout
 
 
+def test_multi_tenant_demo_example():
+    """The round-19 QoS walkthrough: three contracts on one fleet,
+    the 10x flood shed by name, the compliant p99 barely moving while
+    the FIFO contrast explodes, and the bit-identical replay digest —
+    numpy-only virtual time, so it runs in tier-1."""
+    out = _run_example("multi_tenant_demo.py")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "shed by name:" in out.stdout
+    assert "compliant p99 shift under the flood:" in out.stdout
+    assert "NO QoS plane (FIFO, equal chips)" in out.stdout
+    assert "replayed bit-identically" in out.stdout
+    assert "multi-tenant qos ok" in out.stdout
+
+
 def test_device_coord_demo_example():
     """The round-17 device-coordination walkthrough: the host-loop vs
     fused-K=64 overhead race plus the bit-identical straggling-fleet

@@ -63,7 +63,8 @@ def _keys(findings):
                           ("GC004", 55), ("GC004", 56),
                           ("GC004", 63), ("GC004", 64),
                           ("GC004", 71), ("GC004", 72),
-                          ("GC004", 80), ("GC004", 81)]),
+                          ("GC004", 80), ("GC004", 81),
+                          ("GC004", 89), ("GC004", 90)]),
         (
             "gc005_bad.py",
             [("GC005", 17), ("GC005", 18), ("GC005", 21),
@@ -125,7 +126,8 @@ def test_baseline_roundtrip(tmp_path):
                                 ("GC004", 55), ("GC004", 56),
                                 ("GC004", 63), ("GC004", 64),
                                 ("GC004", 71), ("GC004", 72),
-                                ("GC004", 80), ("GC004", 81)]
+                                ("GC004", 80), ("GC004", 81),
+                                ("GC004", 89), ("GC004", 90)]
     assert res.baseline_size == 1
 
 

@@ -64,6 +64,8 @@ def _keys(findings):
             [("GC008", 13), ("GC008", 23),
              ("GC008", 9), ("GC008", 12),  # fleet/: OS clock in a
              # decision function — the round-18 control-plane purity
+             ("GC008", 10), ("GC008", 13),  # qos/: OS clock in a
+             # tenant-budget refill — the round-19 QoS-plane purity
              ("GC008", 4), ("GC008", 9), ("GC008", 11), ("GC008", 12),
              ("GC008", 18)],  # 18: wall sleep through `import time
             # as _t` — alias-proof matching
@@ -196,6 +198,24 @@ def test_gc008_covers_the_fleet_package():
         if os.sep + "fleet" + os.sep in f.path
     ]
     assert fleet_hits == [("GC008", 9), ("GC008", 12)], [
+        f.format() for f in bad.fresh
+    ]
+
+
+def test_gc008_covers_the_qos_package():
+    """Round-19: the QoS plane joined the virtual-time plane — the
+    shipped qos/ package is clean under GC008's purity half (token
+    buckets refill and deficit rotations advance only from the
+    caller-injected ``now``), and the fixture's qos twin pins the
+    OS-clock-in-a-budget-refill leak shape by line."""
+    res = run([os.path.join(_PKG, "qos")], rules=["GC008"])
+    assert res.fresh == [], [f.format() for f in res.fresh]
+    bad = _findings("gc008_bad_pkg", rules=["GC008"])
+    qos_hits = [
+        (f.rule, f.line) for f in bad.fresh
+        if os.sep + "qos" + os.sep in f.path
+    ]
+    assert qos_hits == [("GC008", 10), ("GC008", 13)], [
         f.format() for f in bad.fresh
     ]
 
