@@ -535,6 +535,19 @@ def driver_contract(budget_s: float | None = None) -> dict:
         # falls under the work-conservation floor, or on digest
         # divergence across two flooded replays.
         out["qos"] = _try_rung(rung_qos, est=25, scale=False)
+
+        def rung_chaos():
+            from benchmarks.chaos_bench import bench_chaos_rung
+
+            return bench_chaos_rung()
+
+        # round-20 chaos rung — unscaled like the other sim rungs:
+        # the retry-storm day with one correlated host-group kill and
+        # a 30%-span partition, invariants armed inside the run;
+        # FAILS on any drop, any unnamed shed, a queue over the
+        # pinned ceiling, a metastable (non-recovering) p99, or
+        # digest divergence across two replays.
+        out["chaos"] = _try_rung(rung_chaos, est=20, scale=False)
         # headline: never budget-skipped, loud-fail (it IS the
         # contract) — but SIZED by measurement. Each ladder step is a
         # complete config-3 bench at that cube; the next step runs only
@@ -721,6 +734,10 @@ def _contract_line(out: dict) -> str:
             out.get("qos"), "qos_isolation_eps"),
         "qos_util_floor": _rung_summary(
             out.get("qos"), "qos_util_floor"),
+        "chaos_shed_named_pct": _rung_summary(
+            out.get("chaos"), "chaos_shed_named_pct"),
+        "chaos_p99_recovery_x": _rung_summary(
+            out.get("chaos"), "chaos_p99_recovery_x"),
         "adaptive_speedup": _rung_summary(
             out.get("adaptive_nwait"), "speedup"),
         "obs_overhead_pct": _rung_summary(

@@ -1,0 +1,94 @@
+"""Round-20 chaos rung: the storm-with-host-kill episode as a gate.
+
+One leg, sim-only (unscaled in bench.py — virtual-time bookkeeping
+does not track the matmul rate): the ``storm_with_host_kill``
+scenario from the chaos catalog — a retry-storm day (timeout-and-
+resubmit clients on a seeded coin) with ONE correlated host-group
+kill (two replicas die together mid-day, then revive) and a 30%-span
+router<->replica partition over another two, on the two-class tenant
+mix — driven TWICE through :class:`~mpistragglers_jl_tpu.chaos.
+ChaosInjector` with every pinned invariant armed inside the run.
+
+Gates (any failure raises — the rung IS the contract):
+
+* **drops only by name** — zero dropped requests, and 100% of shed
+  requests carry a reason (``chaos_shed_named_pct``), batch-class
+  shed before interactive per the QoS sheddability contract;
+* **bounded queue** — the probes' peak fleet depth stays at or under
+  the scenario's pinned ceiling (128);
+* **non-metastable** — post-storm windowed p99 TTFT within the
+  scenario's pinned factor of the pre-storm baseline
+  (``chaos_p99_recovery_x``);
+* **bit-identity** — the two runs' ChaosReport digests are equal
+  (the replay witness; partitions reconciled with no request
+  double-retired is checked inside the scenario's own battery).
+
+Headline scalars (bench.py compact line, format in
+benchmarks/README.md round-20 note): ``chaos_shed_named_pct`` and
+``chaos_p99_recovery_x``.
+"""
+
+from __future__ import annotations
+
+import time
+
+_CEILING = 128  # the scenario's pinned hard queue ceiling
+
+
+def bench_chaos_rung(requests: int | None = None):
+    """The driver rung ``chaos``: two replays of the combo episode
+    with the invariant battery armed, gated as the module docstring
+    states."""
+    import os
+
+    from mpistragglers_jl_tpu.chaos import ChaosInjector, get_scenario
+
+    n = int(
+        requests if requests is not None
+        else os.environ.get("CHAOS_BENCH_REQUESTS", "5000")
+    )
+    seed = 20
+    t0 = time.perf_counter()
+    inj = ChaosInjector()
+    r1 = inj.run(get_scenario("storm_with_host_kill", seed=seed, n=n))
+    r2 = inj.run(get_scenario("storm_with_host_kill", seed=seed, n=n))
+    if r1.digest() != r2.digest():
+        raise AssertionError(
+            f"chaos episode not bit-identical: {r1.digest()} != "
+            f"{r2.digest()}"
+        )
+    if r1.dropped:
+        raise AssertionError(
+            f"{r1.dropped} requests dropped: shed is the only "
+            "sanctioned loss, and it is named"
+        )
+    if r1.shed_named_pct < 100.0:
+        raise AssertionError(
+            f"chaos_shed_named_pct {r1.shed_named_pct:.1f} < 100: "
+            "a bare drop slipped through the shed door"
+        )
+    if r1.max_queue_depth > _CEILING:
+        raise AssertionError(
+            f"peak queue depth {r1.max_queue_depth} over the pinned "
+            f"{_CEILING} ceiling"
+        )
+    rec = float(r1.extras["p99_recovery_x"])
+    return {
+        "requests": int(r1.n_requests),
+        "chaos_shed_named_pct": round(r1.shed_named_pct, 1),
+        "chaos_p99_recovery_x": round(rec, 3),
+        "resubmits": int(r1.n_resubmits),
+        "shed": dict(r1.shed_reasons),
+        "partitions": int(r1.n_partitions),
+        "stale_cancelled": int(r1.n_stale_cancelled),
+        "max_queue_depth": int(r1.max_queue_depth),
+        "invariants": list(r1.invariants),
+        "digest": r1.digest(),
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(bench_chaos_rung(), indent=2, default=str))

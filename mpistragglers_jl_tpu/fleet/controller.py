@@ -368,6 +368,15 @@ class FleetController:
         self.n_resizes = 0
         self.n_failovers = 0
         self.n_grow_blocked = 0
+        # flap detector (chaos plane): a resize REVERSING the previous
+        # one's direction is the hysteresis failure signature — a
+        # retry storm that whipsaws the controller grow/shrink/grow
+        # shows up here even when each individual resize looked
+        # justified. The adversarial no-flap test pins this counter
+        # under a storm; dwell_s/cooldown_s are the knobs that keep it
+        # low.
+        self.n_direction_flips = 0
+        self._last_action: str | None = None
         self._grow_blocked = False
         self._seq = 0
         self._obs = (
@@ -547,6 +556,13 @@ class FleetController:
         )
         self._seq += 1
         self.n_resizes += 1
+        if (self._last_action is not None
+                and action in ("grow", "shrink")
+                and self._last_action in ("grow", "shrink")
+                and action != self._last_action):
+            self.n_direction_flips += 1
+        if action in ("grow", "shrink"):
+            self._last_action = action
         self._grow_blocked = False
         self._cooldown_until = now + self.cooldown_s
         self._high_since = self._low_since = None
@@ -762,6 +778,13 @@ class FleetController:
             "target_size": int(self.target_size),
             "n_resizes": int(self.n_resizes),
             "n_failovers": int(self.n_failovers),
+            "n_direction_flips": int(self.n_direction_flips),
+            # -1 none / 0 shrink / 1 grow: the flap detector's memory
+            # rides the checkpoint so a takeover keeps counting
+            "last_action": int(
+                -1 if self._last_action is None
+                else (1 if self._last_action == "grow" else 0)
+            ),
             "seq": int(self._seq),
             "code_rate": float(
                 math.nan if self.code_pair is None
@@ -825,6 +848,11 @@ class FleetController:
         self.target_size = int(state["target_size"])
         self.n_resizes = int(state["n_resizes"])
         self.n_failovers = int(state["n_failovers"])
+        self.n_direction_flips = int(state.get("n_direction_flips", 0))
+        la = int(state.get("last_action", -1))
+        self._last_action = (
+            None if la < 0 else ("grow" if la == 1 else "shrink")
+        )
         self._seq = int(state["seq"])
         cr, ck = float(state["code_rate"]), int(state["code_nwait"])
         self.code_pair = None if math.isnan(cr) else (cr, ck)

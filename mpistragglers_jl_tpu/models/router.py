@@ -83,6 +83,22 @@ hedge legs capped at the contract's ``hedges``, dues beyond it
 refused and counted) so one tenant's deadline panic cannot consume
 another's slack.
 
+**Chaos hardening** (round 20, docs/API.md "Chaos plane"):
+:meth:`~RequestRouter.partition` / :meth:`~RequestRouter.heal` model
+a router<->replica NETWORK PARTITION as distinct from death — the
+replica keeps ticking (its in-flight work progresses and burns
+capacity), its results are unreachable, its requests re-route with
+the stale legs abandoned uncancelled, and the heal withdraws them so
+a rejoin can never double-retire a request. ``shed_depth=`` /
+``shed_depth_hard=`` are the overload ceilings: past the soft
+ceiling sheddable work (batch class; all classless traffic) is shed
+BY NAME with ``shed_reason == "overload"``, past the hard ceiling
+(default 2x soft) every class sheds (``"overload_hard"``) — shed
+beats an unbounded queue, and graftcheck GC010 statically enforces
+that no drop is ever bare. Correlated (same-instant, multi-replica)
+kills evacuate only after the full health scan — see
+:meth:`_probe_health`.
+
 **Observability** is strictly opt-in (the package-wide GC004 contract):
 ``registry=`` exports ``router_requests_total{policy,replica,outcome}``,
 ``router_hedge_fired_total``, ``router_replica_ejections_total``, the
@@ -129,9 +145,12 @@ class RoutedRequest:
     ``"hedge_won"`` (the hedge leg's first token beat the primary),
     ``"hedged"`` (a hedge fired but the primary still won),
     ``"rerouted"`` (the request survived at least one replica death),
-    or ``"shed"`` (refused at the door by name: the tenant was over
-    its token budget and its contract's class is sheddable — the
-    request never reached a replica; ``replica`` stays None).
+    or ``"shed"`` (refused at the door by name — the request never
+    reached a replica; ``replica`` stays None, and ``shed_reason``
+    carries the name: ``"budget"`` for an over-budget sheddable
+    tenant, ``"overload"``/``"overload_hard"`` for the queue-depth
+    ceilings. The chaos plane's shed-by-name contract — graftcheck
+    GC010 — is that no request is ever shed without one).
 
     ``tenant`` names the contract the request is billed to (the QoS
     plane); None on routers without ``qos=``.
@@ -141,7 +160,7 @@ class RoutedRequest:
         "id", "prompt", "max_new", "key", "tenant", "t_submit",
         "t_admitted", "t_first_token", "t_done", "replica",
         "hedge_replica", "hedged", "rerouted", "migrated", "finished",
-        "outcome", "_legs", "_hedge_charged",
+        "outcome", "shed_reason", "_legs", "_hedge_charged",
     )
 
     _next_id = 0
@@ -171,6 +190,7 @@ class RoutedRequest:
         self.migrated = False  # the stream moved tiers (two_tier)
         self.finished = False
         self.outcome: str | None = None
+        self.shed_reason: str | None = None  # set iff outcome "shed"
         # (replica_idx, scheduler_request) in dispatch order; the
         # winner leg is promoted to index 0 when first tokens resolve
         self._legs: list[tuple[int, Any]] = []
@@ -223,10 +243,18 @@ class _RouterObs:
         # (replica, outcome[, tenant]) and cached — label churn is
         # tiny (N x 4 x tenants)
         self._done: dict[tuple, Any] = {}
+        # shed-by-name counters exist on EVERY instrumented router:
+        # the overload ceilings shed tenantless traffic too, and the
+        # chaos invariant (no unnamed drops) reads the reason label
+        self._shed_by_reason: dict[str, Any] = {}
         if self._tenantful:
             self._q_shed: dict[tuple[str, str], Any] = {}
             self._q_ttft: dict[str, Any] = {}
             self._q_hedge_ref: dict[str, Any] = {}
+        self.m_partition = registry.counter(
+            "router_partitions_total",
+            help="router<->replica network partitions begun",
+        )
         self.m_hedge = registry.counter(
             "router_hedge_fired_total",
             help="TTFT-deadline hedges dispatched (hedge_p99 policy)",
@@ -320,24 +348,47 @@ class _RouterObs:
 
     def shed(self, rr: RoutedRequest, reason: str, t: float) -> None:
         """One request refused at the door by name (over-budget
-        sheddable tenant): the per-(tenant, reason) counter plus the
-        flight-recorder instant event."""
+        sheddable tenant, or an overload queue-depth ceiling): the
+        per-reason counter (every router), the per-(tenant, reason)
+        counter (qos routers), plus the flight-recorder instant
+        event."""
         if self._r:
-            key = (str(rr.tenant), str(reason))
-            c = self._q_shed.get(key)
+            c = self._shed_by_reason.get(reason)
             if c is None:
-                c = self._q_shed[key] = self.registry.counter(
-                    "qos_shed_total",
-                    help="requests shed at the router door, by "
-                    "tenant and reason",
-                    tenant=key[0], reason=key[1],
+                c = self._shed_by_reason[reason] = (
+                    self.registry.counter(
+                        "router_shed_total",
+                        help="requests shed at the router door, by "
+                        "reason — the shed-by-name contract's tally",
+                        reason=str(reason),
+                    )
                 )
             c.inc()
+            if self._tenantful:
+                key = (str(rr.tenant), str(reason))
+                qc = self._q_shed.get(key)
+                if qc is None:
+                    qc = self._q_shed[key] = self.registry.counter(
+                        "qos_shed_total",
+                        help="requests shed at the router door, by "
+                        "tenant and reason",
+                        tenant=key[0], reason=key[1],
+                    )
+                qc.inc()
         if self.flight is not None:
-            self.flight.event(
-                "qos shed", src="router", t=t, request=rr.id,
-                tenant=str(rr.tenant), reason=str(reason),
-            )
+            if rr.tenant is not None:
+                self.flight.event(
+                    "qos shed", src="router", t=t, request=rr.id,
+                    tenant=str(rr.tenant), reason=str(reason),
+                )
+            else:
+                # tenant-less shed: no tenant label at all — a
+                # literal "None" masquerading as a tenant name would
+                # poison the postmortem record
+                self.flight.event(
+                    "request shed", src="router", t=t,
+                    request=rr.id, reason=str(reason),
+                )
 
     def hedge_refused(self, rr: RoutedRequest, t: float) -> None:
         if self._r:
@@ -380,6 +431,26 @@ class _RouterObs:
         if self.flight is not None:
             self.flight.event(
                 "replica restored", src="router", t=t, replica=i
+            )
+
+    def partitioned(self, i: int, t: float, rerouted: int) -> None:
+        """A router<->replica partition began: the replica keeps
+        ticking, its results are unreachable, its in-flight requests
+        re-route (legs abandoned UNCANCELLED — no cancel can cross a
+        partition)."""
+        if self._r:
+            self.m_partition.inc()
+        if self.flight is not None:
+            self.flight.event(
+                "replica partitioned", src="router", t=t, replica=i,
+                rerouted=rerouted,
+            )
+
+    def healed(self, i: int, t: float, stale_cancelled: int) -> None:
+        if self.flight is not None:
+            self.flight.event(
+                "partition healed", src="router", t=t, replica=i,
+                stale_cancelled=stale_cancelled,
             )
 
     def migrated(self, rr: RoutedRequest, ticket, j: int, t: float,
@@ -462,6 +533,8 @@ class RequestRouter:
         migrate_threshold_bytes: int | None = None,
         migrate_gbs: float | None = None,
         qos: TenantRegistry | None = None,
+        shed_depth: int | None = None,
+        shed_depth_hard: int | None = None,
         registry=None,
         flight=None,
         exporter=None,
@@ -527,6 +600,43 @@ class RequestRouter:
         self._up = [True] * len(self.replicas)
         self._routable: list[int] = list(range(len(self.replicas)))
         self._down_manual: set[int] = set()
+        # network partitions (chaos plane): a partitioned replica is
+        # unroutable but ALIVE — it keeps ticking, its results are
+        # unreachable, and heal() reconciles its stale legs so a
+        # rejoin can never double-retire a request
+        self._partitioned: set[int] = set()
+        self._partition_stale: dict[int, list] = {}
+        self.n_partitions = 0
+        self.n_partitions_healed = 0
+        self.n_stale_cancelled = 0
+        # overload shedding (chaos plane): with a soft queue-depth
+        # ceiling, sheddable (batch-class; ALL classless) traffic is
+        # shed by name once the fleet's queued depth reaches it; the
+        # hard ceiling (default 2x soft) sheds EVERY class — the
+        # bounded-queue guarantee under offered load past 1. None
+        # keeps the pre-chaos queue-without-bound behavior.
+        if shed_depth is not None and shed_depth < 1:
+            raise ValueError(
+                f"shed_depth must be >= 1 or None, got {shed_depth}"
+            )
+        if shed_depth_hard is not None and shed_depth is None:
+            raise ValueError(
+                "shed_depth_hard without shed_depth: the hard ceiling "
+                "refines the soft one, it cannot stand alone"
+            )
+        self.shed_depth = None if shed_depth is None else int(shed_depth)
+        self.shed_depth_hard = (
+            None if shed_depth is None
+            else int(shed_depth_hard) if shed_depth_hard is not None
+            else 2 * int(shed_depth)
+        )
+        if (self.shed_depth_hard is not None
+                and self.shed_depth_hard < self.shed_depth):
+            raise ValueError(
+                f"shed_depth_hard ({self.shed_depth_hard}) below "
+                f"shed_depth ({self.shed_depth}): the hard ceiling "
+                "must sit at or above the soft one"
+            )
         self._rr = 0
         # in-flight request books, all insertion-ordered dicts (used as
         # ordered sets): hash-order iteration would break bit-identical
@@ -598,6 +708,117 @@ class RequestRouter:
 
     def mark_up(self, i: int) -> None:
         self._down_manual.discard(int(i))
+
+    @property
+    def queue_depth(self) -> int:
+        """Queued (not yet admitted) requests over the ROUTABLE
+        fleet — the exact quantity the overload ceilings bound, so
+        the chaos plane's bounded-queue probe and the shed door can
+        never disagree. Non-routable replicas are excluded by
+        construction: a dead replica's queue is wiped, and a
+        partitioned replica's frozen backlog (its abandoned,
+        uncancelled legs) is bounded by what was in flight at
+        partition onset — no new work ever lands there."""
+        reps = self.replicas
+        return sum(reps[i].pending for i in self._routable)
+
+    # -- network partitions (chaos plane) -------------------------------
+
+    def partition(self, i: int) -> None:
+        """Begin a router<->replica network partition: replica ``i``
+        becomes unroutable, but — unlike a death — it KEEPS TICKING
+        (``step`` still drives it; in-flight work on it progresses and
+        burns its capacity). Its in-flight requests re-route onto the
+        survivors like an ejection, except their legs on ``i`` are
+        abandoned UNCANCELLED: no cancel can cross a partition. The
+        abandoned legs are remembered and reconciled at :meth:`heal`,
+        so the rejoin can never double-retire a request."""
+        i = int(i)
+        if not 0 <= i < len(self.replicas):
+            raise ValueError(f"partition({i}): no such replica")
+        if i in self._partitioned:
+            raise ValueError(
+                f"partition({i}): replica {i} is already partitioned"
+            )
+        now = self._now()
+        self._partitioned.add(i)
+        self.n_partitions += 1
+        moved = 0
+        if self._up[i]:
+            self._up[i] = False
+            self._routable = [
+                j for j, u in enumerate(self._up) if u
+            ]
+            moved = self._evacuate_unreachable(i, now)
+        if self._obs is not None:
+            self._obs.partitioned(i, now, moved)
+
+    def heal(self, i: int) -> None:
+        """End replica ``i``'s partition and reconcile: the re-routed
+        copies are authoritative — every stale leg the replica still
+        holds is cancelled, and legs it finished behind the partition
+        are discarded (their tokens were unreachable when produced).
+        The request-level books were already detached at
+        :meth:`partition`, so nothing the isolated side did can
+        complete a request a second time; ``n_stale_cancelled``
+        counts the withdrawn legs."""
+        i = int(i)
+        if i not in self._partitioned:
+            raise ValueError(
+                f"heal({i}): replica {i} is not partitioned"
+            )
+        now = self._now()
+        self._partitioned.discard(i)
+        stale = self._partition_stale.pop(i, [])
+        replica = self.replicas[i]
+        cancelled = 0
+        for rr, leg in stale:
+            if getattr(leg, "finished", False):
+                continue  # finished behind the partition: discarded
+            try:
+                if replica.cancel(leg):
+                    cancelled += 1
+            except Exception:  # noqa: BLE001 — replica died partitioned
+                pass
+        self.n_stale_cancelled += cancelled
+        self.n_partitions_healed += 1
+        up = i not in self._down_manual and self._probe(replica)
+        if up and not self._up[i]:
+            self._up[i] = True
+            self._routable = [
+                j for j, u in enumerate(self._up) if u
+            ]
+        if self._obs is not None:
+            self._obs.healed(i, now, cancelled)
+
+    def _evacuate_unreachable(self, i: int, now: float) -> int:
+        """The partition twin of :meth:`_evacuate`: requests with a
+        leg on unreachable replica ``i`` lose that leg WITHOUT a
+        cancel (the cancel cannot be delivered) — the abandoned legs
+        are parked in the partition-stale book for :meth:`heal` to
+        withdraw. Single-leg requests re-route (zero drops, the
+        ejection contract)."""
+        moved = 0
+        stale = self._partition_stale.setdefault(i, [])
+        victims = list(self._awaiting[i]) + list(self._streaming[i])
+        self._awaiting[i].clear()
+        self._streaming[i].clear()
+        for rr in victims:
+            for j, leg in rr._legs:
+                if j == i:
+                    stale.append((rr, leg))
+            rr._legs = [leg for leg in rr._legs if leg[0] != i]
+            self._hedge_release(rr)  # the hedge episode died with a leg
+            if rr._legs:
+                j = rr._legs[0][0]
+                if rr.t_first_token is None:
+                    rr.replica = j
+                    rr.hedge_replica = None
+                continue
+            self._hedge.disarm(rr)
+            self._reroute(rr, now)
+            moved += 1
+        return moved
 
     def set_policy(self, policy: str) -> None:
         """Switch the placement policy mid-run — the fleet
@@ -671,11 +892,15 @@ class RequestRouter:
         now = None
         hf = self._health_fn
         dm = self._down_manual
+        parts = self._partitioned
+        downs: list[int] | None = None
         for i, r in enumerate(self.replicas):
             # default probe inlined: this loop runs once per step of a
             # million-event sim, and a per-replica function call
-            # measured ~10% of the whole day
-            up = i not in dm and (
+            # measured ~10% of the whole day. A partitioned replica is
+            # pinned down until heal() — the probe must not flip it
+            # back while its stale legs are unreconciled.
+            up = i not in dm and i not in parts and (
                 getattr(r, "alive", True) if hf is None else bool(hf(r))
             )
             if up == self._up[i]:
@@ -690,6 +915,17 @@ class RequestRouter:
                 if self._obs is not None:
                     self._obs.restored(i, now)
             else:
+                # evacuation is DEFERRED to after the full scan: a
+                # CORRELATED kill flips several replicas in one probe
+                # pass, and evacuating at the first flip would re-route
+                # onto a same-instant casualty still marked routable
+                # (the chaos plane's correlated-host-kill episode
+                # caught exactly this)
+                if downs is None:
+                    downs = []
+                downs.append(i)
+        if downs is not None:
+            for i in downs:
                 n = self._evacuate(i, now)
                 if self._obs is not None:
                     self._obs.ejected(i, now, n)
@@ -890,6 +1126,7 @@ class RequestRouter:
                 "admittable); repair or mark_up a replica"
             )
         now = self._now()
+        contract = None
         if self._qos is not None:
             if tenant is None:
                 raise ValueError(
@@ -899,22 +1136,38 @@ class RequestRouter:
                     "untagged traffic)"
                 )
             contract = self._qos.get(tenant)  # unknown: named KeyError
+        if self.shed_depth is not None:
+            # overload ceilings (chaos plane): queued depth over the
+            # routable fleet (THE queue_depth quantity — one
+            # implementation, so the chaos probe and this door can
+            # never disagree), read BEFORE this submit queues
+            # anything AND before the budget door — an overload shed
+            # must not charge a token bucket for work the fleet never
+            # accepted (the r19 refund convention: refusals never
+            # keep the charge). Soft ceiling sheds sheddable work
+            # (batch class; all classless traffic) by name; the hard
+            # ceiling sheds every class — shed beats an unbounded
+            # queue.
+            depth = self.queue_depth
+            if depth >= self.shed_depth_hard:
+                return self._shed_at_door(
+                    prompt, max_new, key, tenant, now, "overload_hard"
+                )
+            if depth >= self.shed_depth and (
+                contract is None or contract.sheddable
+            ):
+                return self._shed_at_door(
+                    prompt, max_new, key, tenant, now, "overload"
+                )
+        if contract is not None:
             bucket = self._buckets.get(tenant)
             if bucket is not None and not bucket.take(
                 self._prompt_tokens(prompt) + int(max_new), now
             ):
                 if contract.sheddable:
-                    rr = RoutedRequest(prompt, max_new, key, now,
-                                       tenant=tenant)
-                    rr.finished = True
-                    rr.outcome = "shed"
-                    rr.t_done = now
-                    self.n_submitted += 1
-                    self.n_completed += 1
-                    self.n_shed += 1
-                    if self._obs is not None:
-                        self._obs.shed(rr, "budget", now)
-                    return rr
+                    return self._shed_at_door(
+                        prompt, max_new, key, tenant, now, "budget"
+                    )
                 self.n_over_budget += 1
         rr = RoutedRequest(prompt, max_new, key, now, tenant=tenant)
         i = self._pick(prompt, routable)
@@ -925,6 +1178,27 @@ class RequestRouter:
         if self.policy == "hedge_p99":
             self._hedge.arm(rr, now + self.ttft_slo)
         self.n_submitted += 1
+        return rr
+
+    def _shed_at_door(self, prompt, max_new: int, key,
+                      tenant: str | None, now: float,
+                      reason: str) -> RoutedRequest:
+        """Refuse one request at the door BY NAME (graftcheck GC010:
+        no bare drops): the handle comes back finished with
+        ``outcome == "shed"`` and ``shed_reason`` set, counted and
+        flight-stamped, never routed."""
+        if not reason:
+            raise ValueError("a shed needs a non-empty reason")
+        rr = RoutedRequest(prompt, max_new, key, now, tenant=tenant)
+        rr.finished = True
+        rr.outcome = "shed"
+        rr.shed_reason = str(reason)
+        rr.t_done = now
+        self.n_submitted += 1
+        self.n_completed += 1
+        self.n_shed += 1
+        if self._obs is not None:
+            self._obs.shed(rr, reason, now)
         return rr
 
     def _hedge_entitled(self, rr: RoutedRequest, now: float) -> bool:
@@ -1180,6 +1454,21 @@ class RequestRouter:
             elif nt is not None and nt <= now + 1e-12:
                 r.step()
                 ticked.append(i)
+        # partitioned replicas KEEP TICKING (partition != death): their
+        # in-flight work progresses and burns capacity, but they are
+        # never in `ticked` — their first tokens and completions are
+        # unreachable until heal() reconciles. Guarded: step() is the
+        # hottest loop in a million-event day and partitions are rare,
+        # so the common case pays one falsy check, not a sort.
+        if self._partitioned:
+            for i in sorted(self._partitioned):
+                r = self.replicas[i]
+                nt = getattr(r, "next_tick_at", _NO_SCHEDULE)
+                if nt is _NO_SCHEDULE:
+                    if r.pending or r.active:
+                        r.step()
+                elif nt is not None and nt <= now + 1e-12:
+                    r.step()
         if self.clock is None:
             now = self._now()  # live: replica ticks took real time
         if ticked:
@@ -1205,6 +1494,14 @@ class RequestRouter:
         best = None
         reps = self.replicas
         for i in self._routable:
+            t = getattr(reps[i], "next_tick_at", None)
+            if t is not None and (best is None or t < best):
+                best = t
+        # a partitioned replica's ticks are events too: it keeps
+        # working through the partition, and the virtual-time driver
+        # must advance to its ticks or its in-flight work would freeze
+        # (that would be death, which a partition is not)
+        for i in self._partitioned:
             t = getattr(reps[i], "next_tick_at", None)
             if t is not None and (best is None or t < best):
                 best = t

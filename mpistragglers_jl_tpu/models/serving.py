@@ -1152,7 +1152,8 @@ class ServingScheduler:
                  quantize_kv: bool = False, temperature: float = 0.0,
                  top_k: int | None = None, page_tokens: int | None = None,
                  cache_pages: int | None = None,
-                 qos: TenantRegistry | None = None, registry=None,
+                 qos: TenantRegistry | None = None,
+                 max_queue: int | None = None, registry=None,
                  spans=None, flight=None, exporter=None):
         W = _check_ring_cfg(cfg)
         _check_sampling_params(temperature, top_k)
@@ -1188,6 +1189,17 @@ class ServingScheduler:
         self.C = int(prompt_chunk)
         self.Lmax = int(max_prompt)
         self.quantize_kv = bool(quantize_kv)
+        # queue-depth ceiling (chaos plane): the scheduler's own
+        # bounded-queue backstop. The ROUTER is the shed point (it
+        # refuses by name with a reason before a replica ever sees the
+        # request); this ceiling is the hard assertion behind it — a
+        # submit past it is a caller bug surfaced by name, never an
+        # unbounded deque.
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(
+                f"max_queue must be >= 1 or None, got {max_queue}"
+            )
+        self.max_queue = None if max_queue is None else int(max_queue)
         self._queue: deque[Request] = deque()
         # multi-tenant QoS (opt-in): admission order moves from the
         # FIFO deque to a weighted deficit-round-robin scheduler over
@@ -1341,6 +1353,12 @@ class ServingScheduler:
                 "submit(key=...) on a greedy scheduler: the key would "
                 "be silently unused — construct the scheduler with "
                 "temperature > 0 (generate_* raises the same way)"
+            )
+        if self.max_queue is not None and self.pending >= self.max_queue:
+            raise RuntimeError(
+                f"queue ceiling: {self.pending} requests already "
+                f"queued at max_queue={self.max_queue} — shed at the "
+                "router (shed_depth=) instead of queueing unboundedly"
             )
         if self._qos is not None:
             if tenant is None:

@@ -127,6 +127,28 @@ class FlightRecorder:
              {"value": v, "delta": delta})
         )
 
+    def instants(self, name: str | None = None, *,
+                 src: str | None = None) -> list[dict[str, Any]]:
+        """Snapshot the ring's INSTANT events, oldest-first, optionally
+        filtered by exact ``name`` and/or ``src`` — the in-memory half
+        of the postmortem contract. The chaos plane's "flight recorder
+        captures the episode" invariant reads this: an episode's
+        shed/partition/storm instants must be on the ring at
+        episode end, assertable without a file round-trip. Each entry:
+        ``{"name", "src", "t", **args}``."""
+        out: list[dict[str, Any]] = []
+        for kind, esrc, _track, ename, t0, _dur, args in (
+            self._entries_in_order()
+        ):
+            if kind != "I":
+                continue
+            if name is not None and ename != name:
+                continue
+            if src is not None and esrc != src:
+                continue
+            out.append({"name": ename, "src": esrc, "t": t0, **args})
+        return out
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._ring)

@@ -39,16 +39,20 @@ Wall-clock purity: graftcheck GC008 covers ``qos/`` like ``sim/`` and
 
 from .drr import DeficitScheduler
 from .tenancy import (
+    SHED_ORDER,
     SLO_CLASSES,
     TenantContract,
     TenantRegistry,
     TokenBucket,
+    shed_rank,
 )
 
 __all__ = [
+    "SHED_ORDER",
     "SLO_CLASSES",
     "DeficitScheduler",
     "TenantContract",
     "TenantRegistry",
     "TokenBucket",
+    "shed_rank",
 ]

@@ -28,9 +28,37 @@ never from an OS clock, so a tenant-mixed day replays bit-identically
 
 from __future__ import annotations
 
-__all__ = ["SLO_CLASSES", "TenantContract", "TenantRegistry", "TokenBucket"]
+__all__ = [
+    "SLO_CLASSES",
+    "SHED_ORDER",
+    "shed_rank",
+    "TenantContract",
+    "TenantRegistry",
+    "TokenBucket",
+]
 
 SLO_CLASSES = ("latency", "throughput", "batch")
+
+#: Overload shed order (chaos plane): when the fleet must drop work to
+#: keep its queues bounded, classes are shed in THIS order — batch
+#: first (its work retries), latency last (its work is a user staring
+#: at a spinner). The budget door's shed rule (only ``batch`` sheds,
+#: interactive classes are paced) is the rank-0 prefix of this order;
+#: the router's soft overload ceiling sheds rank 0, and only the hard
+#: ceiling — the bounded-queue guarantee under offered load past 1 —
+#: sheds every rank, each by name.
+SHED_ORDER = ("batch", "throughput", "latency")
+
+
+def shed_rank(cls: str) -> int:
+    """Position of an SLO class in :data:`SHED_ORDER` (0 sheds first).
+    Unknown classes are refused by name, never ranked by guess."""
+    try:
+        return SHED_ORDER.index(cls)
+    except ValueError:
+        raise ValueError(
+            f"unknown SLO class {cls!r}; choose one of {SLO_CLASSES}"
+        ) from None
 
 
 class TenantContract:
@@ -111,8 +139,17 @@ class TenantContract:
     def sheddable(self) -> bool:
         """Over-budget requests of this tenant may be dropped by name
         (``batch`` class only — batch work retries; interactive
-        classes are paced by their DRR weight instead)."""
+        classes are paced by their DRR weight instead). Under fleet
+        OVERLOAD the hard queue-depth ceiling sheds every class
+        rather than queue unboundedly — but always in
+        :data:`SHED_ORDER`, batch first, and always by name."""
         return self.cls == "batch"
+
+    @property
+    def shed_rank(self) -> int:
+        """This contract's position in :data:`SHED_ORDER` (0 sheds
+        first under overload)."""
+        return shed_rank(self.cls)
 
     def bucket(self) -> "TokenBucket | None":
         """A fresh token bucket for this contract, or None when the

@@ -44,6 +44,8 @@ from .workload import (
     Arrival,
     CoordinatorKill,
     FleetResize,
+    ReplicaPartition,
+    RetryPolicy,
     SimPrompt,
     SimReplica,
     SimRequest,
@@ -81,6 +83,8 @@ __all__ = [
     "Arrival",
     "CoordinatorKill",
     "FleetResize",
+    "ReplicaPartition",
+    "RetryPolicy",
     "SimPrompt",
     "SimRequest",
     "SimReplica",

@@ -40,6 +40,7 @@ through the same router; the arrival MODELS are reusable for both via
 
 from __future__ import annotations
 
+import heapq
 import json
 import math
 from collections import deque
@@ -47,10 +48,13 @@ from typing import Callable, Iterable, Iterator
 
 import numpy as np
 
+from ..utils.faults import _unit
 from .clock import VirtualClock
 
 __all__ = [
     "Arrival",
+    "ReplicaPartition",
+    "RetryPolicy",
     "SimPrompt",
     "SimRequest",
     "SimReplica",
@@ -454,6 +458,138 @@ class CoordinatorKill:
         return f"CoordinatorKill(t={self.t:.3f})"
 
 
+def _retry_coin(seed: int, index: int, attempt: int) -> float:
+    """Deterministic uniform [0, 1) from (seed, submit index, attempt)
+    — the retry client's seeded coin, delegated to THE fault-plane
+    coin (:func:`~..utils.faults._unit`, one implementation) but keyed
+    on the DAY-LOCAL submit index rather than a process-global request
+    id, so two replays of the same day draw identical jitter."""
+    return _unit(int(seed), int(index), int(attempt))
+
+
+class RetryPolicy:
+    """Timeout-and-resubmit client model — the classic metastable-
+    failure generator (chaos plane). A client whose request shows no
+    first token within ``timeout_s`` resubmits it as a FRESH request
+    (the original is NOT cancelled: the client cannot reach into the
+    fleet, so both copies consume capacity — that feedback is the
+    amplification), up to ``max_retries`` resubmissions per original,
+    with per-attempt timeouts stretched by ``backoff`` and resubmit
+    jitter drawn on a seeded coin keyed by (day-local submit index,
+    attempt) — the storm itself replays bit-identically. A request
+    shed at the door is NOT retried (shed is a fast, named refusal the
+    client backs off from — retrying sheds would defeat overload
+    shedding).
+
+    Consumed by :func:`run_router_day` (``retry=``); resubmissions
+    feed back into the day's arrival stream as first-class submits, so
+    every attempt appears in the :class:`WorkloadReport` (and its
+    digest) and ``n_resubmits`` counts the amplification."""
+
+    __slots__ = ("timeout_s", "max_retries", "backoff", "jitter_s",
+                 "seed")
+
+    def __init__(self, timeout_s: float, *, max_retries: int = 3,
+                 backoff: float = 1.0, jitter_s: float = 0.0,
+                 seed: int = 0):
+        if timeout_s <= 0:
+            raise ValueError(
+                f"timeout_s must be > 0, got {timeout_s}"
+            )
+        if max_retries < 0:
+            raise ValueError(
+                f"max_retries must be >= 0, got {max_retries}"
+            )
+        if backoff < 1.0:
+            raise ValueError(
+                f"backoff must be >= 1 (timeouts never shrink), got "
+                f"{backoff}"
+            )
+        if jitter_s < 0:
+            raise ValueError(
+                f"jitter_s must be >= 0, got {jitter_s}"
+            )
+        self.timeout_s = float(timeout_s)
+        self.max_retries = int(max_retries)
+        self.backoff = float(backoff)
+        self.jitter_s = float(jitter_s)
+        self.seed = int(seed)
+
+    def resubmit_at(self, t_submit: float, index: int,
+                    attempt: int) -> float:
+        """When attempt ``attempt`` (0 = the original) submitted at
+        ``t_submit`` would be resubmitted: its timeout plus the seeded
+        jitter coin."""
+        due = t_submit + self.timeout_s * self.backoff ** attempt
+        if self.jitter_s:
+            due += self.jitter_s * _retry_coin(
+                self.seed, index, attempt
+            )
+        return due
+
+    def __repr__(self) -> str:
+        return (
+            f"RetryPolicy(timeout_s={self.timeout_s}, "
+            f"max_retries={self.max_retries}, "
+            f"backoff={self.backoff}, jitter_s={self.jitter_s})"
+        )
+
+
+class ReplicaPartition:
+    """Control-plane event in the simulated day's event stream: at
+    virtual time ``t`` the router loses network reachability to the
+    named ``replicas`` (a partition is distinct from death — the
+    replicas keep ticking, their results are simply unreachable:
+    :meth:`~..models.router.RequestRouter.partition`), and at
+    ``until`` the partition heals — the replicas rejoin through
+    :meth:`~..models.router.RequestRouter.heal`, which withdraws
+    every stale leg so no request is double-retired. The heal is
+    scheduled on the router's clock at fire time, so it lands exactly
+    on time in the same event-driven drive loop as kill/recover
+    injections."""
+
+    __slots__ = ("t", "replicas", "until")
+
+    def __init__(self, t: float, replicas, until: float):
+        self.t = float(t)
+        self.replicas = (
+            [int(replicas)]
+            if isinstance(replicas, (int, np.integer))
+            else [int(i) for i in replicas]
+        )
+        if not self.replicas:
+            raise ValueError("ReplicaPartition with no replicas")
+        self.until = float(until)
+        if self.until <= self.t:
+            raise ValueError(
+                f"partition must heal after it begins: t={self.t}, "
+                f"until={self.until}"
+            )
+
+    def fire(self, router, controller) -> None:
+        clock = router.clock
+        if clock is None:
+            raise ValueError(
+                "ReplicaPartition event needs a VirtualClock router: "
+                "a live fleet's partitions come from the network, not "
+                "the event stream"
+            )
+        for i in self.replicas:
+            router.partition(i)
+
+        def _heal():
+            for i in self.replicas:
+                router.heal(i)
+
+        clock.call_at(self.until, _heal)
+
+    def __repr__(self) -> str:
+        return (
+            f"ReplicaPartition(t={self.t:.3f}, "
+            f"replicas={self.replicas}, until={self.until:.3f})"
+        )
+
+
 class lognormal_ticks:
     """Deterministic per-tick service-time jitter:
     ``tick_s(tick) = base * exp(sigma * N(0,1))`` with the normals
@@ -583,10 +719,15 @@ class SimReplica:
                  prompt_chunk: int = 256, tier: str = "unified",
                  chunk_s: float = 0.0,
                  kv_bytes_per_token: float = 4096.0,
-                 page_tokens: int = 16, qos=None):
+                 page_tokens: int = 16, qos=None,
+                 max_queue: int | None = None):
         if slots < 1 or n_inner < 1 or prompt_chunk < 1:
             raise ValueError(
                 "slots, n_inner and prompt_chunk must be >= 1"
+            )
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(
+                f"max_queue must be >= 1 or None, got {max_queue}"
             )
         if tier not in ("unified", "prefill", "decode"):
             raise ValueError(
@@ -615,6 +756,10 @@ class SimReplica:
         self.n_inner = int(n_inner)
         self.C = int(prompt_chunk)
         self.tier = tier
+        # the scheduler-side bounded-queue backstop (chaos plane):
+        # mirrors ServingScheduler(max_queue=) — the router sheds by
+        # name first; this is the hard assertion behind it
+        self.max_queue = None if max_queue is None else int(max_queue)
         self.chunk_s = float(chunk_s)
         self.kv_bytes_per_token = float(kv_bytes_per_token)
         self.page_tokens = int(page_tokens)
@@ -658,6 +803,12 @@ class SimReplica:
             raise RuntimeError(
                 "submit to a killed SimReplica: the router must not "
                 "route to an unroutable replica"
+            )
+        if self.max_queue is not None and self.pending >= self.max_queue:
+            raise RuntimeError(
+                f"queue ceiling: {self.pending} requests already "
+                f"queued at max_queue={self.max_queue} — shed at the "
+                "router (shed_depth=) instead of queueing unboundedly"
             )
         if isinstance(prompt, int):
             prompt = SimPrompt(prompt)
@@ -943,10 +1094,19 @@ class WorkloadReport:
     of the same scenario must agree on."""
 
     def __init__(self, requests: list, virtual_s: float, router,
-                 controller=None):
+                 controller=None, n_resubmits: int = 0):
         self.requests = requests
         self.n = len(requests)
         self.virtual_s = float(virtual_s)
+        # chaos-plane counters, all OUTSIDE digest() (the bit-identity
+        # witness keeps its latency-array definition): retry-client
+        # resubmissions, partition begins/heals, and stale legs the
+        # heals withdrew
+        self.n_resubmits = int(n_resubmits)
+        self.n_partitions = getattr(router, "n_partitions", 0)
+        self.n_stale_cancelled = getattr(
+            router, "n_stale_cancelled", 0
+        )
         # control-plane counters (0 without a controller): how often
         # the fleet resized and how many coordinator takeovers the day
         # survived. NOT part of digest() — the bit-identity witness
@@ -969,8 +1129,12 @@ class WorkloadReport:
             [r.latency for r in served], np.float64
         )
         self.outcomes: dict[str, int] = {}
+        self.shed_reasons: dict[str, int] = {}
         for r in requests:
             self.outcomes[r.outcome] = self.outcomes.get(r.outcome, 0) + 1
+            sr = getattr(r, "shed_reason", None)
+            if sr is not None:
+                self.shed_reasons[sr] = self.shed_reasons.get(sr, 0) + 1
         self.n_hedges = router.n_hedges
         self.n_rerouted = router.n_rerouted
         self.n_migrated = getattr(router, "n_migrated", 0)
@@ -1057,7 +1221,7 @@ class WorkloadReport:
 
 def run_router_day(
     router, arrivals: Iterable[Arrival], *,
-    controller=None, events: Iterable = (),
+    controller=None, events: Iterable = (), retry: RetryPolicy | None = None,
 ) -> WorkloadReport:
     """Drive a virtual-time :class:`~..models.router.RequestRouter`
     through an arrival stream to completion: advance the clock to each
@@ -1079,7 +1243,16 @@ def run_router_day(
     (:class:`FleetResize`, :class:`CoordinatorKill`) into the stream;
     an event due at ``t`` fires before an arrival stamped ``t``. With
     neither, the drive loop is byte-for-byte the pre-round-18 one, so
-    recorded digests still hold."""
+    recorded digests still hold.
+
+    ``retry=`` attaches a :class:`RetryPolicy` client model (chaos
+    plane): a submitted request showing no first token by its timeout
+    is resubmitted as a fresh arrival feeding back into THIS day's
+    stream on the policy's seeded coin — the retry storm replays
+    bit-identically, every attempt lands in the report (and its
+    digest), and ``WorkloadReport.n_resubmits`` counts the
+    amplification. Shed requests are never retried. ``retry=None``
+    keeps the drive loop event-for-event the pre-round-20 one."""
     clock = router.clock
     if clock is None:
         raise ValueError(
@@ -1092,6 +1265,11 @@ def run_router_day(
     # clock.next_event() measured ~8% of a million-request day
     heap = clock._heap
     ctl = controller
+    # retry-client state (chaos plane): a heap of (due, submit-index,
+    # request, attempt) timeout checks; empty and untouched when
+    # retry=None, keeping the drive loop event-for-event pre-round-20
+    rheap: list = []
+    n_resubmits = 0
 
     def next_at():
         nt = router.next_event_at()
@@ -1103,6 +1281,10 @@ def run_router_day(
             ct = ctl.next_event_at()
             if ct is not None and (nt is None or ct < nt):
                 nt = ct
+        if rheap:
+            rt = rheap[0][0]
+            if nt is None or rt < nt:
+                nt = rt
         return nt
 
     submitted = []
@@ -1121,6 +1303,38 @@ def run_router_day(
     # next_at(), so the incremental path never skips past it)
     nt = next_at()
 
+    def arm_retry(rr, attempt):
+        # park the client's timeout check; the due time (timeout +
+        # seeded jitter) is an event the driver advances to exactly
+        nonlocal nt
+        idx = router.n_submitted  # day-local, deterministic
+        due = retry.resubmit_at(rr.t_submit, idx, attempt)
+        heapq.heappush(rheap, (due, idx, rr, attempt))
+        if nt is None or due < nt:
+            nt = due
+
+    def fire_retries():
+        # due timeout checks: a request still showing no first token
+        # is resubmitted as a fresh arrival (feedback — the storm);
+        # resolved or exhausted chains just expire
+        nonlocal n_resubmits
+        now_v = clock.now()
+        while rheap and rheap[0][0] <= now_v + 1e-12:
+            _due, _idx, rr0, attempt = heapq.heappop(rheap)
+            if rr0.finished or rr0.t_first_token is not None:
+                continue
+            if attempt + 1 > retry.max_retries:
+                continue
+            rr = submit(rr0.prompt, rr0.max_new, key=rr0.key,
+                        tenant=rr0.tenant)
+            append(rr)
+            n_resubmits += 1
+            if ctl is not None:
+                ctl.observe_arrival(now_v)
+            if rr.finished:
+                continue  # shed at the door: the client backs off
+            arm_retry(rr, attempt + 1)
+
     def advance_to(t):
         # step the fleet (and the controller, when attached) at every
         # due tick up to virtual time t, then land exactly on t
@@ -1130,6 +1344,8 @@ def run_router_day(
             step()
             if ctl is not None:
                 ctl.step()
+            if rheap:
+                fire_retries()
             nt = next_at()
         run_until(t)
 
@@ -1152,6 +1368,8 @@ def run_router_day(
             step()
             if ctl is not None:
                 ctl.step()
+            if rheap:
+                fire_retries()
             nt = next_at()
         run_until(at)
         rr = submit(a.prompt, a.max_new, tenant=a.tenant)
@@ -1167,6 +1385,8 @@ def run_router_day(
             d = rr.t_submit + slo
             if nt is None or d < nt:
                 nt = d
+        if retry is not None:
+            arm_retry(rr, 0)
     if ei < n_evs:
         # events past the last arrival (an end-of-day kill, a scale-in
         # order): fire them at their times, stepping normally between
@@ -1190,6 +1410,8 @@ def run_router_day(
         inflight_before = router.in_flight
         clock.run_until(nt)
         router.step()
+        if rheap:
+            fire_retries()
         if ctl is not None:
             ctl.step()
             if (
@@ -1208,4 +1430,5 @@ def run_router_day(
                     )
             else:
                 barren = 0
-    return WorkloadReport(submitted, clock.now(), router, ctl)
+    return WorkloadReport(submitted, clock.now(), router, ctl,
+                          n_resubmits=n_resubmits)

@@ -33,6 +33,10 @@ GC008  wall-clock           sim modules never read the OS clock; no
 GC009  protocol-drift       transport.py KIND_* table and ctypes
                             argtypes/restype match transport.cpp's
                             constexpr constants and msgt_* signatures
+GC010  shed-by-name         no bare drops: shed outcomes carry a
+                            sibling shed_reason, shed/drop calls carry
+                            an identifiable reason, and a literal
+                            None/empty reason is flagged
 ====== ==================== ==========================================
 """
 
@@ -46,4 +50,5 @@ from . import (  # noqa: F401  (import == register)
     gc007_slot_lifetime,
     gc008_wall_clock,
     gc009_protocol_drift,
+    gc010_shed_by_name,
 )

@@ -131,14 +131,17 @@ def _is_sim_module(mod: ModuleInfo) -> bool:
     ``test_sim*`` virtual-time test family, round 18's ``fleet``
     package (the control plane's decision code must be drivable by
     VirtualClock — a controller day replays bit-identically in
-    tier-1), and — round 19 — any ``qos`` package component: tenant
-    buckets refill and deficit rotations advance only from the ``now``
-    the caller injects, so a tenant-mixed day replays bit-identically;
-    wall seconds enter through the call site's clock argument, never
-    an OS-clock import."""
+    tier-1), round 19's ``qos`` package (tenant buckets refill and
+    deficit rotations advance only from the ``now`` the caller
+    injects), and — round 20 — any ``chaos`` package component: an
+    adversarial episode's whole value is its bit-identical replay, so
+    scenario timing comes from the scenario's seed and the virtual
+    clock, never an OS-clock import."""
     parts = mod.name.split(".")
-    return "sim" in parts or "fleet" in parts or "qos" in parts or any(
-        p.startswith("test_sim") for p in parts
+    return (
+        "sim" in parts or "fleet" in parts or "qos" in parts
+        or "chaos" in parts
+        or any(p.startswith("test_sim") for p in parts)
     )
 
 
@@ -147,10 +150,10 @@ class WallClock(Checker):
     rule = "GC008"
     name = "wall-clock"
     description = (
-        "sim-, fleet-, and qos-package modules never read the OS "
-        "clock (time.time/perf_counter/monotonic/sleep, datetime.now) "
-        "— virtual time, control-plane decisions, and tenant budgets "
-        "stay clock-injected; "
+        "sim-, fleet-, qos-, and chaos-package modules never read the "
+        "OS clock (time.time/perf_counter/monotonic/sleep, "
+        "datetime.now) — virtual time, control-plane decisions, "
+        "tenant budgets, and chaos episodes stay clock-injected; "
         "no assert compares a wall-clock-derived value against a "
         "sub-second margin — port the claim to "
         "SimBackend/VirtualClock or mark the one sanctioned "
@@ -214,7 +217,7 @@ class WallClock(Checker):
                 ):
                     yield mod.finding(
                         self.rule, node,
-                        "virtual-time-plane module (sim/fleet/qos) "
+                        "virtual-time-plane module (sim/fleet/qos/chaos) "
                         "imports OS-clock names from `time` — it must "
                         "not read the wall clock (sim/clock.py is the "
                         "only clock; fleet code takes timer= from the "
@@ -228,7 +231,7 @@ class WallClock(Checker):
                     yield mod.finding(
                         self.rule, node,
                         f"`{'.'.join(path)}` in a virtual-time-plane "
-                        "module (sim/fleet/qos) — it must stay "
+                        "module (sim/fleet/qos/chaos) — it must stay "
                         "wall-clock-free (bit-reproducibility is the "
                         "whole contract); take the VirtualClock (or "
                         "the injected timer=) instead",

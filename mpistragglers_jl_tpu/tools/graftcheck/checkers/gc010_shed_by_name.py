@@ -1,0 +1,183 @@
+"""GC010: shed-by-name — no code path drops or sheds a request
+without a string reason.
+
+The chaos plane's survival contract says every refused request is
+NAMED: an operator debugging a storm must be able to read *why* each
+request was dropped off the counters and the flight ring, and a
+"bare drop" — work vanishing with no reason attached — is
+indistinguishable from a bug. The runtime convention (the
+``RequestRouter._shed_at_door`` / ``_RouterObs.shed`` shapes) is
+statically enforced here, per function:
+
+1. **Shed outcomes carry a reason.** An assignment of the literal
+   ``"shed"`` to an ``outcome`` attribute (``rr.outcome = "shed"``)
+   must be accompanied — in the same function — by an assignment of a
+   non-trivial value to a ``shed_reason`` attribute. The request
+   itself carries the name, so the reason exists even on a DARK
+   router (obs is opt-in; the reason is not).
+
+2. **Shed/drop calls carry a reason.** A call whose callee names a
+   shed/drop ACTION must pass a syntactically identifiable reason: a
+   ``reason=`` keyword, a non-empty string literal positional, or a
+   positional name whose identifier contains ``reason``. A literal
+   ``reason=None`` / ``reason=""`` is a bare drop wearing a costume
+   and is flagged the same. The matched-name grammar is the
+   door-verb convention (underscores stripped at the front): the bare
+   verb (``obs.shed(...)``, ``queue.drop(...)``), ``shed_at_*`` /
+   ``drop_at_*`` (the ``_shed_at_door`` shape), and
+   ``shed_*request*`` / ``drop_*request*``. Helpers that merely
+   compute ABOUT shedding (``shed_rank``, ``_check_shed_order``) or
+   drop non-request state (``_drop_cache``, ``_drop_tombstones``)
+   are outside the contract and outside the grammar.
+
+3. **Reasons are never trivially empty.** Assigning ``None`` or
+   ``""`` to a ``shed_reason`` attribute is flagged (clearing state
+   at construction is fine — rule 3 only fires inside functions that
+   also shed, i.e. contain a rule-1 site or a rule-2 call).
+
+Suppressions and baselining ride the shared machinery
+(``# graftcheck: disable=GC010``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, Finding, ModuleInfo, dotted_path, register
+
+#: the door verbs
+_SHED_VERBS = ("shed", "drop")
+
+
+def _callee_name(call: ast.Call) -> str | None:
+    path = dotted_path(call.func)
+    return path[-1] if path else None
+
+
+def _is_shed_call(name: str) -> bool:
+    """The door-verb naming grammar (module docstring): the bare
+    verb, ``<verb>_at_*``, or ``<verb>_*request*`` — NOT every name
+    containing the word (``shed_rank`` computes about shedding;
+    ``_drop_cache`` drops cache state, not a request)."""
+    n = name.lower().lstrip("_")
+    for verb in _SHED_VERBS:
+        if n == verb:
+            return True
+        if n.startswith(verb + "_at_"):
+            return True
+        if n.startswith(verb + "_") and "request" in n:
+            return True
+    return False
+
+
+def _is_trivial(expr: ast.expr) -> bool:
+    """Literal None or empty string — a reason in name only."""
+    return isinstance(expr, ast.Constant) and (
+        expr.value is None or expr.value == ""
+    )
+
+
+def _carries_reason(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "reason":
+            return not _is_trivial(kw.value)
+    for a in call.args:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str) \
+                and a.value:
+            return True
+        if isinstance(a, ast.Name) and "reason" in a.id.lower():
+            return True
+        if isinstance(a, ast.Attribute) and "reason" in a.attr.lower():
+            return True
+    return False
+
+
+@register
+class ShedByName(Checker):
+    rule = "GC010"
+    name = "shed-by-name"
+    description = (
+        "every dropped/shed request carries a string reason: "
+        "`outcome = \"shed\"` assignments need a sibling shed_reason "
+        "assignment, shed/drop calls need a reason= kwarg or a "
+        "string-literal/`*reason*`-named positional, and a literal "
+        "None/empty reason is a bare drop"
+    )
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        # token gate: a module whose source never says "shed" or
+        # "drop" cannot produce a finding
+        low = mod.source.lower()
+        if "shed" not in low and "drop" not in low:
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(mod, node)
+
+    def _check_function(
+        self, mod: ModuleInfo, fn: ast.AST
+    ) -> Iterator[Finding]:
+        # ONE traversal over this function's own nodes collects
+        # everything (nested defs are skipped — they are visited on
+        # their own by check_module, so a nested def's calls are
+        # attributed to IT, once): re-walking each collected
+        # statement with ast.walk double-counted calls nested inside
+        # compound statements (the If's walk AND the Expr's own —
+        # review finding, pinned by the nested-call fixture lines)
+        shed_outcomes: list[ast.Assign] = []
+        reason_assigns: list[tuple[ast.Assign, bool]] = []  # (stmt, trivial)
+        shed_calls: list[ast.Call] = []
+        stack: list[ast.AST] = list(fn.body)
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(cur, ast.Assign):
+                for t in cur.targets:
+                    if not isinstance(t, ast.Attribute):
+                        continue
+                    if t.attr == "outcome" and isinstance(
+                        cur.value, ast.Constant
+                    ) and cur.value.value == "shed":
+                        shed_outcomes.append(cur)
+                    elif t.attr == "shed_reason":
+                        reason_assigns.append(
+                            (cur, _is_trivial(cur.value))
+                        )
+            elif isinstance(cur, ast.Call):
+                name = _callee_name(cur)
+                if name is not None and _is_shed_call(name):
+                    shed_calls.append(cur)
+            for child in ast.iter_child_nodes(cur):
+                stack.append(child)
+
+        sheds_here = bool(shed_outcomes or shed_calls)
+        good_reason = any(not triv for _s, triv in reason_assigns)
+        for stmt in shed_outcomes:
+            if not good_reason:
+                yield mod.finding(
+                    self.rule, stmt,
+                    'sets outcome = "shed" with no sibling '
+                    "shed_reason assignment: the request must carry "
+                    "its reason even on a dark router (no bare drops)",
+                )
+        for call in shed_calls:
+            if not _carries_reason(call):
+                name = _callee_name(call)
+                yield mod.finding(
+                    self.rule, call,
+                    f"shed/drop call `{name}(...)` carries no "
+                    "identifiable reason: pass reason=, a non-empty "
+                    "string literal, or a *reason*-named variable "
+                    "(no bare drops)",
+                )
+        if sheds_here:
+            for stmt, triv in reason_assigns:
+                if triv:
+                    yield mod.finding(
+                        self.rule, stmt,
+                        "assigns a trivially empty shed_reason "
+                        "(None/\"\") in a function that sheds: a "
+                        "reason in name only is a bare drop",
+                    )

@@ -40,6 +40,8 @@ __all__ = [
     "intermittent",
     "dead_from",
     "kill_group",
+    "correlated_kill",
+    "partition",
     "from_trace",
     "compose",
     "failing",
@@ -190,6 +192,103 @@ class kill_group:
     def __call__(self, worker: int, epoch: int) -> float:
         e0 = self._dead_from.get(int(worker))
         return self.delay if e0 is not None and epoch >= e0 else 0.0
+
+
+class correlated_kill:
+    """Correlated whole-host failure: a contiguous SPAN of host groups
+    dies at one epoch — the blast-radius model of a shared rack, power
+    domain, or top-of-rack switch, where "one host died" is the
+    fair-weather case and the chaos plane's case is "its neighbors
+    went with it".
+
+    ``groups`` is the worker partition (the
+    :func:`~..parallel.multihost.host_groups` shape);
+    ``epicenter`` names the first dead group and ``span`` how many
+    consecutive groups the failure domain covers (clamped at the
+    partition's end — a blast at the last rack does not wrap).
+    Delegates the per-worker schedule to :class:`kill_group`, so death
+    semantics (arbitrarily long stall from ``at_epoch`` onward) and
+    picklability are exactly the single-host case's. Pure in
+    ``(worker, epoch)``: a correlated-failure episode replays
+    bit-identically on :class:`~..sim.backend.SimBackend`.
+
+    >>> sched = faults.correlated_kill(host_groups(32, n_hosts=8),
+    ...                                epicenter=2, at_epoch=10, span=3)
+    """
+
+    def __init__(self, groups, *, epicenter: int, at_epoch: int,
+                 span: int = 2, delay: float = 3600.0):
+        n_groups = len(list(groups))
+        if not 0 <= int(epicenter) < n_groups:
+            raise ValueError(
+                f"epicenter names group {epicenter}, but the partition "
+                f"has {n_groups} groups"
+            )
+        if span < 1:
+            raise ValueError(f"span must be >= 1, got {span}")
+        self.killed_groups = list(
+            range(int(epicenter), min(int(epicenter) + int(span),
+                                      n_groups))
+        )
+        self._inner = kill_group(
+            groups, {g: int(at_epoch) for g in self.killed_groups},
+            delay=delay,
+        )
+        self.at_epoch = int(at_epoch)
+        self.delay = float(delay)
+
+    def __call__(self, worker: int, epoch: int) -> float:
+        return self._inner(worker, epoch)
+
+
+class partition:
+    """Network partition: every worker of the named groups is
+    unreachable — but NOT dead — for epochs in
+    ``[from_epoch, until_epoch)``, then recovers.
+
+    A partition is distinct from :class:`kill_group` death in exactly
+    the way the chaos plane needs stated: the workers keep computing,
+    their results simply cannot cross the partition, and when it heals
+    they answer again with no respawn. Modelled as a stall bounded by
+    the partition's width (never the kill schedules' arbitrarily long
+    one): a worker dispatched at epoch ``e`` inside the window stalls
+    until the window closes. ``groups`` here is the sequence of
+    worker-index sequences that ARE partitioned (pass a sub-list of
+    the fleet partition). Pure in ``(worker, epoch)`` given
+    ``epoch_s`` (the caller's virtual epoch pitch used to convert the
+    remaining window width to stall seconds), a class so it pickles
+    into process-backend workers.
+
+    >>> sched = faults.partition([hosts[2], hosts[3]], from_epoch=10,
+    ...                          until_epoch=16, epoch_s=0.1)
+    """
+
+    def __init__(self, groups, from_epoch: int, until_epoch: int, *,
+                 epoch_s: float = 1.0):
+        if until_epoch <= from_epoch:
+            raise ValueError(
+                f"need from_epoch < until_epoch, got "
+                f"[{from_epoch}, {until_epoch})"
+            )
+        if epoch_s <= 0:
+            raise ValueError(f"epoch_s must be > 0, got {epoch_s}")
+        self._members = sorted(
+            {int(w) for grp in groups for w in grp}
+        )
+        self._member_set = frozenset(self._members)
+        self.from_epoch = int(from_epoch)
+        self.until_epoch = int(until_epoch)
+        self.epoch_s = float(epoch_s)
+
+    def __call__(self, worker: int, epoch: int) -> float:
+        if int(worker) not in self._member_set:
+            return 0.0
+        e = int(epoch)
+        if not self.from_epoch <= e < self.until_epoch:
+            return 0.0
+        # stalled until the window closes: the result arrives the
+        # moment the partition heals, never sooner and never lost
+        return (self.until_epoch - e) * self.epoch_s
 
 
 class from_trace:
@@ -371,6 +470,26 @@ class FaultSchedule:
         return self._add(
             kill_group(groups, kills),
             f"kill_group({dict(kills)})",
+        )
+
+    def correlated_kill(
+        self, groups, *, epicenter: int, at_epoch: int, span: int = 2
+    ) -> "FaultSchedule":
+        return self._add(
+            correlated_kill(groups, epicenter=epicenter,
+                            at_epoch=at_epoch, span=span),
+            f"correlated_kill(epicenter={epicenter},"
+            f"at={at_epoch},span={span})",
+        )
+
+    def partition(
+        self, groups, from_epoch: int, until_epoch: int, *,
+        epoch_s: float = 1.0
+    ) -> "FaultSchedule":
+        return self._add(
+            partition(groups, from_epoch, until_epoch,
+                      epoch_s=epoch_s),
+            f"partition([{from_epoch},{until_epoch}))",
         )
 
     @property

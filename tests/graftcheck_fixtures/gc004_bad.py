@@ -89,3 +89,12 @@ def qos_admit(tenant, registry=None, flight=None):
     registry.counter("qos_admitted_total").inc()  # GC004 line 89
     flight.event("qos reclaim", tenant=tenant)  # GC004 line 90
     return tenant
+
+
+def chaos_inject(episode, registry=None, flight=None):
+    # the round-20 chaos-plane telemetry shape: counting a completed
+    # episode and stamping the begin/end instants without the None
+    # guards
+    registry.counter("chaos_episodes_total").inc()  # GC004 line 98
+    flight.event("chaos episode", scenario=episode)  # GC004 line 99
+    return episode

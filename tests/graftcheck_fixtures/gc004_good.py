@@ -160,3 +160,16 @@ def qos_admit(tenant, registry=None, flight=None):
         registry.histogram("qos_ttft_seconds").observe(0.0)
     ok = flight is not None and flight.event("qos reclaim")
     return tenant if ok else None
+
+
+def chaos_inject(episode, registry=None, flight=None):
+    """The round-20 chaos-plane telemetry shape, guarded: the episode
+    and probe counters, the peak-depth gauge, and the begin/end flight
+    instants only fire inside the is-not-None arms
+    (chaos/injector.py ChaosInjector._emit discipline)."""
+    if registry is not None:
+        registry.counter("chaos_episodes_total").inc()
+        registry.counter("chaos_invariant_probes_total").inc(0)
+        registry.gauge("chaos_max_queue_depth").set(episode)
+    ok = flight is not None and flight.event("chaos episode")
+    return episode if ok else None

@@ -1,0 +1,14 @@
+"""GC008 bad fixture, chaos half: an episode probe that secretly
+reads the OS clock — a chaos scenario timed off the wall can never
+replay bit-identically, which is the plane's whole witness. Violation
+lines pinned by the fixture test."""
+
+import time
+
+
+def probe(router, state):
+    now = time.monotonic()  # GC008: OS clock in an episode probe
+    if router.in_flight and now - state["last"] > 30.0:
+        raise AssertionError("deadlock")
+    state["last"] = time.perf_counter()  # GC008
+    return now

@@ -1,0 +1,12 @@
+"""GC008 good fixture, chaos half: episode probes on the injected
+virtual clock only — the ChaosInjector discipline (``now`` comes from
+the scenario's VirtualClock, timing from the scenario's seed), so an
+episode that fails replays bit-identically."""
+
+
+def probe(router, state, clock):
+    now = clock.now()
+    if router.in_flight and now - state["last"] > 30.0:
+        raise AssertionError("deadlock")
+    state["last"] = now
+    return now

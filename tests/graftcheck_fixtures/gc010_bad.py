@@ -1,0 +1,41 @@
+"""GC010 bad fixture: bare drops. Violation lines pinned by the
+fixture test."""
+
+
+def shed_overload(rr, book):
+    rr.outcome = "shed"  # GC010 line 6: no sibling shed_reason
+    book.pop(rr, None)
+    return rr
+
+
+def refuse(obs, rr):
+    obs.shed(rr)  # GC010 line 12: shed call with no reason
+    return rr
+
+
+def refuse_masked(obs, rr):
+    obs.shed(rr, reason=None)  # GC010 line 17: reason in name only
+    return rr
+
+
+def drop_request(queue, rr):
+    queue.drop(rr, "")  # GC010 line 22: empty string is not a reason
+    return rr
+
+
+def shed_with_empty_stamp(rr):
+    rr.outcome = "shed"
+    rr.shed_reason = None  # GC010 lines 27+28: trivial reason
+    return rr
+
+
+def shed_nested(obs, rr, cond):
+    if cond:
+        obs.shed(rr)  # GC010 line 34: ONE finding, not one per level
+    return rr
+
+
+def outer_with_nested(obs, rr):
+    def inner():
+        obs.shed(rr)  # GC010 line 40: attributed to inner, once
+    return inner

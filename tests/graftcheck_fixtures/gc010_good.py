@@ -1,0 +1,42 @@
+"""GC010 good fixture: every shed shape the rule accepts."""
+
+
+def shed_at_door(rr, reason):
+    """The RequestRouter._shed_at_door shape: the request carries its
+    reason, dark or not."""
+    rr.outcome = "shed"
+    rr.shed_reason = str(reason)
+    return rr
+
+
+def refuse(obs, rr, reason):
+    """A *reason*-named positional is identifiable."""
+    obs.shed(rr, reason, 0.0)
+    return rr
+
+
+def refuse_literal(obs, rr):
+    """A non-empty string literal positional is identifiable."""
+    obs.shed(rr, "overload")
+    return rr
+
+
+def refuse_kw(queue, rr, why):
+    """reason= with any non-trivial expression passes."""
+    queue.drop(rr, reason=f"quota:{why}")
+    return rr
+
+
+def constructor_clear(rr):
+    """Clearing shed_reason where nothing sheds is construction-time
+    state, not a drop (rule 3 fires only in functions that shed)."""
+    rr.shed_reason = None
+    rr.outcome = None
+    return rr
+
+
+def unrelated(book, rr):
+    """`dropped`/`hedge` are not shed words (segment match, not
+    substring)."""
+    book.dropped_total = 1
+    return rr

@@ -95,6 +95,23 @@ def test_multi_tenant_demo_example():
     assert "multi-tenant qos ok" in out.stdout
 
 
+def test_chaos_demo_example():
+    """The round-20 chaos walkthrough: three catalog episodes through
+    the injector with invariants armed — overload shed by name, the
+    storm + correlated kill + partition combo with non-metastable
+    recovery, and the PagePool churn — plus the bit-identical replay
+    digest. Numpy-only virtual time, so it runs in tier-1."""
+    out = _run_example("chaos_demo.py")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "all by name (100% named)" in out.stdout
+    assert "client resubmissions (the storm):" in out.stdout
+    assert "partitions begun/healed: 2" in out.stdout
+    assert "drops: 0" in out.stdout
+    assert "invariants held:" in out.stdout
+    assert "replayed bit-identically" in out.stdout
+    assert "chaos demo ok" in out.stdout
+
+
 def test_device_coord_demo_example():
     """The round-17 device-coordination walkthrough: the host-loop vs
     fused-K=64 overhead race plus the bit-identical straggling-fleet

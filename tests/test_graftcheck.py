@@ -64,11 +64,26 @@ def _keys(findings):
                           ("GC004", 63), ("GC004", 64),
                           ("GC004", 71), ("GC004", 72),
                           ("GC004", 80), ("GC004", 81),
-                          ("GC004", 89), ("GC004", 90)]),
+                          ("GC004", 89), ("GC004", 90),
+                          ("GC004", 98), ("GC004", 99)]),
         (
             "gc005_bad.py",
             [("GC005", 17), ("GC005", 18), ("GC005", 21),
              ("GC005", 22)],
+        ),
+        (
+            # the round-20 shed-by-name contract: bare drops at exact
+            # lines — outcome="shed" with no shed_reason sibling (6,
+            # 27), reason-less/None/empty shed and drop calls (12, 17,
+            # 22), the trivially empty reason stamp (28), a call
+            # nested inside a compound statement reported ONCE (34 —
+            # the per-statement re-walk double-counted it, review
+            # finding), and a nested def's call attributed to the
+            # inner function once (40)
+            "gc010_bad.py",
+            [("GC010", 6), ("GC010", 12), ("GC010", 17),
+             ("GC010", 22), ("GC010", 27), ("GC010", 28),
+             ("GC010", 34), ("GC010", 40)],
         ),
     ],
 )
@@ -81,7 +96,8 @@ def test_bad_fixture_exact_findings(bad, expected):
 @pytest.mark.parametrize(
     "good",
     ["gc001_good_pkg", "gc001_hermetic_good_pkg", "gc002_good.py",
-     "gc003_good.py", "gc004_good.py", "gc005_good.py"],
+     "gc003_good.py", "gc004_good.py", "gc005_good.py",
+     "gc010_good.py"],
 )
 def test_good_fixture_clean(good):
     res = _findings(good)
@@ -127,7 +143,8 @@ def test_baseline_roundtrip(tmp_path):
                                 ("GC004", 63), ("GC004", 64),
                                 ("GC004", 71), ("GC004", 72),
                                 ("GC004", 80), ("GC004", 81),
-                                ("GC004", 89), ("GC004", 90)]
+                                ("GC004", 89), ("GC004", 90),
+                                ("GC004", 98), ("GC004", 99)]
     assert res.baseline_size == 1
 
 
@@ -445,7 +462,8 @@ def test_package_self_run_is_clean():
 
     res = run([_PKG], baseline_path=DEFAULT_BASELINE)
     assert res.ok, "\n".join(f.format() for f in res.fresh)
-    assert res.n_rules == 9  # GC001-GC005 + the v2 set (ISSUE 8)
+    # GC001-GC005 + the v2 set (ISSUE 8) + GC010 shed-by-name (r20)
+    assert res.n_rules == 10
     assert res.n_files > 50  # the whole package, not a subset
 
 
@@ -500,7 +518,7 @@ def test_cli_exit_codes():
     rules = cli("--list-rules")
     assert rules.returncode == 0
     for rule in ("GC001", "GC002", "GC003", "GC004", "GC005",
-                 "GC006", "GC007", "GC008", "GC009"):
+                 "GC006", "GC007", "GC008", "GC009", "GC010"):
         assert rule in rules.stdout
 
 

@@ -61,7 +61,9 @@ def _keys(findings):
         ),
         (
             "gc008_bad_pkg",
-            [("GC008", 13), ("GC008", 23),
+            [("GC008", 10), ("GC008", 13),  # chaos/: OS clock in an
+             # episode probe — the round-20 chaos-plane purity
+             ("GC008", 13), ("GC008", 23),
              ("GC008", 9), ("GC008", 12),  # fleet/: OS clock in a
              # decision function — the round-18 control-plane purity
              ("GC008", 10), ("GC008", 13),  # qos/: OS clock in a
@@ -198,6 +200,25 @@ def test_gc008_covers_the_fleet_package():
         if os.sep + "fleet" + os.sep in f.path
     ]
     assert fleet_hits == [("GC008", 9), ("GC008", 12)], [
+        f.format() for f in bad.fresh
+    ]
+
+
+def test_gc008_covers_the_chaos_package():
+    """Round-20: the chaos plane joined the virtual-time plane — the
+    shipped chaos/ package is clean under GC008's purity half (an
+    episode's timing comes from the scenario's seed and the injected
+    VirtualClock, never the OS clock: bit-identical replay is the
+    plane's whole witness), and the fixture's chaos twin pins the
+    OS-clock-in-an-episode-probe leak shape by line."""
+    res = run([os.path.join(_PKG, "chaos")], rules=["GC008"])
+    assert res.fresh == [], [f.format() for f in res.fresh]
+    bad = _findings("gc008_bad_pkg", rules=["GC008"])
+    chaos_hits = [
+        (f.rule, f.line) for f in bad.fresh
+        if os.sep + "chaos" + os.sep in f.path
+    ]
+    assert chaos_hits == [("GC008", 10), ("GC008", 13)], [
         f.format() for f in bad.fresh
     ]
 
