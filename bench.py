@@ -277,6 +277,29 @@ def _probe_raw_rate() -> float:
     return 2.0 * 1024**3 / max(best, 1e-9)
 
 
+class _PhaseDeadline(Exception):
+    """Raised by the per-phase SIGALRM: this rung blew ITS OWN cap."""
+
+
+def _phase_note(name: str, status: str, dt: float) -> None:
+    """One partial-JSON line to stderr as each phase completes (round
+    21, the BENCH_r05 post-mortem's third leg): if a later phase is
+    cut off by the driver's external ``timeout`` before the watchdog
+    can flush, the per-phase trail — already written and flushed — is
+    what survives. stderr on purpose: stdout's last line must stay the
+    compact contract."""
+    try:
+        print(
+            json.dumps({
+                "bench_phase": name, "status": status,
+                "elapsed_s": round(dt, 1),
+            }),
+            file=sys.stderr, flush=True,
+        )
+    except Exception:  # noqa: BLE001 — a progress note must never
+        pass  # take down the phase it narrates
+
+
 def _try_rung(fn, est: float = 60.0, scale: bool = True, **kw):
     """Round-4 auxiliary rungs record a VISIBLE error instead of
     zeroing out the whole contract on a transient tunnel failure (the
@@ -290,13 +313,27 @@ def _try_rung(fn, est: float = 60.0, scale: bool = True, **kw):
     a partial contract that prints beats a complete one that times out
     at rc 124 (BENCH_r05).
 
+    Round 21 adds the per-phase DEADLINE: the budget skip trusts the
+    estimate, so a rung whose estimate *lies* (BENCH_r05's rc 124 was
+    one open-loop phase eating the entire budget) used to take every
+    later rung down with it. Each rung now runs under its own SIGALRM
+    cap — 3x its scaled estimate (floor est+60 s, clamped to leave
+    10 s of global budget for the contract to print) — and records
+    ``{"error": "phase deadline: ..."}`` on expiry while the rungs
+    after it still run. Main-thread/POSIX only; elsewhere the global
+    watchdog remains the only net. A completed phase also drops a
+    partial-JSON line on stderr (:func:`_phase_note`), so even a hard
+    external kill leaves a parseable per-phase trail.
+
     Each rung is followed by a GC pass: the contract now spans enough
     rungs (decode caches, serving slot arenas, MoE params, spec
     buffers) that lingering cycles can hold HBM into later rungs — the
     r5 full-contract validation OOMed in the rateless rung on exactly
     that accumulation."""
     import gc
+    import threading
 
+    name = getattr(fn, "__name__", "rung")
     if scale:
         # chip estimate -> this machine (see above). scale=False is
         # for device-free rungs (graftcheck's AST walk) whose cost
@@ -304,14 +341,51 @@ def _try_rung(fn, est: float = 60.0, scale: bool = True, **kw):
         est = est * _EST_SCALE
     left = _budget_left()
     if left is not None and left < est:
+        _phase_note(name, "skipped", 0.0)
         return {
             "skipped": f"budget: {left:.0f}s left < {est:.0f}s estimate"
         }
+    cap = max(3.0 * est, est + 60.0)
+    if left is not None:
+        cap = min(cap, max(left - 10.0, 5.0))
+    alarm_armed = False
+    old_handler = old_timer = None
     try:
-        return fn(**kw)
+        import signal
+
+        if threading.current_thread() is threading.main_thread() \
+                and hasattr(signal, "setitimer"):
+
+            def _on_alarm(signum, frame):
+                raise _PhaseDeadline(
+                    f"phase deadline: {name} exceeded its "
+                    f"{cap:.0f}s cap ({est:.0f}s estimate)"
+                )
+
+            old_handler = signal.signal(signal.SIGALRM, _on_alarm)
+            old_timer = signal.setitimer(signal.ITIMER_REAL, cap)
+            alarm_armed = True
+    except Exception:  # noqa: BLE001 — the cap is best-effort; the
+        alarm_armed = False  # global watchdog still backstops
+    t0 = time.perf_counter()
+    try:
+        out = fn(**kw)
+        _phase_note(name, "ok", time.perf_counter() - t0)
+        return out
+    except _PhaseDeadline as e:
+        _phase_note(name, "deadline", time.perf_counter() - t0)
+        return {"error": str(e)}
     except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+        _phase_note(name, "error", time.perf_counter() - t0)
         return {"error": f"{type(e).__name__}: {e}"}
     finally:
+        if alarm_armed:
+            import signal
+
+            signal.setitimer(
+                signal.ITIMER_REAL, *(old_timer or (0.0, 0.0))
+            )
+            signal.signal(signal.SIGALRM, old_handler)
         gc.collect()
 
 
@@ -548,6 +622,21 @@ def driver_contract(budget_s: float | None = None) -> dict:
         # pinned ceiling, a metastable (non-recovering) p99, or
         # digest divergence across two replays.
         out["chaos"] = _try_rung(rung_chaos, est=20, scale=False)
+
+        def rung_simfast():
+            from benchmarks.sim_fastpath_bench import (
+                bench_sim_fastpath_rung,
+            )
+
+            return bench_sim_fastpath_rung()
+
+        # round-21 sim fast-path rung — unscaled like the other sim
+        # rungs: the vectorized day engine vs the scalar loop on the
+        # long-decode day (digest bit-identity asserted first), the
+        # full 1M-request day's events/s against the pinned >= 10x
+        # floor, and the equal-wall-budget tenant-weight sweep where
+        # the fast path must cover strictly more of the grid.
+        out["simfast"] = _try_rung(rung_simfast, est=45, scale=False)
         # headline: never budget-skipped, loud-fail (it IS the
         # contract) — but SIZED by measurement. Each ladder step is a
         # complete config-3 bench at that cube; the next step runs only
@@ -738,6 +827,10 @@ def _contract_line(out: dict) -> str:
             out.get("chaos"), "chaos_shed_named_pct"),
         "chaos_p99_recovery_x": _rung_summary(
             out.get("chaos"), "chaos_p99_recovery_x"),
+        "simfast_events_x": _rung_summary(
+            out.get("simfast"), "simfast_events_x"),
+        "simfast_digest_ok": _rung_summary(
+            out.get("simfast"), "simfast_digest_ok"),
         "adaptive_speedup": _rung_summary(
             out.get("adaptive_nwait"), "speedup"),
         "obs_overhead_pct": _rung_summary(

@@ -1,0 +1,207 @@
+"""Round-21 sim fast-path rung: vectorized day engine vs scalar loop.
+
+Three legs, sim-only (unscaled in bench.py — numpy column passes do
+not track the matmul rate the machine calibration measures):
+
+* **parity** — one seeded long-decode day (the shape the fast path is
+  FOR: slots=128, n_inner=1, max_new=1024, so the scalar loop scans
+  128 slots on every 4 ms tick while each request retires ~1023 ticks
+  after its first token) driven through BOTH engines on the identical
+  :class:`~mpistragglers_jl_tpu.sim.ArrivalBatch`. The workload
+  ``digest()`` must match bit for bit — the witness is the spec, so
+  any divergence fails the rung before a single throughput number is
+  recorded. The scalar leg's measured events/s is the denominator.
+* **throughput** — the FULL 1M-request day on the vectorized engine
+  (the scalar loop would need ~7 minutes for the same day; the rung
+  prices it from the parity leg's identical per-event cost instead).
+  ``simfast_events_x`` = vectorized events/s over scalar events/s;
+  FAILS under the pinned 10x floor.
+* **budget sweep** — the controller-facing claim: the SAME wall-clock
+  decision budget handed to :func:`~..sim.tune.sweep_tenant_weights`
+  twice (``fast="never"`` vs ``fast="auto"``, identical candidate
+  order, identical seeded day per candidate) must let the fast path
+  evaluate a strict superset of the scalar prefix — and because every
+  candidate scores identically on either engine (digest parity), the
+  deeper grid's best score is never worse. FAILS if the fast sweep
+  covers no more of the grid than the scalar one, or scores worse.
+
+Headline scalars (bench.py compact line, benchmarks/README.md):
+``simfast_events_x`` (vectorized/scalar events-per-second ratio,
+floor 10) and ``simfast_digest_ok`` (bit-identity witness).
+"""
+
+from __future__ import annotations
+
+import time
+
+# the long-decode day the fast path is for (see docs/PERF.md "Sim
+# plane throughput"): 8 replicas x 128 slots, one decode token per
+# 4 ms tick, 1024 new tokens per request -> the scalar loop's cost is
+# ~decode_ticks per request while the vectorized engine retires slots
+# analytically and skips uneventful ticks entirely
+_N_REP, _SLOTS, _NI, _TICK = 8, 128, 1, 0.004
+_PLEN, _MNEW, _RATE, _SEED = 96, 1024, 200.0, 3
+_PARITY_N = 3_000
+_FULL_N = 1_000_000
+_FLOOR_X = 10.0
+_SWEEP_BUDGET_S = 3.0
+
+
+def _fleet():
+    from mpistragglers_jl_tpu.models.router import RequestRouter
+    from mpistragglers_jl_tpu.sim import SimReplica, VirtualClock
+
+    clock = VirtualClock()
+    reps = [
+        SimReplica(clock, slots=_SLOTS, n_inner=_NI, tick_s=_TICK)
+        for _ in range(_N_REP)
+    ]
+    return RequestRouter(reps, policy="least_loaded", clock=clock)
+
+
+def _batch(n: int):
+    from mpistragglers_jl_tpu.sim import poisson_arrival_batch
+
+    return poisson_arrival_batch(
+        _RATE, n=n, seed=_SEED, prompt_len=_PLEN, max_new=_MNEW
+    )
+
+
+def _sweep_grid():
+    return [
+        {"gold": g, "silver": s, "bronze": 1.0}
+        for g in (1.0, 1.5, 2.0, 2.5, 3.0, 4.0, 6.0, 8.0, 10.0, 12.0)
+        for s in (1.0, 1.5, 2.0, 3.0)
+    ]
+
+
+def _sweep(fast: str):
+    from mpistragglers_jl_tpu.qos import TenantContract
+    from mpistragglers_jl_tpu.sim.tune import sweep_tenant_weights
+
+    contracts = [
+        TenantContract("gold", cls="latency", weight=4.0, rate=900.0,
+                       burst=600.0, hedges=2, ttft_slo=2.0),
+        TenantContract("silver", cls="throughput", weight=2.0,
+                       rate=700.0, burst=500.0),
+        TenantContract("bronze", cls="batch", weight=1.0, rate=500.0,
+                       burst=400.0),
+    ]
+    # long-decode candidate days (max_new=256): the scalar loop pays
+    # ~0.7 s per candidate where the vectorized engine pays ~0.14 s,
+    # so the same 3 s budget covers ~5x more of the grid
+    return sweep_tenant_weights(
+        contracts=contracts, candidates=_sweep_grid(), requests=2500,
+        max_new=256, seed=11, fast=fast, budget_s=_SWEEP_BUDGET_S,
+        timer=time.perf_counter,
+    )
+
+
+def bench_sim_fastpath_rung(full_n: int | None = None):
+    """The driver rung ``simfast``: digest bit-identity between the
+    two engines, the >= 10x events/s floor on the 1M-request day, and
+    the equal-budget deeper-sweep demonstration."""
+    import os
+
+    from mpistragglers_jl_tpu.sim import (
+        run_router_day,
+        run_router_day_fast,
+    )
+
+    n_full = int(
+        full_n if full_n is not None
+        else os.environ.get("SIMFAST_BENCH_REQUESTS", str(_FULL_N))
+    )
+    t0 = time.perf_counter()
+
+    # -- leg 1: parity + the scalar denominator ------------------------
+    parity = _batch(_PARITY_N)
+    rep_s = run_router_day(_fleet(), parity, timer=time.perf_counter)
+    rep_f = run_router_day_fast(
+        _fleet(), parity, timer=time.perf_counter
+    )
+    digest_ok = rep_s.digest() == rep_f.digest()
+    if not digest_ok:
+        raise AssertionError(
+            f"fast path diverged from the scalar witness: "
+            f"{rep_f.digest()} != {rep_s.digest()} — the digest is "
+            "the spec, so this is a fast-path bug by definition"
+        )
+    if rep_f.fastpath != "vectorized":
+        raise AssertionError(
+            f"parity day fell back to the scalar loop "
+            f"({rep_f.fastpath!r}): nothing was measured"
+        )
+    if rep_s.n_events != rep_f.n_events:
+        raise AssertionError(
+            f"event accounting diverged: scalar {rep_s.n_events} != "
+            f"vectorized {rep_f.n_events}"
+        )
+
+    # -- leg 2: the 1M-request day on the vectorized engine ------------
+    full = _batch(n_full)
+    rep_full = run_router_day_fast(
+        _fleet(), full, timer=time.perf_counter
+    )
+    if rep_full.fastpath != "vectorized":
+        raise AssertionError(
+            f"full day fell back ({rep_full.fastpath!r})"
+        )
+    if rep_full.dropped:
+        raise AssertionError(
+            f"full day dropped {rep_full.dropped} requests"
+        )
+    events_x = rep_full.events_per_s / rep_s.events_per_s
+    if events_x < _FLOOR_X:
+        raise AssertionError(
+            f"simfast_events_x {events_x:.1f} under the pinned "
+            f"{_FLOOR_X:.0f}x floor (vectorized "
+            f"{rep_full.events_per_s:.0f} ev/s vs scalar "
+            f"{rep_s.events_per_s:.0f} ev/s)"
+        )
+
+    # -- leg 3: same decision budget, strictly larger grid -------------
+    slow = _sweep("never")
+    fast = _sweep("auto")
+    if fast["candidates_evaluated"] <= slow["candidates_evaluated"]:
+        raise AssertionError(
+            f"equal-budget sweep: fast path evaluated "
+            f"{fast['candidates_evaluated']} candidates vs scalar "
+            f"{slow['candidates_evaluated']} — no deeper search"
+        )
+    if fast["best_entry"]["score"] > slow["best_entry"]["score"]:
+        raise AssertionError(
+            "deeper grid scored WORSE than its scalar prefix — "
+            "candidate days are seeded identically, so this cannot "
+            "happen unless the engines disagree"
+        )
+
+    return {
+        "requests_full_day": int(rep_full.n),
+        "simfast_events_x": round(events_x, 1),
+        "simfast_digest_ok": digest_ok,
+        "digest": rep_s.digest(),
+        "scalar_events_per_s": round(rep_s.events_per_s, 0),
+        "fast_events_per_s": round(rep_full.events_per_s, 0),
+        "fast_day_wall_s": round(rep_full.wall_s, 2),
+        "scalar_parity_wall_s": round(rep_s.wall_s, 2),
+        "n_events_full_day": int(rep_full.n_events),
+        "sweep_budget_s": _SWEEP_BUDGET_S,
+        "sweep_grid": len(_sweep_grid()),
+        "sweep_candidates_scalar": slow["candidates_evaluated"],
+        "sweep_candidates_fast": fast["candidates_evaluated"],
+        "sweep_best_score_scalar": round(
+            slow["best_entry"]["score"], 4
+        ),
+        "sweep_best_score_fast": round(
+            fast["best_entry"]["score"], 4
+        ),
+        "sweep_best_weights_fast": fast["best_entry"]["weights"],
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(bench_sim_fastpath_rung(), indent=2, default=str))

@@ -704,6 +704,9 @@ class FleetController:
         from ..sim.tune import sweep_router_policy
 
         kw = dict(cfg)
+        # online decisions default to the vectorized day engine — same
+        # digest, same pick, more of the decision budget left for grid
+        kw.setdefault("fast", "auto")
         policies = kw.pop(
             "policies",
             ("round_robin", "least_loaded", "prefix_affinity"),

@@ -17,7 +17,14 @@ straggling run completes in milliseconds, bit-reproducibly. On top:
 * :mod:`.tune` — sweep (nwait, hedge width, code rate) against a
   trace, a fitted :class:`~..utils.straggle.PoolLatencyModel`, or any
   :mod:`..utils.faults` schedule, honoring the decodability floor and
-  cross-checking ``PoolLatencyModel.optimal_nwait``.
+  cross-checking ``PoolLatencyModel.optimal_nwait``;
+* :mod:`.fastpath` — the vectorized router-day engine:
+  :func:`~.fastpath.run_router_day_fast` reproduces the scalar
+  :func:`~.workload.run_router_day` ``digest()`` bit for bit on
+  supported day shapes at ~10-60x the events/s (falling back to the
+  scalar loop at genuinely event-driven boundaries), which is what
+  lets the :mod:`.tune` sweeps search larger candidate grids inside
+  the same online decision budget.
 
 stdlib + numpy only, like the package root: simulating a TPU fleet
 must never require a TPU (or jax) — tests/test_no_compiler.py and
@@ -26,7 +33,20 @@ graftcheck GC001 both pin it.
 
 from .backend import SimBackend, SimEvent, model_delay_fn
 from .clock import VirtualClock
-from .replay import ReplayResult, ReplayTrace, compare, replay
+from .fastpath import (
+    ArrivalBatch,
+    diurnal_arrival_batch,
+    fastpath_supported,
+    poisson_arrival_batch,
+    run_router_day_fast,
+)
+from .replay import (
+    ReplayResult,
+    ReplayTrace,
+    compare,
+    replay,
+    replay_router_day,
+)
 from .tune import (
     NwaitSweep,
     recommend_nwait,
@@ -69,6 +89,12 @@ __all__ = [
     "ReplayResult",
     "replay",
     "compare",
+    "replay_router_day",
+    "ArrivalBatch",
+    "poisson_arrival_batch",
+    "diurnal_arrival_batch",
+    "fastpath_supported",
+    "run_router_day_fast",
     "NwaitSweep",
     "sweep_nwait",
     "sweep_code_rate",

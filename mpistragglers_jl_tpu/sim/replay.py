@@ -39,7 +39,39 @@ from ..utils.faults import from_trace
 from .backend import SimBackend
 from .clock import VirtualClock
 
-__all__ = ["ReplayTrace", "ReplayResult", "replay", "compare"]
+__all__ = [
+    "ReplayTrace", "ReplayResult", "replay", "compare",
+    "replay_router_day",
+]
+
+
+def replay_router_day(
+    router, path, *, events=(), retry=None, fast: str = "auto",
+    timer=None,
+):
+    """Replay a recorded arrival stream (a
+    :func:`~.workload.dump_arrivals_jsonl` file) through ``router`` —
+    the router-plane sibling of :func:`replay`. ``fast="auto"``
+    (default) runs the day on the vectorized
+    :func:`~.fastpath.run_router_day_fast` engine where the day shape
+    supports it (bit-identical ``digest()``, ``report.fastpath`` names
+    the path taken); ``fast="never"`` pins the scalar loop, the parity
+    reference. Counterfactuals — "what would yesterday's traffic have
+    cost under prefix_affinity?" — are one router construction plus
+    this call, in milliseconds."""
+    from .tune import _resolve_fast
+    from .workload import arrivals_from_jsonl, run_router_day
+
+    arrivals = arrivals_from_jsonl(path)
+    if _resolve_fast(fast):
+        from .fastpath import run_router_day_fast
+
+        return run_router_day_fast(
+            router, arrivals, events=events, retry=retry, timer=timer,
+        )
+    return run_router_day(
+        router, arrivals, events=events, retry=retry, timer=timer,
+    )
 
 
 class _EpochSnap:

@@ -70,6 +70,21 @@ def recovered_work_per_s(
     return u / mean_epoch_s if mean_epoch_s > 0 else float(np.inf)
 
 
+def _resolve_fast(fast: str) -> bool:
+    """Shared ``fast=`` knob of the router-day sweeps: ``"auto"`` runs
+    each candidate day through :func:`~.fastpath.run_router_day_fast`
+    (bit-identical digests by contract, so the sweep's decision is
+    unchanged — only its cost), ``"never"`` pins the scalar loop.
+    Unsupported day shapes (e.g. ``chunk_s`` tiers) fall back to the
+    scalar path inside ``run_router_day_fast`` itself, so ``"auto"``
+    is always safe to leave on."""
+    if fast not in ("auto", "never"):
+        raise ValueError(
+            f'fast must be "auto" or "never", got {fast!r}'
+        )
+    return fast == "auto"
+
+
 def _resolve_delay(source, *, seed: int) -> tuple[DelayFn, int | None]:
     """(delay_fn, n_workers hint) from a trace / model / DelayFn."""
     if isinstance(source, ReplayTrace):
@@ -440,6 +455,7 @@ def sweep_router_policy(
     admission_slo_s: float | None = None,
     dead: Sequence[int] = (),
     seed: int = 0,
+    fast: str = "auto",
 ) -> dict[str, Any]:
     """Recommend a request-routing policy for ONE (``load``,
     ``prefix_share``) operating point by running the REAL
@@ -475,6 +491,13 @@ def sweep_router_policy(
     hedges, re-routes, shared admissions, ``admissible``), ``best``
     (lowest p99 TTFT among admissible policies), and
     ``p99_vs_round_robin`` — the headline ratio the bench rung pins.
+
+    ``fast="auto"`` (default) prices each candidate day on the
+    vectorized :mod:`~.fastpath` engine — same digest, so the same
+    decision, at a fraction of the cost; the identical seeded arrival
+    stream is materialized ONCE as an :class:`~.fastpath.ArrivalBatch`
+    and shared across candidates. ``fast="never"`` pins the scalar
+    loop (the parity suite's reference).
     """
     # lazy, like sweep_hierarchical's ops import: models/ is the
     # accelerator package namespace (the router itself is jax-free) —
@@ -552,6 +575,20 @@ def sweep_router_policy(
         for i in range(n_replicas) if i not in dead_set
     )
     rate = load * fleet_rate
+    arrival_kw = dict(
+        prompt_len=prompt_len, max_new=max_new,
+        prefix_share=prefix_share, prefix_len=prefix_len,
+        n_prefix_groups=n_prefix_groups,
+    )
+    batch = None
+    if _resolve_fast(fast):
+        from .fastpath import poisson_arrival_batch, run_router_day_fast
+
+        # every candidate faces the identical seeded stream, so the
+        # cohort batch is generated once and shared across policies
+        batch = poisson_arrival_batch(
+            rate, n=requests, seed=seed, **arrival_kw
+        )
     entries: list[dict] = []
     for policy in policies:
         clock = VirtualClock()
@@ -572,15 +609,15 @@ def sweep_router_policy(
             replicas, policy=policy, clock=clock,
             ttft_slo=ttft_slo if policy == "hedge_p99" else None,
         )
-        report = run_router_day(
-            router,
-            poisson_arrivals(
-                rate, n=requests, seed=seed, prompt_len=prompt_len,
-                max_new=max_new, prefix_share=prefix_share,
-                prefix_len=prefix_len,
-                n_prefix_groups=n_prefix_groups,
-            ),
-        )
+        if batch is not None:
+            report = run_router_day_fast(router, batch)
+        else:
+            report = run_router_day(
+                router,
+                poisson_arrivals(
+                    rate, n=requests, seed=seed, **arrival_kw
+                ),
+            )
         waits = np.asarray([
             (r.t_admitted - r.t_submit) for r in report.requests
             if r.t_admitted is not None
@@ -649,6 +686,9 @@ def sweep_tenant_weights(
     max_new: int = 32,
     prompt_chunk: int = 64,
     seed: int = 0,
+    fast: str = "auto",
+    budget_s: float | None = None,
+    timer: Callable[[], float] | None = None,
 ) -> dict[str, Any]:
     """Recommend DRR weights for a set of tenant contracts by running
     the REAL QoS plane — :class:`~..models.router.RequestRouter` +
@@ -686,7 +726,18 @@ def sweep_tenant_weights(
     Returns entries per candidate (per-tenant p50/p99 TTFT via
     :meth:`~.workload.WorkloadReport.per_tenant`, the worst
     normalized latency-tenant p99 as ``score``), ``best`` (lowest
-    score), and the capacity numbers the feasibility check used."""
+    score), and the capacity numbers the feasibility check used.
+
+    ``fast="auto"`` prices each candidate day on the vectorized
+    :mod:`~.fastpath` engine (bit-identical digest, same decision,
+    lower cost); the seeded tenant-mixed stream is materialized once
+    and shared across candidates. ``budget_s`` bounds the sweep's
+    decision cost: candidates are evaluated in order until the budget
+    is spent (at least one always runs), and the result records
+    ``candidates_evaluated`` / ``budget_exhausted`` — the point of the
+    fast path is that the SAME budget covers a strictly larger grid.
+    Wall time is never read silently (the GC008 contract): ``budget_s``
+    requires an injected ``timer``."""
     # lazy, the sweep_router_policy pattern: models/ is the
     # accelerator package namespace; qos/ is stdlib-only but stays a
     # lazy import for the same explicit-closure discipline
@@ -754,6 +805,12 @@ def sweep_tenant_weights(
                     f"sweep refused: candidate weight {w} for tenant "
                     f"{t!r} must be > 0"
                 )
+    if budget_s is not None and timer is None:
+        raise ValueError(
+            "budget_s requires an injected timer= (wall time is never "
+            "read silently — the GC008 contract); pass "
+            "time.perf_counter or a virtual clock"
+        )
     # each tenant offers `load` of its own budget; shares follow
     tenant_tok_rate = {c.name: load * c.rate for c in contracts}
     offered_tok = sum(tenant_tok_rate.values())
@@ -762,8 +819,24 @@ def sweep_tenant_weights(
     latency_slo = {
         c.name: c.ttft_slo for c in contracts if c.cls == "latency"
     }
+    batch = None
+    if _resolve_fast(fast):
+        from .fastpath import poisson_arrival_batch, run_router_day_fast
+
+        batch = poisson_arrival_batch(
+            rate, n=int(requests), seed=seed, prompt_len=prompt_len,
+            max_new=max_new, tenants=shares,
+        )
+    t0 = timer() if timer is not None else 0.0
     entries: list[dict] = []
+    n_evaluated = 0
     for cand in candidates:
+        if (
+            budget_s is not None and n_evaluated > 0
+            and timer() - t0 > float(budget_s)
+        ):
+            break
+        n_evaluated += 1
         reg = TenantRegistry([
             TenantContract(
                 c.name, cls=c.cls, weight=cand[c.name], rate=c.rate,
@@ -787,14 +860,17 @@ def sweep_tenant_weights(
         router = RequestRouter(
             replicas, policy="least_loaded", clock=clock, qos=reg,
         )
-        report = run_router_day(
-            router,
-            poisson_arrivals(
-                rate, n=int(requests), seed=seed,
-                prompt_len=prompt_len, max_new=max_new,
-                tenants=shares,
-            ),
-        )
+        if batch is not None:
+            report = run_router_day_fast(router, batch)
+        else:
+            report = run_router_day(
+                router,
+                poisson_arrivals(
+                    rate, n=int(requests), seed=seed,
+                    prompt_len=prompt_len, max_new=max_new,
+                    tenants=shares,
+                ),
+            )
         per = report.per_tenant()
         # score: the worst latency-class p99 normalized by its SLO
         # (<= 1 means every latency contract held)
@@ -832,6 +908,9 @@ def sweep_tenant_weights(
         "rate_req_s": rate,
         "tenant_shares": shares,
         "requests": int(requests),
+        "candidates_evaluated": n_evaluated,
+        "budget_s": budget_s,
+        "budget_exhausted": n_evaluated < len(candidates),
     }
 
 
@@ -856,6 +935,7 @@ def sweep_tier_split(
     migrate_gbs: float = 5.2,
     decode_p99_slo_s: float | None = None,
     seed: int = 0,
+    fast: str = "auto",
 ) -> dict[str, Any]:
     """Price ``(n_prefill, n_decode)`` tier splits and migration-size
     thresholds for the disaggregated serving tier by running the REAL
@@ -888,7 +968,14 @@ def sweep_tier_split(
     Returns entries per candidate (decode p99, TTFT percentiles,
     migrations landed/kept local, bytes moved), ``best`` — the
     ``(split, threshold)`` with the lowest decode p99 among admissible
-    candidates — and ``decode_p99_vs_worst`` for quick reading."""
+    candidates — and ``decode_p99_vs_worst`` for quick reading.
+
+    ``fast="auto"`` accepts the shared sweep knob for uniformity, but
+    two-tier days price prefill contention through ``chunk_s`` — a
+    carried-state tick stretch the vectorized engine does not model —
+    so ``run_router_day_fast`` detects the shape and runs the scalar
+    loop (``report.fastpath`` names the reason); the arrival batch is
+    still materialized once per split and shared across thresholds."""
     from ..models.router import RequestRouter
     from .workload import (
         SimReplica,
@@ -930,6 +1017,9 @@ def sweep_tier_split(
         (1.0 - ls) * -(-max(int(max_new) - 1, 0) // int(n_inner))
         + ls * -(-max(lmn - 1, 0) // int(n_inner))
     )
+    use_fast = _resolve_fast(fast)
+    if use_fast:
+        from .fastpath import poisson_arrival_batch, run_router_day_fast
     entries: list[dict] = []
     for (n_p, n_d) in cands:
         # a saturated prefill replica's tick stretches by one chunk_s
@@ -940,6 +1030,12 @@ def sweep_tier_split(
         cap_prefill = n_p * slots / (e_chunks * prefill_tick)
         cap_decode = n_d * slots / (e_decode_ticks * tick_s)
         rate = load * min(cap_prefill, cap_decode)
+        batch = poisson_arrival_batch(
+            rate, n=requests, seed=seed, prompt_len=prompt_len,
+            max_new=max_new, long_share=long_share,
+            long_prompt_len=long_prompt_len,
+            long_max_new=long_max_new,
+        ) if use_fast else None
         for thr in thresholds:
             clock = VirtualClock()
             fleet = []
@@ -960,16 +1056,19 @@ def sweep_tier_split(
                 migrate_threshold_bytes=thr,
                 migrate_gbs=migrate_gbs,
             )
-            report = run_router_day(
-                router,
-                poisson_arrivals(
-                    rate, n=requests, seed=seed,
-                    prompt_len=prompt_len, max_new=max_new,
-                    long_share=long_share,
-                    long_prompt_len=long_prompt_len,
-                    long_max_new=long_max_new,
-                ),
-            )
+            if batch is not None:
+                report = run_router_day_fast(router, batch)
+            else:
+                report = run_router_day(
+                    router,
+                    poisson_arrivals(
+                        rate, n=requests, seed=seed,
+                        prompt_len=prompt_len, max_new=max_new,
+                        long_share=long_share,
+                        long_prompt_len=long_prompt_len,
+                        long_max_new=long_max_new,
+                    ),
+                )
             p99d = report.p99_decode_itl()
             entries.append({
                 "split": (n_p, n_d),

@@ -767,6 +767,10 @@ class SimReplica:
             tick_s if callable(tick_s)
             else (lambda _t, _d=float(tick_s): _d)
         )
+        # the raw tick_s spec, kept for sim/fastpath.py's support
+        # gate: a float constant or a recognized index-pure seeded
+        # callable (lognormal_ticks) can be replayed off the loop
+        self._tick_spec = tick_s
         self._queue: deque[SimRequest] = deque()
         self._slots: list[SimRequest | None] = [None] * self.S
         self._prefill = [0] * self.S
@@ -1094,10 +1098,27 @@ class WorkloadReport:
     of the same scenario must agree on."""
 
     def __init__(self, requests: list, virtual_s: float, router,
-                 controller=None, n_resubmits: int = 0):
+                 controller=None, n_resubmits: int = 0,
+                 n_events: int | None = None,
+                 wall_s: float | None = None):
         self.requests = requests
         self.n = len(requests)
         self.virtual_s = float(virtual_s)
+        # sim-plane throughput self-measurement (round 16): events =
+        # submits + fleet ticks, wall from an INJECTED timer (GC008:
+        # sim/ never reads the OS clock itself). All OUTSIDE digest().
+        self.n_events = None if n_events is None else int(n_events)
+        self.wall_s = None if wall_s is None else float(wall_s)
+        self.events_per_s = (
+            None
+            if (self.n_events is None or self.wall_s is None
+                or self.wall_s <= 0.0)
+            else self.n_events / self.wall_s
+        )
+        # which execution mode produced this report ("scalar" here;
+        # sim/fastpath.py overwrites with "vectorized" or a
+        # "scalar-fallback: <reason>" tag) — observability only
+        self.fastpath = "scalar"
         # chaos-plane counters, all OUTSIDE digest() (the bit-identity
         # witness keeps its latency-array definition): retry-client
         # resubmissions, partition begins/heals, and stale legs the
@@ -1155,6 +1176,53 @@ class WorkloadReport:
                     (r.t_done - r.t_first_token) / (n - 1)
                 )
         self.decode_itl = np.asarray(itl, np.float64)
+
+    @classmethod
+    def from_arrays(cls, requests, virtual_s: float, router, *,
+                    ttft, latency, outcomes: dict, shed_reasons: dict,
+                    dropped: int, decode_itl, n_resubmits: int = 0,
+                    n_events: int | None = None,
+                    wall_s: float | None = None) -> "WorkloadReport":
+        """Array-native constructor for the vectorized day driver
+        (sim/fastpath.py): the witness arrays (``ttft`` / ``latency``,
+        float64, served requests in submission order) and the outcome
+        books arrive precomputed instead of being re-derived from a
+        million per-request records. The witness fields are assigned
+        HERE — in this module — for both execution paths, so the
+        digest definition has a single source of truth (graftcheck
+        GC011). ``requests`` may be any sequence of request views
+        exposing the per-request attributes the sweeps read."""
+        rep = cls.__new__(cls)
+        rep.requests = requests
+        rep.n = len(requests)
+        rep.virtual_s = float(virtual_s)
+        rep.n_resubmits = int(n_resubmits)
+        rep.n_partitions = getattr(router, "n_partitions", 0)
+        rep.n_stale_cancelled = getattr(router, "n_stale_cancelled", 0)
+        rep.n_resizes = 0
+        rep.n_failovers = 0
+        rep.n_events = None if n_events is None else int(n_events)
+        rep.wall_s = None if wall_s is None else float(wall_s)
+        rep.events_per_s = (
+            None
+            if (rep.n_events is None or rep.wall_s is None
+                or rep.wall_s <= 0.0)
+            else rep.n_events / rep.wall_s
+        )
+        rep.fastpath = "scalar"
+        rep.ttft = np.asarray(ttft, np.float64)
+        rep.latency = np.asarray(latency, np.float64)
+        rep.outcomes = dict(outcomes)
+        rep.shed_reasons = dict(shed_reasons)
+        rep.n_hedges = router.n_hedges
+        rep.n_rerouted = router.n_rerouted
+        rep.n_migrated = getattr(router, "n_migrated", 0)
+        rep.n_kept_local = getattr(router, "n_kept_local", 0)
+        rep.n_shed = getattr(router, "n_shed", 0)
+        rep.n_hedges_refused = getattr(router, "n_hedges_refused", 0)
+        rep.dropped = int(dropped)
+        rep.decode_itl = np.asarray(decode_itl, np.float64)
+        return rep
 
     def p50_ttft(self) -> float:
         return float(np.percentile(self.ttft, 50))
@@ -1222,6 +1290,7 @@ class WorkloadReport:
 def run_router_day(
     router, arrivals: Iterable[Arrival], *,
     controller=None, events: Iterable = (), retry: RetryPolicy | None = None,
+    timer: Callable[[], float] | None = None,
 ) -> WorkloadReport:
     """Drive a virtual-time :class:`~..models.router.RequestRouter`
     through an arrival stream to completion: advance the clock to each
@@ -1252,7 +1321,14 @@ def run_router_day(
     bit-identically, every attempt lands in the report (and its
     digest), and ``WorkloadReport.n_resubmits`` counts the
     amplification. Shed requests are never retried. ``retry=None``
-    keeps the drive loop event-for-event the pre-round-20 one."""
+    keeps the drive loop event-for-event the pre-round-20 one.
+
+    ``timer=`` (e.g. ``time.perf_counter``) opts into events/s
+    self-measurement: the report's ``n_events`` (submits + fleet
+    ticks), ``wall_s``, and ``events_per_s`` fill in, all OUTSIDE
+    :meth:`~WorkloadReport.digest`. The timer is injected because
+    sim/ never reads the OS clock itself (graftcheck GC008)."""
+    wall_t0 = timer() if timer is not None else None
     clock = router.clock
     if clock is None:
         raise ValueError(
@@ -1430,5 +1506,10 @@ def run_router_day(
                     )
             else:
                 barren = 0
+    n_events = router.n_submitted + sum(
+        getattr(r, "tick_count", 0) for r in router.replicas
+    )
+    wall = None if wall_t0 is None else timer() - wall_t0
     return WorkloadReport(submitted, clock.now(), router, ctl,
-                          n_resubmits=n_resubmits)
+                          n_resubmits=n_resubmits, n_events=n_events,
+                          wall_s=wall)

@@ -37,6 +37,11 @@ GC010  shed-by-name         no bare drops: shed outcomes carry a
                             sibling shed_reason, shed/drop calls carry
                             an identifiable reason, and a literal
                             None/empty reason is flagged
+GC011  witness-single-source sim digest witness written once: .ttft/
+                            .latency assignments and `def digest` only
+                            in sim/workload.py — the scalar loop and
+                            the vectorized fast path share the
+                            counter-stamping code
 ====== ==================== ==========================================
 """
 
@@ -51,4 +56,5 @@ from . import (  # noqa: F401  (import == register)
     gc008_wall_clock,
     gc009_protocol_drift,
     gc010_shed_by_name,
+    gc011_witness_source,
 )

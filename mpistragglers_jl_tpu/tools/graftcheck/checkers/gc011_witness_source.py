@@ -1,0 +1,111 @@
+"""GC011: witness-single-source — the digest witness is written once.
+
+The sim plane's reproducibility contract hangs on one hash:
+``WorkloadReport.digest()`` over the ``ttft``/``latency`` float64
+columns of served requests in submission order. Round 21 added a
+second execution engine (sim/fastpath.py, the vectorized day loop)
+whose ENTIRE spec is "bit-identical digest to the scalar loop" — an
+equivalence that is only checkable while the witness has a single
+definition. The failure mode this rule pins shut: a future PR teaches
+one path a new outcome (or rounds a column, or re-orders served
+requests) by writing the witness fields *locally*, the parity tests
+keep passing against the drifted twin, and "bit-identical" silently
+stops meaning anything. Statically, per sim module:
+
+1. **Witness columns are assigned only in the home module.** An
+   attribute assignment to ``.ttft`` or ``.latency`` (plain,
+   annotated, or augmented) outside ``sim/workload.py`` is flagged:
+   both engines hand their arrays to ``WorkloadReport`` (``__init__``
+   for the scalar loop, ``from_arrays`` for the vectorized one) and
+   the columns are stamped THERE, once. Reading the fields, passing
+   ``ttft=`` keywords, and ``ttft`` *properties* on request views are
+   all fine — only the assignment is the source of truth.
+
+2. **``digest()`` is defined only in the home module.** A ``def
+   digest`` in any other sim module is a second witness definition:
+   the moment two hashes exist, "the digest matches" can be true of
+   the wrong pair.
+
+Scope is the ``sim`` package component (the two execution paths both
+live there; fleet/qos/chaos consume reports, they do not build them).
+Suppressions and baselining ride the shared machinery
+(``# graftcheck: disable=GC011``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, Finding, ModuleInfo, register
+
+#: the digest()-hashed report columns
+_WITNESS_ATTRS = ("ttft", "latency")
+
+#: the one module allowed to write them (WorkloadReport's home)
+_HOME = "workload"
+
+
+@register
+class WitnessSource(Checker):
+    rule = "GC011"
+    name = "witness-single-source"
+    description = (
+        "the sim digest witness has one home: attribute writes to "
+        ".ttft/.latency and `def digest` live only in sim/workload.py "
+        "(WorkloadReport.__init__ / from_arrays) — the scalar loop and "
+        "the vectorized fast path must share the counter-stamping "
+        "code, never redefine it, or digest bit-identity stops being "
+        "checkable"
+    )
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        parts = mod.name.split(".")
+        if "sim" not in parts or parts[-1] == _HOME:
+            return
+        # token gate: a module whose source never says ttft/latency/
+        # digest cannot produce a finding — skip the tree walk
+        if (
+            "ttft" not in mod.source
+            and "latency" not in mod.source
+            and "digest" not in mod.source
+        ):
+            return
+        hits: list[tuple[ast.AST, str]] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                if node.name == "digest":
+                    hits.append((
+                        node,
+                        "defines `digest()` outside sim/workload.py: "
+                        "the witness hash has ONE home "
+                        "(WorkloadReport.digest) — a second "
+                        "definition lets the two execution paths "
+                        "drift while their parity tests keep passing",
+                    ))
+                continue
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            else:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and t.attr in _WITNESS_ATTRS:
+                    hits.append((
+                        node,
+                        f"writes the digest witness column "
+                        f"`.{t.attr}` outside sim/workload.py: "
+                        "witness arrays are stamped only by "
+                        "WorkloadReport (__init__ / from_arrays), "
+                        "the single source of truth the scalar loop "
+                        "and the vectorized fast path share",
+                    ))
+        for node, msg in sorted(
+            hits,
+            key=lambda p: (p[0].lineno, p[0].col_offset),
+        ):
+            yield mod.finding(self.rule, node, msg)

@@ -1,0 +1,17 @@
+"""GC011 bad fixture: witness writes outside the home module.
+Violation lines pinned by tests/test_graftcheck.py."""
+
+
+def finish(rep, ft, done):
+    rep.ttft = ft  # GC011 line 6: witness column written locally
+    rep.latency = done  # GC011 line 7: the other column
+
+
+def digest(report):  # GC011 line 10: a second witness definition
+    return hash(report)
+
+
+class View:
+    def close(self, arr):
+        self.latency = arr  # GC011 line 16: self-write, same contract
+        self.ttft: list = []  # GC011 line 17: annotated assignment too

@@ -1,0 +1,11 @@
+"""GC011 good half: OUTSIDE the sim package the rule does not apply —
+other planes may keep their own digest()s and latency fields."""
+
+
+class ChaosReport:
+    def __init__(self, spans):
+        self.latency = spans
+        self.ttft = None
+
+    def digest(self):
+        return hash(tuple(self.latency))

@@ -1,0 +1,25 @@
+"""GC011 good half: everything a NON-home sim module may do with the
+witness — read it, pass it as keywords, expose request-view
+properties, bind locals — without ever assigning the columns."""
+
+from .workload import WorkloadReport
+
+
+def finish(ft, done):
+    ttft = list(ft)  # a plain local, not an attribute write
+    latency = list(done)
+    return WorkloadReport.from_arrays(ttft=ttft, latency=latency)
+
+
+def check(rep):
+    return rep.digest() == rep.digest() and len(rep.ttft) >= 0
+
+
+class RequestView:
+    @property
+    def ttft(self):  # a property DEF is a read surface, not a write
+        return self._ft - self._sub
+
+    @property
+    def latency(self):
+        return self._done - self._sub

@@ -1,0 +1,18 @@
+"""GC011 good half: the HOME module — the one place the witness
+columns are stamped and the one digest definition."""
+
+
+class WorkloadReport:
+    def __init__(self, served):
+        self.ttft = [r.ft for r in served]
+        self.latency = [r.done for r in served]
+
+    @classmethod
+    def from_arrays(cls, ttft, latency):
+        rep = cls.__new__(cls)
+        rep.ttft = ttft
+        rep.latency = latency
+        return rep
+
+    def digest(self):
+        return hash((tuple(self.ttft), tuple(self.latency)))

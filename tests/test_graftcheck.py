@@ -85,6 +85,15 @@ def _keys(findings):
              ("GC010", 22), ("GC010", 27), ("GC010", 28),
              ("GC010", 34), ("GC010", 40)],
         ),
+        (
+            # the round-21 witness-single-source contract: digest
+            # witness columns written outside sim/workload.py (6, 7 —
+            # plain, 16 — self-write, 17 — annotated) and a second
+            # digest() definition (10)
+            "gc011_bad_pkg",
+            [("GC011", 6), ("GC011", 7), ("GC011", 10),
+             ("GC011", 16), ("GC011", 17)],
+        ),
     ],
 )
 def test_bad_fixture_exact_findings(bad, expected):
@@ -97,7 +106,7 @@ def test_bad_fixture_exact_findings(bad, expected):
     "good",
     ["gc001_good_pkg", "gc001_hermetic_good_pkg", "gc002_good.py",
      "gc003_good.py", "gc004_good.py", "gc005_good.py",
-     "gc010_good.py"],
+     "gc010_good.py", "gc011_good_pkg"],
 )
 def test_good_fixture_clean(good):
     res = _findings(good)
@@ -463,7 +472,8 @@ def test_package_self_run_is_clean():
     res = run([_PKG], baseline_path=DEFAULT_BASELINE)
     assert res.ok, "\n".join(f.format() for f in res.fresh)
     # GC001-GC005 + the v2 set (ISSUE 8) + GC010 shed-by-name (r20)
-    assert res.n_rules == 10
+    # + GC011 witness-single-source (r21)
+    assert res.n_rules == 11
     assert res.n_files > 50  # the whole package, not a subset
 
 
@@ -518,7 +528,8 @@ def test_cli_exit_codes():
     rules = cli("--list-rules")
     assert rules.returncode == 0
     for rule in ("GC001", "GC002", "GC003", "GC004", "GC005",
-                 "GC006", "GC007", "GC008", "GC009", "GC010"):
+                 "GC006", "GC007", "GC008", "GC009", "GC010",
+                 "GC011"):
         assert rule in rules.stdout
 
 
