@@ -835,6 +835,8 @@ def _contract_line(out: dict) -> str:
             out.get("adaptive_nwait"), "speedup"),
         "obs_overhead_pct": _rung_summary(
             out.get("observability"), "overhead_pct"),
+        "trace_overhead_pct": _rung_summary(
+            out.get("observability"), "trace_overhead_pct"),
         "train_s_per_step": _rung_summary(tt, "value"),
         "train_mfu": _rung_summary(tt, "mfu_vs_raw_matmul"),
         "decode_ms_per_token": _rung_summary(
@@ -1277,7 +1279,15 @@ def bench_observability(epochs=50, n=8):
     and a third pool loop runs with a FlightRecorder attached
     (`flight_epoch_ms`, `flight_overhead_pct` vs dark) plus the raw
     per-record ring cost (`flight_record_us`), the price of keeping
-    the postmortem ring armed in production."""
+    the postmortem ring armed in production.
+
+    Round-22 extension (request-scoped causal tracing): the SAME
+    seeded router day runs dark and then with a TraceBook armed —
+    both on the scalar engine (tracing disqualifies the vectorized
+    fastpath by name) — `trace_overhead_pct` is the marginal wall of
+    stamping every lifecycle event, `trace_events` the stamped volume,
+    and the two digests are asserted byte-identical (the
+    digest-neutrality contract, tests/test_tracing.py)."""
     from mpistragglers_jl_tpu import AsyncPool, LocalBackend, asyncmap, waitall
     from mpistragglers_jl_tpu.obs import (
         FlightRecorder,
@@ -1381,9 +1391,49 @@ def bench_observability(epochs=50, n=8):
             body.count(b"\n"),
         )
 
+    def run_traced_day():
+        """One seeded router day, dark then traced, both scalar: the
+        marginal cost of causal tracing on the request hot path."""
+        from mpistragglers_jl_tpu.models.router import RequestRouter
+        from mpistragglers_jl_tpu.obs import TraceBook
+        from mpistragglers_jl_tpu.sim.clock import VirtualClock
+        from mpistragglers_jl_tpu.sim.workload import (
+            SimReplica,
+            poisson_arrivals,
+            run_router_day,
+        )
+
+        def day(book):
+            clock = VirtualClock()
+            router = RequestRouter(
+                [SimReplica(clock, slots=4, n_inner=8, tick_s=0.02)
+                 for _ in range(3)],
+                clock=clock, trace=book,
+            )
+            arrivals = poisson_arrivals(
+                40.0, n=3000, seed=7, prompt_len=64, max_new=8,
+            )
+            t0 = time.perf_counter()
+            rep = run_router_day(router, arrivals)
+            return time.perf_counter() - t0, rep.digest()
+
+        dark_wall, dark_digest = day(None)
+        book = TraceBook()
+        traced_wall, traced_digest = day(book)
+        if traced_digest != dark_digest:
+            raise AssertionError(
+                "tracing perturbed the day digest: "
+                f"{dark_digest} != {traced_digest}"
+            )
+        n_events = sum(
+            len(book.events(t)) for t in book.ids()
+        )
+        return dark_wall, traced_wall, n_events
+
     dark_s, _, _ = run(False)
     inst_s, tracer, registry = run(True)
     flight_s, flight_record_us = run_flight()
+    day_dark_s, day_traced_s, trace_events = run_traced_day()
     scrape_p50, scrape_p95, scrape_lines = scrape(registry)
     s = tracer.summary()
     snap = registry.snapshot()
@@ -1402,6 +1452,14 @@ def bench_observability(epochs=50, n=8):
             max(flight_s / dark_s - 1.0, 0.0) * 100, 2
         ),
         "flight_record_us": round(flight_record_us, 3),
+        # causal-tracing fields (round 22): seeded router day, scalar
+        # engine both runs, digests asserted byte-identical above
+        "trace_day_dark_ms": round(day_dark_s * 1e3, 1),
+        "trace_day_traced_ms": round(day_traced_s * 1e3, 1),
+        "trace_events": trace_events,
+        "trace_overhead_pct": round(
+            max(day_traced_s / day_dark_s - 1.0, 0.0) * 100, 2
+        ),
         # thread-scheduling noise can make the instrumented loop read
         # FASTER than the dark one; clamp at 0 so the digest scalar
         # reads as "measured overhead", never a nonsense negative

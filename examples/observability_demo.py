@@ -19,7 +19,15 @@ telemetry artifacts:
    HTTP (``curl http://127.0.0.1:<printed port>/metrics`` works too
    while it runs), then trips a ``FlightRecorder`` dump — the bounded
    postmortem ring, with one Perfetto pid per worker process;
-4. everything merges: ``dump_merged_chrome_trace`` writes ONE
+4. request-scoped causal tracing (round 22): a sim router day runs
+   with a ``TraceBook`` armed — every request's life (submitted →
+   prefill chunks → first token → migrate/adopt → retired) is one
+   typed event list — the demo prints one served request's waterfall,
+   fetches the SAME waterfall as JSON from ``GET /trace/<id>`` over
+   real HTTP, and runs the conservation audit (``GET /audit``: every
+   submitted id resolved exactly once, token/migration arithmetic
+   closed);
+5. everything merges: ``dump_merged_chrome_trace`` writes ONE
    Chrome/Perfetto trace with the pool's worker/coordinator tracks,
    the scheduler's tick track, and the worker processes' own task
    spans (clock-aligned) side by side — open it at
@@ -203,6 +211,80 @@ def live_section(registry, flight, outdir):
         srv.close()
 
 
+def tracing_section():
+    """Request-scoped causal tracing: arm a TraceBook on a two-tier
+    sim router day (prefill tier hands streams to decode replicas at
+    first token, so waterfalls cross a migration), print one request's
+    waterfall, then serve it over real HTTP via /trace/<id> and run
+    the conservation audit via /audit."""
+    import urllib.request
+
+    from mpistragglers_jl_tpu.models.router import RequestRouter
+    from mpistragglers_jl_tpu.obs import TraceBook, audit
+    from mpistragglers_jl_tpu.sim.clock import VirtualClock
+    from mpistragglers_jl_tpu.sim.workload import (
+        SimReplica,
+        poisson_arrivals,
+        run_router_day,
+    )
+
+    clock = VirtualClock()
+    fleet = [
+        SimReplica(clock, slots=4, n_inner=8, tick_s=0.02,
+                   tier="prefill" if i < 1 else "decode",
+                   chunk_s=0.005)
+        for i in range(3)
+    ]
+    book = TraceBook("router-day")
+    router = RequestRouter(fleet, policy="two_tier", clock=clock,
+                           trace=book)
+    rep = run_router_day(
+        router,
+        poisson_arrivals(30.0, n=120, seed=3,
+                         prompt_len=64, max_new=8),
+    )
+
+    # one migrated-and-served request's waterfall, door-relative
+    tid = next(
+        t for t in book.ids() if book.cohort(t) == "migrated"
+    )
+    wf = book.waterfall(tid)
+    print(
+        f"tracing: {len(book)} traces on the day "
+        f"(digest {rep.digest()}); request #{tid} waterfall:"
+    )
+    for ev in wf["events"]:
+        attrs = ", ".join(
+            f"{k}={v}" for k, v in ev["attrs"].items()
+        )
+        print(f"  +{ev['dt'] * 1e3:8.2f} ms  {ev['kind']:18s} {attrs}")
+    print(
+        f"  ttft {wf['ttft'] * 1e3:.2f} ms, latency "
+        f"{wf['latency'] * 1e3:.2f} ms, outcome {wf['outcome']}"
+    )
+
+    # the same waterfall over real HTTP, plus the conservation audit
+    with ObsServer() as srv:
+        srv.add_tracebook(book)
+        http_wf = json.loads(
+            urllib.request.urlopen(
+                f"{srv.url}/trace/{tid}"
+            ).read()
+        )
+        assert http_wf["ttft"] == wf["ttft"]
+        assert http_wf["latency"] == wf["latency"]
+        adoc = json.loads(
+            urllib.request.urlopen(srv.url + "/audit").read()
+        )
+    res = audit(book, rep)
+    assert res.ok and adoc["ok"], (res.failures, adoc)
+    print(
+        f"tracing: GET /trace/{tid} reproduced ttft/latency exactly; "
+        f"GET /audit ok ({len(res.checked)} invariants checked: "
+        + ", ".join(res.checked) + ")"
+    )
+
+
 def main():
     outdir = sys.argv[1] if len(sys.argv) > 1 else "."
     os.makedirs(outdir, exist_ok=True)
@@ -213,6 +295,7 @@ def main():
     serving_section(registry, spans)
     tracer = pool_section(registry)
     worker_recorders = live_section(registry, flight, outdir)
+    tracing_section()
 
     trace_path = os.path.join(outdir, "unified_trace.json")
     n_events = dump_merged_chrome_trace(

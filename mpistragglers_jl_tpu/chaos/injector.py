@@ -54,9 +54,10 @@ class ChaosInjector:
     >>> report.digest()     # the replay witness
     """
 
-    def __init__(self, *, registry=None, flight=None):
+    def __init__(self, *, registry=None, flight=None, trace=None):
         self.registry = registry
         self.flight = flight
+        self.trace = trace
 
     # -- episode drive ----------------------------------------------------
 
@@ -108,6 +109,11 @@ class ChaosInjector:
             clock, registry=self.registry, flight=self.flight
         )
         router = built["router"]
+        if self.trace is not None:
+            # arm request-scoped causal tracing for the whole episode:
+            # the post-run battery then runs the conservation audit
+            # over every trace the day minted
+            router.attach_trace(self.trace)
         if self.flight is not None:
             self.flight.event(
                 "chaos episode", src="chaos", t=clock.now(),
@@ -192,6 +198,24 @@ class ChaosInjector:
                     "the episode partitioned replicas but the flight "
                     "ring holds no partition instants"
                 )
+        if self.trace is not None:
+            # conservation audit over the episode's traces: every
+            # submitted id resolved exactly once, hedge/migration
+            # arithmetic closed, report reconciliation exact
+            from ..obs.audit import audit as _trace_audit
+
+            res = _trace_audit(
+                self.trace, workload, self.registry
+            )
+            if not res.ok:
+                raise InvariantViolation(
+                    "trace conservation audit failed: "
+                    + "; ".join(
+                        f"{f.invariant}: {f.detail}"
+                        for f in res.failures
+                    )
+                )
+            invariants.append("trace_conservation")
         extras = {}
         post = built.get("post")
         if post is not None:

@@ -271,10 +271,15 @@ class FleetController:
         timer: Callable[[], float] | None = None,
         registry=None,
         flight=None,
+        trace=None,
     ):
         self.router = router
         self.clock = clock
         self._now = clock.now
+        if trace is not None:
+            # arm causal tracing fleet-wide: the router (and through
+            # it every replica) stamps onto this one book
+            router.attach_trace(trace)
         n = len(router.replicas)
         self.capacity_rps = float(capacity_rps)
         if self.capacity_rps <= 0.0:
@@ -604,6 +609,7 @@ class FleetController:
                 self._provision(i)
                 moved.append(i)
         elif target < size:
+            tb = getattr(self.router, "_trace", None)
             for i in reversed(range(len(self._provisioned))):
                 if size - len(moved) <= target:
                     break
@@ -615,6 +621,13 @@ class FleetController:
                 if not math.isnan(up_at):
                     self._chip_seconds[i] += max(now - up_at, 0.0)
                 self._up_since[i] = math.nan
+                if tb is not None:
+                    # stamp the CAUSE before mark_down's evacuate
+                    # records the mechanics (evacuated/rerouted)
+                    for rr in self.router.inflight_on(i):
+                        if rr.trace is not None:
+                            tb.event(rr.trace, "evacuated_on_resize",
+                                     now, replica=i)
                 self.router.mark_down(i)
                 moved.append(i)
         return moved

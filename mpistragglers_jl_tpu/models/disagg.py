@@ -88,7 +88,7 @@ class MigrationTicket:
     idempotent, so cancel paths can never double-free."""
 
     __slots__ = ("state", "reason", "pages", "nbytes", "frames",
-                 "_ring", "_released", "_owner")
+                 "_ring", "_released", "_owner", "trace")
 
     def __init__(self, state: dict, *, reason: str = "prefill_done"):
         self.state = state
@@ -106,6 +106,10 @@ class MigrationTicket:
         self._ring: "MigrationRing | None" = None
         self._released = False
         self._owner: "MigrationPlanner | None" = None
+        # causal-trace id riding WITH the pages (round 22): set from
+        # the captured request so the destination can rejoin a rebuilt
+        # request to its trace after a frame-serialized hop
+        self.trace = None
 
     @property
     def request(self):
@@ -466,6 +470,7 @@ class MigrationPlanner:
         sched = getattr(src, "sched", src)
         state = sched.export_page_state(req)
         ticket = MigrationTicket(state, reason=reason)
+        ticket.trace = getattr(req, "trace", None)
         ticket._owner = self
         self._inflight[req.id] = ticket
         self.n_captured += 1
@@ -499,6 +504,10 @@ class MigrationPlanner:
                 owner._inflight[req.id] = ticket
             raise
         self.n_landed += 1
+        if ticket.trace is not None \
+                and getattr(out, "trace", None) is None:
+            # a request rebuilt from frames rejoins its trace here
+            out.trace = ticket.trace
         ticket.state["request"] = out
         ticket.release()
         return out
@@ -547,8 +556,11 @@ class _TierReplica:
             else MigrationPlanner()
 
     # -- replica protocol (delegated) -----------------------------------
-    def submit(self, prompt, max_new: int, key=None):
-        return self.sched.submit(prompt, max_new, key=key)
+    def submit(self, prompt, max_new: int, key=None, trace=None):
+        if trace is None:
+            return self.sched.submit(prompt, max_new, key=key)
+        return self.sched.submit(prompt, max_new, key=key,
+                                 trace=trace)
 
     def step(self):
         return self.sched.step()

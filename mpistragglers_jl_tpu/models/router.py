@@ -160,7 +160,7 @@ class RoutedRequest:
         "id", "prompt", "max_new", "key", "tenant", "t_submit",
         "t_admitted", "t_first_token", "t_done", "replica",
         "hedge_replica", "hedged", "rerouted", "migrated", "finished",
-        "outcome", "shed_reason", "_legs", "_hedge_charged",
+        "outcome", "shed_reason", "trace", "_legs", "_hedge_charged",
     )
 
     _next_id = 0
@@ -191,6 +191,7 @@ class RoutedRequest:
         self.finished = False
         self.outcome: str | None = None
         self.shed_reason: str | None = None  # set iff outcome "shed"
+        self.trace: int | None = None  # TraceBook id (None = dark)
         # (replica_idx, scheduler_request) in dispatch order; the
         # winner leg is promoted to index 0 when first tokens resolve
         self._legs: list[tuple[int, Any]] = []
@@ -538,6 +539,7 @@ class RequestRouter:
         registry=None,
         flight=None,
         exporter=None,
+        trace=None,
     ):
         self.replicas = list(replicas)
         if not self.replicas:
@@ -680,6 +682,11 @@ class RequestRouter:
             if registry is not None or flight is not None
             else None
         )
+        # causal tracing (round 22): OPT-IN per the GC004 contract —
+        # a dark router pays one `is None` check per transition
+        self._trace = trace
+        if trace is not None:
+            self._propagate_trace(trace)
         # initial health reading: a replica dead at construction must
         # never receive the first submit (step() keeps probing after)
         for i, r in enumerate(self.replicas):
@@ -687,6 +694,29 @@ class RequestRouter:
         self._routable = [i for i, u in enumerate(self._up) if u]
         if exporter is not None:
             exporter.register_router(self)
+
+    # -- causal tracing (round 22) --------------------------------------
+
+    def attach_trace(self, book) -> None:
+        """Arm causal tracing post-construction — the chaos injector's
+        hook (``scenario.build`` signatures stay untouched): every
+        request submitted from here on mints a trace id at the door,
+        and the replica-side events (DRR, prefill chunks) stamp the
+        same book."""
+        self._trace = book
+        self._propagate_trace(book)
+
+    def _propagate_trace(self, book) -> None:
+        for rep in self.replicas:
+            at = getattr(rep, "attach_trace", None)
+            if at is not None:
+                at(book)
+
+    def inflight_on(self, i: int) -> list[RoutedRequest]:
+        """Snapshot of the requests with a leg on replica ``i`` — the
+        fleet controller reads this at a shrink to stamp
+        ``evacuated_on_resize`` on the traces it is about to drain."""
+        return list(self._awaiting[i]) + list(self._streaming[i])
 
     # -- health ---------------------------------------------------------
 
@@ -808,6 +838,15 @@ class RequestRouter:
                 if j == i:
                     stale.append((rr, leg))
             rr._legs = [leg for leg in rr._legs if leg[0] != i]
+            if self._trace is not None and rr.trace is not None:
+                self._trace.event(
+                    rr.trace, "partition_abandoned", now, replica=i
+                )
+                if (rr.hedged and rr.t_first_token is None
+                        and rr.hedge_replica is not None):
+                    self._trace.event(
+                        rr.trace, "hedge_abandoned", now, replica=i
+                    )
             self._hedge_release(rr)  # the hedge episode died with a leg
             if rr._legs:
                 j = rr._legs[0][0]
@@ -956,6 +995,18 @@ class RequestRouter:
                 except Exception:  # noqa: BLE001 — dead replica
                     pass
             rr._legs = [leg for leg in rr._legs if leg[0] != i]
+            if self._trace is not None and rr.trace is not None:
+                self._trace.event(
+                    rr.trace, "evacuated", now, replica=i
+                )
+                if (rr.hedged and rr.t_first_token is None
+                        and rr.hedge_replica is not None):
+                    # the hedge EPISODE died with the leg (whichever
+                    # side was lost): neither won nor race-cancelled
+                    # — the audit's third hedge-leg disposition
+                    self._trace.event(
+                        rr.trace, "hedge_abandoned", now, replica=i
+                    )
             self._hedge_release(rr)  # the hedge episode died with a leg
             if rr._legs:
                 # the surviving hedge leg carries the request alone
@@ -986,8 +1037,15 @@ class RequestRouter:
         rr.replica = j
         rr.hedge_replica = None
         self._awaiting[j][rr] = None
+        if self._trace is not None and rr.trace is not None:
+            self._trace.event(rr.trace, "rerouted", now, replica=j)
         if self.policy == "hedge_p99":
             self._hedge.arm(rr, now + self.ttft_slo)
+            if self._trace is not None and rr.trace is not None:
+                self._trace.event(
+                    rr.trace, "hedge_armed", now,
+                    fire_at=now + self.ttft_slo,
+                )
 
     # -- policy ---------------------------------------------------------
 
@@ -1095,13 +1153,36 @@ class RequestRouter:
         """One replica-submit with the tenant threaded through —
         only when the request carries one, so tenant-less traffic
         keeps the pre-QoS replica protocol verbatim."""
-        if rr.tenant is None:
+        if rr.trace is None:
+            # dark path: the pre-trace replica protocol verbatim
+            if rr.tenant is None:
+                return self.replicas[j].submit(
+                    rr.prompt, rr.max_new, key=rr.key
+                )
             return self.replicas[j].submit(
-                rr.prompt, rr.max_new, key=rr.key
+                rr.prompt, rr.max_new, key=rr.key, tenant=rr.tenant
             )
-        return self.replicas[j].submit(
-            rr.prompt, rr.max_new, key=rr.key, tenant=rr.tenant
-        )
+        kw = {"trace": rr.trace}
+        if rr.tenant is not None:
+            kw["tenant"] = rr.tenant
+        try:
+            # traced path: the id travels IN the submit so the
+            # replica's enqueue-time events (drr_queued) carry it
+            return self.replicas[j].submit(
+                rr.prompt, rr.max_new, key=rr.key, **kw
+            )
+        except TypeError:
+            # foreign replica type without the trace kwarg: submit
+            # dark, then stamp the leg post-hoc where possible
+            del kw["trace"]
+            leg = self.replicas[j].submit(
+                rr.prompt, rr.max_new, key=rr.key, **kw
+            )
+            try:
+                leg.trace = rr.trace
+            except AttributeError:
+                pass
+            return leg
 
     def submit(self, prompt, max_new: int, key=None,
                tenant: str | None = None) -> RoutedRequest:
@@ -1170,6 +1251,12 @@ class RequestRouter:
                     )
                 self.n_over_budget += 1
         rr = RoutedRequest(prompt, max_new, key, now, tenant=tenant)
+        if self._trace is not None:
+            rr.trace = self._trace.mint()
+            self._trace.event(
+                rr.trace, "submitted", now, tenant=tenant,
+                prompt=self._prompt_tokens(prompt),
+            )
         i = self._pick(prompt, routable)
         leg = self._submit_leg(i, rr)
         rr._legs = [(i, leg)]
@@ -1177,6 +1264,11 @@ class RequestRouter:
         self._awaiting[i][rr] = None
         if self.policy == "hedge_p99":
             self._hedge.arm(rr, now + self.ttft_slo)
+            if rr.trace is not None:
+                self._trace.event(
+                    rr.trace, "hedge_armed", now,
+                    fire_at=now + self.ttft_slo,
+                )
         self.n_submitted += 1
         return rr
 
@@ -1194,6 +1286,15 @@ class RequestRouter:
         rr.outcome = "shed"
         rr.shed_reason = str(reason)
         rr.t_done = now
+        if self._trace is not None:
+            rr.trace = self._trace.mint()
+            self._trace.event(
+                rr.trace, "submitted", now, tenant=tenant,
+                prompt=self._prompt_tokens(prompt),
+            )
+            self._trace.event(
+                rr.trace, "shed", now, reason=str(reason)
+            )
         self.n_submitted += 1
         self.n_completed += 1
         self.n_shed += 1
@@ -1255,6 +1356,10 @@ class RequestRouter:
             self.n_hedges += 1
             if self._obs is not None:
                 self._obs.hedge_fired(rr, j, now)
+            if self._trace is not None and rr.trace is not None:
+                self._trace.event(
+                    rr.trace, "hedge_fired", now, replica=j
+                )
 
     def _resolve_first_tokens(self, now: float,
                               ticked: Sequence[int]) -> None:
@@ -1276,6 +1381,11 @@ class RequestRouter:
                         rr.t_admitted = now
                         if self._obs is not None:
                             self._obs.admitted(now - rr.t_submit)
+                        if (self._trace is not None
+                                and rr.trace is not None):
+                            self._trace.event(
+                                rr.trace, "admitted", now, replica=j
+                            )
                     if winner is None and len(leg.tokens) > 0:
                         winner = idx
                 if winner is None:
@@ -1286,9 +1396,30 @@ class RequestRouter:
                         continue
                     self._awaiting[jj].pop(rr, None)
                     self.replicas[jj].cancel(loser)
+                    if (self._trace is not None
+                            and rr.trace is not None
+                            and rr.hedged
+                            and jj == rr.hedge_replica):
+                        # the HEDGE leg lost the race and was reaped:
+                        # the "cancelled == fired - won - abandoned"
+                        # arithmetic the audit checks counts exactly
+                        # these (a reaped PRIMARY is the hedge_won
+                        # case, not a cancellation)
+                        self._trace.event(
+                            rr.trace, "hedge_cancelled", now,
+                            replica=jj,
+                        )
                 rr._legs = [(j, leg)]
                 rr.replica = j
                 rr.t_first_token = now
+                if self._trace is not None and rr.trace is not None:
+                    self._trace.event(
+                        rr.trace, "first_token", now, replica=j
+                    )
+                    if rr.hedged and j == rr.hedge_replica:
+                        self._trace.event(
+                            rr.trace, "hedge_won", now, replica=j
+                        )
                 self._hedge.disarm(rr)
                 self._hedge_release(rr)
                 self._awaiting[j].pop(rr, None)
@@ -1324,6 +1455,19 @@ class RequestRouter:
                 self.n_kept_local += 1
                 return False
         ticket = migrate_out(leg)
+        if self._trace is not None and rr.trace is not None:
+            # the trace id rides INSIDE the ticket so an adopting
+            # replica (possibly a different process in the live plane)
+            # can keep stamping the same record
+            try:
+                ticket.trace = rr.trace
+            except AttributeError:
+                pass
+            self._trace.event(
+                rr.trace, "migrate_out", now, replica=i,
+                nbytes=int(getattr(ticket, "nbytes", 0)),
+                pages=int(getattr(ticket, "pages", 0) or 0),
+            )
         delay = (
             ticket.nbytes / (self.migrate_gbs * 1e9)
             if self.migrate_gbs else 0.0
@@ -1399,6 +1543,11 @@ class RequestRouter:
             self.migrated_bytes += int(getattr(ticket, "nbytes", 0))
             if self._obs is not None:
                 self._obs.migrated(rr, ticket, j, now, now - t0)
+            if self._trace is not None and rr.trace is not None:
+                self._trace.event(
+                    rr.trace, "adopt", now, replica=j,
+                    bounced=bounced,
+                )
 
     def _resolve_completions(
         self, now: float, ticked: Sequence[int]
@@ -1428,6 +1577,11 @@ class RequestRouter:
                 self.n_completed += 1
                 if self._obs is not None:
                     self._obs.completed(rr)
+                if self._trace is not None and rr.trace is not None:
+                    self._trace.event(
+                        rr.trace, "retired", now, outcome=rr.outcome,
+                        tokens=len(leg.tokens),
+                    )
                 done.append(rr)
         return done
 

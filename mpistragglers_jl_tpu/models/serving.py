@@ -1026,6 +1026,13 @@ class Request:
         # first EOS if found, and how many tokens were already scanned
         self._eos_at: int | None = None
         self._scanned = 0
+        # causal tracing (round 22): the TraceBook id following this
+        # request across planes (None = dark). _trace_owned marks a
+        # trace MINTED at this scheduler's door — terminal events are
+        # stamped by the owner only (a router-managed leg's terminals
+        # belong to the router, obs/tracing.py docstring)
+        self.trace: int | None = None
+        self._trace_owned = False
 
 
 class _Admitting:
@@ -1154,7 +1161,7 @@ class ServingScheduler:
                  cache_pages: int | None = None,
                  qos: TenantRegistry | None = None,
                  max_queue: int | None = None, registry=None,
-                 spans=None, flight=None, exporter=None):
+                 spans=None, flight=None, exporter=None, trace=None):
         W = _check_ring_cfg(cfg)
         _check_sampling_params(temperature, top_k)
         if cfg.n_experts:
@@ -1319,10 +1326,33 @@ class ServingScheduler:
             self._obs is not None or flight is not None
             or exporter is not None
         )
+        # causal tracing (round 22, opt-in per GC004): request
+        # lifecycle events on the wall clock; dark schedulers pay one
+        # `is None` check per transition
+        self._trace = None
+        if trace is not None:
+            self.attach_trace(trace)
         if exporter is not None:
             # register the tick-freshness health check (+ the span
             # recorder as a /trace source) on the ObsServer
             exporter.register_scheduler(self)
+
+    def attach_trace(self, book) -> None:
+        """Arm causal tracing (constructor ``trace=`` routes here; a
+        router propagates its book the same way). DRR admission
+        transitions ride the scheduler's trace hook — qos/ itself
+        stays clock-free."""
+        self._trace = book
+        if self._drr is not None:
+            self._drr.set_trace(self._drr_trace_event)
+
+    def _drr_trace_event(self, kind, tenant, item, cost) -> None:
+        tid = item.trace
+        if tid is not None:
+            self._trace.event(
+                tid, kind, time.perf_counter(), tenant=tenant,
+                cost=cost,
+            )
 
     # -- public API -----------------------------------------------------
 
@@ -1336,7 +1366,7 @@ class ServingScheduler:
         self._stamp_ticks = True
 
     def submit(self, prompt, max_new: int, key=None,
-               tenant: str | None = None) -> Request:
+               tenant: str | None = None, trace=None) -> Request:
         """Queue a request; returns the live :class:`Request` whose
         ``tokens``/``finished`` the caller watches. Admission happens
         inside subsequent ticks — requests may arrive while others are
@@ -1377,6 +1407,18 @@ class ServingScheduler:
         obs = self._obs
         if obs is not None:
             req._t_submit = time.perf_counter()
+        if trace is not None:
+            # router-minted id: the leg joins an existing record
+            req.trace = trace
+        elif self._trace is not None:
+            # this scheduler IS the entry door: mint here and own the
+            # terminal events
+            req.trace = self._trace.mint()
+            req._trace_owned = True
+            self._trace.event(
+                req.trace, "submitted", time.perf_counter(),
+                tenant=tenant, prompt=int(req.prompt.size),
+            )
         if self._drr is not None:
             # DRR cost is in tokens (prompt + budget — the same unit
             # as the contracts' rate budgets), so fairness is fair
@@ -1538,6 +1580,15 @@ class ServingScheduler:
         req.finished = True
         req.reason = "cancelled"
         req.retired_tick = self.tick_count
+        # terminal events belong to the request's OWNER: only traces
+        # minted at THIS door get their cancel stamped here (a router
+        # leg's cancel is the router's reap, not the request's end)
+        if self._trace is not None and req.trace is not None \
+                and req._trace_owned:
+            self._trace.event(
+                req.trace, "cancelled", time.perf_counter(),
+                tick=self.tick_count,
+            )
 
     # -- KV-page migration (models/disagg.py's replica hooks) -----------
     #
@@ -1781,6 +1832,12 @@ class ServingScheduler:
                 wrapper=wraps,
             )
             pids[j] = pid
+            if self._trace is not None and req is not None \
+                    and req.trace is not None:
+                self._trace.event(
+                    req.trace, "share_hit", time.perf_counter(),
+                    page=int(pid),
+                )
             if self._drr is not None and pid in self._cold:
                 self._warm_cold(pid)
         try:
@@ -1824,6 +1881,12 @@ class ServingScheduler:
         self._slot_req[s] = req
         if req.admitted_tick is None:
             req.admitted_tick = self.tick_count
+        if self._trace is not None \
+                and getattr(req, "trace", None) is not None:
+            self._trace.event(
+                req.trace, "admitted", time.perf_counter(),
+                tick=self.tick_count, adopted=True,
+            )
         return req
 
     def run(self, max_ticks: int = 10_000) -> None:
@@ -1921,6 +1984,11 @@ class ServingScheduler:
         req.admitted_tick = self.tick_count
         if self._obs is not None and req.tenant is not None:
             self._obs.qos_admitted(self, req.tenant)
+        if self._trace is not None and req.trace is not None:
+            self._trace.event(
+                req.trace, "admitted", time.perf_counter(),
+                tick=self.tick_count,
+            )
         # first chunk runs this very tick (short prompts admit in
         # one tick and decode from the next)
         self._advance_admission(s, retired)
@@ -1992,6 +2060,11 @@ class ServingScheduler:
                 wrapper=wraps,
             )
             pids[j] = pid
+            if self._trace is not None and req.trace is not None:
+                self._trace.event(
+                    req.trace, "share_hit", time.perf_counter(),
+                    page=int(pid),
+                )
             if self._drr is not None and pid in self._cold:
                 # a cold page found its next sharer: the cache's hold
                 # transfers to the new slot (warm)
@@ -2173,6 +2246,13 @@ class ServingScheduler:
                 if self.pool.refcount(pid) > 1:
                     new = self.pool.cow_alloc(pid)
                     copies.append((pid, new))
+                    if self._trace is not None:
+                        _r = self._slot_req[s]
+                        if _r is not None and _r.trace is not None:
+                            self._trace.event(
+                                _r.trace, "cow_copy",
+                                time.perf_counter(), page=int(pid),
+                            )
                     # the writer leaves the shared page for its copy;
                     # only wrapping slots ever write shared pages, so
                     # the page's wrapper count drops with it
@@ -2211,6 +2291,11 @@ class ServingScheduler:
         st.next_chunk += 1
         if self._obs is not None:
             self._obs.prefill_chunk()
+        if self._trace is not None and st.req.trace is not None:
+            self._trace.event(
+                st.req.trace, "prefill_chunk", time.perf_counter(),
+                tick=self.tick_count,
+            )
         if st.next_chunk < st.n_chunks:
             return
         Tp = st.req.prompt.size
@@ -2250,6 +2335,11 @@ class ServingScheduler:
         st.req.tokens.append(int(tok0))
         if self._obs is not None:
             self._obs.first_token(st.req, time.perf_counter())
+        if self._trace is not None and st.req.trace is not None:
+            self._trace.event(
+                st.req.trace, "first_token", time.perf_counter(),
+                tick=self.tick_count,
+            )
         del self._admitting[s]
         if self._retire_if_due(st.req):  # max_new == 1 or prompt EOS
             self._free_slot(s)
@@ -2283,6 +2373,13 @@ class ServingScheduler:
         del req.tokens[cut:]
         req.finished = True
         req.retired_tick = self.tick_count
+        # owner-only terminal stamp (see _retire_cancelled)
+        if self._trace is not None and req.trace is not None \
+                and req._trace_owned:
+            self._trace.event(
+                req.trace, "retired", time.perf_counter(),
+                outcome=req.reason, tokens=len(req.tokens),
+            )
         return True
 
     def _free_slot(self, s: int) -> None:

@@ -21,6 +21,16 @@ counter-delta semantics across respawns and clock-aligned spans;
 deltas and dumps it automatically on watchdog stalls, pool deadline
 expiries, and interpreter exit — the postmortem artifact for hangs.
 
+**Request-scoped** (round 22): :mod:`.tracing` follows ONE request
+through every serving plane it crosses as a flat list of typed
+lifecycle events on the owner's clock (:class:`TraceBook` —
+deterministic under sim replay, digest-neutral, one ``is None`` on
+dark paths); :mod:`.audit` closes the loop with a conservation audit
+(:func:`audit`) proving every submitted id resolved exactly once and
+the books' arithmetic — tokens, pages, hedge legs, migration bytes —
+matches the report and the metrics registry. :class:`ObsServer`
+serves both: ``/trace/<id>`` waterfalls and ``/audit``.
+
 Everything here is strictly OPT-IN, mirroring the tracer contract:
 instrumented layers (``ServingScheduler``, ``CodedGradTrainer``,
 ``CodedGemm``, ``HedgedServer``, ``ProcessBackend``) accept
@@ -31,6 +41,7 @@ jax-free import contract holds.
 """
 
 from .aggregate import OBS_TAG, TelemetryAggregator, WorkerTelemetry
+from .audit import AuditFailure, AuditResult, audit
 from .export import HealthCheck, ObsServer
 from .flight import FlightRecorder, FlightWatchdog
 from .metrics import (
@@ -46,6 +57,7 @@ from .timeline import (
     dump_merged_chrome_trace,
     merged_chrome_trace,
 )
+from .tracing import TERMINAL_KINDS, TraceBook
 
 __all__ = [
     "Counter",
@@ -64,4 +76,9 @@ __all__ = [
     "OBS_TAG",
     "FlightRecorder",
     "FlightWatchdog",
+    "TraceBook",
+    "TERMINAL_KINDS",
+    "audit",
+    "AuditResult",
+    "AuditFailure",
 ]

@@ -16,7 +16,13 @@ hot path never blocks on a scrape) exposing
                     HTTP 200 when all pass, 503 otherwise
 ``/trace``          on-demand merged Chrome/Perfetto trace of every
                     registered tracer/recorder (plus the per-worker
-                    recorders of registered aggregators)
+                    recorders of registered aggregators and the
+                    request-cohort tracks of registered trace books)
+``/trace/<id>``     one request's causal waterfall (JSON): every typed
+                    lifecycle event with door-relative ``dt``, derived
+                    ttft/latency, cohort, retry lineage
+``/audit``          the conservation audit over every registered trace
+                    book — invariant pass/fail with offending ids
 ``/flight``         the flight recorder's ring as a Chrome trace
 ==================  ====================================================
 
@@ -109,6 +115,7 @@ class ObsServer:
         self._tracers: list = []
         self._recorders: list = []
         self._aggregators: list = []
+        self._books: list = []
         self._checks: dict[str, HealthCheck] = {}
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
@@ -135,6 +142,13 @@ class ObsServer:
         """A :class:`~.aggregate.TelemetryAggregator` whose per-worker
         recorders join ``/trace`` (one pid per worker process)."""
         self._aggregators.append(agg)
+        return self
+
+    def add_tracebook(self, book) -> "ObsServer":
+        """A :class:`~.tracing.TraceBook`: its request-cohort tracks
+        join ``/trace``, its waterfalls serve ``/trace/<id>``, and the
+        conservation audit over it serves ``/audit``."""
+        self._books.append(book)
         return self
 
     def _unique_name(self, base: str) -> str:
@@ -242,6 +256,9 @@ class ObsServer:
             )
 
         self.add_health(name, check)
+        book = getattr(router, "_trace", None)
+        if book is not None:
+            self.add_tracebook(book)
 
     def register_hedge(self, srv, name: str = "hedge") -> None:
         """Wire a :class:`~..utils.hedge.HedgedServer` in: replica
@@ -314,14 +331,48 @@ class ObsServer:
 
     def trace_doc(self) -> dict[str, Any]:
         # same snapshot discipline as healthz: sources register while
-        # scrapes run
+        # scrapes run. TraceBook satisfies the recorder contract
+        # (chrome_events(pid) -> (meta, events)), so books merge as
+        # one more process each — request-cohort tracks alongside the
+        # component spans.
         recorders = list(self._recorders)
         for agg in list(self._aggregators):
             recorders.extend(agg.recorders())
+        recorders.extend(self._books)
         doc, _ = merged_chrome_trace(
             tracers=list(self._tracers), recorders=recorders
         )
         return doc
+
+    def trace_waterfall(self, tid: int) -> dict[str, Any] | None:
+        """The ``GET /trace/<id>`` body: the waterfall from the first
+        registered book holding ``tid`` (books partition id spaces by
+        serving plane; None when no book knows the id)."""
+        for book in list(self._books):
+            if tid in book:
+                return book.waterfall(tid)
+        return None
+
+    def audit_doc(self) -> dict[str, Any]:
+        """The ``GET /audit`` body: the conservation audit over every
+        registered book, against the attached registry."""
+        from .audit import audit
+
+        books = list(self._books)
+        if not books:
+            return {"error": "no trace book registered"}
+        out = {
+            "ok": True,
+            "books": [],
+        }
+        for book in books:
+            res = audit(book, None, self.registry)
+            doc = res.to_dict()
+            doc["book"] = book.name
+            doc["view"] = book.audit_view()
+            out["books"].append(doc)
+            out["ok"] = out["ok"] and res.ok
+        return out
 
     def __repr__(self) -> str:
         state = self.url if self._httpd is not None else "stopped"
@@ -375,6 +426,28 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(doc, 200 if ok else 503)
             elif path == "/trace":
                 self._json(obs.trace_doc())
+            elif path.startswith("/trace/"):
+                raw = path[len("/trace/"):]
+                try:
+                    tid = int(raw)
+                except ValueError:
+                    self._json(
+                        {"error": f"bad trace id {raw!r}"}, 400
+                    )
+                    return
+                doc = obs.trace_waterfall(tid)
+                if doc is None:
+                    self._json(
+                        {"error": f"unknown trace id {tid}"}, 404
+                    )
+                    return
+                self._json(doc)
+            elif path == "/audit":
+                doc = obs.audit_doc()
+                if "error" in doc:
+                    self._json(doc, 404)
+                    return
+                self._json(doc, 200 if doc["ok"] else 503)
             elif path == "/flight":
                 if obs.flight is None:
                     self._json({"error": "no flight recorder"}, 404)
@@ -383,7 +456,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/":
                 self._json({
                     "endpoints": ["/metrics", "/metrics.json",
-                                  "/healthz", "/trace", "/flight"],
+                                  "/healthz", "/trace", "/trace/<id>",
+                                  "/audit", "/flight"],
                 })
             else:
                 self._send(404, b"not found\n", "text/plain")

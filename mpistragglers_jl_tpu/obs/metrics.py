@@ -262,6 +262,13 @@ def _escape_label(v: str) -> str:
     )
 
 
+def _escape_help(v: str) -> str:
+    # exposition 0.0.4 HELP escaping: backslash and newline only (no
+    # quote escaping — HELP text is not quoted). Round-trips exactly,
+    # unlike the old newline->space flattening.
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _prom_name(name: str) -> str:
     # _check_name already enforces the exposition grammar; kept as the
     # single seam if the registry grammar ever widens again
@@ -415,7 +422,7 @@ class MetricsRegistry:
             if insts[0].help:
                 lines.append(
                     f"# HELP {pname} "
-                    + insts[0].help.replace("\n", " ")
+                    + _escape_help(insts[0].help)
                 )
             lines.append(f"# TYPE {pname} {insts[0].kind}")
             for inst in insts:

@@ -55,7 +55,7 @@ __all__ = [
 
 
 def pipeline_spmd(stage_fn, stage_params, x, *, axis: str = "pp",
-                  n_microbatch: int, trace: bool = False):
+                  n_microbatch: int, return_busy: bool = False):
     """Run ``x`` through pp stages of ``stage_fn``; call inside shard_map.
 
     ``stage_fn(stage_params, micro) -> micro`` applies this device's
@@ -66,7 +66,7 @@ def pipeline_spmd(stage_fn, stage_params, x, *, axis: str = "pp",
 
     Returns the full-batch output, replicated across the ``pp`` axis
     (one psum at the end — the output buffer is only populated on the
-    last stage). ``trace=True`` additionally returns this device's
+    last stage). ``return_busy=True`` additionally returns this device's
     per-tick busy mask (T,) — True where the tick's stage application
     consumed a real microbatch — the measured-bubble evidence
     (:func:`measure_bubble`).
@@ -120,7 +120,7 @@ def pipeline_spmd(stage_fn, stage_params, x, *, axis: str = "pp",
     # out is nonzero only on the last stage; replicate it everywhere
     out = jax.lax.psum(out, axis)
     out = out.reshape(B, *x.shape[1:])
-    return (out, busy) if trace else out
+    return (out, busy) if return_busy else out
 
 
 def stack_layers(layers: list[dict]) -> dict:
@@ -188,7 +188,7 @@ def measure_bubble(mesh: Mesh, n_microbatch: int, schedule: str = "1f1b",
                 lambda sp, pl: (pl[0] * sp["w"], pl[1]),
                 lambda hp, pl, t: (pl[0] * hp["w"]).sum(),
                 {"w": jnp.float32(1.001)}, {"w": jnp.float32(1.0)},
-                x, tgt, axis=axis, n_microbatch=M, trace=True,
+                x, tgt, axis=axis, n_microbatch=M, return_busy=True,
             )
             return slots[None]
 
@@ -202,7 +202,7 @@ def measure_bubble(mesh: Mesh, n_microbatch: int, schedule: str = "1f1b",
         def local(x):
             _, b = pipeline_spmd(
                 lambda sp, m: m * sp["w"], {"w": jnp.float32(1.001)},
-                x, axis=axis, n_microbatch=M, trace=True,
+                x, axis=axis, n_microbatch=M, return_busy=True,
             )
             return b[None]
 
@@ -215,7 +215,7 @@ def measure_bubble(mesh: Mesh, n_microbatch: int, schedule: str = "1f1b",
         def local(x):
             _, b = pipeline_circular(
                 lambda cp, j, m: m * cp["w"], {"w": jnp.float32(1.001)},
-                x, axis=axis, n_microbatch=M, v=v, trace=True,
+                x, axis=axis, n_microbatch=M, v=v, return_busy=True,
             )
             return b[None]
 
@@ -238,7 +238,7 @@ def measure_bubble(mesh: Mesh, n_microbatch: int, schedule: str = "1f1b",
 
 
 def pipeline_circular(chunk_fn, chunk_params, x, *, axis: str = "pp",
-                      n_microbatch: int, v: int = 2, trace: bool = False):
+                      n_microbatch: int, v: int = 2, return_busy: bool = False):
     """Interleaved virtual stages: each device holds ``v`` NON-contiguous
     layer chunks and microbatches lap the device ring ``v`` times —
     call inside shard_map.
@@ -340,12 +340,12 @@ def pipeline_circular(chunk_fn, chunk_params, x, *, axis: str = "pp",
     )
     out = jax.lax.psum(out, axis)  # populated on device 0 only
     out = out.reshape(B, *x.shape[1:])
-    return (out, busy) if trace else out
+    return (out, busy) if return_busy else out
 
 
 def pipeline_1f1b(stage_fn, head_fn, stage_params, head_params, x, targets,
                   *, axis: str = "pp", n_microbatch: int,
-                  trace: bool = False):
+                  return_busy: bool = False):
     """One-forward-one-backward pipeline step; call inside shard_map.
 
     The GPipe formulation above leans on ``jax.grad`` through the scan,
@@ -515,7 +515,7 @@ def pipeline_1f1b(stage_fn, head_fn, stage_params, head_params, x, targets,
     # each tick runs a forward AND a backward slot; the (T, 2) mask says
     # which consumed a real microbatch — 1F1B's bubble denominator is
     # slot-time, 2T
-    return out + (slots,) if trace else out
+    return out + (slots,) if return_busy else out
 
 
 # ---------------------------------------------------------------- model

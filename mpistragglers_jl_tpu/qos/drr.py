@@ -83,6 +83,11 @@ class DeficitScheduler:
         self._cursor = 0
         self._granted = False  # current cursor already got its visit's quantum
         self._n = 0
+        # causal-tracing hook (round 22, opt-in): the OWNER's callback
+        # — ``hook(kind, tenant, item, cost)`` — fired at enqueue and
+        # grant. The scheduler that owns this DRR owns the clock too;
+        # qos/ itself stays clock-free (graftcheck GC008)
+        self._trace_hook = None
 
     # -- introspection ---------------------------------------------------
 
@@ -116,6 +121,14 @@ class DeficitScheduler:
 
     # -- the queue faces -------------------------------------------------
 
+    def set_trace(self, hook) -> None:
+        """Install (or clear, with None) the owner's causal-tracing
+        callback: ``hook(kind, tenant, item, cost)`` fires on
+        ``drr_queued`` (enqueue) and ``drr_picked`` (grant). The hook
+        stamps the owner's TraceBook on the OWNER's clock — this
+        module never reads one."""
+        self._trace_hook = hook
+
     def enqueue(self, tenant: str, item: Any, cost: float) -> None:
         """Queue ``item`` for ``tenant`` at ``cost`` tokens. The
         tenant must hold a contract (its weight is the quantum);
@@ -136,6 +149,8 @@ class DeficitScheduler:
         self._n += 1
         if cost > self._max_cost:
             self._max_cost = float(cost)
+        if self._trace_hook is not None:
+            self._trace_hook("drr_queued", tenant, item, float(cost))
 
     def _quantum(self, tenant: str) -> float:
         unit = self._unit if self._unit is not None else self._max_cost
@@ -186,6 +201,8 @@ class DeficitScheduler:
                     self._deficit[t] = d - c
                     if not q or self._deficit[t] < q[0][1]:
                         self._advance()
+                    if self._trace_hook is not None:
+                        self._trace_hook("drr_picked", t, item, c)
                     return t, item, c
             self._advance()
         raise AssertionError(

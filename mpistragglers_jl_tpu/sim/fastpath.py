@@ -357,6 +357,8 @@ def fastpath_supported(router, *, controller=None, events=(),
         return False, "clock has scheduled injections (chaos day)"
     if getattr(router, "_obs", None) is not None:
         return False, "router observability attached"
+    if getattr(router, "_trace", None) is not None:
+        return False, "tracing attached"
     policy = getattr(router, "policy", None)
     if policy not in _FAST_POLICIES:
         return False, f"policy {policy!r} (two_tier is event-driven)"

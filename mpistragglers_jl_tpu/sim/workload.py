@@ -627,7 +627,7 @@ class SimRequest:
 
     __slots__ = ("prompt", "max_new", "tenant", "n_emitted",
                  "finished", "reason", "admitted_tick", "migrated",
-                 "_holds_prefix")
+                 "trace", "_holds_prefix")
 
     def __init__(self, prompt: SimPrompt, max_new: int,
                  tenant: str | None = None):
@@ -640,6 +640,7 @@ class SimRequest:
         self.finished = False
         self.reason = None
         self.admitted_tick = None
+        self.trace = None  # TraceBook id (None = dark)
         # True once adopted by another replica: admission then skips
         # prefill entirely (the pages arrived with the request)
         self.migrated = False
@@ -659,7 +660,7 @@ class SimTicket:
     request object itself crosses (in-process sim), so adoption is
     stream-continuous exactly like the live in-process fast path."""
 
-    __slots__ = ("request", "nbytes", "pages", "reason")
+    __slots__ = ("request", "nbytes", "pages", "reason", "trace")
 
     def __init__(self, request: SimRequest, nbytes: int, pages: int,
                  reason: str = "prefill_done"):
@@ -667,6 +668,7 @@ class SimTicket:
         self.nbytes = int(nbytes)
         self.pages = int(pages)
         self.reason = reason
+        self.trace = None  # trace id riding inside the ticket
 
 
 class SimReplica:
@@ -720,7 +722,7 @@ class SimReplica:
                  chunk_s: float = 0.0,
                  kv_bytes_per_token: float = 4096.0,
                  page_tokens: int = 16, qos=None,
-                 max_queue: int | None = None):
+                 max_queue: int | None = None, trace=None):
         if slots < 1 or n_inner < 1 or prompt_chunk < 1:
             raise ValueError(
                 "slots, n_inner and prompt_chunk must be >= 1"
@@ -789,6 +791,27 @@ class SimReplica:
         # intervals scheduled while busy) — the numerator of the QoS
         # plane's work-conservation floor; NOT in any digest
         self.busy_s = 0.0
+        # causal tracing (round 22, opt-in per GC004): replica-side
+        # events — DRR queue transitions, prefill chunks — stamped on
+        # the VIRTUAL clock against trace ids the router minted
+        self._trace = None
+        if trace is not None:
+            self.attach_trace(trace)
+
+    def attach_trace(self, book) -> None:
+        """Arm causal tracing (the router propagates its book here).
+        DRR transitions route through the scheduler's own trace hook
+        so qos/ stays clock-free — this callback owns the clock."""
+        self._trace = book
+        if self._drr is not None:
+            self._drr.set_trace(self._drr_trace_event)
+
+    def _drr_trace_event(self, kind, tenant, item, cost) -> None:
+        tid = item.trace
+        if tid is not None:
+            self._trace.event(
+                tid, kind, self.clock.now(), tenant=tenant, cost=cost
+            )
 
     # -- replica protocol -------------------------------------------------
 
@@ -802,7 +825,7 @@ class SimReplica:
         return self._n_active
 
     def submit(self, prompt, max_new: int, key=None,
-               tenant: str | None = None) -> SimRequest:
+               tenant: str | None = None, trace=None) -> SimRequest:
         if not self.alive:
             raise RuntimeError(
                 "submit to a killed SimReplica: the router must not "
@@ -817,6 +840,10 @@ class SimReplica:
         if isinstance(prompt, int):
             prompt = SimPrompt(prompt)
         req = SimRequest(prompt, max_new, tenant=tenant)
+        if trace is not None:
+            # stamped BEFORE the enqueue so the DRR trace hook sees
+            # the id on its drr_queued event
+            req.trace = trace
         self._enqueue(req)
         if self.next_tick_at is None:
             self.next_tick_at = (
@@ -955,6 +982,7 @@ class SimReplica:
         slots = self._slots
         prefill = self._prefill
         n_inner = self.n_inner
+        trace = self._trace  # hoisted: dark ticks pay one local read
         n_chunks = 0  # prefill chunks advanced this tick (chunk_s)
         for s in range(self.S):
             req = slots[s]
@@ -1006,6 +1034,11 @@ class SimReplica:
                 req.admitted_tick = self.tick_count
                 prefill[s] = chunks - 1
                 n_chunks += 1  # the first chunk's work
+                if trace is not None and req.trace is not None:
+                    trace.event(
+                        req.trace, "prefill_chunk", now,
+                        tick=self.tick_count,
+                    )
                 if chunks == 1:
                     req.n_emitted = 1
                     if req.max_new == 1:
@@ -1016,6 +1049,11 @@ class SimReplica:
                 # advance the admission one chunk
                 prefill[s] = pf - 1
                 n_chunks += 1
+                if trace is not None and req.trace is not None:
+                    trace.event(
+                        req.trace, "prefill_chunk", now,
+                        tick=self.tick_count,
+                    )
                 if pf == 1:
                     req.n_emitted = 1  # first token, last chunk
                     if req.max_new == 1:
@@ -1405,6 +1443,16 @@ def run_router_day(
                         tenant=rr0.tenant)
             append(rr)
             n_resubmits += 1
+            tb = router._trace
+            if (tb is not None and rr.trace is not None
+                    and rr0.trace is not None):
+                # the child trace links back to the timed-out parent:
+                # the retry CLIENT alone knows the chain
+                tb.link(rr.trace, rr0.trace)
+                tb.event(
+                    rr.trace, "retry_resubmit", now_v,
+                    parent=rr0.trace, attempt=attempt + 1,
+                )
             if ctl is not None:
                 ctl.observe_arrival(now_v)
             if rr.finished:

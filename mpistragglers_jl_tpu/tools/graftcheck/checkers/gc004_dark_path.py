@@ -7,8 +7,9 @@ default to ``None``, and the dark path pays nothing beyond ``is None``
 checks. Two halves, statically checked:
 
 1. **Defaults + guards.** Any function/method taking a parameter
-   named ``registry``/``spans``/``tracer``/``exporter``/``flight``
-   with a DEFAULT must default it to ``None``, and every *dereference*
+   named ``registry``/``spans``/``tracer``/``exporter``/``flight``/
+   ``trace`` with a DEFAULT must default it to ``None``, and every
+   *dereference*
    of the parameter (``tracer.begin(...)``, ``registry.counter(...)``)
    must sit under a ``<name> is not None`` guard (an enclosing
    ``if``/ternary test, a containing ``and`` chain, or after an early
@@ -43,7 +44,8 @@ from typing import Iterator
 
 from ..core import Checker, Finding, ModuleInfo, register
 
-PARAMS = ("registry", "spans", "tracer", "exporter", "flight")
+PARAMS = ("registry", "spans", "tracer", "exporter", "flight",
+          "trace")
 
 _NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
 _FRAGMENT_RE = re.compile(r"[a-zA-Z0-9_:]*\Z")
@@ -282,8 +284,9 @@ class DarkPath(Checker):
     rule = "GC004"
     name = "dark-path"
     description = (
-        "registry/spans/tracer/exporter/flight parameters default to "
-        "None with every dereference guarded by `is not None` "
+        "registry/spans/tracer/exporter/flight/trace parameters "
+        "default to None with every dereference guarded by "
+        "`is not None` "
         "(required params are export targets and exempt); literal "
         "metric names match the Prometheus grammar "
         "[a-zA-Z_:][a-zA-Z0-9_:]*"

@@ -98,3 +98,10 @@ def chaos_inject(episode, registry=None, flight=None):
     registry.counter("chaos_episodes_total").inc()  # GC004 line 98
     flight.event("chaos episode", scenario=episode)  # GC004 line 99
     return episode
+
+
+def trace_append(tid, trace=None):
+    # the round-22 causal-tracing shape: appending a lifecycle event
+    # to the trace book without the None guard
+    trace.event(tid, "first_token", 0.0)  # GC004 line 106
+    return tid

@@ -173,3 +173,14 @@ def chaos_inject(episode, registry=None, flight=None):
         registry.gauge("chaos_max_queue_depth").set(episode)
     ok = flight is not None and flight.event("chaos episode")
     return episode if ok else None
+
+
+def trace_append(tid, trace=None):
+    """The round-22 causal-tracing shape, guarded: lifecycle events
+    only append inside the is-not-None arm (obs/tracing.py TraceBook
+    discipline — the book's owner stamps on its own clock), and the
+    mint-at-door path early-returns the dark case."""
+    if trace is not None:
+        trace.event(tid, "submitted", 0.0, tenant=None)
+    ok = trace is not None and trace.mint()
+    return tid if ok else None

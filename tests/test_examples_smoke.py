@@ -230,6 +230,13 @@ def test_observability_demo(tmp_path):
     # scraped, worker pids in /trace, the flight ring dumped
     assert "live: ObsServer on http://127.0.0.1:" in out.stdout
     assert "healthz ok, 3 worker pids in /trace" in out.stdout
+    # round 22: the causal-tracing section printed a waterfall that
+    # crossed a migration, re-fetched it over real HTTP, and the
+    # conservation audit passed
+    assert "waterfall:" in out.stdout
+    assert "migrate_out" in out.stdout and "adopt" in out.stdout
+    assert "reproduced ttft/latency exactly" in out.stdout
+    assert "GET /audit ok" in out.stdout
     # the artifacts really exist and the trace is valid trace-event JSON
     import json
 

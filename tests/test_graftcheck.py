@@ -65,7 +65,8 @@ def _keys(findings):
                           ("GC004", 71), ("GC004", 72),
                           ("GC004", 80), ("GC004", 81),
                           ("GC004", 89), ("GC004", 90),
-                          ("GC004", 98), ("GC004", 99)]),
+                          ("GC004", 98), ("GC004", 99),
+                          ("GC004", 106)]),
         (
             "gc005_bad.py",
             [("GC005", 17), ("GC005", 18), ("GC005", 21),
@@ -153,7 +154,8 @@ def test_baseline_roundtrip(tmp_path):
                                 ("GC004", 71), ("GC004", 72),
                                 ("GC004", 80), ("GC004", 81),
                                 ("GC004", 89), ("GC004", 90),
-                                ("GC004", 98), ("GC004", 99)]
+                                ("GC004", 98), ("GC004", 99),
+                                ("GC004", 106)]
     assert res.baseline_size == 1
 
 

@@ -202,6 +202,52 @@ class TestRegistry:
         # both labeled series of one family export under one TYPE
         assert sum(1 for ln in lines if ln.startswith("# TYPE route_total")) == 1
 
+    def test_prometheus_help_escaping_roundtrips(self):
+        """Exposition 0.0.4 conformance (round 22): HELP text escapes
+        backslash as ``\\\\`` and newline as ``\\n`` — byte-exact
+        round-trip through the spec's unescaping, not the old
+        newline->space flattening. Label values were already
+        conformant; pinned here beside the HELP arm."""
+        reg = MetricsRegistry()
+        help_text = 'rate in req\\s\nsecond line with "quotes"'
+        reg.counter("tricky_total", help=help_text).inc()
+        reg.counter(
+            "labeled_total", path='a\\b\n"c"'
+        ).inc()
+        text = reg.to_prometheus()
+        lines = text.splitlines()
+        # every line is still single-line (no raw newline leaked)
+        help_line = next(
+            ln for ln in lines if ln.startswith("# HELP tricky_total")
+        )
+        escaped = help_line[len("# HELP tricky_total "):]
+        assert "\n" not in escaped
+        assert escaped == (
+            'rate in req\\\\s\\nsecond line with "quotes"'
+        )
+
+        # the spec's unescaping recovers the original exactly
+        def unescape_help(s):
+            out, i = [], 0
+            while i < len(s):
+                if s[i] == "\\" and i + 1 < len(s):
+                    out.append(
+                        {"\\": "\\", "n": "\n"}[s[i + 1]]
+                    )
+                    i += 2
+                else:
+                    out.append(s[i])
+                    i += 1
+            return "".join(out)
+
+        assert unescape_help(escaped) == help_text
+        # label values: backslash, quote, and newline all escaped
+        sample = next(
+            ln for ln in lines if ln.startswith("labeled_total{")
+        )
+        assert 'path="a\\\\b\\n\\"c\\""' in sample
+        assert "\n" not in sample
+
     def test_json_snapshot_roundtrips(self):
         reg = MetricsRegistry()
         reg.counter("a_total").inc(2)

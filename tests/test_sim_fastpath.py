@@ -373,6 +373,16 @@ class TestFallbackParity:
         ok, reason = fastpath_supported(router)
         assert not ok and "chaos" in reason
 
+    def test_tracing_attached_falls_back(self):
+        # round 22: a traced day records per-request lifecycle events
+        # the vectorized engine never stamps — the fallback is named
+        from mpistragglers_jl_tpu.obs import TraceBook
+
+        _, _, router = _fleet()
+        router.attach_trace(TraceBook())
+        ok, reason = fastpath_supported(router)
+        assert not ok and reason == "tracing attached"
+
     def test_used_router_falls_back(self):
         _, _, router = _fleet()
         batch = poisson_arrival_batch(40.0, n=200, seed=1,

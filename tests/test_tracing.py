@@ -1,0 +1,458 @@
+"""Causal tracing + conservation audit (ISSUE 17): the TraceBook
+follows one request door to door across every serving plane, and the
+audit turns the prose conservation claims into executable invariants.
+
+Four layers: (1) TraceBook unit semantics (dense mint order, retry
+lineage both ways, waterfall arithmetic, find_last for re-routed
+TTFT); (2) event coverage — a plain day, a hedge race, a two-tier
+migration, a partition + re-route, a retry storm, and a DRR-paced QoS
+day each stamp their documented taxonomy and pass the audit; (3) the
+ISSUE acceptance: seeded storm_with_host_kill traced end to end —
+audit zero discrepancies across two replays, books byte-identical,
+and the traced digest equal to the dark one (tracing is
+digest-neutral); (4) the audit names its failures — a deliberately
+broken book yields the offending invariant AND trace ids, and
+unarmed invariants are listed as skipped with reasons, so "passed" is
+never confused with "not checked"."""
+
+import json
+import urllib.request
+
+import pytest
+
+from mpistragglers_jl_tpu.chaos import ChaosInjector, get_scenario
+from mpistragglers_jl_tpu.models.router import RequestRouter
+from mpistragglers_jl_tpu.obs import (
+    TERMINAL_KINDS,
+    AuditResult,
+    ObsServer,
+    TraceBook,
+    audit,
+)
+from mpistragglers_jl_tpu.qos import TenantContract, TenantRegistry
+from mpistragglers_jl_tpu.sim import (
+    ReplicaPartition,
+    RetryPolicy,
+    VirtualClock,
+)
+from mpistragglers_jl_tpu.sim.workload import (
+    SimPrompt,
+    SimReplica,
+    poisson_arrivals,
+    run_router_day,
+)
+
+
+def _day(trace=None, *, n=120, rate=30.0, policy="least_loaded",
+         n_rep=3, qos=None, retry=None, events=(), tenants=None,
+         seed=3):
+    """One seeded router day on virtual time; returns (report, book,
+    router). trace=None runs dark over the identical stream."""
+    clock = VirtualClock()
+    reps = [
+        SimReplica(clock, slots=4, n_inner=8, tick_s=0.02,
+                   qos=qos,
+                   tier=("prefill" if policy == "two_tier" and i < 1
+                         else "decode"),
+                   chunk_s=0.005)
+        for i in range(n_rep)
+    ]
+    router = RequestRouter(reps, policy=policy, clock=clock,
+                           qos=qos, trace=trace)
+    rep = run_router_day(
+        router,
+        poisson_arrivals(rate, n=n, seed=seed, prompt_len=64,
+                         max_new=8, tenants=tenants),
+        retry=retry, events=list(events),
+    )
+    return rep, trace, router
+
+
+def _book_fingerprint(book):
+    """The full observable ledger of a book, for byte-identity."""
+    return (
+        list(book.iter_events()),
+        {t: book.parent(t) for t in book.ids()
+         if book.parent(t) is not None},
+    )
+
+
+# --------------------------------------------------------------------------
+# TraceBook unit semantics
+# --------------------------------------------------------------------------
+
+
+class TestTraceBook:
+    def test_mint_is_dense_and_ordered(self):
+        book = TraceBook()
+        assert [book.mint() for _ in range(5)] == list(range(5))
+        assert len(book) == 5
+        assert 4 in book and 5 not in book
+
+    def test_lineage_links_both_ways(self):
+        book = TraceBook()
+        a = book.mint()
+        b = book.mint(parent=a)
+        c = book.mint()
+        book.link(c, a)
+        book.link(c, a)  # idempotent
+        assert book.parent(b) == book.parent(c) == a
+        assert book.children(a) == [b, c]
+        assert book.parent(a) is None
+
+    def test_waterfall_arithmetic(self):
+        book = TraceBook()
+        t = book.mint()
+        book.event(t, "submitted", 10.0, tenant="chat")
+        book.event(t, "admitted", 10.5)
+        book.event(t, "first_token", 11.0)
+        book.event(t, "retired", 12.0, outcome="done", tokens=8)
+        wf = book.waterfall(t)
+        assert wf["t0"] == 10.0
+        assert wf["ttft"] == 1.0 and wf["latency"] == 2.0
+        assert wf["outcome"] == "retired"
+        assert [e["dt"] for e in wf["events"]] == [0.0, 0.5, 1.0, 2.0]
+        assert wf["events"][0]["attrs"] == {"tenant": "chat"}
+
+    def test_waterfall_ttft_uses_last_first_token(self):
+        """A re-route restarts the stream; the scheduler's TTFT stamp
+        restarts with it, and the waterfall must agree."""
+        book = TraceBook()
+        t = book.mint()
+        book.event(t, "submitted", 0.0)
+        book.event(t, "first_token", 1.0)
+        book.event(t, "evacuated", 1.5, replica=0)
+        book.event(t, "rerouted", 1.5, replica=1)
+        book.event(t, "first_token", 3.0)
+        book.event(t, "retired", 4.0)
+        assert book.waterfall(t)["ttft"] == 3.0
+        assert book.find(t, "first_token")[1] == 1.0
+        assert book.find_last(t, "first_token")[1] == 3.0
+
+    def test_terminal_and_cohorts(self):
+        book = TraceBook()
+        plain = book.mint()
+        for k, t in (("submitted", 0.0), ("retired", 1.0)):
+            book.event(plain, k, t)
+        shed = book.mint()
+        book.event(shed, "submitted", 0.0)
+        book.event(shed, "shed", 0.0, reason="overload")
+        hedged = book.mint()
+        for k in ("submitted", "hedge_fired", "hedge_won", "retired"):
+            book.event(hedged, k, 0.0)
+        open_ = book.mint()
+        book.event(open_, "submitted", 0.0)
+        assert book.terminal(plain)[0] == "retired"
+        assert book.terminal(shed)[0] == "shed"
+        assert book.terminal(open_) is None
+        assert book.cohort(plain) == "served"
+        assert book.cohort(shed) == "shed"
+        assert book.cohort(hedged) == "hedged"
+        assert book.cohort(open_) == "open"
+        assert TERMINAL_KINDS == ("retired", "shed", "cancelled")
+
+    def test_unknown_trace_refused(self):
+        with pytest.raises(KeyError, match="unknown trace id"):
+            TraceBook().waterfall(0)
+
+
+# --------------------------------------------------------------------------
+# event coverage: every plane stamps its documented taxonomy
+# --------------------------------------------------------------------------
+
+
+class TestEventCoverage:
+    def test_plain_day_lifecycle_and_neutral_digest(self):
+        dark, _, _ = _day(None)
+        rep, book, _ = _day(TraceBook())
+        # tracing never perturbs the day
+        assert rep.digest() == dark.digest()
+        assert len(book) == rep.n
+        for r in rep.requests:
+            assert r.trace is not None
+            kinds = book.kinds(r.trace)
+            assert kinds[0] == "submitted"
+            assert "first_token" in kinds and kinds[-1] == "retired"
+            # timestamps are monotone within a trace
+            ts = [t for _, t, _ in book.events(r.trace)]
+            assert ts == sorted(ts)
+        res = audit(book, rep)
+        assert res.ok, res.failures
+
+    def test_replay_books_are_byte_identical(self):
+        _, b1, _ = _day(TraceBook())
+        _, b2, _ = _day(TraceBook())
+        assert _book_fingerprint(b1) == _book_fingerprint(b2)
+
+    def test_hedge_race_events(self):
+        clock = VirtualClock()
+        reps = [
+            SimReplica(clock, slots=2, n_inner=8, prompt_chunk=64,
+                       tick_s=lambda t, m=(1.0, 6.0)[i]: 0.01 * m)
+            for i in range(2)
+        ]
+        book = TraceBook()
+        router = RequestRouter(reps, policy="hedge_p99",
+                               ttft_slo=0.03, clock=clock, trace=book)
+        rrs = [router.submit(SimPrompt(64), 16) for _ in range(6)]
+        while router.in_flight:
+            clock.run_until(router.next_event_at())
+            router.step()
+        assert router.n_hedges > 0
+        armed = [t for t in book.ids()
+                 if book.find(t, "hedge_armed") is not None]
+        fired = [t for t in book.ids()
+                 if book.find(t, "hedge_fired") is not None]
+        assert armed and fired
+        for t in fired:
+            kinds = book.kinds(t)
+            # every fired leg resolves: won, cancelled, or abandoned
+            assert (kinds.count("hedge_fired")
+                    == kinds.count("hedge_won")
+                    + kinds.count("hedge_cancelled")
+                    + kinds.count("hedge_abandoned"))
+            assert book.cohort(t) == "hedged"
+        assert all(rr.trace is not None for rr in rrs)
+        res = audit(book)
+        assert res.ok and "hedge_legs" in res.checked
+
+    def test_two_tier_migration_events(self):
+        rep, book, _ = _day(TraceBook(), policy="two_tier")
+        migrated = [t for t in book.ids()
+                    if book.cohort(t) == "migrated"]
+        assert migrated  # the prefill tier handed streams over
+        for t in migrated:
+            kinds = book.kinds(t)
+            assert kinds.count("migrate_out") == kinds.count("adopt")
+            out = book.find(t, "migrate_out")
+            assert out[2]["nbytes"] > 0
+        res = audit(book, rep)
+        assert res.ok and "migration_pairing" in res.checked
+        assert res.counts["migrate_out"] == res.counts["adopts"] > 0
+
+    def test_partition_abandon_and_reroute_events(self):
+        rep, book, _ = _day(
+            TraceBook(), n_rep=4, rate=60.0, n=240,
+            events=[ReplicaPartition(1.0, (2, 3), 3.0)],
+        )
+        abandoned = [t for t in book.ids()
+                     if book.find(t, "partition_abandoned")]
+        assert abandoned  # legs were caught behind the partition
+        for t in abandoned:
+            assert book.find(t, "rerouted") is not None
+            assert book.terminal(t)[0] == "retired"  # zero drops
+            assert book.cohort(t) == "rescued"
+        assert audit(book, rep).ok
+
+    def test_retry_resubmit_child_lineage(self):
+        retry = RetryPolicy(timeout_s=0.05, max_retries=2,
+                            backoff=1.5, jitter_s=0.02, seed=9)
+        rep, book, _ = _day(TraceBook(), rate=90.0, n=260, n_rep=2,
+                            retry=retry)
+        assert rep.n_resubmits > 0
+        children = [t for t in book.ids()
+                    if book.find(t, "retry_resubmit") is not None]
+        assert len(children) == rep.n_resubmits
+        for c in children:
+            ev = book.find(c, "retry_resubmit")
+            parent = ev[2]["parent"]
+            assert book.parent(c) == parent
+            assert c in book.children(parent)
+            assert ev[2]["attempt"] >= 1
+        assert audit(book, rep).ok
+
+    def test_qos_day_stamps_drr_and_shed(self):
+        reg = TenantRegistry([
+            TenantContract("chat", cls="latency", weight=4.0,
+                           ttft_slo=0.5),
+            TenantContract("bulk", cls="batch", weight=1.0),
+        ])
+        rep, book, router = _day(
+            TraceBook(), qos=reg, rate=80.0, n=240, n_rep=2,
+            tenants={"chat": 0.5, "bulk": 0.5},
+        )
+        queued = [t for t in book.ids()
+                  if book.find(t, "drr_queued") is not None]
+        assert queued  # the deficit rotation actually paced the day
+        for t in queued:
+            q = book.find(t, "drr_queued")
+            p = book.find(t, "drr_picked")
+            assert p is not None and p[1] >= q[1]
+            assert q[2]["tenant"] in ("chat", "bulk")
+        assert audit(book, rep).ok
+
+
+# --------------------------------------------------------------------------
+# the ISSUE acceptance: traced chaos day, conserved and digest-neutral
+# --------------------------------------------------------------------------
+
+
+class TestChaosAcceptance:
+    def test_storm_traced_conserved_and_digest_neutral(self):
+        """storm_with_host_kill with tracing armed: the audit finds
+        zero discrepancies across two replays, the two books are
+        byte-identical, and the traced digest equals the dark one."""
+        dark = ChaosInjector().run(
+            get_scenario("storm_with_host_kill", seed=5, n=1800)
+        )
+        books, reports = [], []
+        for _ in range(2):
+            book = TraceBook("storm")
+            r = ChaosInjector(trace=book).run(
+                get_scenario("storm_with_host_kill", seed=5, n=1800)
+            )
+            # the injector armed the audit inside the run and it held
+            assert "trace_conservation" in r.invariants
+            books.append(book)
+            reports.append(r)
+        assert reports[0].digest() == reports[1].digest() \
+            == dark.digest()
+        assert _book_fingerprint(books[0]) == \
+            _book_fingerprint(books[1])
+        view = books[0].audit_view()
+        assert view["open"] == 0 and view["traces"] > 1800
+        assert view["shed"] > 0  # the storm really shed
+
+
+# --------------------------------------------------------------------------
+# audit: failures are NAMED, skips are reasoned
+# --------------------------------------------------------------------------
+
+
+class TestAuditNaming:
+    def test_double_terminal_named_with_trace_ids(self):
+        book = TraceBook()
+        t = book.mint()
+        book.event(t, "submitted", 0.0)
+        book.event(t, "retired", 1.0)
+        book.event(t, "retired", 2.0)  # the double-retire bug
+        res = audit(book)
+        assert not res.ok
+        (f,) = res.failures
+        assert f.invariant == "terminal_exactly_once"
+        assert f.trace_ids == [t]
+        assert "double-retire" in f.detail
+        d = f.to_dict()
+        assert d["invariant"] == "terminal_exactly_once"
+
+    def test_unmatched_migration_and_hedge_named(self):
+        book = TraceBook()
+        m = book.mint()
+        for k in ("submitted", "migrate_out", "retired"):
+            book.event(m, k, 0.0)  # migrate_out with no adopt
+        h = book.mint()
+        for k in ("submitted", "hedge_fired", "retired"):
+            book.event(h, k, 0.0)  # fired leg never resolved
+        res = audit(book)
+        by_inv = {f.invariant: f for f in res.failures}
+        assert by_inv["migration_pairing"].trace_ids == [m]
+        assert by_inv["hedge_legs"].trace_ids == [h]
+
+    def test_open_trace_orphans_only_at_end_of_day(self):
+        book = TraceBook()
+        t = book.mint()
+        book.event(t, "submitted", 0.0)
+        # mid-day (no report): an open trace is not a violation
+        assert audit(book).ok
+
+        class _Rep:  # minimal end-of-day report: no requests traced
+            requests = ()
+            n = 0
+            outcomes = {}
+            dropped = 0
+
+        res = audit(book, _Rep())
+        assert not res.ok
+        assert res.failures[0].invariant == "terminal_exactly_once"
+        assert "never resolved" in res.failures[0].detail
+        assert res.failures[0].trace_ids == [t]
+
+    def test_skips_are_reasoned_not_silent(self):
+        res = audit(TraceBook())
+        assert isinstance(res, AuditResult) and res.ok
+        assert res.skipped["report_reconciliation"] == \
+            "no report passed"
+        assert res.skipped["pool_drain"] == "no pool passed"
+        assert "token_conservation_counter" in res.skipped
+        # checked and skipped never overlap
+        assert not set(res.checked) & set(res.skipped)
+        d = res.to_dict()
+        assert d["ok"] and d["skipped"] == res.skipped
+
+    def test_token_counter_cross_check(self):
+        from mpistragglers_jl_tpu.obs import MetricsRegistry
+
+        book = TraceBook()
+        t = book.mint()
+        book.event(t, "submitted", 0.0)
+        book.event(t, "retired", 1.0, tokens=8)
+        reg = MetricsRegistry()
+        reg.counter("serving_tokens_total").inc(8)
+        res = audit(book, None, reg)
+        assert res.ok
+        assert "token_conservation_counter" in res.checked
+        reg.counter("serving_tokens_total").inc(1)  # drift
+        res = audit(book, None, reg)
+        assert any(
+            f.invariant == "token_conservation_counter"
+            for f in res.failures
+        )
+
+
+# --------------------------------------------------------------------------
+# surfacing: the waterfall over real HTTP reproduces the timings
+# --------------------------------------------------------------------------
+
+
+class TestHTTPSurfacing:
+    def test_trace_endpoint_reproduces_timings_exactly(self):
+        rep, book, _ = _day(TraceBook(), n=40)
+        tid = next(iter(book.ids()))
+        wf = book.waterfall(tid)
+        with ObsServer() as srv:
+            srv.add_tracebook(book)
+            http_wf = json.loads(urllib.request.urlopen(
+                f"{srv.url}/trace/{tid}").read())
+            assert http_wf == wf  # the whole body, timestamps included
+            assert http_wf["ttft"] == wf["ttft"]
+            assert http_wf["latency"] == wf["latency"]
+            adoc = json.loads(urllib.request.urlopen(
+                srv.url + "/audit").read())
+            assert adoc["ok"] and adoc["books"][0]["book"] == book.name
+            # unknown and malformed ids are named refusals
+            for bad, code in (("/trace/999999", 404),
+                              ("/trace/xyz", 400)):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(srv.url + bad)
+                assert ei.value.code == code
+
+    def test_audit_endpoint_503_on_violation(self):
+        book = TraceBook()
+        t = book.mint()
+        book.event(t, "submitted", 0.0)
+        book.event(t, "retired", 1.0)
+        book.event(t, "retired", 2.0)
+        with ObsServer() as srv:
+            srv.add_tracebook(book)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/audit")
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert not body["ok"]
+            assert body["books"][0]["failures"][0]["invariant"] == \
+                "terminal_exactly_once"
+
+    def test_books_merge_into_perfetto_doc(self):
+        _, book, router = _day(TraceBook(), n=30)
+        srv = ObsServer()
+        try:
+            srv.register_router(router)  # auto-adds the attached book
+            doc = srv.trace_doc()
+            names = {
+                e["args"]["name"] for e in doc["traceEvents"]
+                if e.get("ph") == "M"
+                and e["name"] == "process_name"
+            }
+            assert book.name in names
+        finally:
+            srv.close()
