@@ -8,8 +8,11 @@ opt-in observability (GC004), cross-thread lock discipline (GC005),
 and — the v2 interprocedural set (ISSUE 8) — lock-order acyclicity
 with no blocking calls under a lock (GC006), RingAlloc slot/pin
 lifetime (GC007), wall-clock discipline for the sim plane and the
-timing-margin flake family (GC008), and cross-language protocol
-drift between transport.py and transport.cpp (GC009). Run it:
+timing-margin flake family (GC008), cross-language protocol
+drift between transport.py and transport.cpp (GC009), and — ISSUE
+18's dataflow set — interprocedural replay-purity taint for the
+digest-bearing planes (GC012, on the shared :mod:`.analysis` engine)
+plus stale-suppression detection (GC013). Run it:
 
 .. code-block:: bash
 

@@ -1,11 +1,13 @@
 """CLI: ``python -m mpistragglers_jl_tpu.tools.graftcheck [paths]``.
 
 Exit codes: 0 clean, 1 fresh findings, 2 configuration error (invalid
-or stale baseline, unknown rule, bad path). Default scan target is the
-package this tool ships inside; default baseline is the checked-in
-``baseline.json`` beside the tool. The per-file result cache lives in
-the system temp dir keyed by scan root (``--no-cache`` disables,
-``--cache PATH`` relocates).
+or stale baseline, unknown rule, bad path, unwritable --sarif target).
+Default scan target is the package this tool ships inside; default
+baseline is the checked-in ``baseline.json`` beside the tool. The
+per-file result cache lives in the system temp dir keyed by scan root
+(``--no-cache`` disables, ``--cache PATH`` relocates). ``--sarif
+PATH`` additionally writes a SARIF 2.1.0 report (CI annotates findings
+at file:line from it); ``-`` writes SARIF to stdout.
 """
 
 from __future__ import annotations
@@ -44,10 +46,96 @@ def _default_cache(paths: list[str]) -> str:
     return os.path.join(d, f"cache-{key}.json")
 
 
+def _rule_range() -> str:
+    """``"GC001-GC013"`` derived from the live registry — the old
+    hardcoded range went stale twice (ISSUE 18 satellite); now it
+    cannot."""
+    rules = sorted(all_checkers())
+    if not rules:
+        return "no rules registered"
+    if len(rules) == 1:
+        return rules[0]
+    return f"{rules[0]}-{rules[-1]}"
+
+
+def _sarif_report(result, checkers) -> dict:
+    """SARIF 2.1.0: fresh findings as results, baselined findings as
+    externally-suppressed results, suppressed as in-source — so a CI
+    viewer shows the whole picture, and only fresh ones gate."""
+
+    def res(f, suppressions=None):
+        out = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": f.line,
+                        "startColumn": f.col + 1,
+                    },
+                },
+                "logicalLocations": [{
+                    "fullyQualifiedName": f.symbol,
+                }],
+            }],
+        }
+        if suppressions is not None:
+            out["suppressions"] = suppressions
+        return out
+
+    return {
+        "version": "2.1.0",
+        "$schema": (
+            "https://json.schemastore.org/sarif-2.1.0.json"
+        ),
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "graftcheck",
+                    "informationUri": (
+                        "docs/GRAFTCHECK.md in this repository"
+                    ),
+                    "rules": [
+                        {
+                            "id": rule,
+                            "name": chk.name,
+                            "shortDescription": {
+                                "text": chk.description
+                            },
+                        }
+                        for rule, chk in sorted(checkers.items())
+                    ],
+                },
+            },
+            "results": (
+                [res(f) for f in result.fresh]
+                + [
+                    res(f, [{
+                        "kind": "external",
+                        "justification": "baseline.json entry",
+                    }])
+                    for f in result.baselined
+                ]
+                + [
+                    res(f, [{"kind": "inSource"}])
+                    for f in result.suppressed
+                ]
+            ),
+        }],
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="graftcheck",
-        description="project-invariant static analysis (GC001-GC009)",
+        description=(
+            f"project-invariant static analysis ({_rule_range()})"
+        ),
     )
     ap.add_argument(
         "paths", nargs="*",
@@ -70,6 +158,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--json", action="store_true", dest="as_json",
         help="machine-readable report on stdout",
+    )
+    ap.add_argument(
+        "--sarif", default=None, metavar="PATH",
+        help="also write a SARIF 2.1.0 report to PATH ('-' = stdout)",
     )
     ap.add_argument(
         "--list-rules", action="store_true",
@@ -116,6 +208,28 @@ def main(argv: list[str] | None = None) -> int:
         print(f"graftcheck: {e}", file=sys.stderr)
         return 2
     dt = time.perf_counter() - t0
+
+    if args.sarif:
+        checkers = all_checkers()
+        if rules is not None:
+            checkers = {
+                r: c for r, c in checkers.items() if r in rules
+            }
+        report = json.dumps(
+            _sarif_report(result, checkers), indent=2
+        )
+        if args.sarif == "-":
+            print(report)
+        else:
+            try:
+                with open(args.sarif, "w", encoding="utf-8") as fh:
+                    fh.write(report + "\n")
+            except OSError as e:
+                # an unwritable report target is a config error: CI
+                # asked for an artifact it will not get — exit 2, not
+                # a silent pass/fail on the findings alone
+                print(f"graftcheck: --sarif: {e}", file=sys.stderr)
+                return 2
 
     if args.as_json:
         print(json.dumps({

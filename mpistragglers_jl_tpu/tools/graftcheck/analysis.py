@@ -1,0 +1,1069 @@
+"""Shared dataflow engine: import maps, a best-effort call graph, and
+an intraprocedural taint pass with composable per-function summaries.
+
+The checkers that predate this module each hand-rolled a slice of the
+same analysis — GC008 propagated clock taint through assignments and
+``.append``, GC001 built an import closure. This module is the one
+engine they (and future rules) ride, in the compositional-summary
+style of the production taint analyzers in PAPERS (Infer: analyze each
+function once into a summary, link summaries over the call graph):
+
+* **Atoms** — the abstract values the pass computes. Hashable tuples:
+
+  - ``("src", kind, line, detail, flagged)`` — a nondeterminism (or
+    clock) source. ``kind`` is one of the ``KIND_*`` constants below;
+    ``detail`` carries a human-readable provenance including the
+    source module's relpath:line (summaries cross files, so a finding
+    at a sink must be able to name a source two modules away);
+    ``flagged`` marks sources already reported at their own site so
+    sink findings don't double-report them.
+  - ``("param", name)`` — flows from the enclosing function's
+    parameter ``name``; link-time expansion maps it through call-site
+    arguments.
+  - ``("call", key, bound, args)`` — a call the module resolver could
+    name (``key`` = ``"pkg.mod:Class.method"``); ``args`` is a tuple
+    of ``(slot, frozenset[atoms])`` with integer positional slots and
+    string keyword slots, ``bound`` marks ``self.m(...)`` receivers
+    (positional args shift past the callee's ``self``). Unresolvable
+    calls collapse eagerly to the union of their argument atoms.
+  - ``("clean", kinds, atoms)`` — a cleaner (``sorted`` et al.)
+    erased the listed kinds from the wrapped atoms; other kinds pass
+    through (``sorted`` fixes set ORDER but not a clock value).
+
+* :class:`FunctionTaint` — one function (or the module body), GC008's
+  linearized-statement walk generalized: two monotone passes over the
+  statements in source order (the second catches loop-carried flows),
+  an abstract ``eval`` over expressions, container-mutator tainting
+  (``x.append(tainted)`` taints ``x``, ``heappush(h, item)`` taints
+  ``h``), set-iteration sources, and collected ``assert`` statements.
+
+* :class:`ModuleResolver` — per-module import maps (module-level AND
+  function-level imports; resolution needs them all even though GC001
+  only judges the former) plus local def/method tables, yielding the
+  call keys above and :meth:`~ModuleResolver.expand_path`
+  normalization (``npr.default_rng`` -> ``numpy.random.default_rng``
+  under ``import numpy.random as npr``).
+
+* :func:`link` / :func:`expand` — the interprocedural half: a bounded
+  fixpoint over per-function :class:`FuncRecord` rows producing
+  :class:`Summary` rows (concrete sources a function returns, which
+  params flow to its return, which params reach a sink inside it),
+  then expansion of any atom set against those summaries.
+
+Records serialize to plain JSON (:func:`record_to_json` /
+:func:`record_from_json`) so project-wide checkers can park them in
+``core._Cache``'s ``aux`` section keyed by (relpath, content sha): on
+a warm tree only changed modules re-run the intraprocedural pass, and
+the link step (cheap, pure dict crunching) re-runs over cached rows.
+
+Stdlib-``ast``-only like everything else in the tool.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Iterator
+
+from .core import ModuleInfo, dotted_path, resolve_relative
+
+__all__ = [
+    "KIND_RNG",
+    "KIND_SET_ORDER",
+    "KIND_ID_ORDER",
+    "KIND_CLOCK",
+    "KIND_ENVIRON",
+    "src_atom",
+    "has_kind",
+    "FunctionTaint",
+    "ModuleResolver",
+    "iter_functions",
+    "class_set_attrs",
+    "FuncRecord",
+    "Summary",
+    "link",
+    "expand",
+    "record_to_json",
+    "record_from_json",
+]
+
+# taint kinds
+KIND_RNG = "rng"
+KIND_SET_ORDER = "set-order"
+KIND_ID_ORDER = "id-order"
+KIND_CLOCK = "clock"
+KIND_ENVIRON = "environ"
+
+#: builtins that erase iteration-order nondeterminism from their
+#: argument (value-determined output) — and ONLY that kind: a clock
+#: reading summed over a list is still a clock reading
+_SET_ORDER_CLEANERS = frozenset(
+    {"sorted", "sum", "min", "max", "len", "any", "all", "set",
+     "frozenset"}
+)
+
+#: builtins whose output ORDER follows their input's iteration order
+_ORDER_KEEPERS = frozenset(
+    {"list", "tuple", "iter", "enumerate", "reversed", "map",
+     "filter"}
+)
+
+#: method names that flow argument taint into the receiver
+_MUTATORS = frozenset(
+    {"append", "extend", "add", "insert", "appendleft", "setdefault",
+     "update", "push", "put", "put_nowait"}
+)
+
+#: cap on atoms tracked per expression/variable — a wide expression
+#: degenerates to its most-relevant atoms instead of blowing up the
+#: cache (deterministic: capped by sorted repr)
+_MAX_ATOMS = 32
+
+#: cap on structural atom NESTING (call args / clean wrappers inside
+#: call args inside ...): without it a chain like ``x = f(x)`` over N
+#: statements builds atoms whose size is exponential in N — the cap
+#: hoists inner content out of too-deep containers instead. Depth 2
+#: keeps the shapes interprocedural findings need (a call atom inside
+#: a caller's argument set); deeper nesting only refines per-arg
+#: mappings of call-in-call-in-call chains, which a linter can
+#: over-approximate
+_MAX_DEPTH = 2
+
+#: cap on the width of EMBEDDED atom sets (a call atom's per-argument
+#: sets) — tighter than the top-level cap so a single atom's total
+#: size stays O(_MAX_EMBED ** _MAX_DEPTH) in the worst case
+_MAX_EMBED = 6
+
+
+def _capw(atoms: set, n: int) -> set:
+    if len(atoms) <= n:
+        return atoms
+    return set(sorted(atoms, key=repr)[:n])
+
+
+def src_atom(
+    kind: str, line: int, detail: str, flagged: bool = False
+) -> tuple:
+    return ("src", kind, line, detail, flagged)
+
+
+#: (atom, depth) -> frozenset of squashed atoms; atoms are immutable
+#: and content-addressed, so the rewrite is a pure function of the
+#: pair — memoizing it turns the pass's dominant cost (re-squashing
+#: the same structures at every bind) into dict hits
+_SQUASH_MEMO: dict = {}
+
+
+def _squash(atoms, depth: int = 0) -> set:
+    """Copy of ``atoms`` with bounded structure. Two rules keep atom
+    size linear where naive nesting is exponential (``x = f(x)`` /
+    ``x = sorted(x)`` statement chains):
+
+    * a call atom at depth ``_MAX_DEPTH`` keeps its key (summaries
+      still link) but drops its argument structure, hoisting the
+      arguments' content up a level — losing only the per-arg
+      parameter mapping of deep calls;
+    * clean atoms never nest: ``clean(k1, {clean(k2, X), y})``
+      rewrites to ``clean(k1|k2, X') ∪ clean(k1, {y})``, which is
+      exact (an atom filtered by both wrappers is filtered by the
+      union of their kinds).
+    """
+    out: set = set()
+    for a in atoms:
+        if a[0] in ("src", "param"):
+            out.add(a)
+            continue
+        key = (a, depth)
+        got = _SQUASH_MEMO.get(key)
+        if got is None:
+            got = frozenset(_squash_atom(a, depth))
+            if len(_SQUASH_MEMO) > (1 << 16):
+                _SQUASH_MEMO.clear()
+            _SQUASH_MEMO[key] = got
+        out |= got
+    return out
+
+
+def _squash_atom(a: tuple, depth: int) -> set:
+    out: set = set()
+    if a[0] == "call":
+        if depth >= _MAX_DEPTH:
+            out.add(("call", a[1], a[2], ()))
+            for _slot, sub in a[3]:
+                out |= _squash(sub, depth)
+        else:
+            out.add((
+                "call", a[1], a[2],
+                tuple(
+                    (slot, frozenset(_capw(
+                        _squash(sub, depth + 1), _MAX_EMBED
+                    )))
+                    for slot, sub in a[3]
+                ),
+            ))
+    else:  # clean
+        out |= _norm_clean(a[1], a[2], depth)
+    return out
+
+
+def _norm_clean(kinds, inner, depth: int) -> set:
+    """Flattened clean atoms for ``kinds`` over ``inner`` (see
+    :func:`_squash`): nested cleans merge their kind filters, so a
+    clean atom's contents are always clean-free."""
+    flat: set = set()
+    out: set = set()
+    for x in _squash(inner, min(depth + 1, _MAX_DEPTH)):
+        if x[0] == "clean":
+            out |= _norm_clean(
+                tuple(sorted(set(kinds) | set(x[1]))), x[2], depth
+            )
+        else:
+            flat.add(x)
+    if flat:
+        out.add(("clean", tuple(sorted(kinds)), frozenset(flat)))
+    return out
+
+
+def _cap(atoms: set) -> set:
+    atoms = _squash(atoms, 0)
+    if len(atoms) <= _MAX_ATOMS:
+        return atoms
+    return set(sorted(atoms, key=repr)[:_MAX_ATOMS])
+
+
+def has_kind(atoms, kind: str) -> bool:
+    """True iff any source of ``kind`` is reachable in ``atoms``
+    WITHOUT link-time summaries: call atoms are traversed through
+    their arguments only (the intraprocedural view GC008 needs)."""
+    for a in atoms:
+        t = a[0]
+        if t == "src" and a[1] == kind:
+            return True
+        if t == "clean" and kind not in a[1] and has_kind(a[2], kind):
+            return True
+        if t == "call":
+            for _slot, sub in a[3]:
+                if has_kind(sub, kind):
+                    return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# module resolver: import maps + local def tables -> call keys
+# --------------------------------------------------------------------------
+
+
+class ModuleResolver:
+    """Best-effort name resolution for one module.
+
+    ``alias`` maps local names to dotted module targets (``np`` ->
+    ``numpy``), ``frommap`` maps from-imported names to their
+    ``(module, original_name)`` home; both are fed by EVERY import in
+    the file including function-local ones. ``funcs``/``classes``
+    index the module's own top-level defs and methods. Keys look like
+    ``"pkg.sim.day:helper"`` / ``"pkg.sim.day:Engine.step"``."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.modname = mod.name
+        is_pkg = mod.path.endswith("__init__.py")
+        self.alias: dict[str, str] = {}
+        self.frommap: dict[str, tuple[str, str]] = {}
+        self.funcs: set[str] = set()
+        self.classes: dict[str, set[str]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.alias[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        self.alias.setdefault(head, head)
+            elif isinstance(node, ast.ImportFrom):
+                base = resolve_relative(self.modname, is_pkg, node)
+                if not base:
+                    continue
+                for a in node.names:
+                    if a.name != "*":
+                        self.frommap[a.asname or a.name] = (
+                            base, a.name,
+                        )
+        for st in mod.tree.body:
+            if isinstance(
+                st, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                self.funcs.add(st.name)
+            elif isinstance(st, ast.ClassDef):
+                self.classes[st.name] = {
+                    s.name for s in st.body
+                    if isinstance(
+                        s, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    )
+                }
+
+    def expand_path(self, path: tuple[str, ...]) -> tuple[str, ...]:
+        """Normalize a dotted chain through the import maps to an
+        absolute dotted tuple (``("np", "random", "random")`` ->
+        ``("numpy", "random", "random")``)."""
+        if not path:
+            return path
+        head = path[0]
+        if head in self.alias:
+            return tuple(self.alias[head].split(".")) + tuple(
+                path[1:]
+            )
+        if head in self.frommap:
+            base, orig = self.frommap[head]
+            return tuple(base.split(".")) + (orig,) + tuple(path[1:])
+        return tuple(path)
+
+    def resolve_call(
+        self, call: ast.Call, class_name: str | None = None
+    ) -> tuple[str | None, bool]:
+        """``(key, bound)`` for a call this module can name, else
+        ``(None, False)``. ``bound`` is True for ``self.m(...)``."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in self.funcs:
+                return f"{self.modname}:{f.id}", False
+            if f.id in self.frommap:
+                base, orig = self.frommap[f.id]
+                return f"{base}:{orig}", False
+            return None, False
+        path = dotted_path(f)
+        if path is None or len(path) < 2:
+            return None, False
+        if path[0] == "self" and class_name:
+            if len(path) == 2 and path[1] in self.classes.get(
+                class_name, ()
+            ):
+                return (
+                    f"{self.modname}:{class_name}.{path[1]}", True,
+                )
+            return None, False
+        if path[0] in self.alias:
+            full = self.alias[path[0]].split(".") + list(path[1:])
+            return f"{'.'.join(full[:-1])}:{full[-1]}", False
+        if path[0] in self.classes and len(path) == 2:
+            # Class.method(obj, ...) — unbound: args map 1:1
+            return f"{self.modname}:{path[0]}.{path[1]}", False
+        return None, False
+
+
+def iter_functions(
+    tree: ast.Module,
+) -> Iterator[tuple[str, str | None, ast.AST]]:
+    """``(qualname, enclosing_class, node)`` for the module body
+    (``"<module>"``) and every def at any depth."""
+    yield "<module>", None, tree
+
+    def rec(node, prefix, cls):
+        for ch in ast.iter_child_nodes(node):
+            if isinstance(
+                ch, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                q = prefix + ch.name
+                yield q, cls, ch
+                yield from rec(ch, q + ".", None)
+            elif isinstance(ch, ast.ClassDef):
+                yield from rec(ch, prefix + ch.name + ".", ch.name)
+            else:
+                yield from rec(ch, prefix, cls)
+
+    yield from rec(tree, "", None)
+
+
+def class_set_attrs(cls_node: ast.ClassDef) -> frozenset[str]:
+    """``self.<attr>`` names any method assigns a set display /
+    ``set()`` / ``frozenset()`` to, minus those ever re-bound to a
+    non-set — iterating them is a set-order source."""
+    cand: set[str] = set()
+    veto: set[str] = set()
+    for node in ast.walk(cls_node):
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        is_set = isinstance(value, (ast.Set, ast.SetComp)) or (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id in ("set", "frozenset")
+        )
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                (cand if is_set else veto).add(t.attr)
+    return frozenset(cand - veto)
+
+
+# --------------------------------------------------------------------------
+# the intraprocedural pass
+# --------------------------------------------------------------------------
+
+SourceFn = Callable[[ast.AST], "list[tuple] | None"]
+
+
+class FunctionTaint:
+    """Abstract interpretation of ONE function body (or the module
+    body when ``fn`` is the ``ast.Module``).
+
+    Statements are linearized in source order exactly the way GC008's
+    hand-rolled pass did (nested defs/classes/lambdas excluded — they
+    are analyzed on their own and rarely share locals) and executed
+    TWICE so loop-carried flows converge; the environment only grows,
+    so the pass is monotone. ``source_fn`` is the pluggable source
+    pattern (clock calls for GC008, RNG/uuid/environ for GC012):
+    called on Name/Attribute/Call/Subscript nodes, returns src atoms
+    or None. With a ``resolver``, named calls become symbolic call
+    atoms (and are recorded in ``.calls`` for summary linking);
+    without one, every call collapses to argument passthrough —
+    the pure intraprocedural mode."""
+
+    def __init__(
+        self,
+        mod: ModuleInfo,
+        fn: ast.AST,
+        *,
+        source_fn: SourceFn | None = None,
+        resolver: ModuleResolver | None = None,
+        class_name: str | None = None,
+        set_attrs: frozenset[str] = frozenset(),
+    ):
+        self.mod = mod
+        self.fn = fn
+        self.source_fn = source_fn or (lambda node: None)
+        self.resolver = resolver
+        self.class_name = class_name
+        self.set_attrs = set_attrs
+        self.params = self._param_names(fn)
+        self._param_set = set(self.params)
+        self.env: dict[str, set] = {}
+        self.set_names: set[str] = set()
+        self.asserts: list[ast.Assert] = []
+        self.ret: set = set()
+        #: (node, key, bound, args) for every resolver-named call
+        self.calls: list[tuple[ast.Call, str, bool, tuple]] = []
+        self._memo: dict[int, set] = {}
+        self._recording = True
+        #: this function's own statements, linearized in source order
+        #: (public: sink scanners iterate them for pattern matches)
+        self.stmts = self._linearize(fn)
+        for second in (False, True):
+            if second:
+                self.asserts.clear()
+                self.ret.clear()
+                self.calls.clear()
+                self._memo.clear()
+            for st in self.stmts:
+                self._exec(st)
+        self._recording = False
+
+    # -- setup -------------------------------------------------------------
+
+    @staticmethod
+    def _param_names(fn: ast.AST) -> list[str]:
+        if isinstance(fn, ast.Module):
+            return []
+        a = fn.args
+        return [
+            p.arg for p in a.posonlyargs + a.args + a.kwonlyargs
+        ]
+
+    @staticmethod
+    def _linearize(fn: ast.AST) -> list[ast.stmt]:
+        out: list[ast.stmt] = []
+        stack: list[ast.AST] = list(fn.body)
+        while stack:
+            cur = stack.pop()
+            if isinstance(
+                cur,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                 ast.Lambda),
+            ):
+                continue
+            if isinstance(cur, ast.stmt):
+                out.append(cur)
+            for ch in ast.iter_child_nodes(cur):
+                stack.append(ch)
+        out.sort(key=lambda n: (n.lineno, n.col_offset))
+        return out
+
+    # -- statements --------------------------------------------------------
+
+    def _exec(self, st: ast.stmt) -> None:
+        if isinstance(st, ast.Assign):
+            atoms = self.eval(st.value)
+            is_set = self._is_set_expr(st.value)
+            for t in st.targets:
+                self._bind(t, atoms, is_set)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._bind(
+                    st.target,
+                    self.eval(st.value),
+                    self._is_set_expr(st.value),
+                )
+        elif isinstance(st, ast.AugAssign):
+            self._bind(st.target, self.eval(st.value), None)
+        elif isinstance(st, ast.Expr):
+            self.eval(st.value)
+            if isinstance(st.value, ast.Call):
+                self._mutate(st.value)
+        elif isinstance(st, ast.Return):
+            if st.value is not None:
+                self.ret |= self.eval(st.value)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            atoms = set(self.eval(st.iter))
+            if self._is_set_expr(st.iter):
+                atoms.add(
+                    self._mk_src(
+                        KIND_SET_ORDER, st.iter,
+                        "iteration over a set",
+                    )
+                )
+            self._bind(st.target, atoms, None)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                atoms = self.eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, atoms, None)
+        elif isinstance(st, ast.Assert):
+            self.asserts.append(st)
+            self.eval(st.test)
+        elif isinstance(st, (ast.If, ast.While)):
+            self.eval(st.test)
+        elif isinstance(st, ast.Raise):
+            if st.exc is not None:
+                self.eval(st.exc)
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    self.env.pop(t.id, None)
+                    self.set_names.discard(t.id)
+
+    def _bind(
+        self, target: ast.expr, atoms: set, is_set: bool | None
+    ) -> None:
+        atoms = _cap(atoms)
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                if atoms:
+                    self.env.setdefault(n.id, set()).update(atoms)
+                if n is target:
+                    if is_set is True:
+                        self.set_names.add(n.id)
+                    elif is_set is False:
+                        self.set_names.discard(n.id)
+
+    def _mutate(self, call: ast.Call) -> None:
+        """``x.append(tainted)`` taints ``x``; ``heappush(h, item)``
+        taints ``h`` with the item's atoms."""
+        f = call.func
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.attr in _MUTATORS
+        ):
+            atoms: set = set()
+            for a in call.args:
+                atoms |= self.eval(a)
+            for kw in call.keywords:
+                atoms |= self.eval(kw.value)
+            if atoms:
+                self.env.setdefault(f.value.id, set()).update(
+                    _cap(atoms)
+                )
+            return
+        path = dotted_path(f)
+        if (
+            path is not None
+            and path[-1] == "heappush"
+            and len(call.args) >= 2
+            and isinstance(call.args[0], ast.Name)
+        ):
+            atoms = self.eval(call.args[1])
+            if atoms:
+                self.env.setdefault(
+                    call.args[0].id, set()
+                ).update(_cap(atoms))
+
+    # -- expressions -------------------------------------------------------
+
+    def eval(self, e: ast.expr | None) -> set:
+        if e is None:
+            return set()
+        key = id(e)
+        got = self._memo.get(key)
+        if got is not None:
+            return got
+        out = _cap(self._eval(e))
+        self._memo[key] = out
+        return out
+
+    def _eval(self, e: ast.expr) -> set:
+        extra: set = set()
+        if isinstance(
+            e, (ast.Call, ast.Attribute, ast.Name, ast.Subscript)
+        ):
+            s = self.source_fn(e)
+            if s:
+                extra = set(s)
+        if isinstance(e, ast.Name):
+            out = set(self.env.get(e.id, ()))
+            if e.id in self._param_set:
+                out.add(("param", e.id))
+            return out | extra
+        if isinstance(e, ast.Call):
+            return extra | self._eval_call(e)
+        if isinstance(e, ast.Attribute):
+            return extra | self.eval(e.value)
+        if isinstance(e, (ast.Yield, ast.YieldFrom)):
+            inner = self.eval(e.value)
+            if isinstance(e, ast.YieldFrom) and self._is_set_expr(
+                e.value
+            ):
+                inner = set(inner)
+                inner.add(
+                    self._mk_src(
+                        KIND_SET_ORDER, e, "yield from a set"
+                    )
+                )
+            self.ret |= inner  # a generator's yields ARE its returns
+            return inner
+        if isinstance(e, ast.Lambda):
+            return extra  # opaque; sink checkers read bodies directly
+        if isinstance(e, ast.NamedExpr):
+            atoms = self.eval(e.value)
+            self._bind(e.target, atoms, self._is_set_expr(e.value))
+            return atoms | extra
+        if isinstance(
+            e, (ast.ListComp, ast.SetComp, ast.DictComp,
+                ast.GeneratorExp)
+        ):
+            out = set(extra)
+            # a SetComp's RESULT is a set — its own order taint is
+            # born where it is consumed, so generator order does not
+            # flow out of it; every other comprehension preserves
+            # generation order
+            ordered = not isinstance(e, ast.SetComp)
+            for gen in e.generators:
+                atoms = set(self.eval(gen.iter))
+                if ordered and self._is_set_expr(gen.iter):
+                    atoms.add(
+                        self._mk_src(
+                            KIND_SET_ORDER, gen.iter,
+                            "comprehension over a set",
+                        )
+                    )
+                self._bind(gen.target, atoms, None)
+                out |= atoms
+                for c in gen.ifs:
+                    self.eval(c)
+            if isinstance(e, ast.DictComp):
+                out |= self.eval(e.key) | self.eval(e.value)
+            else:
+                out |= self.eval(e.elt)
+            return out
+        if isinstance(e, ast.IfExp):
+            self.eval(e.test)
+            return extra | self.eval(e.body) | self.eval(e.orelse)
+        out = set(extra)
+        for ch in ast.iter_child_nodes(e):
+            if isinstance(ch, ast.expr):
+                out |= self.eval(ch)
+        return out
+
+    def _eval_call(self, call: ast.Call) -> set:
+        out: set = set()
+        path = dotted_path(call.func)
+        arg_atoms = [self.eval(a) for a in call.args]
+        kw_atoms = [
+            (kw.arg, self.eval(kw.value)) for kw in call.keywords
+        ]
+        union: set = set()
+        for s in arg_atoms:
+            union |= s
+        for _n, s in kw_atoms:
+            union |= s
+        if not isinstance(call.func, (ast.Name, ast.Attribute)):
+            union |= self.eval(call.func)  # (f or g)(x)
+
+        if path is not None and len(path) == 1:
+            name = path[0]
+            if name in _ORDER_KEEPERS and any(
+                self._is_set_expr(a) for a in call.args
+            ):
+                out.add(
+                    self._mk_src(
+                        KIND_SET_ORDER, call, f"{name}() over a set"
+                    )
+                )
+            if name in ("id", "hash") and call.args:
+                out.add(
+                    self._mk_src(
+                        KIND_ID_ORDER, call,
+                        f"{name}()-derived value",
+                    )
+                )
+            if name in _SET_ORDER_CLEANERS:
+                if union:
+                    out.add(
+                        ("clean", (KIND_SET_ORDER,),
+                         frozenset(_cap(union)))
+                    )
+                return out
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "join"
+            and any(self._is_set_expr(a) for a in call.args)
+        ):
+            out.add(
+                self._mk_src(KIND_SET_ORDER, call, "join over a set")
+            )
+
+        if self.resolver is not None:
+            key, bound = self.resolver.resolve_call(
+                call, self.class_name
+            )
+            if key is not None:
+                args: list[tuple] = []
+                for i, s in enumerate(arg_atoms):
+                    if s:
+                        args.append((i, frozenset(_cap(s))))
+                for n, s in kw_atoms:
+                    if n and s:
+                        args.append((n, frozenset(_cap(s))))
+                targs = tuple(args)
+                if self._recording:
+                    self.calls.append((call, key, bound, targs))
+                return out | {("call", key, bound, targs)}
+        if isinstance(call.func, ast.Attribute):
+            # unresolved method call: receiver taint flows through
+            # (`delta.total_seconds()` is as tainted as `delta`)
+            union |= self.eval(call.func.value)
+        return out | union
+
+    # -- helpers -----------------------------------------------------------
+
+    def _mk_src(
+        self, kind: str, node: ast.AST, desc: str
+    ) -> tuple:
+        line = getattr(node, "lineno", 1)
+        return src_atom(
+            kind, line, f"{desc} ({self.mod.relpath}:{line})"
+        )
+
+    def _is_set_expr(self, e: ast.expr | None) -> bool:
+        if isinstance(e, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(e, ast.Name):
+            return e.id in self.set_names
+        if isinstance(e, ast.Call):
+            p = dotted_path(e.func)
+            if p is None:
+                return False
+            if len(p) == 1 and p[0] in ("set", "frozenset"):
+                return True
+            # dict.fromkeys(<set>) iterates like the set it came from
+            if (
+                p[-1] == "fromkeys"
+                and e.args
+                and self._is_set_expr(e.args[0])
+            ):
+                return True
+            return False
+        if isinstance(e, ast.Attribute):
+            if (
+                isinstance(e.value, ast.Name)
+                and e.value.id == "self"
+            ):
+                return e.attr in self.set_attrs
+            # s.keys()/.difference(...) handled via the Call branch's
+            # receiver when needed; attribute reads stay conservative
+            return False
+        if isinstance(e, ast.BinOp) and isinstance(
+            e.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+        ):
+            return self._is_set_expr(e.left) or self._is_set_expr(
+                e.right
+            )
+        return False
+
+    def taint_of(self, expr: ast.expr) -> set:
+        """Atoms of ``expr`` under the converged environment (for
+        post-pass queries — GC008's assert sides, GC012's sink
+        arguments). Does not record new call atoms."""
+        self._recording = False
+        return self.eval(expr)
+
+    def iter_calls(self) -> Iterator[ast.Call]:
+        """Every call in this function's own body (nested defs /
+        classes / lambdas excluded), for sink scanning."""
+        stack: list[ast.AST] = list(
+            self.fn.body if not isinstance(self.fn, ast.Module)
+            else self.fn.body
+        )
+        while stack:
+            cur = stack.pop()
+            if isinstance(
+                cur,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                 ast.Lambda),
+            ):
+                continue
+            if isinstance(cur, ast.Call):
+                yield cur
+            for ch in ast.iter_child_nodes(cur):
+                stack.append(ch)
+
+
+# --------------------------------------------------------------------------
+# summaries + linking
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class FuncRecord:
+    """The serializable per-function row the link step consumes."""
+
+    params: list[str]
+    ret: list  # atoms flowing to return/yield
+    #: sink rows: {"line", "col", "symbol", "desc", "atoms"}
+    sinks: list = field(default_factory=list)
+    #: call rows: {"line", "col", "symbol", "key", "bound", "args"}
+    calls: list = field(default_factory=list)
+
+
+@dataclass
+class Summary:
+    """Link-time digest of one function."""
+
+    returns_srcs: set = field(default_factory=set)
+    returns_params: set = field(default_factory=set)
+    #: param name -> sink descriptions it reaches inside the callee
+    param_sinks: dict = field(default_factory=dict)
+
+
+def _param_slots(
+    params: list[str], bound: bool
+) -> dict[str, int]:
+    ps = params[1:] if bound and params else params
+    return {name: i for i, name in enumerate(ps)}
+
+
+def _args_for(args, pmap: dict[str, int], p: str) -> set:
+    out: set = set()
+    idx = pmap.get(p)
+    for slot, sub in args:
+        if slot == p or (idx is not None and slot == idx):
+            out |= set(sub)
+    return out
+
+
+def expand(
+    atoms,
+    records: dict[str, FuncRecord],
+    summaries: dict[str, Summary],
+    _depth: int = 0,
+) -> tuple[set, set]:
+    """``(srcs, params)`` reachable from ``atoms`` under the current
+    summaries: concrete src atoms, and names of the ENCLOSING
+    function's params that flow in. Recursion descends syntactic atom
+    nesting only (summaries are flat), so it terminates."""
+    srcs: set = set()
+    params: set = set()
+    if _depth > 12:
+        return srcs, params
+    for a in atoms:
+        t = a[0]
+        if t == "src":
+            srcs.add(a)
+        elif t == "param":
+            params.add(a[1])
+        elif t == "clean":
+            s2, p2 = expand(a[2], records, summaries, _depth + 1)
+            srcs |= {x for x in s2 if x[1] not in a[1]}
+            params |= p2
+        elif t == "call":
+            key, bound, args = a[1], a[2], a[3]
+            rec = records.get(key)
+            if rec is None:
+                for _slot, sub in args:
+                    s2, p2 = expand(
+                        sub, records, summaries, _depth + 1
+                    )
+                    srcs |= s2
+                    params |= p2
+                continue
+            summ = summaries.get(key)
+            if summ is None:
+                continue
+            srcs |= summ.returns_srcs
+            pmap = _param_slots(rec.params, bound)
+            for p in summ.returns_params:
+                sub = _args_for(args, pmap, p)
+                if sub:
+                    s2, p2 = expand(
+                        sub, records, summaries, _depth + 1
+                    )
+                    srcs |= s2
+                    params |= p2
+    return srcs, params
+
+
+def link(
+    records: dict[str, FuncRecord], *, rounds: int = 20
+) -> dict[str, Summary]:
+    """Bounded fixpoint over the call graph: repeatedly expand each
+    function's return atoms and sink atoms against the current
+    summaries until nothing changes (or ``rounds`` passes — summary
+    sets only grow, so early exit is the common case)."""
+    summaries = {k: Summary() for k in records}
+    for _ in range(rounds):
+        changed = False
+        for key, rec in records.items():
+            s = summaries[key]
+            srcs, params = expand(rec.ret, records, summaries)
+            if not srcs <= s.returns_srcs:
+                s.returns_srcs |= srcs
+                changed = True
+            if not params <= s.returns_params:
+                s.returns_params |= params
+                changed = True
+            for sink in rec.sinks:
+                _s2, p2 = expand(
+                    sink["atoms"], records, summaries
+                )
+                for p in p2:
+                    got = s.param_sinks.setdefault(p, set())
+                    if sink["desc"] not in got:
+                        got.add(sink["desc"])
+                        changed = True
+            for c in rec.calls:
+                crec = records.get(c["key"])
+                csum = summaries.get(c["key"])
+                if crec is None or csum is None:
+                    continue
+                if not csum.param_sinks:
+                    continue
+                pmap = _param_slots(crec.params, c["bound"])
+                for p, descs in csum.param_sinks.items():
+                    sub = _args_for(c["args"], pmap, p)
+                    if not sub:
+                        continue
+                    _s3, p3 = expand(sub, records, summaries)
+                    for q in p3:
+                        got = s.param_sinks.setdefault(q, set())
+                        new = descs - got
+                        if new:
+                            got |= new
+                            changed = True
+        if not changed:
+            break
+    return summaries
+
+
+# --------------------------------------------------------------------------
+# JSON round-trip (for core._Cache's aux section)
+# --------------------------------------------------------------------------
+
+
+def _atom_to_json(a):
+    t = a[0]
+    if t == "src":
+        return {"t": "s", "k": a[1], "l": a[2], "d": a[3],
+                "f": bool(a[4])}
+    if t == "param":
+        return {"t": "p", "n": a[1]}
+    if t == "call":
+        return {
+            "t": "c", "k": a[1], "b": bool(a[2]),
+            "a": [
+                [slot, [_atom_to_json(x) for x in sub]]
+                for slot, sub in a[3]
+            ],
+        }
+    if t == "clean":
+        return {
+            "t": "x", "k": list(a[1]),
+            "a": [_atom_to_json(x) for x in a[2]],
+        }
+    raise ValueError(f"unknown atom {a!r}")
+
+
+def _atom_from_json(d):
+    t = d["t"]
+    if t == "s":
+        return ("src", d["k"], int(d["l"]), d["d"], bool(d["f"]))
+    if t == "p":
+        return ("param", d["n"])
+    if t == "c":
+        return (
+            "call", d["k"], bool(d["b"]),
+            tuple(
+                (slot if isinstance(slot, str) else int(slot),
+                 frozenset(_atom_from_json(x) for x in sub))
+                for slot, sub in d["a"]
+            ),
+        )
+    if t == "x":
+        return (
+            "clean", tuple(d["k"]),
+            frozenset(_atom_from_json(x) for x in d["a"]),
+        )
+    raise ValueError(f"unknown atom json {d!r}")
+
+
+def record_to_json(rec: FuncRecord) -> dict:
+    return {
+        "params": list(rec.params),
+        "ret": [_atom_to_json(a) for a in rec.ret],
+        "sinks": [
+            dict(s, atoms=[_atom_to_json(a) for a in s["atoms"]])
+            for s in rec.sinks
+        ],
+        "calls": [
+            dict(c, args=[
+                [slot, [_atom_to_json(x) for x in sub]]
+                for slot, sub in c["args"]
+            ])
+            for c in rec.calls
+        ],
+    }
+
+
+def record_from_json(d: dict) -> FuncRecord:
+    """Inverse of :func:`record_to_json`. Raises on any structural
+    mismatch — callers treat that as a cache miss, never as data."""
+
+    def args(raw):
+        return tuple(
+            (slot if isinstance(slot, str) else int(slot),
+             frozenset(_atom_from_json(x) for x in sub))
+            for slot, sub in raw
+        )
+
+    return FuncRecord(
+        params=[str(p) for p in d["params"]],
+        ret=[_atom_from_json(a) for a in d["ret"]],
+        sinks=[
+            {
+                "line": int(s["line"]), "col": int(s["col"]),
+                "symbol": str(s["symbol"]), "desc": str(s["desc"]),
+                "atoms": [_atom_from_json(a) for a in s["atoms"]],
+            }
+            for s in d["sinks"]
+        ],
+        calls=[
+            {
+                "line": int(c["line"]), "col": int(c["col"]),
+                "symbol": str(c["symbol"]), "key": str(c["key"]),
+                "bound": bool(c["bound"]), "args": args(c["args"]),
+            }
+            for c in d["calls"]
+        ],
+    )

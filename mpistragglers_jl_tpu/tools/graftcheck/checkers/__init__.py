@@ -42,6 +42,17 @@ GC011  witness-single-source sim digest witness written once: .ttft/
                             in sim/workload.py — the scalar loop and
                             the vectorized fast path share the
                             counter-stamping code
+GC012  replay-purity        digest-bearing planes (sim/chaos/qos/
+                            fleet, models.router/serving/disagg/
+                            paging) are deterministic: no unseeded or
+                            global RNG / uuid4 / urandom / environ
+                            reads, and no set-iteration or id()/
+                            hash() order reaching a digest, heap, or
+                            sort key — interprocedural, on the
+                            :mod:`..analysis` taint engine
+GC013  stale-suppression    a `# graftcheck: disable=` comment that
+                            suppresses zero findings is itself a
+                            finding (mypy unused-ignore semantics)
 ====== ==================== ==========================================
 """
 
@@ -57,4 +68,6 @@ from . import (  # noqa: F401  (import == register)
     gc009_protocol_drift,
     gc010_shed_by_name,
     gc011_witness_source,
+    gc012_replay_purity,
+    gc013_stale_suppression,
 )

@@ -33,7 +33,13 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from ..core import Checker, Finding, ModuleInfo, register
+from ..core import (
+    Checker,
+    Finding,
+    ModuleInfo,
+    register,
+    resolve_relative,
+)
 
 # a package __init__ carrying this marker (comment or docstring line)
 # becomes an additional GC001 closure root — its whole reachable set
@@ -85,22 +91,9 @@ def module_level_imports(
                 stack.append(child)
 
 
-def resolve_relative(
-    mod_name: str, is_package: bool, node: ast.ImportFrom
-) -> str | None:
-    """Absolute dotted target of a (possibly relative) ImportFrom, or
-    None when the relative level climbs out of the root package."""
-    if node.level == 0:
-        return node.module
-    parts = mod_name.split(".") if mod_name else []
-    pkg = parts if is_package else parts[:-1]
-    up = node.level - 1
-    if up > len(pkg):
-        return None
-    base = pkg[: len(pkg) - up]
-    if node.module:
-        base = base + node.module.split(".")
-    return ".".join(base) if base else None
+# resolve_relative moved to core (the analysis engine's import maps
+# share it); the import above keeps this module's historical
+# `gc001_import_hygiene.resolve_relative` name working
 
 
 def _edges(

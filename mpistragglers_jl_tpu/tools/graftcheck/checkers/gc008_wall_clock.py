@@ -42,6 +42,12 @@ import ast
 import re
 from typing import Iterator
 
+from ..analysis import (
+    KIND_CLOCK,
+    FunctionTaint,
+    has_kind,
+    src_atom,
+)
 from ..core import Checker, Finding, ModuleInfo, dotted_path, register
 
 REAL_SMOKE_MARKER = "# graftcheck: real-smoke"
@@ -90,23 +96,28 @@ def _is_wall_path(
     )
 
 
+def _is_clock_call(
+    call: ast.Call, time_aliases: set[str] = frozenset()
+) -> bool:
+    path = dotted_path(call.func)
+    if path is None:
+        return False
+    if len(path) >= 2 and _is_wall_path(path, time_aliases):
+        return True
+    # `from time import perf_counter` style bare calls: the clock
+    # names are distinctive enough to match unqualified
+    return len(path) == 1 and path[0] in (
+        _CLOCK_ATTRS | {"perf_counter_ns", "monotonic_ns"}
+    )
+
+
 def _contains_clock_call(
     expr: ast.expr, time_aliases: set[str] = frozenset()
 ) -> bool:
-    for node in ast.walk(expr):
-        if isinstance(node, ast.Call):
-            path = dotted_path(node.func)
-            if path is None:
-                continue
-            if len(path) >= 2 and _is_wall_path(path, time_aliases):
-                return True
-            # `from time import perf_counter` style bare calls: the
-            # clock names are distinctive enough to match unqualified
-            if len(path) == 1 and path[0] in (
-                _CLOCK_ATTRS | {"perf_counter_ns", "monotonic_ns"}
-            ):
-                return True
-    return False
+    return any(
+        isinstance(node, ast.Call) and _is_clock_call(node, time_aliases)
+        for node in ast.walk(expr)
+    )
 
 
 def _marked_real_smoke(mod: ModuleInfo, fn: ast.AST) -> bool:
@@ -241,57 +252,24 @@ class WallClock(Checker):
     def _check_margins(
         self, mod: ModuleInfo, fn: ast.AST, aliases: set[str]
     ) -> Iterator[Finding]:
-        tainted: set[str] = set()
-
-        def taints(expr: ast.expr) -> bool:
-            if _contains_clock_call(expr, aliases):
-                return True
-            return any(
-                isinstance(n, ast.Name) and n.id in tainted
-                for n in ast.walk(expr)
-            )
-
-        # straight-line taint pass over this function's own statements
-        # (source order; nested defs excluded — they are visited on
-        # their own and rarely share locals)
-        stmts: list[ast.stmt] = []
-        stack: list[ast.AST] = list(fn.body)
-        while stack:
-            cur = stack.pop()
-            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if isinstance(cur, ast.stmt):
-                stmts.append(cur)
-            for child in ast.iter_child_nodes(cur):
-                stack.append(child)
-        stmts.sort(key=lambda n: (n.lineno, n.col_offset))
-
-        asserts: list[ast.Assert] = []
-        for stmt in stmts:
-            if isinstance(stmt, ast.Assign) and taints(stmt.value):
-                for t in stmt.targets:
-                    for n in ast.walk(t):
-                        if isinstance(n, ast.Name):
-                            tainted.add(n.id)
-            elif isinstance(stmt, ast.AugAssign) and taints(stmt.value):
-                if isinstance(stmt.target, ast.Name):
-                    tainted.add(stmt.target.id)
-            elif isinstance(stmt, ast.Expr) and isinstance(
-                stmt.value, ast.Call
+        # the taint pass rides the shared engine (ISSUE 18): clock
+        # calls are the source pattern, and the engine's converged
+        # environment answers "is this assert side clock-derived" —
+        # including flows the old hand-rolled walk missed (loop-
+        # carried assignments, for-targets, with-items)
+        def clock_src(node: ast.AST):
+            if isinstance(node, ast.Call) and _is_clock_call(
+                node, aliases
             ):
-                # errs.append(<tainted>) taints errs
-                call = stmt.value
-                if (
-                    isinstance(call.func, ast.Attribute)
-                    and call.func.attr in ("append", "extend", "add")
-                    and isinstance(call.func.value, ast.Name)
-                    and any(taints(a) for a in call.args)
-                ):
-                    tainted.add(call.func.value.id)
-            elif isinstance(stmt, ast.Assert):
-                asserts.append(stmt)
+                line = node.lineno
+                return [src_atom(
+                    KIND_CLOCK, line,
+                    f"clock read ({mod.relpath}:{line})",
+                )]
+            return None
 
-        for stmt in asserts:
+        ft = FunctionTaint(mod, fn, source_fn=clock_src)
+        for stmt in ft.asserts:
             test = stmt.test
             if not isinstance(test, ast.Compare):
                 continue
@@ -306,7 +284,8 @@ class WallClock(Checker):
             if not margins:
                 continue
             if any(
-                taints(s) for s in sides
+                has_kind(ft.taint_of(s), KIND_CLOCK)
+                for s in sides
                 if not isinstance(s, ast.Constant)
             ):
                 yield mod.finding(

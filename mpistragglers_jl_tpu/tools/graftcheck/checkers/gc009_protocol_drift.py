@@ -28,16 +28,19 @@ This checker diffs the two statements of the protocol on every run:
   .cpp no longer exports is equally a finding (it would segfault at
   first call).
 
-Project-wide checker (never cached — its verdict depends on a .cpp
-the per-file sha cache cannot key) that activates for any scanned
-module named ``transport.py`` with a sibling ``transport.cpp``;
-findings anchor at the Python line that disagrees, since the .py is
-the statement the analyzer can point into.
+Project-wide checker that activates for any scanned module named
+``transport.py`` with a sibling ``transport.cpp``; findings anchor at
+the Python line that disagrees, since the .py is the statement the
+analyzer can point into. The .cpp lives outside the per-file sha
+cache's world, so this checker contributes the sibling .cpp bytes to
+the whole-tree project cache key via :meth:`project_fingerprint` —
+editing only the C++ side still invalidates the cached verdict.
 """
 
 from __future__ import annotations
 
 import ast
+import hashlib
 import os
 import re
 from typing import Iterator
@@ -181,6 +184,26 @@ class ProtocolDrift(Checker):
         "are Python-internal and must merely not collide)"
     )
     project = True  # reads a sibling .cpp the per-file cache can't key
+
+    def project_fingerprint(self, mods: list[ModuleInfo]) -> str:
+        """Digest of every sibling ``transport.cpp`` this run would
+        read, so the whole-tree project cache invalidates on a
+        C++-only edit (must not parse — path/bytes work only)."""
+        h = hashlib.sha256()
+        for mod in sorted(mods, key=lambda m: m.relpath):
+            if os.path.basename(mod.path) != "transport.py":
+                continue
+            cpp_path = os.path.join(
+                os.path.dirname(mod.path), "transport.cpp"
+            )
+            if not os.path.exists(cpp_path):
+                continue
+            h.update(mod.relpath.encode())
+            h.update(b"\0")
+            with open(cpp_path, "rb") as f:
+                h.update(f.read())
+            h.update(b"\n")
+        return h.hexdigest()
 
     def check_project(
         self, mods: list[ModuleInfo]

@@ -1,0 +1,552 @@
+"""GC012: replay purity — the digest-bearing planes stay
+deterministic, enforced by interprocedural taint.
+
+Every plane grown since r16 rests on one claim: a seeded day replays
+digest-bit-identically (ROADMAP "digest bit-identity"). Chaos survival
+invariants and obs/audit.py enforce it *dynamically* — on the paths a
+test happens to execute. This rule enforces it statically, riding the
+shared dataflow engine in :mod:`..analysis`:
+
+**Scope.** Modules under ``sim``/``chaos``/``qos``/``fleet`` package
+components, plus ``models.router`` / ``models.serving`` /
+``models.disagg`` / ``models.paging`` — the planes whose outputs feed
+replay digests. Code elsewhere is analyzed (its summaries carry taint
+*into* the planes) but never flagged on its own.
+
+**Sources** (the nondeterminism this rule tracks):
+
+* unseeded / process-global RNG: ``numpy.random.<fn>`` module calls,
+  ``default_rng()`` / ``RandomState()`` / ``Generator`` et al.
+  WITHOUT a seed argument, any ``random.<fn>`` module function,
+  ``random.Random()`` without a seed, ``secrets.*``. Seeded
+  constructions — ``default_rng((0x9E3779B9, seed))`` as in
+  sim/workload.py and sim/fastpath.py, ``random.Random(0xC4A05 ^
+  seed)`` — are deterministic given the seed and terminate taint.
+* ``uuid.uuid4`` / ``uuid.uuid1``, ``os.urandom``.
+* ``id()`` / ``hash()``-derived values (PYTHONHASHSEED and allocator
+  addresses vary per process) — *order* sources: only flagged when
+  they reach an order-sensitive sink.
+* iteration order of ``set``s (including ``dict.fromkeys(set)`` and
+  ``self.<attr>`` sets) — likewise sink-gated: ``sorted(the_set)`` is
+  fine, ``list(the_set)`` into a digest is not.
+* ``os.environ`` / ``os.getenv`` reads inside ``sim`` — the hermetic
+  plane's configuration reaches a day through its seeded spec, never
+  ambient process state.
+
+**Sinks** (where nondeterminism becomes a broken replay): hashlib
+constructor arguments and ``<h>.update(...)`` on a hash object,
+arguments of any ``*digest*``-named call, items pushed onto a heap
+(``heapq.heappush`` orders the event queue), and ``key=`` functions
+of ``sort``/``sorted`` calls.
+
+RNG/uuid/environ sources inside a scoped plane are reported AT the
+source line — in a replay plane an unseeded RNG is a hazard wherever
+its value lands. Order sources (sets, ``id``/``hash``) are reported
+at the sink they reach, naming the source's file:line; taint crosses
+function and module boundaries through the engine's summaries
+(helper returns, positional args, kwargs), so the finding can sit in
+``sim/`` while the set it indicts lives in a shared helper.
+
+Project-wide checker; per-module records (sources, sinks, call edges,
+per-function summaries) are parked in the shared cache's ``aux``
+section keyed by (relpath, content sha), so a warm run re-analyzes
+only changed modules and the whole-tree project cache skips even the
+link step when nothing changed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..analysis import (
+    KIND_ENVIRON,
+    KIND_RNG,
+    FuncRecord,
+    FunctionTaint,
+    ModuleResolver,
+    _args_for,
+    _param_slots,
+    class_set_attrs,
+    expand,
+    iter_functions,
+    link,
+    record_from_json,
+    record_to_json,
+    src_atom,
+)
+from ..core import (
+    Checker,
+    Finding,
+    ModuleInfo,
+    dotted_path,
+    register,
+    symbol_of,
+)
+
+#: package components that make a module a replay plane
+_PLANES = frozenset({"sim", "chaos", "qos", "fleet"})
+#: models.<leaf> modules that are replay planes
+_MODEL_LEAVES = frozenset({"router", "serving", "disagg", "paging"})
+
+#: numpy.random constructors that are clean WHEN given a seed
+_SEEDABLE = frozenset({
+    "default_rng", "RandomState", "Generator", "SeedSequence",
+    "BitGenerator", "PCG64", "PCG64DXSM", "Philox", "MT19937",
+    "SFC64",
+})
+
+_HASHLIB_CTORS = frozenset({
+    "new", "md5", "sha1", "sha224", "sha256", "sha384", "sha512",
+    "sha3_224", "sha3_256", "sha3_384", "sha3_512", "shake_128",
+    "shake_256", "blake2b", "blake2s",
+})
+
+_CACHE_SECTION = "gc012"
+_RECORD_V = 1
+
+
+def _plane_of(mod: ModuleInfo) -> tuple[bool, bool]:
+    """(scoped, sim) for a module by its dotted name."""
+    parts = mod.name.split(".")
+    sim = "sim" in parts
+    if _PLANES & set(parts):
+        return True, sim
+    for i, p in enumerate(parts):
+        if p == "models" and i + 1 < len(parts) and (
+            parts[i + 1] in _MODEL_LEAVES
+        ):
+            return True, sim
+    return False, sim
+
+
+class _SourceMatcher:
+    """The source pattern, shared between the at-source finding walk
+    and the engine's ``source_fn``: classify a node, or None."""
+
+    def __init__(
+        self, mod: ModuleInfo, resolver: ModuleResolver,
+        scoped: bool, sim: bool,
+    ):
+        self.mod = mod
+        self.resolver = resolver
+        self.scoped = scoped
+        self.sim = sim
+
+    # -- classification ---------------------------------------------------
+
+    def classify_call(
+        self, call: ast.Call
+    ) -> tuple[str, str] | None:
+        path = dotted_path(call.func)
+        if path is None:
+            return None
+        eff = self.resolver.expand_path(path)
+        seeded = bool(call.args or call.keywords)
+        if len(eff) >= 3 and eff[:2] == ("numpy", "random"):
+            name = eff[2]
+            if name in _SEEDABLE:
+                if seeded:
+                    return None  # deterministic given the seed
+                return KIND_RNG, (
+                    f"unseeded numpy.random.{name}()"
+                )
+            return KIND_RNG, (
+                f"numpy.random.{name} (module-global RNG state)"
+            )
+        if len(eff) == 2 and eff[0] == "random":
+            if eff[1] == "Random":
+                if seeded:
+                    return None
+                return KIND_RNG, "unseeded random.Random()"
+            if eff[1] == "SystemRandom":
+                return KIND_RNG, "random.SystemRandom (OS entropy)"
+            return KIND_RNG, (
+                f"random.{eff[1]} (process-global RNG state)"
+            )
+        if eff in (("uuid", "uuid4"), ("uuid", "uuid1")):
+            return KIND_RNG, f"uuid.{eff[1]}()"
+        if eff == ("os", "urandom"):
+            return KIND_RNG, "os.urandom()"
+        if len(eff) >= 2 and eff[0] == "secrets":
+            return KIND_RNG, f"secrets.{eff[1]}"
+        if self.sim and eff == ("os", "getenv"):
+            return KIND_ENVIRON, "os.getenv()"
+        return None
+
+    def classify_attr(
+        self, attr: ast.Attribute
+    ) -> tuple[str, str] | None:
+        if not self.sim:
+            return None
+        # EXACT os.environ only: `os.environ.get` is an Attribute too,
+        # but its `os.environ` child matches — one site, one finding
+        if self.resolver.expand_path(
+            dotted_path(attr) or ()
+        ) == ("os", "environ"):
+            return KIND_ENVIRON, "os.environ"
+        return None
+
+    # -- engine source_fn protocol ----------------------------------------
+
+    def __call__(self, node: ast.AST):
+        if isinstance(node, ast.Call):
+            got = self.classify_call(node)
+        elif isinstance(node, ast.Attribute):
+            got = self.classify_attr(node)
+        else:
+            got = None
+        if got is None:
+            return None
+        kind, desc = got
+        line = getattr(node, "lineno", 1)
+        # sources inside a scoped plane are reported at-source by the
+        # walk below; the flagged bit stops sinks re-reporting them
+        return [src_atom(
+            kind, line, f"{desc} ({self.mod.relpath}:{line})",
+            flagged=self.scoped,
+        )]
+
+
+def _source_message(kind: str, desc: str) -> str:
+    if kind == KIND_ENVIRON:
+        return (
+            f"{desc} read inside the hermetic sim plane — "
+            "configuration reaches a day through its seeded spec, "
+            "never ambient process state (replay would depend on "
+            "the environment of the replaying host)"
+        )
+    return (
+        f"{desc} in a replay plane — digests must be a pure "
+        "function of the run seed; derive randomness from the seed "
+        "(sim/workload.py's default_rng((0x9E3779B9, seed)) fold) "
+        "or thread the run's Generator in"
+    )
+
+
+@register
+class ReplayPurity(Checker):
+    rule = "GC012"
+    name = "replay-purity"
+    description = (
+        "digest-bearing planes (sim/chaos/qos/fleet, "
+        "models.router/serving/disagg/paging) are deterministic: no "
+        "unseeded or process-global RNG, uuid4, os.urandom, or "
+        "environ reads (sim); no set-iteration or id()/hash() order "
+        "reaching a digest, heap, or sort key — tracked "
+        "interprocedurally through the analysis engine's summaries"
+    )
+    project = True  # taint crosses modules; summaries link tree-wide
+
+    # -- per-module record (aux-cached) ------------------------------------
+
+    def _module_data(self, mod: ModuleInfo):
+        key = f"{mod.relpath}\0{mod.sha}"
+        if self.aux_cache is not None:
+            raw = self.aux_cache.aux_get(_CACHE_SECTION, key)
+            if raw is not None:
+                try:
+                    return self._decode(raw)
+                except (KeyError, TypeError, ValueError):
+                    pass  # structurally invalid: rebuild
+        data = self._build(mod)
+        if self.aux_cache is not None:
+            self.aux_cache.aux_put(
+                _CACHE_SECTION, key, self._encode(*data)
+            )
+        return data
+
+    @staticmethod
+    def _encode(scoped, src_rows, funcs) -> dict:
+        return {
+            "v": _RECORD_V,
+            "scoped": bool(scoped),
+            "src": list(src_rows),
+            "funcs": {
+                k: record_to_json(rec) for k, rec in funcs.items()
+            },
+        }
+
+    @staticmethod
+    def _decode(raw: dict):
+        if raw["v"] != _RECORD_V:
+            raise ValueError("record version mismatch")
+        src_rows = [
+            {
+                "line": int(r["line"]), "col": int(r["col"]),
+                "symbol": str(r["symbol"]),
+                "message": str(r["message"]),
+            }
+            for r in raw["src"]
+        ]
+        funcs = {
+            str(k): record_from_json(v)
+            for k, v in raw["funcs"].items()
+        }
+        return bool(raw["scoped"]), src_rows, funcs
+
+    def _build(self, mod: ModuleInfo):
+        resolver = ModuleResolver(mod)
+        scoped, sim = _plane_of(mod)
+        matcher = _SourceMatcher(mod, resolver, scoped, sim)
+
+        src_rows: list[dict] = []
+        if scoped:
+            # at-source findings: a full walk, independent of
+            # reachability — dead code in a replay plane still rots
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    got = matcher.classify_call(node)
+                elif isinstance(node, ast.Attribute):
+                    got = matcher.classify_attr(node)
+                else:
+                    got = None
+                if got is not None:
+                    kind, desc = got
+                    src_rows.append({
+                        "line": node.lineno,
+                        "col": node.col_offset,
+                        "symbol": symbol_of(mod.tree, node),
+                        "message": _source_message(kind, desc),
+                    })
+
+        class_nodes = {
+            n.name: n for n in ast.walk(mod.tree)
+            if isinstance(n, ast.ClassDef)
+        }
+        set_attr_cache: dict[str, frozenset] = {}
+        funcs: dict[str, FuncRecord] = {}
+        for qual, cls, node in iter_functions(mod.tree):
+            if cls is not None and cls not in set_attr_cache:
+                set_attr_cache[cls] = class_set_attrs(
+                    class_nodes[cls]
+                )
+            ft = FunctionTaint(
+                mod, node,
+                source_fn=matcher,
+                resolver=resolver,
+                class_name=cls,
+                set_attrs=set_attr_cache.get(cls or "", frozenset()),
+            )
+            funcs[f"{mod.name}:{qual}"] = FuncRecord(
+                params=ft.params,
+                ret=list(ft.ret),
+                sinks=self._collect_sinks(qual, ft, resolver),
+                # a call with no taint-carrying argument can never
+                # route anything into a callee's param sinks — drop
+                # the row (most calls; the records shrink ~10x)
+                calls=[
+                    {
+                        "line": c.lineno, "col": c.col_offset,
+                        "symbol": qual, "key": ckey,
+                        "bound": bound, "args": args,
+                    }
+                    for c, ckey, bound, args in ft.calls
+                    if args
+                ],
+            )
+        return scoped, src_rows, funcs
+
+    # -- sinks -------------------------------------------------------------
+
+    def _collect_sinks(
+        self, qual: str, ft: FunctionTaint, resolver: ModuleResolver
+    ) -> list[dict]:
+        sinks: list[dict] = []
+
+        # names this function binds to hashlib constructors: their
+        # `.update(...)` arguments are digest inputs
+        hash_names: set[str] = set()
+        for st in ft.stmts:
+            if isinstance(st, ast.Assign) and isinstance(
+                st.value, ast.Call
+            ):
+                p = dotted_path(st.value.func)
+                if p is None:
+                    continue
+                eff = resolver.expand_path(p)
+                if len(eff) == 2 and eff[0] == "hashlib" and (
+                    eff[1] in _HASHLIB_CTORS
+                ):
+                    for t in st.targets:
+                        if isinstance(t, ast.Name):
+                            hash_names.add(t.id)
+
+        def add(node: ast.AST, desc: str, atoms: set) -> None:
+            if atoms:
+                sinks.append({
+                    "line": getattr(node, "lineno", 1),
+                    "col": getattr(node, "col_offset", 0),
+                    "symbol": qual,
+                    "desc": desc,
+                    "atoms": list(atoms),
+                })
+
+        for call in ft.iter_calls():
+            p = dotted_path(call.func)
+            if p is None:
+                continue
+            eff = resolver.expand_path(p)
+            if len(eff) == 2 and eff[0] == "hashlib" and (
+                eff[1] in _HASHLIB_CTORS
+            ):
+                for a in call.args:
+                    add(
+                        call, f"digest input (hashlib.{eff[1]})",
+                        ft.taint_of(a),
+                    )
+            elif (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "update"
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id in hash_names
+            ):
+                for a in call.args:
+                    add(
+                        call,
+                        f"digest input "
+                        f"({call.func.value.id}.update)",
+                        ft.taint_of(a),
+                    )
+            elif "digest" in p[-1].lower():
+                for a in call.args:
+                    add(
+                        call, f"digest input ({p[-1]})",
+                        ft.taint_of(a),
+                    )
+                for kw in call.keywords:
+                    add(
+                        call, f"digest input ({p[-1]})",
+                        ft.taint_of(kw.value),
+                    )
+            elif p[-1] == "heappush" and len(call.args) >= 2:
+                add(
+                    call, "heap event order (heappush)",
+                    ft.taint_of(call.args[1]),
+                )
+            if (
+                p == ("sorted",)
+                or (
+                    p[-1] == "sort"
+                    and isinstance(call.func, ast.Attribute)
+                )
+            ):
+                for kw in call.keywords:
+                    if kw.arg != "key":
+                        continue
+                    kv = kw.value
+                    if isinstance(kv, ast.Lambda):
+                        atoms = ft.taint_of(kv.body)
+                    elif isinstance(kv, ast.Name) and (
+                        kv.id in resolver.funcs
+                    ):
+                        # key=local_fn — its RETURN order-taints the
+                        # sort; the call atom lets link() expand it
+                        atoms = {(
+                            "call",
+                            f"{resolver.modname}:{kv.id}",
+                            False, (),
+                        )} | ft.taint_of(kv)
+                    else:
+                        atoms = ft.taint_of(kv)
+                    add(call, "sort key", atoms)
+        return sinks
+
+    # -- the project pass --------------------------------------------------
+
+    def check_project(
+        self, mods: list[ModuleInfo]
+    ) -> Iterator[Finding]:
+        per_mod = []
+        records: dict[str, FuncRecord] = {}
+        wanted_keys: set[str] = set()
+        for mod in mods:
+            wanted_keys.add(f"{mod.relpath}\0{mod.sha}")
+            scoped, src_rows, funcs = self._module_data(mod)
+            per_mod.append((mod, scoped, src_rows, funcs))
+            records.update(funcs)
+        if self.aux_cache is not None:
+            # drop rows for files that changed or left the scan —
+            # the aux section otherwise grows one orphan per edit
+            sec = self.aux_cache.aux.get(_CACHE_SECTION)
+            if isinstance(sec, dict):
+                for k in list(sec):
+                    if k not in wanted_keys:
+                        del sec[k]
+                        self.aux_cache.dirty = True
+
+        summaries = link(records)
+
+        out: list[Finding] = []
+        seen: set[tuple] = set()
+
+        def emit(
+            mod: ModuleInfo, line: int, col: int, symbol: str,
+            message: str,
+        ) -> None:
+            k = (mod.relpath, line, message)
+            if k not in seen:
+                seen.add(k)
+                out.append(Finding(
+                    rule=self.rule, path=mod.relpath, line=line,
+                    col=col, symbol=symbol, message=message,
+                ))
+
+        for mod, scoped, src_rows, funcs in per_mod:
+            if not scoped:
+                continue
+            for r in src_rows:
+                emit(
+                    mod, r["line"], r["col"], r["symbol"],
+                    r["message"],
+                )
+            for rec in funcs.values():
+                for s in rec.sinks:
+                    srcs, _params = expand(
+                        s["atoms"], records, summaries
+                    )
+                    for a in sorted(srcs, key=repr):
+                        if a[4]:
+                            continue  # reported at its source line
+                        emit(
+                            mod, s["line"], s["col"], s["symbol"],
+                            f"nondeterministic input reaches "
+                            f"{s['desc']}: {a[3]} — a replay digest "
+                            "must be a pure function of the run "
+                            "seed (sort sets before iterating; "
+                            "never order by id()/hash())",
+                        )
+                for c in rec.calls:
+                    csum = summaries.get(c["key"])
+                    crec = records.get(c["key"])
+                    if not csum or crec is None or (
+                        not csum.param_sinks
+                    ):
+                        continue
+                    pmap = _param_slots(crec.params, c["bound"])
+                    for pname in sorted(csum.param_sinks):
+                        sub = _args_for(c["args"], pmap, pname)
+                        if not sub:
+                            continue
+                        srcs, _params = expand(
+                            sub, records, summaries
+                        )
+                        for a in sorted(srcs, key=repr):
+                            if a[4]:
+                                continue
+                            for desc in sorted(
+                                csum.param_sinks[pname]
+                            ):
+                                emit(
+                                    mod, c["line"], c["col"],
+                                    c["symbol"],
+                                    f"argument `{pname}` carries "
+                                    f"nondeterminism ({a[3]}) into "
+                                    f"{desc} inside `{c['key']}` — "
+                                    "a replay digest must be a "
+                                    "pure function of the run "
+                                    "seed",
+                                )
+        yield from sorted(
+            out, key=lambda f: (f.path, f.line, f.message)
+        )

@@ -1,0 +1,128 @@
+"""GC013: stale suppressions — every ``disable=`` must earn its keep.
+
+A ``# graftcheck: disable=GC005`` comment is a standing claim: "this
+line violates GC005 on purpose." When the code under it is later
+fixed or refactored away, the comment survives — and now silently
+pre-authorizes a FUTURE violation on that line. mypy solved the same
+rot with ``--warn-unused-ignores``; this rule is that semantics for
+graftcheck: a suppression comment that suppresses zero findings is
+itself a finding, per rule name it lists (so ``disable=GC003,GC008``
+with only GC003 firing reports the GC008 half — including typo'd rule
+ids, which by construction never match anything).
+
+Runs through the :meth:`~..core.Checker.check_run` post-suppression
+hook: it must see which findings the suppression pass actually
+dropped, so it cannot be a per-file checker, and its findings bypass
+line suppression — a stale-suppression report must not be silenceable
+by the very comment it reports.
+
+``--rules`` subset runs judge only the rules that ran (a GC008
+suppression is not stale just because this run didn't run GC008);
+rule names outside the registry and ``disable=all`` are judged only
+when the full registry ran. Comments are found with :mod:`tokenize`,
+not a substring scan, so ``disable=`` inside a string literal (this
+docstring, say) is never misread as a suppression.
+"""
+
+from __future__ import annotations
+
+import io
+import tokenize
+from typing import Iterator
+
+from ..core import (
+    Checker,
+    Finding,
+    ModuleInfo,
+    _suppressed_rules,
+    register,
+    symbol_of,
+)
+
+
+class _At:
+    """Position shim for :func:`symbol_of` (line-only anchor)."""
+
+    def __init__(self, line: int):
+        self.lineno = line
+
+
+def _suppression_comments(
+    mod: ModuleInfo,
+) -> Iterator[tuple[int, set[str]]]:
+    """(line, rule names) per real ``disable=`` COMMENT token."""
+    try:
+        for tok in tokenize.generate_tokens(
+            io.StringIO(mod.source).readline
+        ):
+            if tok.type == tokenize.COMMENT and (
+                "graftcheck" in tok.string
+            ):
+                rules = _suppressed_rules(tok.string)
+                if rules:
+                    yield tok.start[0], rules
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return  # un-tokenizable file: the parse error surfaces elsewhere
+
+
+@register
+class StaleSuppression(Checker):
+    rule = "GC013"
+    name = "stale-suppression"
+    description = (
+        "every `# graftcheck: disable=<rule>` comment suppresses at "
+        "least one finding of each rule it names (mypy unused-ignore "
+        "semantics) — a suppression whose violation was fixed is "
+        "deleted with it, never left pre-authorizing the next one"
+    )
+
+    def check_run(
+        self,
+        mods: list[ModuleInfo],
+        *,
+        suppressed: list[Finding],
+        active_rules: set[str],
+        all_rules_active: bool,
+    ) -> Iterator[Finding]:
+        by_path: dict[str, list[Finding]] = {}
+        for f in suppressed:
+            by_path.setdefault(f.path, []).append(f)
+        for mod in mods:
+            # token gate: no "graftcheck" substring, no comment to
+            # judge — and no tokenize pass (most files)
+            if "graftcheck" not in mod.source:
+                continue
+            sups = by_path.get(mod.relpath, [])
+            for line, rules in _suppression_comments(mod):
+                # a comment at line L silences findings at L or L+1
+                near = [
+                    f for f in sups if f.line in (line, line + 1)
+                ]
+                for name in sorted(rules):
+                    if name == "all" or name not in active_rules:
+                        # `all`, and names the registry doesn't know
+                        # (typos), are judgeable only when every
+                        # rule ran; a --rules subset must not call a
+                        # GC008 suppression stale for not running
+                        # GC008
+                        if not all_rules_active:
+                            continue
+                        used = bool(near) if name == "all" else False
+                    else:
+                        used = any(f.rule == name for f in near)
+                    if not used:
+                        yield Finding(
+                            rule=self.rule,
+                            path=mod.relpath,
+                            line=line,
+                            col=0,
+                            symbol=symbol_of(mod.tree, _At(line)),
+                            message=(
+                                f"suppression `disable={name}` on "
+                                "this line suppresses no finding — "
+                                "the violation it covered is gone "
+                                "(or the rule name is a typo); "
+                                "delete the comment so it cannot "
+                                "pre-authorize a future violation"
+                            ),
+                        )

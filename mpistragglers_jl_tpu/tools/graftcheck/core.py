@@ -18,9 +18,16 @@ checkers (:mod:`.checkers`) plug into:
   field): growing it past the cap fails the run, and a stale entry
   (matching nothing) fails too — the baseline can only shrink quietly,
   never grow or rot.
-* per-file result cache keyed on (content sha, tool fingerprint): a
-  clean re-run over an unchanged tree re-parses nothing. Project-wide
-  checkers always run live (they are cheap; their inputs span files).
+* per-file result cache keyed on (content sha, tool fingerprint), plus
+  a whole-tree cache for project-wide checkers keyed on the sorted
+  (relpath, content sha) set and each project checker's
+  :meth:`Checker.project_fingerprint` (extra inputs outside the .py
+  set — GC009's sibling ``transport.cpp``). With both hot, a clean
+  re-run over an unchanged tree parses NOTHING: :class:`ModuleInfo`
+  defers ``ast.parse`` to first ``.tree`` access.
+* :meth:`Checker.check_run` — a post-suppression hook that sees the
+  suppressed bucket; GC013 uses it to flag suppressions that suppress
+  nothing (its findings are not themselves suppressible).
 
 Stdlib-only by contract (the tier-1 self-run asserts the tool pulls in
 no jax): everything here is :mod:`ast` + :mod:`json` + :mod:`hashlib`.
@@ -45,6 +52,7 @@ __all__ = [
     "Baseline",
     "BaselineError",
     "dotted_path",
+    "resolve_relative",
     "load_modules",
     "run",
     "RunResult",
@@ -99,6 +107,27 @@ def dotted_path(expr: ast.expr) -> tuple[str, ...] | None:
     return tuple(reversed(parts))
 
 
+def resolve_relative(
+    mod_name: str, is_package: bool, node: ast.ImportFrom
+) -> str | None:
+    """Absolute dotted target of a (possibly relative) ImportFrom, or
+    None when the relative level climbs out of the root package.
+    Shared by GC001's closure walk and the analysis engine's import
+    maps (it lives here so :mod:`.analysis` need not import a checker
+    module)."""
+    if node.level == 0:
+        return node.module
+    parts = mod_name.split(".") if mod_name else []
+    pkg = parts if is_package else parts[:-1]
+    up = node.level - 1
+    if up > len(pkg):
+        return None
+    base = pkg[: len(pkg) - up]
+    if node.module:
+        base = base + node.module.split(".")
+    return ".".join(base) if base else None
+
+
 def symbol_of(tree: ast.Module, node: ast.AST) -> str:
     """Enclosing qualname of ``node`` ("<module>" at top level).
 
@@ -135,16 +164,26 @@ def symbol_of(tree: ast.Module, node: ast.AST) -> str:
 
 @dataclass
 class ModuleInfo:
-    """One parsed source file handed to the checkers."""
+    """One source file handed to the checkers. The AST is LAZY: a
+    warm cached run (per-file and project caches both hot) must parse
+    nothing, so ``ast.parse`` happens at first ``.tree`` access — a
+    syntax error therefore surfaces at first use, which the runner
+    still reports as the same exit-2 configuration failure."""
 
     path: str  # absolute
     relpath: str  # posix, relative to the scan root's parent
     name: str  # dotted module name ("pkg.sub.mod"; "" outside a pkg)
     source: str
-    tree: ast.Module
     sha: str
 
+    _tree: ast.Module | None = field(default=None, repr=False)
     _lines: list[str] | None = field(default=None, repr=False)
+
+    @property
+    def tree(self) -> ast.Module:
+        if self._tree is None:
+            self._tree = ast.parse(self.source, filename=self.path)
+        return self._tree
 
     @property
     def lines(self) -> list[str]:
@@ -195,10 +234,12 @@ def package_base(top: str) -> str:
 
 
 def load_modules(paths: Iterable[str]) -> list[ModuleInfo]:
-    """Parse every ``.py`` under ``paths`` (files or directories).
+    """Read every ``.py`` under ``paths`` (files or directories).
 
-    Files that fail to parse raise — a syntax error in the tree is a
-    finding-level event for CI, not something to skip silently.
+    Parsing is deferred to first ``.tree`` access (so fully cached
+    runs never parse); a file that fails to parse raises there — a
+    syntax error in the tree is a finding-level event for CI, not
+    something to skip silently.
     """
     out: list[ModuleInfo] = []
     seen: set[str] = set()
@@ -240,7 +281,6 @@ def load_modules(paths: Iterable[str]) -> list[ModuleInfo]:
                     relpath=os.path.relpath(f, base).replace(os.sep, "/"),
                     name=_module_name(f, base),
                     source=src,
-                    tree=ast.parse(src, filename=f),
                     sha=hashlib.sha256(src.encode()).hexdigest(),
                 )
             )
@@ -255,12 +295,19 @@ def load_modules(paths: Iterable[str]) -> list[ModuleInfo]:
 class Checker:
     """Base class: subclass, set ``rule``/``name``/``description``,
     implement ``check_module`` (per-file; cached) or ``check_project``
-    (whole module set; always live — set ``project = True``)."""
+    (whole module set, ``project = True``; cached whole-tree on the
+    sorted (relpath, sha) set plus :meth:`project_fingerprint`)."""
 
     rule: str = "GC000"
     name: str = "unnamed"
     description: str = ""
     project: bool = False
+
+    #: attached by the runner around ``check_project`` so a
+    #: project-wide checker can keep derived per-file artifacts (the
+    #: analysis engine's per-function summaries) in the shared cache
+    #: file via ``aux_get``/``aux_put``; None under ``--no-cache``
+    aux_cache: "_Cache | None" = None
 
     def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
         return iter(())
@@ -268,6 +315,31 @@ class Checker:
     def check_project(
         self, mods: list[ModuleInfo]
     ) -> Iterator[Finding]:
+        return iter(())
+
+    def project_fingerprint(self, mods: list[ModuleInfo]) -> str:
+        """Extra whole-tree cache-key material for a project checker
+        whose verdict depends on inputs OUTSIDE the scanned .py set
+        (GC009 reads a sibling transport.cpp): return a digest of
+        those inputs so the project cache invalidates when they
+        change. Must not parse — it runs on every (including fully
+        cached) invocation."""
+        return ""
+
+    def check_run(
+        self,
+        mods: list[ModuleInfo],
+        *,
+        suppressed: list[Finding],
+        active_rules: set[str],
+        all_rules_active: bool,
+    ) -> Iterator[Finding]:
+        """Post-suppression hook, always live (must be cheap): runs
+        after findings are bucketed, seeing what was suppressed.
+        GC013 implements this to flag suppressions that suppress
+        nothing. Findings yielded here bypass line suppression (a
+        stale-suppression report must not be silenceable by the very
+        comment it reports) but still pass the baseline split."""
         return iter(())
 
 
@@ -448,12 +520,23 @@ class _Cache:
     the fingerprint because a ``--rules`` subset run records only its
     subset's findings; without the salt a later full scan would
     replay those partial results as if they were complete (a dirty
-    tree reading clean)."""
+    tree reading clean).
+
+    Two more sections ride the same file and the same fingerprint:
+
+    * ``aux`` — free-form per-checker artifact store (the analysis
+      engine's per-function summaries), sectioned by checker and keyed
+      however the checker likes (by (relpath, sha), conventionally).
+    * ``project`` — ONE whole-tree record for the project checkers,
+      keyed on the runner-computed project key; see :func:`run`.
+    """
 
     def __init__(self, path: str | None, salt: str = ""):
         self.path = path
         self.fingerprint = _tool_fingerprint() + "|" + salt
         self.data: dict[str, list[dict]] = {}
+        self.aux: dict[str, dict] = {}
+        self.project: dict = {}
         self.dirty = False
         if path and os.path.exists(path):
             try:
@@ -461,6 +544,12 @@ class _Cache:
                     raw = json.load(f)
                 if raw.get("fingerprint") == self.fingerprint:
                     self.data = raw.get("files", {})
+                    aux = raw.get("aux", {})
+                    self.aux = aux if isinstance(aux, dict) else {}
+                    proj = raw.get("project", {})
+                    self.project = (
+                        proj if isinstance(proj, dict) else {}
+                    )
             except (OSError, ValueError):
                 self.data = {}
 
@@ -468,12 +557,7 @@ class _Cache:
         ("rule", "path", "line", "col", "symbol", "message")
     )
 
-    def get(self, key: str) -> list[Finding] | None:
-        """Cached findings for ``key``, or None. The file's contents
-        are NOT trusted: any structurally invalid entry voids that
-        sha's record (treated as a miss and re-analyzed) instead of
-        crashing or replaying garbage."""
-        got = self.data.get(key)
+    def _decode(self, got) -> list[Finding] | None:
         if not isinstance(got, list):
             return None
         out = []
@@ -485,8 +569,37 @@ class _Cache:
             out.append(Finding(**d))
         return out
 
+    def get(self, key: str) -> list[Finding] | None:
+        """Cached findings for ``key``, or None. The file's contents
+        are NOT trusted: any structurally invalid entry voids that
+        sha's record (treated as a miss and re-analyzed) instead of
+        crashing or replaying garbage."""
+        return self._decode(self.data.get(key))
+
     def put(self, key: str, findings: list[Finding]) -> None:
         self.data[key] = [f.__dict__ for f in findings]
+        self.dirty = True
+
+    def aux_get(self, section: str, key: str):
+        """Checker-owned artifact, or None. Structure is the owning
+        checker's contract — it must validate what it reads back."""
+        sec = self.aux.get(section)
+        return sec.get(key) if isinstance(sec, dict) else None
+
+    def aux_put(self, section: str, key: str, value) -> None:
+        self.aux.setdefault(section, {})[key] = value
+        self.dirty = True
+
+    def project_get(self, key: str) -> list[Finding] | None:
+        if self.project.get("key") != key:
+            return None
+        return self._decode(self.project.get("findings"))
+
+    def project_put(self, key: str, findings: list[Finding]) -> None:
+        self.project = {
+            "key": key,
+            "findings": [f.__dict__ for f in findings],
+        }
         self.dirty = True
 
     def save(self) -> None:
@@ -503,7 +616,9 @@ class _Cache:
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(
                     {"fingerprint": self.fingerprint,
-                     "files": self.data},
+                     "files": self.data,
+                     "aux": self.aux,
+                     "project": self.project},
                     f,
                 )
             os.replace(tmp, self.path)
@@ -580,8 +695,37 @@ def run(
         findings += mine
         if progress is not None:
             progress(mod.relpath)
-    for chk in project:
-        findings += list(chk.check_project(mods))
+    if project:
+        # whole-tree cache: the project checkers' verdict is a pure
+        # function of the (relpath, sha) set, the project rule ids,
+        # and whatever non-.py inputs each checker fingerprints
+        # (GC009's transport.cpp) — key all of it, replay on a hit
+        pf = hashlib.sha256()
+        for m in sorted(mods, key=lambda m: m.relpath):
+            pf.update(m.relpath.encode())
+            pf.update(b"\0")
+            pf.update(m.sha.encode())
+            pf.update(b"\n")
+        for chk in sorted(project, key=lambda c: c.rule):
+            pf.update(chk.rule.encode())
+            pf.update(chk.project_fingerprint(mods).encode())
+        pkey = pf.hexdigest()
+        cached_p = cache.project_get(pkey)
+        if cached_p is not None:
+            findings += cached_p
+        else:
+            mine_p: list[Finding] = []
+            for chk in project:
+                # a pathless cache (--no-cache) can never persist, so
+                # handing it over would only buy the serialization
+                # cost of aux_put with none of the warm-run payoff
+                chk.aux_cache = cache if cache.path else None
+                try:
+                    mine_p += list(chk.check_project(mods))
+                finally:
+                    chk.aux_cache = None
+            cache.project_put(pkey, mine_p)
+            findings += mine_p
     cache.save()
 
     live: list[Finding] = []
@@ -592,6 +736,20 @@ def run(
             suppressed.append(f)
         else:
             live.append(f)
+
+    # post-suppression hooks (GC013 stale-suppression): always live,
+    # appended to the live set AFTER bucketing so a stale-suppression
+    # report cannot be silenced by the comment it reports
+    all_rules_active = set(checkers) == set(_REGISTRY)
+    for chk in checkers.values():
+        live += list(
+            chk.check_run(
+                mods,
+                suppressed=suppressed,
+                active_rules=set(checkers),
+                all_rules_active=all_rules_active,
+            )
+        )
 
     if baseline_path is not None and not os.path.exists(baseline_path):
         # a typo'd --baseline must be a loud config error, not a

@@ -1,0 +1,1 @@
+# GC012 bad fixture package root — intentionally empty.

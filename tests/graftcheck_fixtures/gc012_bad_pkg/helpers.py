@@ -1,0 +1,18 @@
+"""Helpers OUTSIDE every replay plane: nothing here is flagged
+at-source, but taint crosses into ``sim/`` through a return value
+(``unordered_ids``) and through a kwarg into a digest sink
+(``stamp``). The findings land in sim/day.py, naming these lines."""
+
+import hashlib
+
+
+def unordered_ids(events):
+    ids = {e.node for e in events}
+    return list(ids)  # order-revealing: list() over a set
+
+
+def stamp(payload, *, salt=b""):
+    h = hashlib.sha256()
+    h.update(salt)
+    h.update(payload)  # param sink: `payload` is a digest input
+    return h.hexdigest()

@@ -1,0 +1,58 @@
+"""GC012 bad fixture: a deliberately impure day engine. Every
+source class fires, plus two interprocedural flows through
+``..helpers``. Violation lines pinned by the fixture test."""
+
+import hashlib
+import heapq
+import os
+import random
+import uuid
+
+import numpy as np
+
+from ..helpers import stamp, unordered_ids
+
+
+def seed_state():
+    rng = np.random.default_rng()  # GC012: unseeded default_rng
+    jitter = np.random.normal()  # GC012: module-global RNG state
+    token = uuid.uuid4()  # GC012: uuid4
+    salt = os.urandom(8)  # GC012: OS entropy
+    draw = random.random()  # GC012: process-global RNG
+    mode = os.environ.get("DAY_MODE", "fast")  # GC012: environ in sim
+    level = os.getenv("DAY_LEVEL")  # GC012: getenv in sim
+    return rng, jitter, token, salt, draw, mode, level
+
+
+def digest_events(events):
+    nodes = {e.node for e in events}
+    h = hashlib.sha256()
+    for n in nodes:  # set iteration order...
+        h.update(n)  # GC012: ...reaches the digest here
+    return h.hexdigest()
+
+
+def rank(e):
+    return hash(e)  # id-order: sink-gated, flagged at the sort below
+
+
+def order_events(events):
+    events.sort(key=rank)  # GC012: hash()-ordered sort key
+    events.sort(key=lambda e: id(e))  # GC012: id()-ordered sort key
+    heap = []
+    for e in events:
+        heapq.heappush(heap, (hash(e), e))  # GC012: heap event order
+    return heap
+
+
+def day_digest(events):
+    ids = unordered_ids(events)  # helper returns set-order
+    h = hashlib.sha256()
+    for i in ids:
+        h.update(i)  # GC012: helper's set order reaches the digest
+    return h.hexdigest()
+
+
+def day_stamp(events):
+    tags = list({e.tag for e in events})
+    return stamp(payload=b"|".join(tags))  # GC012: kwarg into sink

@@ -1,0 +1,1 @@
+# GC012 good fixture package root — intentionally empty.

@@ -1,0 +1,16 @@
+"""The good twin of gc012_bad_pkg/helpers.py: the set is sorted
+before it leaves, so the order taint never forms, and the digest
+helper only ever receives deterministic bytes."""
+
+import hashlib
+
+
+def ordered_ids(events):
+    return sorted({e.node for e in events})
+
+
+def stamp(payload, *, salt=b""):
+    h = hashlib.sha256()
+    h.update(salt)
+    h.update(payload)
+    return h.hexdigest()

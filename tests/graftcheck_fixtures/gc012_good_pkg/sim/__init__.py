@@ -1,0 +1,1 @@
+# The `sim` component puts every module below inside the replay scope.

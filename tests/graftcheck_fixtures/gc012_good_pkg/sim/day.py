@@ -1,0 +1,46 @@
+"""GC012 good fixture: the same day engine, replay-pure. Seeded
+RNG constructions terminate taint; every set is sorted before its
+order can matter; event order comes from a sequence counter."""
+
+import hashlib
+import heapq
+import random
+
+import numpy as np
+
+from ..helpers import ordered_ids, stamp
+
+
+def seed_state(seed):
+    rng = np.random.default_rng((0x9E3779B9, seed))
+    lane = np.random.default_rng(seed + 1)
+    rnd = random.Random(0xC4A05 ^ seed)
+    return rng, lane, rnd
+
+
+def digest_events(events):
+    h = hashlib.sha256()
+    for n in sorted({e.node for e in events}):
+        h.update(n)
+    return h.hexdigest()
+
+
+def order_events(events):
+    events.sort(key=lambda e: (e.t, e.node))
+    heap = []
+    for seq, e in enumerate(events):
+        heapq.heappush(heap, (seq, e))
+    return heap
+
+
+def day_digest(events):
+    ids = ordered_ids(events)
+    h = hashlib.sha256()
+    for i in ids:
+        h.update(i)
+    return h.hexdigest()
+
+
+def day_stamp(events):
+    tags = sorted({e.tag for e in events})
+    return stamp(payload=b"|".join(tags))

@@ -1,0 +1,29 @@
+"""GC013 bad fixture: stale suppressions. One disable comment earns
+its keep (the GC010 it covers still fires — no GC013 there); the
+rest suppress nothing. Violation lines pinned by the fixture test."""
+
+
+def refuse(obs, rr):
+    obs.shed(rr)  # graftcheck: disable=GC010
+    return rr
+
+
+def fixed_long_ago(obs, rr):
+    obs.shed(rr, reason="overload")  # graftcheck: disable=GC010
+    return rr
+
+
+def half_stale(obs, rr):
+    # graftcheck: disable=GC010,GC005
+    obs.shed(rr)
+    return rr
+
+
+def typo(obs, rr):
+    obs.shed(rr, reason="hot")  # graftcheck: disable=GC910
+    return rr
+
+
+def all_for_nothing(obs, rr):
+    obs.shed(rr, reason="warm")  # graftcheck: disable=all
+    return rr

@@ -1,0 +1,18 @@
+"""GC013 good fixture: every disable comment suppresses a finding
+that still exists — same-line, line-above, and blanket forms."""
+
+
+def refuse(obs, rr):
+    obs.shed(rr)  # graftcheck: disable=GC010
+    return rr
+
+
+def refuse_above(obs, rr):
+    # graftcheck: disable=GC010
+    obs.shed(rr)
+    return rr
+
+
+def blanket(obs, rr):
+    obs.shed(rr)  # graftcheck: disable=all
+    return rr

@@ -95,6 +95,31 @@ def _keys(findings):
             [("GC011", 6), ("GC011", 7), ("GC011", 10),
              ("GC011", 16), ("GC011", 17)],
         ),
+        (
+            # ISSUE 18 replay-purity: at-source RNG/uuid/urandom/
+            # environ hits (17-23), set iteration reaching the digest
+            # (31), hash()/id() order reaching sort keys (40, 41) and
+            # the event heap (44), and the two interprocedural flows —
+            # a helper's returned set order reaching a sim digest (52)
+            # and a kwarg carrying set order into the helper's own
+            # hashlib sink (58)
+            "gc012_bad_pkg",
+            [("GC012", 17), ("GC012", 18), ("GC012", 19),
+             ("GC012", 20), ("GC012", 21), ("GC012", 22),
+             ("GC012", 23), ("GC012", 31), ("GC012", 40),
+             ("GC012", 41), ("GC012", 44), ("GC012", 52),
+             ("GC012", 58)],
+        ),
+        (
+            # stale suppressions: a retired finding (12), the dead
+            # half of a two-rule comment (17), a typo'd rule id (23),
+            # and a blanket disable=all covering nothing (28); the
+            # comment on line 7 suppresses a live GC010 and stays
+            # silent
+            "gc013_bad.py",
+            [("GC013", 12), ("GC013", 17), ("GC013", 23),
+             ("GC013", 28)],
+        ),
     ],
 )
 def test_bad_fixture_exact_findings(bad, expected):
@@ -107,7 +132,8 @@ def test_bad_fixture_exact_findings(bad, expected):
     "good",
     ["gc001_good_pkg", "gc001_hermetic_good_pkg", "gc002_good.py",
      "gc003_good.py", "gc004_good.py", "gc005_good.py",
-     "gc010_good.py", "gc011_good_pkg"],
+     "gc010_good.py", "gc011_good_pkg", "gc012_good_pkg",
+     "gc013_good.py"],
 )
 def test_good_fixture_clean(good):
     res = _findings(good)
@@ -474,8 +500,9 @@ def test_package_self_run_is_clean():
     res = run([_PKG], baseline_path=DEFAULT_BASELINE)
     assert res.ok, "\n".join(f.format() for f in res.fresh)
     # GC001-GC005 + the v2 set (ISSUE 8) + GC010 shed-by-name (r20)
-    # + GC011 witness-single-source (r21)
-    assert res.n_rules == 11
+    # + GC011 witness-single-source (r21) + GC012 replay-purity and
+    # GC013 stale-suppression (ISSUE 18)
+    assert res.n_rules == 13
     assert res.n_files > 50  # the whole package, not a subset
 
 
@@ -531,8 +558,185 @@ def test_cli_exit_codes():
     assert rules.returncode == 0
     for rule in ("GC001", "GC002", "GC003", "GC004", "GC005",
                  "GC006", "GC007", "GC008", "GC009", "GC010",
-                 "GC011"):
+                 "GC011", "GC012", "GC013"):
         assert rule in rules.stdout
+    # the argparse banner derives its range from the live registry —
+    # the hardcoded "(GC001-GC009)" went stale twice (ISSUE 18)
+    helptext = cli("--help")
+    assert "GC001-GC013" in helptext.stdout
+
+
+# --------------------------------------------------------------------------
+# GC012 replay-purity: interprocedural taint (ISSUE 18)
+# --------------------------------------------------------------------------
+
+
+def test_gc012_interprocedural_return_names_helper_source():
+    """The finding sits in sim/day.py (the sink), but the message
+    indicts the helper module's list()-over-set — taint crossed the
+    module boundary through the engine's function summaries."""
+    res = _findings("gc012_bad_pkg", rules=["GC012"])
+    by_line = {f.line: f for f in res.fresh}
+    f = by_line[52]
+    assert f.path == "gc012_bad_pkg/sim/day.py"
+    assert "digest input" in f.message
+    assert "gc012_bad_pkg/helpers.py" in f.message
+
+
+def test_gc012_interprocedural_kwarg_into_helper_sink():
+    """The reverse direction: sim/ passes a set-ordered value as a
+    KWARG into a helper whose body feeds it to hashlib — the finding
+    lands at the call site, naming the parameter and the callee."""
+    res = _findings("gc012_bad_pkg", rules=["GC012"])
+    by_line = {f.line: f for f in res.fresh}
+    f = by_line[58]
+    assert "`payload`" in f.message
+    assert "gc012_bad_pkg.helpers:stamp" in f.message
+
+
+def test_gc012_order_sources_are_sink_gated():
+    """hash() in the local key function (line 36) is not a finding on
+    its own — it surfaces only at the sort that consumes it (line 40),
+    with the source's file:line in the message."""
+    res = _findings("gc012_bad_pkg", rules=["GC012"])
+    by_line = {f.line: f for f in res.fresh}
+    assert 36 not in by_line
+    assert "gc012_bad_pkg/sim/day.py:36" in by_line[40].message
+
+
+def test_gc012_aux_cache_reuses_module_records(tmp_path):
+    """Touching ONE file invalidates the whole-tree project key but
+    not the sibling modules' aux records: the second run rebuilds only
+    the touched module and replays day.py's sources/sinks/summaries
+    through record_from_json — findings must be byte-identical."""
+    import shutil
+
+    pkg = tmp_path / "gc012_bad_pkg"
+    shutil.copytree(os.path.join(_FIX, "gc012_bad_pkg"), pkg)
+    cache = str(tmp_path / "c.json")
+    first = run([str(pkg)], cache_path=cache, rules=["GC012"])
+    helpers = pkg / "helpers.py"
+    helpers.write_text(helpers.read_text() + "\n# touched\n")
+    second = run([str(pkg)], cache_path=cache, rules=["GC012"])
+    assert [f.format() for f in second.fresh] == [
+        f.format() for f in first.fresh
+    ]
+    assert len(first.fresh) == 13
+
+
+# --------------------------------------------------------------------------
+# GC013 stale suppressions (ISSUE 18)
+# --------------------------------------------------------------------------
+
+
+def test_gc013_half_stale_names_only_the_dead_rule():
+    """A two-rule comment whose GC010 half still fires is reported
+    ONLY for the GC005 half; the typo'd and blanket comments name
+    themselves in the message."""
+    res = _findings("gc013_bad.py")
+    msgs = {f.line: f.message for f in res.fresh}
+    assert "disable=GC005" in msgs[17]
+    assert "disable=GC010" not in msgs[17]
+    assert "disable=GC910" in msgs[23]
+    assert "disable=all" in msgs[28]
+
+
+def test_gc013_rules_subset_never_fakes_staleness():
+    """Under --rules, a suppression for an INACTIVE rule cannot be
+    judged stale (its findings were never computed), and unknown/all
+    names are only judged on a full-registry run — so a subset run
+    reports exactly the one provably dead active-rule suppression."""
+    res = _findings("gc013_bad.py", rules=["GC010", "GC013"])
+    assert _keys(res.fresh) == [("GC013", 12)]
+
+
+# --------------------------------------------------------------------------
+# whole-tree project cache + SARIF (ISSUE 18 satellites)
+# --------------------------------------------------------------------------
+
+
+def test_warm_clean_rerun_parses_nothing(tmp_path, monkeypatch):
+    """With the per-file cache AND the whole-tree project cache hot, a
+    clean re-run never builds an AST: ast.parse is forbidden outright
+    and the run still completes with identical (empty) findings."""
+    import ast as _ast
+
+    target = os.path.join(_PKG, "sim")
+    cache = str(tmp_path / "c.json")
+    first = run([target], cache_path=cache)
+    assert first.ok, "\n".join(f.format() for f in first.fresh)
+
+    def boom(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("warm clean re-run must not parse")
+
+    monkeypatch.setattr(_ast, "parse", boom)
+    second = run([target], cache_path=cache)
+    assert second.ok
+    assert second.fresh == []
+    assert second.n_files == first.n_files
+
+
+def test_cli_sarif_report(tmp_path):
+    """--sarif PATH: fresh findings as plain results, baselined ones
+    suppressed kind=external, in-source comments kind=inSource; the
+    driver catalog carries the full registry; an unwritable target is
+    a loud exit-2 config error."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m",
+             "mpistragglers_jl_tpu.tools.graftcheck", *args],
+            capture_output=True, text=True, cwd=_REPO, env=env,
+            timeout=120,
+        )
+
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"cap": 1, "entries": [{
+        "rule": "GC004", "path": "gc004_bad.py", "symbol": "tick",
+        "justification": "fixture: exercising the ledger",
+    }]}))
+    out = tmp_path / "report.sarif"
+    r = cli(os.path.join(_FIX, "gc004_bad.py"),
+            "--baseline", str(bl), "--no-cache",
+            "--sarif", str(out), "-q")
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    sarif_run = doc["runs"][0]
+    catalog = {x["id"] for x in sarif_run["tool"]["driver"]["rules"]}
+    assert {"GC001", "GC012", "GC013"} <= catalog
+    results = sarif_run["results"]
+    plain = [x for x in results if "suppressions" not in x]
+    external = [
+        x for x in results
+        if any(s["kind"] == "external"
+               for s in x.get("suppressions", []))
+    ]
+    assert len(plain) == 21 and len(external) == 1
+    loc = external[0]["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == 6
+    assert loc["artifactLocation"]["uriBaseId"] == "SRCROOT"
+
+    # in-source suppressions (gc003_bad.py lines 38/56) + '-' = stdout
+    r = cli(os.path.join(_FIX, "gc003_bad.py"),
+            "--baseline", "none", "--no-cache", "--sarif", "-", "-q")
+    assert r.returncode == 1
+    doc, _end = json.JSONDecoder().raw_decode(
+        r.stdout, r.stdout.index("{")
+    )
+    kinds = [
+        s["kind"] for x in doc["runs"][0]["results"]
+        for s in x.get("suppressions", [])
+    ]
+    assert kinds.count("inSource") == 2
+
+    unwritable = cli(os.path.join(_FIX, "gc002_good.py"),
+                     "--baseline", "none", "--no-cache",
+                     "--sarif", str(tmp_path / "no" / "dir" / "r"))
+    assert unwritable.returncode == 2
+    assert "--sarif" in unwritable.stderr
 
 
 def test_bad_snippet_injection_fails_package_scan(tmp_path):
