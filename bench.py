@@ -837,6 +837,8 @@ def _contract_line(out: dict) -> str:
             out.get("observability"), "overhead_pct"),
         "trace_overhead_pct": _rung_summary(
             out.get("observability"), "trace_overhead_pct"),
+        "series_overhead_pct": _rung_summary(
+            out.get("observability"), "series_overhead_pct"),
         "train_s_per_step": _rung_summary(tt, "value"),
         "train_mfu": _rung_summary(tt, "mfu_vs_raw_matmul"),
         "decode_ms_per_token": _rung_summary(
@@ -1430,10 +1432,87 @@ def bench_observability(epochs=50, n=8):
         )
         return dark_wall, traced_wall, n_events
 
+    def run_windowed_day():
+        """The round-24 leg: the SAME seeded router day, registry
+        attached both runs, then with the windowed SLO plane (series
+        store + burn-rate policy) bound — the marginal cost of window
+        rollover, per-window evaluation, and the cost ledger on the
+        request hot path. Interleaved pairs with a collect before each
+        timed run; the scalar is the best PAIRWISE ratio — the two
+        runs of a pair are adjacent in time, so a load shift on the
+        host inflates both sides together where min-of-N per side
+        reads it as overhead. Digests asserted byte-identical."""
+        import gc
+
+        from mpistragglers_jl_tpu.models.router import RequestRouter
+        from mpistragglers_jl_tpu.obs import (
+            MetricsRegistry,
+            SeriesStore,
+            SloObjective,
+            SloPolicy,
+        )
+        from mpistragglers_jl_tpu.sim.clock import VirtualClock
+        from mpistragglers_jl_tpu.sim.workload import (
+            SimReplica,
+            poisson_arrivals,
+            run_router_day,
+        )
+
+        def day(windowed):
+            clock = VirtualClock()
+            registry = MetricsRegistry()
+            router = RequestRouter(
+                [SimReplica(clock, slots=4, n_inner=8, tick_s=0.02)
+                 for _ in range(3)],
+                clock=clock, registry=registry,
+            )
+            series = slo = None
+            if windowed:
+                series = SeriesStore(
+                    registry, clock=clock, window_s=1.0,
+                    max_windows=600,
+                )
+                slo = SloPolicy(series, [
+                    SloObjective("ttft-p99", "latency", 0.5, q=0.99),
+                ])
+            arrivals = poisson_arrivals(
+                40.0, n=3000, seed=7, prompt_len=64, max_new=8,
+            )
+            gc.collect()
+            t0 = time.perf_counter()
+            rep = run_router_day(
+                router, arrivals, series=series, slo=slo,
+            )
+            return time.perf_counter() - t0, rep.digest(), series
+
+        day(True)  # warmup
+        best, n_windows = None, 0
+        for _ in range(6):
+            dw, dark_digest, _none = day(False)
+            ww, windowed_digest, series = day(True)
+            if windowed_digest != dark_digest:
+                raise AssertionError(
+                    "the windowed SLO plane perturbed the day "
+                    f"digest: {dark_digest} != {windowed_digest}"
+                )
+            if best is None or ww / dw < best[1] / best[0]:
+                best = (dw, ww)
+            n_windows = len(series)
+        return best[0], best[1], n_windows
+
     dark_s, _, _ = run(False)
     inst_s, tracer, registry = run(True)
     flight_s, flight_record_us = run_flight()
     day_dark_s, day_traced_s, trace_events = run_traced_day()
+    sday_dark_s, sday_windowed_s, series_windows = run_windowed_day()
+    series_overhead_pct = round(
+        max(sday_windowed_s / sday_dark_s - 1.0, 0.0) * 100, 2
+    )
+    if series_overhead_pct > 5.0:
+        raise AssertionError(
+            "windowed SLO plane overhead gate: "
+            f"{series_overhead_pct}% > 5% on the 3k-request day"
+        )
     scrape_p50, scrape_p95, scrape_lines = scrape(registry)
     s = tracer.summary()
     snap = registry.snapshot()
@@ -1460,6 +1539,13 @@ def bench_observability(epochs=50, n=8):
         "trace_overhead_pct": round(
             max(day_traced_s / day_dark_s - 1.0, 0.0) * 100, 2
         ),
+        # windowed-SLO-plane fields (round 24): same seeded day shape,
+        # registry attached BOTH runs so the scalar is the marginal
+        # cost of the series/slo plane alone, gated at 5% above
+        "series_day_dark_ms": round(sday_dark_s * 1e3, 1),
+        "series_day_windowed_ms": round(sday_windowed_s * 1e3, 1),
+        "series_windows": series_windows,
+        "series_overhead_pct": series_overhead_pct,
         # thread-scheduling noise can make the instrumented loop read
         # FASTER than the dark one; clamp at 0 so the digest scalar
         # reads as "measured overhead", never a nonsense negative

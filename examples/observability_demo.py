@@ -27,7 +27,13 @@ telemetry artifacts:
    real HTTP, and runs the conservation audit (``GET /audit``: every
    submitted id resolved exactly once, token/migration arithmetic
    closed);
-5. everything merges: ``dump_merged_chrome_trace`` writes ONE
+5. the windowed SLO plane (round 24): a sim router day with a mid-day
+   latency regression runs with a ``SeriesStore`` + ``SloPolicy``
+   attached — the TTFT fast-burn alert fires during the regression
+   and clears after the heal, the alert timeline and per-tenant cost
+   ledger print, and ``GET /slo`` / ``GET /series`` serve the same
+   state over real HTTP;
+6. everything merges: ``dump_merged_chrome_trace`` writes ONE
    Chrome/Perfetto trace with the pool's worker/coordinator tracks,
    the scheduler's tick track, and the worker processes' own task
    spans (clock-aligned) side by side — open it at
@@ -285,6 +291,93 @@ def tracing_section():
     )
 
 
+def slo_section():
+    """The windowed SLO plane (round 24): a sim router day with a
+    mid-day latency regression (two of three replicas partitioned
+    under load) runs with a SeriesStore + SloPolicy attached — the
+    TTFT fast-burn alert fires during the regression and clears after
+    the heal; the demo prints the alert timeline and the per-tenant
+    cost ledger, then re-fetches the SAME policy state as JSON from
+    ``GET /slo`` over real HTTP."""
+    import urllib.request
+
+    from mpistragglers_jl_tpu.models.router import RequestRouter
+    from mpistragglers_jl_tpu.obs import (
+        SeriesStore,
+        SloObjective,
+        SloPolicy,
+    )
+    from mpistragglers_jl_tpu.sim.clock import VirtualClock
+    from mpistragglers_jl_tpu.sim.workload import (
+        ReplicaPartition,
+        SimReplica,
+        poisson_arrivals,
+        run_router_day,
+    )
+
+    clock = VirtualClock()
+    fleet = [
+        SimReplica(clock, slots=2, n_inner=4, tick_s=0.02)
+        for _ in range(3)
+    ]
+    reg = MetricsRegistry()
+    router = RequestRouter(fleet, policy="least_loaded", clock=clock,
+                           registry=reg)
+    series = SeriesStore(reg, clock=clock, window_s=1.0,
+                         max_windows=120)
+    slo = SloPolicy(series, [SloObjective(
+        "ttft-p99", "latency", 0.1, q=0.9,
+        fast_s=2.0, slow_s=6.0, fire_burn=2.0,
+    )])
+    rep = run_router_day(
+        router,
+        poisson_arrivals(60.0, n=1200, seed=5, prompt_len=64,
+                         max_new=8),
+        events=[ReplicaPartition(4.0, (1, 2), 5.0)],
+        series=series, slo=slo,
+    )
+    assert slo.timeline, "the regression must fire the alert"
+    assert slo.fast_burn_firing() == [], "the heal must clear it"
+    print(
+        f"slo: {series.n_rolled} windows over a "
+        f"{rep.virtual_s:.1f} s day, alert timeline:"
+    )
+    for ev in slo.timeline:
+        print(
+            f"  t={ev['t']:6.2f} s  {ev['phase']:5s} "
+            f"{ev['objective']} (fast burn {ev['fast_burn']:.2f}x, "
+            f"slow burn {ev['slow_burn']:.2f}x)"
+        )
+    busy = sum(
+        v["busy_s"] for row in slo.ledger()
+        for v in row["tenants"].values()
+    )
+    print(
+        f"slo: cost ledger attributed {busy:.1f} busy chip-seconds "
+        f"over {len(slo.ledger())} windows"
+    )
+
+    # the same policy state over real HTTP: /slo is the pageable
+    # surface (503 while a fast-burn alert fires; 200 here — cleared)
+    with ObsServer(reg) as srv:
+        srv.add_slo(slo)
+        doc = json.loads(
+            urllib.request.urlopen(srv.url + "/slo").read()
+        )
+        sdoc = json.loads(
+            urllib.request.urlopen(srv.url + "/series").read()
+        )
+    assert doc["ok"] and doc["policies"][0]["timeline"] == slo.timeline
+    assert sdoc["stores"][0]["n_rolled"] == series.n_rolled
+    obj = doc["policies"][0]["objectives"][0]
+    print(
+        f"slo: GET /slo ok={doc['ok']} (budget burned "
+        f"{obj['budget']['burned_frac']:.2f}, "
+        f"{len(doc['policies'][0]['timeline'])} transitions); "
+        f"GET /series mirrors {sdoc['stores'][0]['n_rolled']} windows"
+    )
+
+
 def main():
     outdir = sys.argv[1] if len(sys.argv) > 1 else "."
     os.makedirs(outdir, exist_ok=True)
@@ -296,6 +389,7 @@ def main():
     tracer = pool_section(registry)
     worker_recorders = live_section(registry, flight, outdir)
     tracing_section()
+    slo_section()
 
     trace_path = os.path.join(outdir, "unified_trace.json")
     n_events = dump_merged_chrome_trace(

@@ -54,10 +54,18 @@ class ChaosInjector:
     >>> report.digest()     # the replay witness
     """
 
-    def __init__(self, *, registry=None, flight=None, trace=None):
+    def __init__(self, *, registry=None, flight=None, trace=None,
+                 series=None, slo=None):
         self.registry = registry
         self.flight = flight
         self.trace = trace
+        # round-24 windowed SLO plane: series/slo ride the day's
+        # drive loop (digest-neutral rollover), and an attached slo
+        # arms the alert-timeline invariant — every fired fast-burn
+        # alert must clear by episode end (the storm recovers), and
+        # the alert counts fold into the report digest
+        self.series = series
+        self.slo = slo
 
     # -- episode drive ----------------------------------------------------
 
@@ -166,6 +174,7 @@ class ChaosInjector:
             router, built["arrivals"],
             events=built.get("events", ()),
             retry=built.get("retry"),
+            series=self.series, slo=self.slo,
         )
 
         # post-run battery: shed-by-name, zero "silent" loss, flight
@@ -221,6 +230,22 @@ class ChaosInjector:
         if post is not None:
             invariants.append("scenario_post")
             extras = post(workload, router) or {}
+        if self.slo is not None:
+            # alert-timeline invariant: an episode that fired a
+            # fast-burn alert must also have cleared it — the storm
+            # RECOVERS, and the timeline (pure virtual time) says so
+            invariants.append("alert_timeline")
+            still = self.slo.fast_burn_firing()
+            if still:
+                raise InvariantViolation(
+                    f"episode ended with fast-burn alert(s) {still} "
+                    "still firing: the storm never recovered "
+                    f"({scenario.name})"
+                )
+            counts = self.slo.alert_counts()
+            extras = dict(extras)
+            extras["slo_alerts_fired"] = counts["fired"]
+            extras["slo_alerts_cleared"] = counts["cleared"]
         report = ChaosReport(
             scenario.name, scenario.seed, workload=workload,
             max_queue_depth=state["max_depth"],

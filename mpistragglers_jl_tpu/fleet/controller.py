@@ -272,10 +272,15 @@ class FleetController:
         registry=None,
         flight=None,
         trace=None,
+        slo=None,
     ):
         self.router = router
         self.clock = clock
         self._now = clock.now
+        # round-24 SLO plane: a bound SloPolicy makes burn-rate an
+        # additional grow trigger (step()); slo=None keeps the
+        # decision procedure byte-for-byte the round-18 one
+        self.slo = slo
         if trace is not None:
             # arm causal tracing fleet-wide: the router (and through
             # it every replica) stamps onto this one book
@@ -455,6 +460,17 @@ class FleetController:
             self.depth_high is not None
             and sig.depth_per_replica > self.depth_high
         )
+        # SLO burn as a grow trigger (round 24): a firing fast-burn
+        # alert joins the high-pressure signal — it rides the same
+        # dwell/cooldown machinery, and the decision record names the
+        # alert. Evaluated on the policy's windows (virtual time), so
+        # a controller day with slo= replays bit-identically.
+        slo_alert = None
+        if self.slo is not None:
+            firing = self.slo.fast_burn_firing()
+            if firing:
+                slo_alert = firing[0]
+                breach_high = True
         if breach_high:
             if self._high_since is None:
                 self._high_since = now
@@ -466,6 +482,14 @@ class FleetController:
         else:
             self._low_since = None
         target = self._target_size(sig)
+        if (
+            slo_alert is not None and target <= self.size
+            and self.size < self.max_replicas
+        ):
+            # the rate/capacity model says steady but the SLO is
+            # burning budget: grow one replica per decision until the
+            # fast window recovers
+            target = self.size + 1
         self.target_size = target
         if self._obs is not None:
             self._obs.sizes(self.size, target)
@@ -480,7 +504,11 @@ class FleetController:
             action = "grow"
             reason = (
                 "util_high" if sig.utilization > self.high
-                else "depth_high"
+                else "depth_high" if (
+                    self.depth_high is not None
+                    and sig.depth_per_replica > self.depth_high
+                )
+                else f"slo_burn:{slo_alert}"
             )
             # only controller-drained replicas are restorable (a
             # replica dead at construction is not the controller's to

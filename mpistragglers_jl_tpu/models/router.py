@@ -272,6 +272,15 @@ class _RouterObs:
             "router_ttft_seconds",
             help="submit -> first token, across hedges and re-routes",
         )
+        # busy chip-time (admission -> done): the cost-ledger plane's
+        # source series — per tenant on qos routers, router-wide
+        # always (the windowed SLO layer attributes these per window)
+        self.m_busy = registry.counter(
+            "router_busy_seconds_total",
+            help="admission -> completion chip-time, all requests",
+        )
+        if self._tenantful:
+            self._q_busy: dict[str, Any] = {}
         self.m_depth = [
             registry.gauge(
                 "router_replica_depth",
@@ -346,6 +355,22 @@ class _RouterObs:
             h.observe(rr.ttft)
         if rr.ttft is not None:
             self.m_ttft.observe(rr.ttft)
+        if rr.t_done is not None and rr.t_admitted is not None:
+            busy = rr.t_done - rr.t_admitted
+            if busy > 0:
+                self.m_busy.inc(busy)
+                if self._tenantful and rr.tenant is not None:
+                    b = self._q_busy.get(rr.tenant)
+                    if b is None:
+                        b = self._q_busy[rr.tenant] = (
+                            self.registry.counter(
+                                "qos_busy_seconds_total",
+                                help="admission -> completion "
+                                "chip-time, per tenant",
+                                tenant=rr.tenant,
+                            )
+                        )
+                    b.inc(busy)
 
     def shed(self, rr: RoutedRequest, reason: str, t: float) -> None:
         """One request refused at the door by name (over-budget

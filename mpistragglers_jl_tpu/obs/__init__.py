@@ -31,6 +31,18 @@ the books' arithmetic — tokens, pages, hedge legs, migration bytes —
 matches the report and the metrics registry. :class:`ObsServer`
 serves both: ``/trace/<id>`` waterfalls and ``/audit``.
 
+**Windowed SLO plane** (round 24): :mod:`.series` derives bounded
+ring-buffer time series from an attached registry on a caller-injected
+clock (:class:`SeriesStore` — counter deltas as per-window rates,
+gauge last-values, histogram bucket-delta windows so windowed p50/p99
+come out of the fixed log grid; respawn-safe via the aggregate plane's
+boot ids); :mod:`.slo` evaluates named objectives over those windows
+with error-budget accounting, multi-window fast/slow burn-rate alerts,
+and a per-tenant cost ledger (:class:`SloPolicy`, :class:`SloObjective`
+— flight-stamped fire/clear, bit-identical under sim replay).
+:class:`ObsServer` serves both: ``/series`` and ``/slo`` (503 while a
+fast-burn alert fires).
+
 Everything here is strictly OPT-IN, mirroring the tracer contract:
 instrumented layers (``ServingScheduler``, ``CodedGradTrainer``,
 ``CodedGemm``, ``HedgedServer``, ``ProcessBackend``) accept
@@ -51,6 +63,8 @@ from .metrics import (
     Histogram,
     MetricsRegistry,
 )
+from .series import SeriesStore
+from .slo import SloObjective, SloPolicy
 from .timeline import (
     SpanRecorder,
     annotate,
@@ -76,6 +90,9 @@ __all__ = [
     "OBS_TAG",
     "FlightRecorder",
     "FlightWatchdog",
+    "SeriesStore",
+    "SloObjective",
+    "SloPolicy",
     "TraceBook",
     "TERMINAL_KINDS",
     "audit",

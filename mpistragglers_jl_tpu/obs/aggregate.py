@@ -347,6 +347,14 @@ class TelemetryAggregator:
                               int(n) - prev[2])
 
     # -- exports ----------------------------------------------------------
+    def boots(self) -> dict[int, str]:
+        """rank -> boot id of its CURRENT incarnation (snapshot).
+        The windowed plane keys its counter deltas on these so a
+        respawned worker's reset never yields a negative-rate window
+        (:class:`~.series.SeriesStore`)."""
+        with self._lock:
+            return dict(self._boots)
+
     def recorders(self) -> list[SpanRecorder]:
         """The per-worker span recorders (one Chrome pid each in the
         merged trace), rank order."""

@@ -116,6 +116,8 @@ class ObsServer:
         self._recorders: list = []
         self._aggregators: list = []
         self._books: list = []
+        self._series: list = []
+        self._slos: list = []
         self._checks: dict[str, HealthCheck] = {}
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
@@ -149,6 +151,23 @@ class ObsServer:
         join ``/trace``, its waterfalls serve ``/trace/<id>``, and the
         conservation audit over it serves ``/audit``."""
         self._books.append(book)
+        return self
+
+    def add_series(self, store) -> "ObsServer":
+        """A :class:`~.series.SeriesStore`: its windows serve
+        ``/series`` and its per-window counter tracks join ``/trace``
+        (the recorder contract — one Perfetto pid per store)."""
+        self._series.append(store)
+        return self
+
+    def add_slo(self, policy) -> "ObsServer":
+        """A :class:`~.slo.SloPolicy`: objectives, burn rates, the
+        alert timeline, and the cost ledger serve ``/slo`` — 503 while
+        any fast-burn alert is firing, the paging contract. The
+        policy's store also joins ``/series`` (once)."""
+        self._slos.append(policy)
+        if policy.series not in self._series:
+            self.add_series(policy.series)
         return self
 
     def _unique_name(self, base: str) -> str:
@@ -339,6 +358,7 @@ class ObsServer:
         for agg in list(self._aggregators):
             recorders.extend(agg.recorders())
         recorders.extend(self._books)
+        recorders.extend(self._series)
         doc, _ = merged_chrome_trace(
             tracers=list(self._tracers), recorders=recorders
         )
@@ -373,6 +393,25 @@ class ObsServer:
             out["books"].append(doc)
             out["ok"] = out["ok"] and res.ok
         return out
+
+    def series_doc(self) -> dict[str, Any]:
+        """The ``GET /series`` body: every registered store's window
+        ring (module-level JSON export, one entry per store)."""
+        stores = list(self._series)
+        if not stores:
+            return {"error": "no series store registered"}
+        return {"stores": [s.to_doc() for s in stores]}
+
+    def slo_doc(self) -> tuple[bool, dict[str, Any]]:
+        """The ``GET /slo`` body: ``ok`` is False — and the endpoint
+        503s — while ANY registered policy has a fast-burn alert
+        firing (mirrors ``/healthz``/``/audit`` degradation)."""
+        policies = list(self._slos)
+        if not policies:
+            return True, {"error": "no slo policy registered"}
+        docs = [p.to_doc() for p in policies]
+        ok = all(d["ok"] for d in docs)
+        return ok, {"ok": ok, "policies": docs}
 
     def __repr__(self) -> str:
         state = self.url if self._httpd is not None else "stopped"
@@ -453,11 +492,24 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json({"error": "no flight recorder"}, 404)
                     return
                 self._json(obs.flight.snapshot())
+            elif path == "/series":
+                doc = obs.series_doc()
+                if "error" in doc:
+                    self._json(doc, 404)
+                    return
+                self._json(doc)
+            elif path == "/slo":
+                ok, doc = obs.slo_doc()
+                if "error" in doc:
+                    self._json(doc, 404)
+                    return
+                self._json(doc, 200 if ok else 503)
             elif path == "/":
                 self._json({
                     "endpoints": ["/metrics", "/metrics.json",
                                   "/healthz", "/trace", "/trace/<id>",
-                                  "/audit", "/flight"],
+                                  "/audit", "/flight", "/series",
+                                  "/slo"],
                 })
             else:
                 self._send(404, b"not found\n", "text/plain")

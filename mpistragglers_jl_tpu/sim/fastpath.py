@@ -339,7 +339,8 @@ _FAST_POLICIES = ("round_robin", "least_loaded", "prefix_affinity",
 
 
 def fastpath_supported(router, *, controller=None, events=(),
-                       retry=None) -> tuple[bool, str]:
+                       retry=None, series=None,
+                       slo=None) -> tuple[bool, str]:
     """Can this day run on the vectorized engine? Returns
     ``(ok, reason)`` — the reason names the scalar-fallback boundary
     (module docstring) and lands in ``report.fastpath``."""
@@ -347,6 +348,10 @@ def fastpath_supported(router, *, controller=None, events=(),
         return False, "controller attached (elastic day)"
     if events:
         return False, "control-plane events in stream"
+    if series is not None or slo is not None:
+        # window rollover needs the scalar driver's per-step clock
+        # walk; the vectorized engine never visits intermediate times
+        return False, "series/slo attached"
     clock = router.clock
     if clock is None:
         return False, "no VirtualClock (live router)"
@@ -1264,6 +1269,7 @@ def run_router_day_fast(
     router, arrivals, *, controller=None, events: Iterable = (),
     retry: RetryPolicy | None = None,
     timer: Callable[[], float] | None = None,
+    series=None, slo=None,
 ) -> WorkloadReport:
     """:func:`~.workload.run_router_day` with the vectorized engine on
     supported days and a transparent scalar fallback on the rest —
@@ -1274,7 +1280,8 @@ def run_router_day_fast(
     self-measurement exactly as on the scalar driver."""
     evs = list(events)
     ok, reason = fastpath_supported(
-        router, controller=controller, events=evs, retry=retry
+        router, controller=controller, events=evs, retry=retry,
+        series=series, slo=slo,
     )
     batch = None
     if ok:
@@ -1290,7 +1297,8 @@ def run_router_day_fast(
             ok, reason = False, bad
     if not ok:
         rep = run_router_day(router, arrivals, controller=controller,
-                             events=evs, retry=retry, timer=timer)
+                             events=evs, retry=retry, timer=timer,
+                             series=series, slo=slo)
         rep.fastpath = f"scalar-fallback: {reason}"
         return rep
     wall_t0 = timer() if timer is not None else None

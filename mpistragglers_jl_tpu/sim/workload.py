@@ -1329,6 +1329,7 @@ def run_router_day(
     router, arrivals: Iterable[Arrival], *,
     controller=None, events: Iterable = (), retry: RetryPolicy | None = None,
     timer: Callable[[], float] | None = None,
+    series=None, slo=None,
 ) -> WorkloadReport:
     """Drive a virtual-time :class:`~..models.router.RequestRouter`
     through an arrival stream to completion: advance the clock to each
@@ -1365,7 +1366,17 @@ def run_router_day(
     self-measurement: the report's ``n_events`` (submits + fleet
     ticks), ``wall_s``, and ``events_per_s`` fill in, all OUTSIDE
     :meth:`~WorkloadReport.digest`. The timer is injected because
-    sim/ never reads the OS clock itself (graftcheck GC008)."""
+    sim/ never reads the OS clock itself (graftcheck GC008).
+
+    ``series=`` / ``slo=`` attach the windowed SLO plane (round 24: a
+    :class:`~..obs.SeriesStore` and/or :class:`~..obs.SloPolicy`):
+    the driver calls their ``maybe_roll(now)`` with the day clock at
+    every drive-loop point it already visits — after each fleet step
+    and each submit — so window rollover is digest-neutral by
+    construction: no clock event is ever scheduled and no router or
+    replica state is touched; the stores only READ the registry.
+    Dark (both None), the loop is event-for-event the pre-round-24
+    one."""
     wall_t0 = timer() if timer is not None else None
     clock = router.clock
     if clock is None:
@@ -1379,6 +1390,43 @@ def run_router_day(
     # clock.next_event() measured ~8% of a million-request day
     heap = clock._heap
     ctl = controller
+    # round-24 windowed SLO plane: one bound rollover callable (or
+    # None, keeping the dark drive loop branch-cheap); rolls happen
+    # only at points the dark loop already visits, so the day's
+    # digest is untouched by construction
+    obs_roll = None
+    if series is not None or slo is not None:
+        if slo is not None and (series is None or slo.series is series):
+            _store, _roll = slo.series, slo.maybe_roll
+        elif series is not None and slo is None:
+            _store, _roll = series, series.maybe_roll
+        else:
+            # distinct stores bound at once (unusual): roll both; no
+            # shared boundary to fast-path on
+            _store = None
+
+            def _roll(now_v):
+                if series is not None:
+                    series.maybe_roll(now_v)
+                if slo is not None:
+                    slo.maybe_roll(now_v)
+
+        if _store is not None:
+            from ..obs.series import _EPS as _w_eps
+
+            _w_s = _store.window_s
+
+            def obs_roll(now_v):
+                # called at every step/submit with the loop's current
+                # virtual time; crossing a boundary is rare, so the
+                # common case is one compare against the open window's
+                # start (package-internal peek, same license as
+                # clock._heap above)
+                t0 = _store._t0
+                if t0 is None or now_v - t0 + _w_eps >= _w_s:
+                    _roll(now_v)
+        else:
+            obs_roll = _roll
     # retry-client state (chaos plane): a heap of (due, submit-index,
     # request, attempt) timeout checks; empty and untouched when
     # retry=None, keeping the drive loop event-for-event pre-round-20
@@ -1405,7 +1453,7 @@ def run_router_day(
     append = submitted.append
     run_until, step = clock.run_until, router.step
     submit, replicas = router.submit, router.replicas
-    slo = router.ttft_slo
+    ttft_slo = router.ttft_slo
     evs = sorted(events, key=lambda e: e.t)
     ei = 0
     n_evs = len(evs)
@@ -1470,8 +1518,12 @@ def run_router_day(
                 ctl.step()
             if rheap:
                 fire_retries()
+            if obs_roll is not None:
+                obs_roll(nt)
             nt = next_at()
         run_until(t)
+        if obs_roll is not None:
+            obs_roll(t)
 
     def fire_events_through(t):
         # control-plane events due at or before t, in stream order
@@ -1494,19 +1546,23 @@ def run_router_day(
                 ctl.step()
             if rheap:
                 fire_retries()
+            if obs_roll is not None:
+                obs_roll(nt)
             nt = next_at()
         run_until(at)
         rr = submit(a.prompt, a.max_new, tenant=a.tenant)
         append(rr)
         if ctl is not None:
             ctl.observe_arrival(at)
+        if obs_roll is not None:
+            obs_roll(at)
         if rr.finished:
             continue  # shed at the door: no leg, no events to add
         t = getattr(replicas[rr.replica], "next_tick_at", None)
         if t is not None and (nt is None or t < nt):
             nt = t
-        if slo is not None:
-            d = rr.t_submit + slo
+        if ttft_slo is not None:
+            d = rr.t_submit + ttft_slo
             if nt is None or d < nt:
                 nt = d
         if retry is not None:
@@ -1536,6 +1592,8 @@ def run_router_day(
         router.step()
         if rheap:
             fire_retries()
+        if obs_roll is not None:
+            obs_roll(nt)
         if ctl is not None:
             ctl.step()
             if (

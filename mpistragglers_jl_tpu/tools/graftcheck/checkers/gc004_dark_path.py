@@ -8,7 +8,8 @@ checks. Two halves, statically checked:
 
 1. **Defaults + guards.** Any function/method taking a parameter
    named ``registry``/``spans``/``tracer``/``exporter``/``flight``/
-   ``trace`` with a DEFAULT must default it to ``None``, and every
+   ``trace``/``series``/``slo`` with a DEFAULT must default it to
+   ``None``, and every
    *dereference*
    of the parameter (``tracer.begin(...)``, ``registry.counter(...)``)
    must sit under a ``<name> is not None`` guard (an enclosing
@@ -45,7 +46,7 @@ from typing import Iterator
 from ..core import Checker, Finding, ModuleInfo, register
 
 PARAMS = ("registry", "spans", "tracer", "exporter", "flight",
-          "trace")
+          "trace", "series", "slo")
 
 _NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
 _FRAGMENT_RE = re.compile(r"[a-zA-Z0-9_:]*\Z")
@@ -284,7 +285,8 @@ class DarkPath(Checker):
     rule = "GC004"
     name = "dark-path"
     description = (
-        "registry/spans/tracer/exporter/flight/trace parameters "
+        "registry/spans/tracer/exporter/flight/trace/series/slo "
+        "parameters "
         "default to None with every dereference guarded by "
         "`is not None` "
         "(required params are export targets and exempt); literal "

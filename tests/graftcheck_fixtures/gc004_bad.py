@@ -105,3 +105,11 @@ def trace_append(tid, trace=None):
     # to the trace book without the None guard
     trace.event(tid, "first_token", 0.0)  # GC004 line 106
     return tid
+
+
+def window_roll(now, series=None, slo=None):
+    # the round-24 windowed-SLO shape: rolling the series store and
+    # evaluating the burn policy without the None guards
+    series.maybe_roll(now)  # GC004 line 113
+    slo.maybe_roll(now)  # GC004 line 114
+    return now

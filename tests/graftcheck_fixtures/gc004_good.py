@@ -184,3 +184,17 @@ def trace_append(tid, trace=None):
         trace.event(tid, "submitted", 0.0, tenant=None)
     ok = trace is not None and trace.mint()
     return tid if ok else None
+
+
+def window_roll(now, series=None, slo=None):
+    """The round-24 windowed-SLO shape, guarded: the series store
+    rolls and the burn policy evaluates only inside the is-not-None
+    arms (sim/workload.py run_router_day obs_roll discipline — the
+    policy rolls its own store, so a day driving both pays two None
+    checks)."""
+    if series is not None:
+        series.maybe_roll(now)
+    if slo is not None:
+        slo.maybe_roll(now)
+    ok = slo is not None and slo.fast_burn_firing()
+    return now if ok else None

@@ -237,6 +237,16 @@ def test_observability_demo(tmp_path):
     assert "migrate_out" in out.stdout and "adopt" in out.stdout
     assert "reproduced ttft/latency exactly" in out.stdout
     assert "GET /audit ok" in out.stdout
+    # round 24: the SLO section's injected latency regression fired
+    # the fast-burn alert and the heal cleared it — the timeline
+    # printed with both transitions, the cost ledger attributed the
+    # day, and /slo + /series served the same state over real HTTP
+    assert "alert timeline:" in out.stdout
+    assert "fire  ttft-p99" in out.stdout
+    assert "clear ttft-p99" in out.stdout
+    assert "cost ledger attributed" in out.stdout
+    assert "GET /slo ok=True" in out.stdout
+    assert "GET /series mirrors" in out.stdout
     # the artifacts really exist and the trace is valid trace-event JSON
     import json
 

@@ -66,7 +66,8 @@ def _keys(findings):
                           ("GC004", 80), ("GC004", 81),
                           ("GC004", 89), ("GC004", 90),
                           ("GC004", 98), ("GC004", 99),
-                          ("GC004", 106)]),
+                          ("GC004", 106),
+                          ("GC004", 113), ("GC004", 114)]),
         (
             "gc005_bad.py",
             [("GC005", 17), ("GC005", 18), ("GC005", 21),
@@ -181,7 +182,8 @@ def test_baseline_roundtrip(tmp_path):
                                 ("GC004", 80), ("GC004", 81),
                                 ("GC004", 89), ("GC004", 90),
                                 ("GC004", 98), ("GC004", 99),
-                                ("GC004", 106)]
+                                ("GC004", 106),
+                                ("GC004", 113), ("GC004", 114)]
     assert res.baseline_size == 1
 
 
@@ -714,7 +716,7 @@ def test_cli_sarif_report(tmp_path):
         if any(s["kind"] == "external"
                for s in x.get("suppressions", []))
     ]
-    assert len(plain) == 21 and len(external) == 1
+    assert len(plain) == 23 and len(external) == 1
     loc = external[0]["locations"][0]["physicalLocation"]
     assert loc["region"]["startLine"] == 6
     assert loc["artifactLocation"]["uriBaseId"] == "SRCROOT"

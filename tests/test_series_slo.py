@@ -1,0 +1,741 @@
+"""Windowed SLO plane (round 24, obs/series.py + obs/slo.py).
+
+Contracts under test:
+
+* **SeriesStore** — counter deltas / gauge last-value / histogram
+  bucket-delta windows off an attached registry on a caller-injected
+  clock; multi-window gap semantics; JSON export and the Perfetto
+  counter-track merge; the respawn discipline (aggregate-plane boot
+  ids key the delta state, so a respawned worker's counter reset can
+  never produce a negative-rate window) at unit level AND over a real
+  ProcessBackend kill/respawn;
+* **windowed-quantile fidelity** — the store's p99 over a seeded day
+  lands within one fixed-log bucket of the exact nearest-rank
+  percentile computed from the WorkloadReport arrays, for window
+  sizes {1 s, 10 s, 60 s};
+* **SloPolicy** — error-budget accounting, multi-window fast/slow
+  burn-rate fire/clear on the timeline (flight-ring instants), the
+  per-tenant cost ledger with the tenantless "-" fallback, and the
+  ``/series`` + ``/slo`` HTTP endpoints (503 while a fast-burn alert
+  fires);
+* **the storm acceptance** — ``storm_with_host_kill`` with the plane
+  attached: the fast-burn alert fires during the storm and clears
+  after recovery, the alert timeline and the ledger are bit-identical
+  across two replays, and the instrumented day's WorkloadReport
+  digest equals the dark run's (rollover is digest-neutral);
+* **the controller consumer** — burn-rate as a grow trigger whose
+  decision records carry the alert and replay bit-identically, while
+  a policy-free day stays byte-for-byte the round-18 loop.
+"""
+
+import json
+import math
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mpistragglers_jl_tpu import AsyncPool, asyncmap, waitall
+from mpistragglers_jl_tpu.backends.process import ProcessBackend
+from mpistragglers_jl_tpu.chaos import ChaosInjector, get_scenario
+from mpistragglers_jl_tpu.fleet import FleetController, replica_capacity_rps
+from mpistragglers_jl_tpu.models.router import RequestRouter
+from mpistragglers_jl_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    ObsServer,
+    SeriesStore,
+    SloObjective,
+    SloPolicy,
+)
+from mpistragglers_jl_tpu.sim import (
+    SimReplica,
+    VirtualClock,
+    poisson_arrivals,
+    run_router_day,
+)
+
+
+def echo_work(i, payload, epoch):
+    return payload * (i + 1)
+
+
+def _get(url, timeout=10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _fleet(n=3, *, slots=4, n_inner=8, tick=0.02, registry=None,
+           flight=None, policy="least_loaded"):
+    clock = VirtualClock()
+    reps = [
+        SimReplica(clock, slots=slots, n_inner=n_inner, tick_s=tick)
+        for _ in range(n)
+    ]
+    router = RequestRouter(
+        reps, policy=policy, clock=clock, registry=registry,
+        flight=flight,
+    )
+    return clock, reps, router
+
+
+# ---------------------------------------------------------------------------
+# SeriesStore windows
+# ---------------------------------------------------------------------------
+
+
+class TestSeriesStore:
+    def test_counter_gauge_hist_windows(self):
+        reg = MetricsRegistry()
+        store = SeriesStore(reg, window_s=1.0, max_windows=8)
+        c = reg.counter("demo_total", route="a")
+        g = reg.gauge("demo_depth")
+        h = reg.histogram("demo_seconds")
+        store.maybe_roll(0.0)          # pins t0, primes the baseline
+        c.inc(3)
+        g.set(7)
+        h.observe(0.01)
+        h.observe(0.02)
+        assert store.maybe_roll(0.5) == 0    # mid-window: nothing due
+        assert store.maybe_roll(1.0) == 1
+        assert store.window_delta("demo_total") == 3.0
+        assert store.window_rate("demo_total") == 3.0
+        assert store.window_delta(
+            "demo_total", labels={"route": "a"}
+        ) == 3.0
+        assert store.window_delta(
+            "demo_total", labels={"route": "b"}
+        ) == 0.0
+        assert store.gauge_value("demo_depth") == 7
+        assert store.window_count("demo_seconds") == 2
+        # the NEXT window sees only its own activity
+        c.inc(1)
+        store.maybe_roll(2.0)
+        assert store.window_delta("demo_total") == 1.0
+        assert store.window_count("demo_seconds") == 0
+
+    def test_pre_store_history_not_in_first_window(self):
+        """A store built over a registry with history baselines at its
+        first boundary: the first window carries only in-window
+        deltas, not the counter's whole past."""
+        reg = MetricsRegistry()
+        reg.counter("old_total").inc(100)
+        store = SeriesStore(reg, clock=lambda: 0.0, window_s=1.0)
+        reg.counter("old_total").inc(2)
+        store.maybe_roll(1.0)
+        assert store.window_delta("old_total") == 2.0
+
+    def test_multi_window_gap_semantics(self):
+        """A coarse driver: the whole delta lands in the most recent
+        elapsed window, the intervening windows close empty."""
+        reg = MetricsRegistry()
+        store = SeriesStore(reg, window_s=1.0, max_windows=16)
+        c = reg.counter("gap_total")
+        store.maybe_roll(0.0)
+        c.inc(5)
+        assert store.maybe_roll(4.2) == 4
+        wins = store.windows()
+        assert [w["i"] for w in wins] == [0, 1, 2, 3]
+        assert [sum(w["counters"].values()) for w in wins] == (
+            [0, 0, 0, 5]
+        )
+
+    def test_ring_bounded_and_doc_roundtrips(self):
+        reg = MetricsRegistry()
+        store = SeriesStore(reg, window_s=1.0, max_windows=4,
+                            name="day")
+        c = reg.counter("r_total")
+        h = reg.histogram("r_seconds")
+        store.maybe_roll(0.0)
+        for t in range(1, 11):
+            c.inc()
+            h.observe(0.01 * t)
+            store.maybe_roll(float(t))
+        assert len(store) == 4 and store.n_rolled == 10
+        doc = store.to_doc()
+        json.dumps(doc)                       # JSON-able end to end
+        assert doc["name"] == "day" and doc["n_rolled"] == 10
+        assert len(doc["windows"]) == 4
+        assert doc["windows"][-1]["counters"]["r_total"] == 1.0
+        # bucket grids hoisted once, not per window
+        assert "r_seconds" in doc["buckets"]
+        assert "counts" in doc["windows"][-1]["hists"]["r_seconds"]
+
+    def test_chrome_counter_tracks(self):
+        """chrome_events follows the recorder merge contract: counter
+        tracks (ph "C"), one sample per window at its close, counters
+        as rates, gauges as-is — so the store rides /trace."""
+        reg = MetricsRegistry()
+        store = SeriesStore(reg, window_s=2.0)
+        reg.counter("t_total", route="x").inc(10)
+        reg.gauge("t_depth").set(3)
+        store.maybe_roll(0.0)
+        reg.counter("t_total", route="x").inc(4)
+        store.maybe_roll(2.0)
+        meta, events = store.chrome_events(pid=9)
+        assert meta[0]["args"]["name"] == "series series"
+        by_name = {e["name"]: e for e in events}
+        rate = by_name['t_total{route="x"}']
+        assert rate["ph"] == "C" and rate["pid"] == 9
+        assert rate["ts"] == pytest.approx(2.0 * 1e6)
+        assert rate["args"]['t_total{route="x"}'] == 2.0  # 4 / 2s
+        assert by_name["t_depth"]["args"]["t_depth"] == 3
+
+    def test_explicit_now_required_without_clock(self):
+        store = SeriesStore(MetricsRegistry())
+        with pytest.raises(ValueError, match="explicit now="):
+            store.maybe_roll()
+        with pytest.raises(ValueError, match="window_s"):
+            SeriesStore(MetricsRegistry(), window_s=0.0)
+        with pytest.raises(ValueError, match="MetricsRegistry"):
+            SeriesStore(None)
+
+
+# ---------------------------------------------------------------------------
+# respawn discipline: counter resets never go negative
+# ---------------------------------------------------------------------------
+
+
+class _FakeAgg:
+    """The aggregate plane's boots() surface, hand-driven."""
+
+    def __init__(self):
+        self._boots = {}
+
+    def boots(self):
+        return dict(self._boots)
+
+
+class TestRespawnDiscipline:
+    def test_boot_flip_rebaselines_worker_series(self):
+        """A respawned rank's fresh counter (restarts at zero) with a
+        flipped boot id: the window carries the fresh incarnation's
+        value, never a negative delta."""
+        reg = MetricsRegistry()
+        agg = _FakeAgg()
+        agg._boots[1] = "boot-a"
+        store = SeriesStore(reg, window_s=1.0, aggregator=agg)
+        c = reg.counter("worker_tasks_total", worker="1")
+        store.maybe_roll(0.0)
+        c.inc(10)
+        store.maybe_roll(1.0)
+        assert store.window_delta("worker_tasks_total") == 10.0
+        # the respawn: boot flips AND the raw mirror resets below the
+        # dead incarnation's cumulative value
+        agg._boots[1] = "boot-b"
+        c._value = 3.0
+        store.maybe_roll(2.0)
+        assert store.window_delta("worker_tasks_total") == 3.0
+        for win in store.windows():
+            assert all(d >= 0.0 for d in win["counters"].values())
+
+    def test_observed_decrease_clamped_without_boot_map(self):
+        """A reset the boot map missed (no aggregator bound at all):
+        the decrease itself re-baselines — count the fresh value from
+        zero rather than emit a negative window."""
+        reg = MetricsRegistry()
+        store = SeriesStore(reg, window_s=1.0)
+        c = reg.counter("worker_tasks_total", worker="0")
+        store.maybe_roll(0.0)
+        c.inc(8)
+        store.maybe_roll(1.0)
+        c._value = 2.0                  # the reset, observed raw
+        store.maybe_roll(2.0)
+        assert store.window_delta("worker_tasks_total") == 2.0
+
+    def test_monotone_merged_counter_unaffected_by_flip(self):
+        """The aggregate plane's MERGED counters stay monotonic across
+        a flip — the store must then subtract cleanly (delta, not the
+        whole fresh value twice)."""
+        reg = MetricsRegistry()
+        agg = _FakeAgg()
+        agg._boots[2] = "boot-a"
+        store = SeriesStore(reg, window_s=1.0, aggregator=agg)
+        c = reg.counter("worker_tasks_total", worker="2")
+        store.maybe_roll(0.0)
+        c.inc(5)
+        store.maybe_roll(1.0)
+        agg._boots[2] = "boot-b"
+        c.inc(4)                        # merged plane: 5 + 4, monotone
+        store.maybe_roll(2.0)
+        assert store.window_delta("worker_tasks_total") == 4.0
+
+    def test_process_backend_kill_respawn_no_negative_rates(self):
+        """The regression end to end: a real ProcessBackend pool with
+        the aggregate plane attached, one worker killed and respawned
+        mid-run — every window of every worker-labeled series stays
+        non-negative."""
+        reg = MetricsRegistry()
+        backend = ProcessBackend(echo_work, 2, registry=reg)
+        store = SeriesStore(
+            reg, window_s=0.05, max_windows=600,
+            aggregator=backend.aggregator,
+        )
+        try:
+            pool = AsyncPool(2)
+            store.maybe_roll(time.monotonic())
+            for _ in range(3):
+                asyncmap(pool, [1.0, 2.0], backend, nwait=2)
+                store.maybe_roll(time.monotonic())
+            waitall(pool, backend)
+            store.maybe_roll(time.monotonic())
+            backend._procs[1].terminate()
+            deadline = time.perf_counter() + 30.0
+            while (
+                1 not in backend.dead_workers()
+                and time.perf_counter() < deadline
+            ):
+                time.sleep(0.02)
+            assert 1 in backend.dead_workers(), (
+                "worker 1 death not detected within 30s"
+            )
+            backend.respawn(1)
+            for _ in range(3):
+                asyncmap(pool, [1.0, 2.0], backend, nwait=2)
+                store.maybe_roll(time.monotonic())
+            waitall(pool, backend)
+            time.sleep(0.06)
+            store.maybe_roll(time.monotonic())
+        finally:
+            backend.shutdown()
+        assert store.n_rolled > 0
+        total = 0.0
+        for win in store.windows():
+            for (name, labels), d in win["counters"].items():
+                assert d >= 0.0, (name, labels, d)
+                if name == "worker_tasks_total":
+                    total += d
+        # both incarnations' work is attributed (6 rounds x 2 tasks)
+        assert total >= 12.0
+
+
+# ---------------------------------------------------------------------------
+# windowed-quantile fidelity against the exact report arrays
+# ---------------------------------------------------------------------------
+
+
+class TestWindowedQuantileFidelity:
+    @pytest.mark.parametrize("window_s", [1.0, 10.0, 60.0])
+    def test_p99_within_one_bucket_of_nearest_rank(self, window_s):
+        """The store's windowed p99 over a whole seeded day lands in
+        the same fixed-log bucket as the exact nearest-rank percentile
+        from the WorkloadReport arrays — one bucket's relative width
+        is the quantization the grid admits."""
+        reg = MetricsRegistry()
+        clock, _, router = _fleet(n=3, registry=reg)
+        store = SeriesStore(reg, clock=clock, window_s=window_s,
+                            max_windows=600)
+        rep = run_router_day(
+            router,
+            poisson_arrivals(40.0, n=1500, seed=7, prompt_len=64,
+                             max_new=8),
+            series=store,
+        )
+        # force-close the final partial window so the merge covers
+        # every observation of the day
+        store.maybe_roll(clock.now() + window_s)
+        n_win = store.n_rolled
+        approx = store.window_quantile(
+            "router_ttft_seconds", 0.99, windows=n_win
+        )
+        ttfts = sorted(
+            r.ttft for r in rep.requests if r.ttft is not None
+        )
+        assert store.window_count(
+            "router_ttft_seconds", windows=n_win
+        ) == len(ttfts)
+        exact = ttfts[math.ceil(0.99 * len(ttfts)) - 1]
+        assert approx is not None and not math.isinf(approx)
+        # the store returns the covering bucket's UPPER bound: the
+        # exact percentile sits inside that same bucket
+        bounds, _dc, _ds, _dn = store._merge_hists(
+            "router_ttft_seconds", n_win
+        )
+        idx = bounds.index(approx)
+        lower = bounds[idx - 1] if idx > 0 else 0.0
+        assert lower - 1e-12 < exact <= approx + 1e-12, (
+            window_s, exact, lower, approx,
+        )
+
+
+# ---------------------------------------------------------------------------
+# SloPolicy: burn alerts, budget, ledger
+# ---------------------------------------------------------------------------
+
+
+def _policy(window_s=1.0, flight=None, objectives=None):
+    reg = MetricsRegistry()
+    series = SeriesStore(reg, window_s=window_s, max_windows=64)
+    slo = SloPolicy(series, objectives or [
+        SloObjective("ttft-p99", "latency", 0.5, q=0.99,
+                     fast_s=2.0, slow_s=6.0, fire_burn=2.0),
+        SloObjective("avail", "availability", 0.99,
+                     fast_s=2.0, slow_s=6.0, fire_burn=2.0),
+    ], flight=flight)
+    return reg, series, slo
+
+
+class TestSloPolicy:
+    def test_budget_fractions(self):
+        lat = SloObjective("l", "latency", 0.5, q=0.99)
+        av = SloObjective("a", "availability", 0.999)
+        sh = SloObjective("s", "shed_rate", 0.05)
+        assert lat.budget_frac == pytest.approx(0.01)
+        assert av.budget_frac == pytest.approx(0.001)
+        assert sh.budget_frac == pytest.approx(0.05)
+
+    def test_refusals_by_name(self):
+        with pytest.raises(ValueError, match="kind"):
+            SloObjective("x", "throughput", 0.5)
+        with pytest.raises(ValueError, match="fast_s"):
+            SloObjective("x", "latency", 0.5, fast_s=10.0, slow_s=5.0)
+        with pytest.raises(ValueError, match="in \\(0,1\\)"):
+            SloObjective("x", "availability", 1.5)
+        with pytest.raises(ValueError, match=">= 1 objective"):
+            SloPolicy(SeriesStore(MetricsRegistry()), [])
+        with pytest.raises(ValueError, match="unique"):
+            _policy(objectives=[
+                SloObjective("x", "latency", 0.5),
+                SloObjective("x", "shed_rate", 0.1),
+            ])
+
+    def test_fire_needs_both_windows_then_fast_clears(self):
+        """The SRE discipline: a one-window blip cannot page (the slow
+        window holds); a sustained burn fires; the fast window
+        recovering clears — all stamped on the timeline and the
+        flight ring."""
+        fl = FlightRecorder(capacity=256)
+        reg, series, slo = _policy(flight=fl)
+        h = reg.histogram("router_ttft_seconds")
+
+        def window(bad, good, t):
+            for _ in range(bad):
+                h.observe(5.0)          # over the 0.5 s target
+            for _ in range(good):
+                h.observe(0.01)
+            slo.maybe_roll(t)
+
+        slo.maybe_roll(0.0)
+        for t in (1.0, 2.0, 3.0, 4.0, 5.0):
+            window(0, 100, t)           # healthy history
+        # the blip: 5/100 bad — fast burn (5/200)/0.01 = 2.5 >= 2,
+        # slow burn (5/600)/0.01 = 0.83 < 2: no page
+        window(5, 95, 6.0)
+        assert slo.fast_burn_firing() == []
+        window(50, 50, 7.0)
+        window(50, 50, 8.0)
+        window(50, 50, 9.0)             # sustained: both windows hot
+        assert slo.fast_burn_firing() == ["ttft-p99"]
+        fire = [e for e in slo.timeline if e["phase"] == "fire"]
+        assert fire and fire[0]["objective"] == "ttft-p99"
+        assert fire[0]["fast_burn"] >= 2.0
+        assert fire[0]["slow_burn"] >= 2.0
+        window(0, 100, 10.0)
+        window(0, 100, 11.0)            # fast window all healthy
+        assert slo.fast_burn_firing() == []
+        assert slo.alert_counts() == {"fired": 1, "cleared": 1}
+        stamps = fl.instants("slo alert")
+        assert [e["phase"] for e in stamps] == ["fire", "clear"]
+        assert stamps[0]["objective"] == "ttft-p99"
+        doc = slo.to_doc()
+        json.dumps(doc)
+        assert doc["ok"] and doc["firing"] == []
+        budget = {
+            o["name"]: o["budget"] for o in doc["objectives"]
+        }["ttft-p99"]
+        assert budget["bad"] == 155.0 and budget["total"] == 1100.0
+
+    def test_availability_and_ledger_tenantless_fallback(self):
+        """Door decisions: served vs shed-by-name; without per-tenant
+        counters the ledger books busy/shed under "-"."""
+        reg, series, slo = _policy()
+        served = reg.counter(
+            "router_requests_total", policy="p", replica="0",
+            outcome="ok",
+        )
+        shed = reg.counter("router_shed_total", reason="overload")
+        busy = reg.counter("router_busy_seconds_total")
+        slo.maybe_roll(0.0)
+        served.inc(4)
+        shed.inc(6)
+        busy.inc(1.25)
+        slo.maybe_roll(1.0)
+        (row,) = slo.ledger(1)
+        assert row["tenants"] == {
+            "-": {"busy_s": 1.25, "served": 4, "shed": 6},
+        }
+        # 6 shed / 10 door decisions against a 1% budget: a second
+        # hot window makes both burn windows hot — the alert fires
+        served.inc(4)
+        shed.inc(6)
+        slo.maybe_roll(2.0)
+        assert "avail" in slo.fast_burn_firing()
+        # quiet windows drain the fast burn to zero: the alert clears
+        for t in (3.0, 4.0):
+            slo.maybe_roll(t)
+        assert slo.fast_burn_firing() == []
+        assert slo.alert_counts() == {"fired": 1, "cleared": 1}
+
+    def test_ledger_prefers_per_tenant_counters(self):
+        """On a QoS router the per-tenant planes carry the SAME
+        chip-time/sheds as the router-wide totals — the ledger books
+        the tenant rows and skips the would-be double count."""
+        reg, series, slo = _policy()
+        reg.counter("qos_busy_seconds_total", tenant="t0").inc(0.5)
+        reg.counter("qos_busy_seconds_total", tenant="t1").inc(0.25)
+        reg.counter("router_busy_seconds_total").inc(0.75)
+        reg.counter(
+            "router_requests_total", tenant="t0", outcome="ok",
+        ).inc(3)
+        reg.counter(
+            "qos_shed_total", tenant="t1", reason="over_budget",
+        ).inc(2)
+        reg.counter("router_shed_total", reason="over_budget").inc(2)
+        slo.maybe_roll(0.0)
+        # everything above predates the first boundary: baseline
+        reg.counter("qos_busy_seconds_total", tenant="t0").inc(0.5)
+        reg.counter("qos_busy_seconds_total", tenant="t1").inc(0.25)
+        reg.counter("router_busy_seconds_total").inc(0.75)
+        reg.counter(
+            "router_requests_total", tenant="t0", outcome="ok",
+        ).inc(3)
+        reg.counter(
+            "qos_shed_total", tenant="t1", reason="over_budget",
+        ).inc(2)
+        reg.counter("router_shed_total", reason="over_budget").inc(2)
+        slo.maybe_roll(1.0)
+        (row,) = slo.ledger(1)
+        assert row["tenants"] == {
+            "t0": {"busy_s": 0.5, "served": 3, "shed": 0},
+            "t1": {"busy_s": 0.25, "served": 0, "shed": 2},
+        }
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: /series and /slo
+# ---------------------------------------------------------------------------
+
+
+class TestHttpSurface:
+    def test_series_and_slo_endpoints(self):
+        fl = FlightRecorder(capacity=256)
+        reg, series, slo = _policy(flight=fl)
+        h = reg.histogram("router_ttft_seconds")
+        srv = ObsServer(reg, flight=fl).start()
+        try:
+            # before registration the endpoints 404 by name
+            status, body = _get(srv.url + "/series")
+            assert status == 404 and b"no series store" in body
+            srv.add_slo(slo)            # auto-registers slo.series
+            slo.maybe_roll(0.0)
+            h.observe(0.01)
+            reg.counter("router_requests_total", outcome="ok").inc(3)
+            slo.maybe_roll(1.0)
+            status, body = _get(srv.url + "/series")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["stores"][0]["n_rolled"] == 1
+            status, body = _get(srv.url + "/slo")
+            assert status == 200 and json.loads(body)["ok"]
+            # drive the latency objective hot: /slo flips 503
+            for t in (2.0, 3.0, 4.0):
+                for _ in range(50):
+                    h.observe(5.0)
+                slo.maybe_roll(t)
+            assert slo.fast_burn_firing() == ["ttft-p99"]
+            status, body = _get(srv.url + "/slo")
+            doc = json.loads(body)
+            assert status == 503 and not doc["ok"]
+            assert doc["policies"][0]["firing"] == ["ttft-p99"]
+            # recovery: healthy windows clear the alert, 200 again
+            for t in (5.0, 6.0, 7.0):
+                for _ in range(50):
+                    h.observe(0.01)
+                slo.maybe_roll(t)
+            status, body = _get(srv.url + "/slo")
+            assert status == 200 and json.loads(body)["ok"]
+            # the store rides /trace as Perfetto counter tracks
+            status, body = _get(srv.url + "/trace")
+            assert status == 200
+            events = json.loads(body)["traceEvents"]
+            assert any(e.get("ph") == "C" for e in events)
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# the storm acceptance: fire during the storm, clear after recovery,
+# bit-identical replays, digest-neutral instrumentation
+# ---------------------------------------------------------------------------
+
+
+def _storm_replay():
+    reg = MetricsRegistry()
+    fl = FlightRecorder(capacity=4096)
+    series = SeriesStore(reg, window_s=1.0, max_windows=120)
+    slo = SloPolicy(series, [SloObjective(
+        "ttft-p99", "latency", 0.5, q=0.99,
+        fast_s=3.0, slow_s=9.0, fire_burn=2.0,
+    )], flight=fl)
+    inj = ChaosInjector(registry=reg, flight=fl, series=series,
+                        slo=slo)
+    rep = inj.run(get_scenario("storm_with_host_kill", seed=0))
+    return rep, series, slo, fl
+
+
+class TestStormAcceptance:
+    def test_storm_fires_clears_and_replays_bit_identically(self):
+        dark = ChaosInjector().run(
+            get_scenario("storm_with_host_kill", seed=0)
+        )
+        rep1, s1, p1, f1 = _storm_replay()
+        rep2, s2, p2, f2 = _storm_replay()
+
+        # digest-neutral instrumentation: the WINDOWED day's workload
+        # digest equals the dark run's (the ChaosReport digest itself
+        # folds the alert counts by design — a different witness)
+        assert rep1.workload.digest() == dark.workload.digest()
+        assert rep1.digest() == rep2.digest()
+        assert rep1.digest() != dark.digest()
+        assert rep1.extras["slo_alerts_fired"] == 1
+        assert rep1.extras["slo_alerts_cleared"] == 1
+        assert "alert_timeline" in rep1.invariants
+
+        # the storm window spans ~[0.35, 0.65] of the day: the alert
+        # fires inside it and clears only after the heal
+        span = rep1.workload.virtual_s
+        (fire, clear) = p1.timeline
+        assert fire["phase"] == "fire" and clear["phase"] == "clear"
+        assert 0.35 * span <= fire["t"] <= 0.70 * span
+        assert clear["t"] > 0.65 * span
+        assert p1.fast_burn_firing() == []
+
+        # bit-identical replays: timeline, ledger, flight instants
+        dump = lambda x: json.dumps(x, sort_keys=True)  # noqa: E731
+        assert dump(p1.timeline) == dump(p2.timeline)
+        assert dump(p1.ledger()) == dump(p2.ledger())
+        assert dump(f1.instants("slo alert")) == (
+            dump(f2.instants("slo alert"))
+        )
+        assert len(f1.instants("slo alert")) == 2
+
+        # the ledger actually attributed the day: busy chip-time and
+        # the storm's sheds are on the books, all non-negative
+        rows = p1.ledger()
+        assert rows and s1.n_rolled == len(rows)
+        busy = sum(
+            v["busy_s"] for r in rows for v in r["tenants"].values()
+        )
+        shed = sum(
+            v["shed"] for r in rows for v in r["tenants"].values()
+        )
+        assert busy > 0.0 and shed > 0
+        for r in rows:
+            for v in r["tenants"].values():
+                assert v["busy_s"] >= 0.0 and v["served"] >= 0
+                assert v["shed"] >= 0
+
+    def test_unrecovered_alert_violates_the_episode(self):
+        """An objective the day cannot clear (the short episode ends
+        inside the burn) is an InvariantViolation — the chaos plane's
+        alert-timeline contract."""
+        from mpistragglers_jl_tpu.chaos import InvariantViolation
+
+        reg = MetricsRegistry()
+        series = SeriesStore(reg, window_s=1.0, max_windows=120)
+        slo = SloPolicy(series, [SloObjective(
+            "ttft-p99", "latency", 0.5, q=0.99,
+            fast_s=3.0, slow_s=9.0, fire_burn=2.0,
+        )])
+        inj = ChaosInjector(registry=reg, series=series, slo=slo)
+        with pytest.raises(InvariantViolation, match="still firing"):
+            inj.run(get_scenario(
+                "storm_with_host_kill", seed=0, n=1800,
+            ))
+
+
+# ---------------------------------------------------------------------------
+# the controller consumer: burn-rate as a grow trigger
+# ---------------------------------------------------------------------------
+
+
+SLOTS, NI, TICK, PLEN, CHUNK, MNEW = 2, 4, 0.25, 64, 64, 16
+CAP = replica_capacity_rps(
+    slots=SLOTS, n_inner=NI, tick_s=TICK, prompt_len=PLEN,
+    prompt_chunk=CHUNK, max_new=MNEW,
+)
+
+
+def _controller_day(mode):
+    """mode: "slo" (policy bound), "none" (slo=None), "r18" (kwarg
+    absent — the round-18 construction)."""
+    clock = VirtualClock()
+    reps = [
+        SimReplica(clock, slots=SLOTS, n_inner=NI,
+                   prompt_chunk=CHUNK, tick_s=TICK)
+        for _ in range(4)
+    ]
+    reg = MetricsRegistry()
+    router = RequestRouter(reps, policy="least_loaded", clock=clock,
+                           registry=reg)
+    series = slo = None
+    if mode == "slo":
+        series = SeriesStore(reg, clock=clock, window_s=1.0,
+                             max_windows=600)
+        slo = SloPolicy(series, [SloObjective(
+            "ttft-p99", "latency", 0.1, q=0.9,
+            fast_s=5.0, slow_s=15.0, fire_burn=2.0,
+        )])
+    kw = {} if mode == "r18" else {"slo": slo}
+    ctl = FleetController(
+        router, clock=clock, capacity_rps=CAP, min_replicas=2,
+        max_replicas=4, high=0.85, low=0.3,
+        decision_interval_s=5.0, dwell_s=0.0, cooldown_s=0.0, **kw,
+    )
+    rep = run_router_day(
+        router,
+        poisson_arrivals(0.5 * 2 * CAP, n=1200, seed=11,
+                         prompt_len=PLEN, max_new=MNEW),
+        controller=ctl, series=series, slo=slo,
+    )
+    return rep, ctl, slo
+
+
+class TestControllerBurnGrow:
+    def test_burn_grow_recorded_and_replays_bit_identically(self):
+        """A fleet sitting comfortably under the util bands but
+        burning its TTFT budget: the bound policy's fast-burn alert is
+        a grow trigger, the decision record names the alert, and two
+        replays agree byte for byte."""
+        r1, c1, p1 = _controller_day("slo")
+        r2, c2, p2 = _controller_day("slo")
+        burns = [
+            d for d in c1.decisions if d.reason.startswith("slo_burn:")
+        ]
+        assert burns, [d.reason for d in c1.decisions]
+        assert burns[0].action == "grow"
+        assert burns[0].reason == "slo_burn:ttft-p99"
+        assert burns[0].size_after == burns[0].size_before + 1
+        assert p1.alert_counts()["fired"] >= 1
+        assert r1.digest() == r2.digest()
+        assert [d.to_dict() for d in c1.decisions] == (
+            [d.to_dict() for d in c2.decisions]
+        )
+
+    def test_policy_free_day_is_byte_for_byte_round18(self):
+        """slo=None keeps the decision procedure exactly the round-18
+        one: same digest, same decision records as a controller built
+        without the kwarg at all."""
+        r_none, c_none, _ = _controller_day("none")
+        r_r18, c_r18, _ = _controller_day("r18")
+        assert r_none.digest() == r_r18.digest()
+        assert [d.to_dict() for d in c_none.decisions] == (
+            [d.to_dict() for d in c_r18.decisions]
+        )
+        # and the burn-grown day genuinely diverges from it
+        r_slo, _, _ = _controller_day("slo")
+        assert r_slo.n_resizes > r_none.n_resizes

@@ -383,6 +383,28 @@ class TestFallbackParity:
         ok, reason = fastpath_supported(router)
         assert not ok and reason == "tracing attached"
 
+    def test_series_slo_attached_falls_back(self):
+        # round 24: a windowed day rolls the series store (and the
+        # burn policy) on the drive loop — the vectorized engine has
+        # no loop to hook, so the fallback is named
+        from mpistragglers_jl_tpu.obs import (
+            MetricsRegistry,
+            SeriesStore,
+            SloObjective,
+            SloPolicy,
+        )
+
+        _, _, router = _fleet()
+        reg = MetricsRegistry()
+        series = SeriesStore(reg, window_s=1.0)
+        ok, reason = fastpath_supported(router, series=series)
+        assert not ok and reason == "series/slo attached"
+        slo = SloPolicy(series, [SloObjective(
+            "ttft-p99", "latency", 0.5, q=0.99,
+        )])
+        ok, reason = fastpath_supported(router, slo=slo)
+        assert not ok and reason == "series/slo attached"
+
     def test_used_router_falls_back(self):
         _, _, router = _fleet()
         batch = poisson_arrival_batch(40.0, n=200, seed=1,
