@@ -623,6 +623,23 @@ def driver_contract(budget_s: float | None = None) -> dict:
         # digest divergence across two replays.
         out["chaos"] = _try_rung(rung_chaos, est=20, scale=False)
 
+        def rung_fleet_cache():
+            from benchmarks.fleet_cache_bench import (
+                bench_fleet_cache_rung,
+            )
+
+            return bench_fleet_cache_rung()
+
+        # round-25 fleet prefix-cache rung — unscaled like the other
+        # sim rungs: local-only prefix sharing vs the tiered fleet
+        # cache (host-DRAM store, then peer HBM) on identical
+        # prefix-heavy arrivals at equal device memory; FAILS when
+        # fleet_hit_x lands under the pinned 1.5x floor, on any drop,
+        # or on digest divergence across two cache-day replays.
+        out["fleet_cache"] = _try_rung(
+            rung_fleet_cache, est=15, scale=False
+        )
+
         def rung_simfast():
             from benchmarks.sim_fastpath_bench import (
                 bench_sim_fastpath_rung,
@@ -823,6 +840,10 @@ def _contract_line(out: dict) -> str:
             out.get("qos"), "qos_isolation_eps"),
         "qos_util_floor": _rung_summary(
             out.get("qos"), "qos_util_floor"),
+        "fleet_cache_hit_x": _rung_summary(
+            out.get("fleet_cache"), "fleet_hit_x"),
+        "fleet_cache_chip_s_saved": _rung_summary(
+            out.get("fleet_cache"), "prefill_chip_s_saved"),
         "chaos_shed_named_pct": _rung_summary(
             out.get("chaos"), "chaos_shed_named_pct"),
         "chaos_p99_recovery_x": _rung_summary(

@@ -1,0 +1,161 @@
+"""Round-25 fleet prefix-cache rung: fleet hits vs local-only sharing.
+
+One leg, sim-only (unscaled in bench.py — virtual-time bookkeeping
+does not track the matmul rate): a many-tenant prefix-heavy day over
+a 3-replica fleet — 70% of prompts reuse one of 24 shared prefix
+groups (system prompts / few-shot headers), routed ``least_loaded``
+so groups land on whichever replica is free — driven two ways on
+IDENTICAL seeded arrivals at equal total HBM (the tiered cache adds
+host DRAM and peer links, never device memory):
+
+* **local-only** (the r19 baseline): prefix pages are shared only
+  while some slot on the SAME replica still holds the group —
+  ``least_loaded`` scatters a group across the fleet, so most
+  admissions re-prefill a prefix another replica already computed;
+* **fleet cache**: the :class:`~mpistragglers_jl_tpu.sim.workload.
+  SimFleetCache` hub prices the tiered lookup — host-DRAM spill
+  store first, then a reachable peer's HBM — and an admission that
+  hits EITHER tier skips its shared prefill chunks, paying the
+  planner's byte-priced transfer seconds instead; run TWICE for the
+  bit-identity witness.
+
+Headline scalars (bench.py compact line, format in
+benchmarks/README.md round-25 note):
+
+* ``fleet_hit_x`` — (local shared admits + fleet tier hits) on the
+  cache day over local shared admits on the baseline day; FAILS
+  under the pinned 1.5x floor (measured ~13x on the reference day:
+  with 24 groups over 3 replicas, local residency is the rare case);
+* ``prefill_chip_s_saved`` — fleet hits x shared prefill chunks per
+  hit x ``chunk_s``: prefill chip-seconds the tiers returned to the
+  fleet, the currency the paper prices stragglers in.
+
+Both cache days (same seed) must agree on the workload digest — the
+sim plane's bit-identity witness; spill/fetch/fallback counters stay
+OUTSIDE the digest. Zero drops on every leg.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+_N_REP, _SLOTS, _NI, _TICK = 3, 4, 8, 0.02
+_CHUNK_S = 0.004  # priced prefill: one chunk of real chip work
+_PLEN, _CHUNK, _MNEW = 512, 64, 32
+_PFX_LEN, _PFX_SHARE, _GROUPS = 256, 0.7, 24
+_RATE = 30.0  # ~0.7 of fleet capacity at these service times
+_STORE_GROUPS = 64  # host-DRAM capacity: holds every group warm
+_HIT_X_FLOOR = 1.5
+
+
+def _day(n: int, seed: int, *, fleet: bool):
+    from mpistragglers_jl_tpu.models.router import RequestRouter
+    from mpistragglers_jl_tpu.sim import (
+        SimFleetCache,
+        SimReplica,
+        VirtualClock,
+        lognormal_ticks,
+        poisson_arrivals,
+        run_router_day,
+    )
+
+    clock = VirtualClock()
+    cache = SimFleetCache(store_groups=_STORE_GROUPS) if fleet else None
+    reps = [
+        SimReplica(clock, slots=_SLOTS, n_inner=_NI,
+                   prompt_chunk=_CHUNK, chunk_s=_CHUNK_S,
+                   cache=cache,
+                   tick_s=lognormal_ticks(_TICK, 0.1, seed=2017 + i))
+        for i in range(_N_REP)
+    ]
+    router = RequestRouter(reps, policy="least_loaded", clock=clock)
+    arrivals = poisson_arrivals(
+        _RATE, n=n, seed=seed, prompt_len=_PLEN, max_new=_MNEW,
+        prefix_share=_PFX_SHARE, prefix_len=_PFX_LEN,
+        n_prefix_groups=_GROUPS,
+        tenants={"a": 0.4, "b": 0.3, "c": 0.2, "d": 0.1},
+    )
+    report = run_router_day(router, arrivals)
+    shared = sum(r.n_shared_admits for r in reps)
+    hits = sum(r.n_fleet_hits for r in reps)
+    return report, shared, hits, cache
+
+
+def bench_fleet_cache_rung(requests: int | None = None):
+    """The driver rung ``fleet_cache``: local-only vs tiered fleet
+    cache on identical prefix-heavy arrivals, with the 1.5x hit-rate
+    gate, the chip-seconds-saved readout, and the bit-identity
+    witness over the cache day."""
+    import os
+
+    n = int(
+        requests if requests is not None
+        else os.environ.get("FLEET_CACHE_BENCH_REQUESTS", "3000")
+    )
+    seed = 29
+    t0 = time.perf_counter()
+    base, base_shared, base_hits, _ = _day(n, seed, fleet=False)
+    if base_hits:
+        raise AssertionError(
+            f"baseline day counted {base_hits} fleet hits with no "
+            "cache attached"
+        )
+    fc1, shared, hits, cache = _day(n, seed, fleet=True)
+    fc2, _, hits2, _ = _day(n, seed, fleet=True)
+    if fc1.digest() != fc2.digest():
+        raise AssertionError(
+            f"fleet-cache day not bit-identical: {fc1.digest()} != "
+            f"{fc2.digest()}"
+        )
+    if hits != hits2:
+        raise AssertionError(
+            f"fleet hit count drifted across replays: {hits} != {hits2}"
+        )
+    if base.dropped or fc1.dropped:
+        raise AssertionError(
+            f"dropped requests (base {base.dropped}, fleet "
+            f"{fc1.dropped}): the day must complete"
+        )
+    hit_x = (shared + hits) / max(base_shared, 1)
+    if hit_x < _HIT_X_FLOOR:
+        raise AssertionError(
+            f"fleet_hit_x {hit_x:.2f} under the pinned "
+            f"{_HIT_X_FLOOR}x floor: the tiers added nothing over "
+            "local residency"
+        )
+    cache.check()
+    chunks_per_hit = math.ceil(_PFX_LEN / _CHUNK)
+    saved_s = hits * chunks_per_hit * _CHUNK_S
+    st = cache.stats()
+    pb, pf = base.p99_ttft(), fc1.p99_ttft()
+    return {
+        "requests": int(fc1.n),
+        "fleet_hit_x": round(hit_x, 2),
+        "prefill_chip_s_saved": round(saved_s, 3),
+        "fleet_hits": int(hits),
+        "fleet_hits_by_src": {
+            k: int(v) for k, v in sorted(st["fetches"].items())
+        },
+        "local_shared_admits": int(shared),
+        "baseline_shared_admits": int(base_shared),
+        "spills": int(st["spills"]),
+        "evictions": int(st["evictions"]),
+        "fetch_fallbacks": int(st["fallbacks"]),
+        "spill_bytes": int(st["spill_bytes"]),
+        "fetch_bytes": int(st["fetch_bytes"]),
+        "p99_ttft_x": round(pb / pf, 2) if pf > 0 else None,
+        "p99_ttft_ms": {
+            "local_only": round(pb * 1e3, 1),
+            "fleet_cache": round(pf * 1e3, 1),
+        },
+        "virtual_day_s": round(fc1.virtual_s, 1),
+        "digest": fc1.digest(),
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(bench_fleet_cache_rung(), indent=2, default=str))

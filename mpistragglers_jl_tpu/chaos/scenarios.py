@@ -40,6 +40,12 @@ Catalog (``SCENARIOS``; each factory takes ``seed`` and a size knob):
 ``storm_with_host_kill`` the acceptance combo — retry storm + one
                          correlated host-group kill + a 30%-span
                          partition in ONE day, all invariants at once
+``partition_mid_fetch``  a prefix-heavy fleet sharing a SimFleetCache
+                         loses 3 of 8 replicas to a partition mid-day:
+                         peer fetches from the partitioned owners must
+                         FALL BACK to re-prefill (never deadlock, never
+                         drop), the DRAM tier keeps serving, and the
+                         day replays bit-identically
 =======================  =============================================
 
 Run scenarios through :class:`~.injector.ChaosInjector`, which
@@ -633,6 +639,96 @@ def storm_with_host_kill(seed: int = 0, n: int = 5000,
 
 
 #: name -> factory(seed=..., ...) — the episode suite tier-1 runs
+def partition_mid_fetch(seed: int = 0, n: int = 2400) -> ChaosScenario:
+    """A prefix-heavy day over a fleet sharing one
+    :class:`~..sim.workload.SimFleetCache`, with 3 of 8 replicas
+    partitioned for 30% of the span: fetches that would have hit the
+    partitioned owners' HBM must FALL BACK to re-prefilling (the
+    cache's fail-to-prefill contract — counted, named, never a
+    deadlock), the host-DRAM tier keeps serving because it is fleet
+    state rather than replica state, and zero requests drop. The
+    injector's replay harness holds the digest bit-identical, so the
+    fallback path is deterministic, not racy."""
+
+    def build(clock, *, registry=None, flight=None):
+        from ..models.router import RequestRouter
+        from ..sim.workload import (
+            ReplicaPartition,
+            SimFleetCache,
+            SimReplica,
+            lognormal_ticks,
+            poisson_arrivals,
+        )
+
+        # a deliberately small DRAM tier: most groups live only in
+        # some owner's HBM, so the partition actually interposes
+        # peer fetches (a huge store would absorb the episode)
+        cache = SimFleetCache(store_groups=2, registry=registry)
+        reps = [
+            SimReplica(
+                clock, slots=_SLOTS, n_inner=_NI, prompt_chunk=_CHUNK,
+                tick_s=lognormal_ticks(_TICK, _SIGMA,
+                                       seed=seed * 101 + i),
+                cache=cache,
+            )
+            for i in range(_N_REP)
+        ]
+        router = RequestRouter(
+            reps, policy="least_loaded", clock=clock,
+            registry=registry, flight=flight,
+        )
+        rate = 0.5 * _capacity_rps(_N_REP)
+        span = n / rate
+        arrivals = poisson_arrivals(
+            rate, n=n, seed=seed, prompt_len=_PLEN, max_new=_MNEW,
+            prefix_share=0.7, prefix_len=_CHUNK, n_prefix_groups=12,
+        )
+        events = [
+            ReplicaPartition(0.35 * span, (5, 6, 7), 0.65 * span)
+        ]
+
+        def post(report, router):
+            _check_partitions_reconciled(router)
+            if report.dropped:
+                raise InvariantViolation(
+                    f"{report.dropped} requests dropped across the "
+                    "partition: a failed fetch must re-prefill, "
+                    "never lose the request"
+                )
+            hits = sum(r.n_fleet_hits for r in reps)
+            if hits < 1:
+                raise InvariantViolation(
+                    "the fleet cache served nothing on a prefix-heavy "
+                    "day: the episode never exercised the fetch path"
+                )
+            if cache.n_fallbacks < 1:
+                raise InvariantViolation(
+                    "no fetch fell back across a 30%-span partition "
+                    "of 3 owners: the partition never interposed — "
+                    "the scenario is not testing what it claims"
+                )
+            if cache.stats()["unreachable"]:
+                raise InvariantViolation(
+                    "replicas still marked unreachable after heal: "
+                    "the router's heal hook never reached the cache"
+                )
+            cache.check()
+            return {
+                "partitions": router.n_partitions,
+                "fleet_hits": hits,
+                "fetch_fallbacks": cache.n_fallbacks,
+                "spills": cache.n_spills,
+                "rerouted": report.n_rerouted,
+            }
+
+        return {
+            "router": router, "arrivals": arrivals,
+            "events": events, "post": post,
+        }
+
+    return ChaosScenario("partition_mid_fetch", seed, build)
+
+
 SCENARIOS: dict[str, Callable[..., ChaosScenario]] = {
     "overload_shed": overload_shed,
     "retry_storm": retry_storm,
@@ -640,6 +736,7 @@ SCENARIOS: dict[str, Callable[..., ChaosScenario]] = {
     "correlated_host_kill": correlated_host_kill,
     "prefix_churn": prefix_churn,
     "storm_with_host_kill": storm_with_host_kill,
+    "partition_mid_fetch": partition_mid_fetch,
 }
 
 

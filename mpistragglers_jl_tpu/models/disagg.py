@@ -71,6 +71,8 @@ __all__ = [
     "DecodeReplica",
     "ticket_to_frames",
     "ticket_from_frames",
+    "page_to_frames",
+    "page_from_frames",
 ]
 
 
@@ -333,6 +335,30 @@ class MigrationRingReader:
 # --------------------------------------------------------------------------
 # frame (de)serialization
 # --------------------------------------------------------------------------
+
+
+def page_to_frames(ring: MigrationRing, payload) -> list:
+    """Stage ONE prefix page's KV bytes on the migration ring — the
+    cache plane's T3 (peer-fetch) wire unit. A page is a single flat
+    segment (the concatenated sorted-leaf row slices the serving
+    scheduler's ``_page_payload`` produces), so it rides the same
+    frames a ticket leaf does: slot frames while the ring has room,
+    copying frames under pin pressure. The caller owns the sender
+    pins until :func:`page_from_frames` (or ``release_frames``)."""
+    return ring.send_segment(payload)
+
+
+def page_from_frames(reader: MigrationRingReader, frames: list, *,
+                     ring: MigrationRing | None = None) -> np.ndarray:
+    """Read one page back off its frames as a flat uint8 array, then
+    (when ``ring`` is given — the in-process adoption shape) drop the
+    sender pins; consumer-view pins keep the bytes alive until the
+    returned array dies, so the destination can device-scatter from
+    it without a defensive copy."""
+    out = reader.read_segment(frames)
+    if ring is not None:
+        ring.release_frames(frames)
+    return out
 
 
 def ticket_to_frames(ticket: MigrationTicket,

@@ -129,6 +129,14 @@ class PagePool:
         # serving_prefix_share_hits_total / serving_cow_copies_total
         self.share_hits = 0
         self.cow_copies = 0
+        # fleet-cache hooks (cache/ package, opt-in): called with the
+        # digest whenever a prefix page enters or leaves the share
+        # table, so a fleet-level directory can mirror THIS pool's
+        # registrations without polling. None = dark (no per-call cost
+        # beyond the `is not None` check); the pool itself never knows
+        # what is on the other end.
+        self.register_hook = None
+        self.unregister_hook = None
 
     # -- capacity -------------------------------------------------------
 
@@ -197,6 +205,8 @@ class PagePool:
         d = self._page_digest.pop(pid, None)
         if d is not None:
             self._digest_to_page.pop(d, None)
+            if self.unregister_hook is not None:
+                self.unregister_hook(d)
         self._free.append(pid)
         return True
 
@@ -229,6 +239,13 @@ class PagePool:
         (:meth:`note_write`, COW retarget, free), so a registered
         sole-held page is safe to keep resident for future sharers."""
         return pid in self._page_digest
+
+    def digest_of(self, pid: int) -> bytes | None:
+        """The prefix digest ``pid`` is registered under, or None. The
+        spill path reads this BEFORE the freeing decref — a registered
+        page's bytes still match its digest, which is what makes the
+        page's content portable to the host-DRAM tier."""
+        return self._page_digest.get(pid)
 
     def is_volatile(self, pid: int) -> bool:
         """Will a CURRENT holder eventually overwrite this page (some
@@ -307,6 +324,8 @@ class PagePool:
         self._page_digest[pid] = digest
         if volatile:
             self._wrappers[pid] = self._wrappers.get(pid, 0) + 1
+        if self.register_hook is not None:
+            self.register_hook(digest, pid)
 
     def note_write(self, pid: int) -> None:
         """A sole owner is about to overwrite ``pid`` (ring wrap): its
@@ -316,6 +335,8 @@ class PagePool:
         d = self._page_digest.pop(pid, None)
         if d is not None:
             self._digest_to_page.pop(d, None)
+            if self.unregister_hook is not None:
+                self.unregister_hook(d)
 
     # -- invariants (tests + postmortems) -------------------------------
 

@@ -798,6 +798,12 @@ class RequestRouter:
         now = self._now()
         self._partitioned.add(i)
         self.n_partitions += 1
+        # fleet prefix cache (cache/ package): a partitioned replica
+        # can neither serve nor issue peer-page fetches — the hub
+        # fails those fetches to re-prefill until heal()
+        _c = getattr(self.replicas[i], "cache", None)
+        if _c is not None:
+            _c.partition(self.replicas[i].cache_name)
         moved = 0
         if self._up[i]:
             self._up[i] = False
@@ -837,6 +843,9 @@ class RequestRouter:
                 pass
         self.n_stale_cancelled += cancelled
         self.n_partitions_healed += 1
+        _c = getattr(replica, "cache", None)
+        if _c is not None:
+            _c.heal(replica.cache_name)
         up = i not in self._down_manual and self._probe(replica)
         if up and not self._up[i]:
             self._up[i] = True

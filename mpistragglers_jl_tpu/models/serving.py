@@ -849,11 +849,18 @@ class _ServingObs:
                 "serving_cache_pages_used",
                 help="KV cache pages allocated to slots",
             )
+            # tier-labeled (cache/ package): hbm = local share, the
+            # only tier a fleet-less scheduler ever increments;
+            # dram/peer appear lazily via fleet_hit when a fleet
+            # cache serves the page instead
             self.m_share = registry.counter(
                 "serving_prefix_share_hits_total",
-                help="prompt prefix pages shared at admission (each "
-                "skipped that page's prefill and residency)",
+                help="prompt prefix pages whose prefill was skipped "
+                "at admission, by serving tier (hbm = local share, "
+                "dram = host page store, peer = replica fetch)",
+                tier="hbm",
             )
+            self._share_tier: dict[str, Any] = {"hbm": self.m_share}
             self.m_cow = registry.counter(
                 "serving_cow_copies_total",
                 help="copy-on-write page copies (a slot wrote a page "
@@ -933,6 +940,19 @@ class _ServingObs:
     def prefill_chunk(self) -> None:
         if self._r:
             self.m_prefill.inc()
+
+    def fleet_hit(self, tier: str) -> None:
+        """One prefix page served from the fleet cache (``dram`` |
+        ``peer``) instead of prefilled — the same family as the local
+        share counter, so tier shares read off one query."""
+        if not self._r:
+            return
+        c = self._share_tier.get(tier)
+        if c is None:
+            c = self._share_tier[tier] = self.registry.counter(
+                "serving_prefix_share_hits_total", tier=tier,
+            )
+        c.inc()
 
     def tick_done(
         self, sched: "ServingScheduler", retired, t0: float,
@@ -1161,7 +1181,8 @@ class ServingScheduler:
                  cache_pages: int | None = None,
                  qos: TenantRegistry | None = None,
                  max_queue: int | None = None, registry=None,
-                 spans=None, flight=None, exporter=None, trace=None):
+                 spans=None, flight=None, exporter=None, trace=None,
+                 cache=None):
         W = _check_ring_cfg(cfg)
         _check_sampling_params(temperature, top_k)
         if cfg.n_experts:
@@ -1332,6 +1353,21 @@ class ServingScheduler:
         self._trace = None
         if trace is not None:
             self.attach_trace(trace)
+        # fleet prefix cache (cache/ package, opt-in): admission
+        # probes the fleet namespace for page-aligned prefixes it
+        # cannot share locally, fetching from host DRAM or a peer
+        # replica instead of prefilling; reclaimed cold pages spill
+        # the other way. Requires the paged arena — the fleet unit is
+        # the page.
+        self.cache = cache
+        self.cache_name: str | None = None
+        if cache is not None:
+            if not self.paged:
+                raise ValueError(
+                    "cache= needs the paged arena: pass page_tokens "
+                    "(the fleet cache's unit is the prefix page)"
+                )
+            self.cache_name = cache.attach(self)
         if exporter is not None:
             # register the tick-freshness health check (+ the span
             # recorder as a /trace source) on the ObsServer
@@ -1620,6 +1656,66 @@ class ServingScheduler:
             for a in cl.values():
                 total += a.nbytes * self.P // a.shape[0]
         return total
+
+    def _page_payload(self, pid: int) -> np.ndarray:
+        """One page's KV bytes as a flat uint8 array: per layer (list
+        order), per leaf (SORTED key order — the frame-serialization
+        convention of disagg.py), the page's row slice. This layout IS
+        the fleet cache's wire/storage format: two schedulers with the
+        same config produce byte-identical payloads for the same
+        digest, which is what the spill/fetch parity tests pin."""
+        P = self.P
+        parts = []
+        for cl in self._caches:
+            for kk in sorted(cl):
+                a = np.asarray(cl[kk][pid * P:(pid + 1) * P])
+                parts.append(
+                    np.ascontiguousarray(a).reshape(-1).view(np.uint8)
+                )
+        return np.concatenate(parts)
+
+    def _install_page_payload(self, pid: int, payload) -> None:
+        """Scatter a :meth:`_page_payload`-format byte string into
+        page ``pid`` of this arena (the fetch landing). The split
+        walks the same layer/sorted-leaf order; a size mismatch is a
+        geometry bug refused by name (the cache hub validates
+        page-byte equality at attach, so this only fires on config
+        drift between attach and fetch)."""
+        P = self.P
+        buf = np.asarray(payload).reshape(-1).view(np.uint8)
+        if buf.size != self._page_row_bytes():
+            raise ValueError(
+                f"page payload is {buf.size} bytes, this arena's "
+                f"pages are {self._page_row_bytes()}"
+            )
+        off = 0
+        for cl in self._caches:
+            for kk in sorted(cl):
+                a = cl[kk]
+                row_shape = (P,) + a.shape[1:]
+                nb = a.dtype.itemsize * int(np.prod(row_shape))
+                vals = np.frombuffer(
+                    buf[off:off + nb].tobytes(), dtype=a.dtype
+                ).reshape(row_shape)
+                cl[kk] = a.at[pid * P:(pid + 1) * P].set(
+                    jnp.asarray(vals)
+                )
+                off += nb
+
+    def _spill_page(self, pid: int, *,
+                    tenant: str | None = None) -> None:
+        """Offer a still-registered, sole-held page to the fleet
+        cache's DRAM tier before it is freed/evicted. Reads the bytes
+        BEFORE the freeing decref — a registered page's content still
+        matches its digest (note_write/COW drop registration first).
+        No-ops when the fleet already holds the digest somewhere else
+        (re-spilling wastes the eviction bandwidth)."""
+        d = self.pool.digest_of(pid)
+        if d is None:
+            return
+        if not self.cache.wants(d, exclude=self.cache_name):
+            return
+        self.cache.spill(d, self._page_payload(pid), tenant=tenant)
 
     def migration_nbytes(self, req: Request) -> int:
         """Payload bytes a migration of ``req`` would move —
@@ -2008,22 +2104,33 @@ class ServingScheduler:
         every decode write including the bounded overshoot of the
         retirement tick — so :class:`PagePoolExhausted` is unreachable
         mid-decode (the capacity contract the fuzz tests pin)."""
-        shared, digests, n_pages, wraps, n_fresh, reserve = \
+        shared, digests, n_pages, wraps, n_fresh, reserve, fetch = \
             self._page_needs(req)
         if not self.pool.can_alloc(n_fresh, reserve=reserve):
             return None
-        return (shared, digests, n_pages, wraps)
+        return (shared, digests, n_pages, wraps, fetch)
 
     def _page_needs(self, req: Request):
         """The share walk + budget arithmetic both planners share:
-        (shared, digests, n_pages, wraps, n_fresh, reserve), computed
-        WITHOUT consulting pool capacity — :meth:`_plan_pages` checks
-        ``can_alloc`` and :meth:`_plan_pages_qos` turns the same
-        numbers into a reclaim shortfall instead."""
+        (shared, digests, n_pages, wraps, n_fresh, reserve, fetch),
+        computed WITHOUT consulting pool capacity —
+        :meth:`_plan_pages` checks ``can_alloc`` and
+        :meth:`_plan_pages_qos` turns the same numbers into a reclaim
+        shortfall instead. ``fetch`` is the fleet-cache extension:
+        where the LOCAL share walk breaks, the walk continues against
+        the fleet directory (host-DRAM store / peer replicas), and
+        every contiguously-probeable digest becomes a planned fetch —
+        a fresh allocation whose prefill is replaced by a page copy.
+        Budget-wise fetched pages ARE fresh pages (they are inside
+        ``n_fresh``), so the capacity/quota arithmetic is unchanged;
+        only the prefill skip differs, and a fetch that fails at
+        commit time degrades to exactly the prefill the plan budgeted
+        for."""
         Tp = req.prompt.size
         W, P = self.W, self.P
         digests: list[bytes] = []
         shared: list[int] = []
+        fetch: list[bytes] = []
         if Tp <= W:
             # within-window prompts: ring slot s == position s, so the
             # page content is determined by the page-aligned prefix —
@@ -2032,11 +2139,18 @@ class ServingScheduler:
             digests = prefix_page_digests(req.prompt, P, self.max_pages)
             # cap: at least the prompt's last token must prefill (the
             # first sampled token needs its logits)
-            for d in digests[: (Tp - 1) // P]:
+            shareable = digests[: (Tp - 1) // P]
+            for d in shareable:
                 pid = self.pool.lookup(d)
                 if pid is None:
                     break
                 shared.append(pid)
+            if self.cache is not None:
+                for d in shareable[len(shared):]:
+                    if self.cache.probe(
+                            d, exclude=self.cache_name) is None:
+                        break
+                    fetch.append(d)
         m = len(shared)
         horizon = Tp + req.max_new + self.n_inner
         wraps = horizon > W
@@ -2045,13 +2159,21 @@ class ServingScheduler:
             1 for pid in shared
             if self.pool.share_needs_reserve(pid, wraps)
         )
-        return shared, digests, n_pages, wraps, n_pages - m, reserve
+        return (shared, digests, n_pages, wraps, n_pages - m, reserve,
+                fetch)
 
     def _commit_pages(self, req: Request, plan) -> tuple[int, dict]:
         """Execute an admission plan: take references on the shared
-        pages (attaching their COW reservations) and allocate the
-        fresh tail. Returns (base, _Admitting kwargs)."""
-        shared, digests, n_pages, wraps = plan
+        pages (attaching their COW reservations), FETCH the planned
+        fleet-cache pages (host DRAM or a peer replica — each fetched
+        page is a fresh allocation filled with the transferred bytes
+        and registered, extending the prefill skip past the local
+        share run), and allocate the fresh tail. A fetch that comes
+        back empty (eviction, partition, kill raced the plan) stops
+        the fetch run and the remaining pages prefill as budgeted —
+        the cache saves work or does nothing, never corrupts.
+        Returns (base, _Admitting kwargs)."""
+        shared, digests, n_pages, wraps, fetch = plan
         m = len(shared)
         pids = [NULL_PAGE] * self.max_pages
         for j, pid in enumerate(shared):
@@ -2069,6 +2191,28 @@ class ServingScheduler:
                 # a cold page found its next sharer: the cache's hold
                 # transfers to the new slot (warm)
                 self._warm_cold(pid)
+        n_fetched = 0
+        for d in fetch:
+            got = self.cache.fetch(d, exclude=self.cache_name)
+            if got is None:
+                break  # fall back to prefill for the rest of the run
+            src, payload = got
+            pid = self.pool.alloc()
+            self._install_page_payload(pid, payload)
+            # first-wins: if a concurrent admission registered the
+            # digest since planning, this is a no-op and the page is
+            # simply this slot's private copy — still correct bytes
+            self.pool.register(d, pid, volatile=wraps)
+            pids[m + n_fetched] = pid
+            n_fetched += 1
+            if self._obs is not None:
+                self._obs.fleet_hit(src)
+            if self._trace is not None and req.trace is not None:
+                self._trace.event(
+                    req.trace, "share_hit", time.perf_counter(),
+                    page=int(pid), tier=src,
+                )
+        m += n_fetched
         for j in range(m, n_pages):
             pids[j] = self.pool.alloc()
         if self._drr is not None and req.tenant is not None:
@@ -2165,6 +2309,11 @@ class ServingScheduler:
                         break
         if victim is None:
             return False
+        if self.cache is not None:
+            # the evicted cold page's last HBM incarnation dies here:
+            # spill its bytes to the DRAM tier (tenant-attributed, so
+            # spill_pages quotas bind) before the freeing decref
+            self._spill_page(victim, tenant=self._cold.get(victim))
         t = self._drop_cold(victim)
         if self._flight is not None:
             self._flight.event(
@@ -2181,7 +2330,7 @@ class ServingScheduler:
         request still cannot be planned — the DRR pass then defers
         this tenant, not the rotation."""
         contract = self._qos.get(req.tenant)
-        shared, digests, n_pages, wraps, n_fresh, reserve = \
+        shared, digests, n_pages, wraps, n_fresh, reserve, fetch = \
             self._page_needs(req)
         # the plan's own shares are never reclaim victims: evicting
         # one to make room would trade a prefill skip for a fresh
@@ -2214,7 +2363,7 @@ class ServingScheduler:
                                              tenant=req.tenant):
                     return None
                 need -= 1
-        return (shared, digests, n_pages, wraps)
+        return (shared, digests, n_pages, wraps, fetch)
 
     def _prepare_tick_pages(self, decoding: list[int]) -> None:
         """Pre-tick COW pass: the next ``n_inner`` decode steps write
@@ -2413,6 +2562,18 @@ class ServingScheduler:
                         self._cold_count.get(tenant, 0) + 1
                     )
                 else:
+                    # fleet spill: a sole-held registered page is
+                    # about to free (and leave the share table) —
+                    # offer its bytes to the DRAM tier first, so a
+                    # sibling's future admission fetches instead of
+                    # re-prefilling. Cold retention above takes
+                    # precedence (HBM residency beats DRAM); eviction
+                    # of the cold set spills on its own path.
+                    if (self.cache is not None
+                            and not self._slot_wraps[s]
+                            and self.pool.refcount(pid) == 1
+                            and self.pool.registered(pid)):
+                        self._spill_page(pid, tenant=tenant)
                     self.pool.decref(pid,
                                      wrapper=self._slot_wraps[s])
             self._tenant_debit(tenant, n_refs)

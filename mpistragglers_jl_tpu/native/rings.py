@@ -39,6 +39,8 @@ __all__ = [
     "next_pow2",
     "RingAlloc",
     "MemfdRegion",
+    "HeapRegion",
+    "region_create",
     "track_release",
     "as_u8",
 ]
@@ -160,6 +162,36 @@ class MemfdRegion:
         if self.fd >= 0:
             _os.close(self.fd)
             self.fd = -1
+
+
+class HeapRegion:
+    """The copy-fallback twin of :class:`MemfdRegion`: one anonymous
+    heap-backed buffer with the same ``nbytes``/``view``/``close``
+    surface but no ``fd`` — nothing can cross a process boundary
+    zero-copy, which is exactly the degradation the callers already
+    handle (``MemfdRegion.create`` returning None routes here instead
+    of forcing every consumer to grow a second code path). In-process
+    consumers still get zero-copy ``view`` slices; cross-process ones
+    see ``fd is None`` and fall back to copying frames."""
+
+    __slots__ = ("nbytes", "view")
+
+    fd = None
+
+    def __init__(self, nbytes: int):
+        self.nbytes = int(nbytes)
+        self.view = np.zeros(self.nbytes, np.uint8)
+
+    def close(self) -> None:
+        self.view = None
+
+
+def region_create(nbytes: int, name: str = "msgt-ring"):
+    """A shared-memory region where the platform has ``memfd_create``,
+    the heap twin everywhere else — the one-call spelling of the
+    fallback dance every ring consumer performs."""
+    region = MemfdRegion.create(nbytes, name)
+    return HeapRegion(nbytes) if region is None else region
 
 
 def track_release(view: np.ndarray, callback, *args) -> None:

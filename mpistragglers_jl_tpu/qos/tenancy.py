@@ -67,19 +67,26 @@ class TenantContract:
     ``rate`` is a token-rate budget in tokens per clock second
     (``None`` = unlimited); ``burst`` is the bucket depth in tokens
     (default: one second of ``rate``). ``pages`` is the KV page-pool
-    quota (``None`` = unlimited). ``hedges`` caps OUTSTANDING
-    TTFT-hedge legs (``None`` = unlimited, ``0`` = never hedge).
-    ``ttft_slo`` is the advertised first-token deadline the sweeps
-    validate latency-class contracts against — a latency tenant
-    without one is refused by ``sweep_tenant_weights``, never guessed.
+    quota (``None`` = unlimited). ``spill_pages`` extends the page
+    quota to the host-DRAM spill tier (cache/ package): how many of
+    the tenant's evicted cold pages the fleet page store may keep
+    resident at once (``None`` = unlimited — the store's own capacity
+    still bounds it; enforced the same way as cold-page reclaim: the
+    tenant's OWN oldest spilled page is evicted first). ``hedges``
+    caps OUTSTANDING TTFT-hedge legs (``None`` = unlimited, ``0`` =
+    never hedge). ``ttft_slo`` is the advertised first-token deadline
+    the sweeps validate latency-class contracts against — a latency
+    tenant without one is refused by ``sweep_tenant_weights``, never
+    guessed.
     """
 
     __slots__ = ("name", "cls", "weight", "rate", "burst", "pages",
-                 "hedges", "ttft_slo")
+                 "spill_pages", "hedges", "ttft_slo")
 
     def __init__(self, name: str, *, cls: str = "throughput",
                  weight: float = 1.0, rate: float | None = None,
                  burst: float | None = None, pages: int | None = None,
+                 spill_pages: int | None = None,
                  hedges: int | None = None,
                  ttft_slo: float | None = None):
         if not name or not isinstance(name, str):
@@ -114,6 +121,12 @@ class TenantContract:
                 f"tenant {name!r} page quota must be >= 1 or None "
                 f"(unlimited), got {pages}"
             )
+        if spill_pages is not None and spill_pages < 0:
+            raise ValueError(
+                f"tenant {name!r} spill-page quota must be >= 0 or "
+                f"None (unlimited; 0 = never spill for this tenant), "
+                f"got {spill_pages}"
+            )
         if hedges is not None and hedges < 0:
             raise ValueError(
                 f"tenant {name!r} hedge entitlement must be >= 0 or "
@@ -132,6 +145,9 @@ class TenantContract:
             else (None if burst is None else float(burst))
         )
         self.pages = None if pages is None else int(pages)
+        self.spill_pages = (
+            None if spill_pages is None else int(spill_pages)
+        )
         self.hedges = None if hedges is None else int(hedges)
         self.ttft_slo = None if ttft_slo is None else float(ttft_slo)
 

@@ -57,6 +57,7 @@ from .tune import (
     sweep_hierarchical,
     sweep_nwait,
     sweep_router_policy,
+    sweep_spill_capacity,
     sweep_tenant_weights,
     sweep_tier_split,
 )
@@ -66,6 +67,7 @@ from .workload import (
     FleetResize,
     ReplicaPartition,
     RetryPolicy,
+    SimFleetCache,
     SimPrompt,
     SimReplica,
     SimRequest,
@@ -102,6 +104,7 @@ __all__ = [
     "sweep_hedge",
     "sweep_hierarchical",
     "sweep_router_policy",
+    "sweep_spill_capacity",
     "sweep_tenant_weights",
     "sweep_tier_split",
     "recommend_nwait",
@@ -111,6 +114,7 @@ __all__ = [
     "FleetResize",
     "ReplicaPartition",
     "RetryPolicy",
+    "SimFleetCache",
     "SimPrompt",
     "SimRequest",
     "SimReplica",

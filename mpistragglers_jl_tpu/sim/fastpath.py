@@ -383,6 +383,8 @@ def fastpath_supported(router, *, controller=None, events=(),
             return False, "non-SimReplica replica"
         if r.chunk_s != 0.0:
             return False, "chunk_s prefill pricing (two-tier timing)"
+        if getattr(r, "cache", None) is not None:
+            return False, "fleet cache attached (spill/fetch pricing)"
         if (r.tick_count or r.next_tick_at is not None or r.pending
                 or r.active or r._resident or r.busy_s):
             return False, "replica already carries state"

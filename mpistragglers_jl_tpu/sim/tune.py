@@ -43,6 +43,7 @@ __all__ = [
     "sweep_harvest_k",
     "sweep_hierarchical",
     "sweep_router_policy",
+    "sweep_spill_capacity",
     "sweep_tenant_weights",
     "sweep_tier_split",
     "recommend_nwait",
@@ -1115,6 +1116,199 @@ def sweep_tier_split(
         ),
         "load": load,
         "long_share": ls,
+        "requests": int(requests),
+    }
+
+
+def sweep_spill_capacity(
+    *,
+    store_groups_candidates: Sequence[int],
+    replicas: int = 3,
+    slots: int = 4,
+    n_inner: int = 8,
+    tick_s: float = 0.02,
+    tick_sigma: float = 0.0,
+    chunk_s: float = 0.004,
+    load: float = 0.8,
+    requests: int = 2000,
+    prompt_len: int = 512,
+    max_new: int = 32,
+    prefix_share: float = 0.7,
+    prefix_len: int = 256,
+    n_prefix_groups: int = 16,
+    prompt_chunk: int = 64,
+    kv_bytes_per_token: float = 4096.0,
+    spill_gbs: float = 8.0,
+    fetch_gbs: float = 8.0,
+    seed: int = 0,
+    fast: str = "auto",
+) -> dict[str, Any]:
+    """Price the host-DRAM spill tier's capacity
+    (:class:`~.workload.SimFleetCache` ``store_groups``) by running
+    the real router over fleets sharing one fleet cache per candidate,
+    one seeded prefix-heavy Poisson stream for ALL candidates (same
+    seed: identical arrivals, so the ONLY variable is how many prefix
+    groups the DRAM tier can hold).
+
+    The trade being swept: a fleet fetch skips a request's shared
+    prefill chunks but charges the planner-priced transfer seconds to
+    the admitting tick (``spill_gbs``/``fetch_gbs`` — the PERF byte
+    model), while a capacity-0 tier falls back to peer-HBM hits only
+    and a too-small tier churns (``evictions`` in the entry says so).
+    The headline per candidate is **p99 TTFT** with the prefill
+    chip-seconds saved (``chunks_saved * chunk_s``) as the efficiency
+    axis.
+
+    Refusals, never clamps (the ``sweep_nwait`` contract):
+
+    * **empty candidate list** — nothing to sweep;
+    * **negative capacity** — ``store_groups`` is a page-count floor
+      at 0 (0 = peer-only fleet, a legal baseline candidate);
+    * **shareless stream** (``prefix_share <= 0`` or
+      ``prefix_len < 1``) — without shared prefixes every fetch path
+      is dead and the sweep would recommend noise;
+    * **offered load >= 1** — open-loop saturation.
+
+    Returns entries per candidate (TTFT percentiles, fleet hits by
+    tier, spills/evictions/fallbacks, bytes moved, chip seconds
+    saved), ``best`` — the capacity with the lowest p99 TTFT — and
+    ``p99_ttft_vs_no_dram`` against the 0-capacity baseline when one
+    was swept. ``fast=`` is accepted for knob uniformity; fleet-cache
+    days price tick stretches the vectorized engine does not model, so
+    ``run_router_day_fast`` falls back to the scalar loop by shape."""
+    from ..cache import SpillFetchPlanner
+    from ..models.router import RequestRouter
+    from .workload import (
+        SimFleetCache,
+        SimReplica,
+        lognormal_ticks,
+        poisson_arrivals,
+        run_router_day,
+    )
+
+    cands = [int(g) for g in store_groups_candidates]
+    if not cands:
+        raise ValueError(
+            "empty sweep: no store_groups candidates given"
+        )
+    for g in cands:
+        if g < 0:
+            raise ValueError(
+                f"sweep refused: store_groups {g} is negative — the "
+                "DRAM tier holds 0 or more groups (0 = peer-only "
+                "baseline)"
+            )
+    if not (0.0 < float(prefix_share) <= 1.0) or int(prefix_len) < 1:
+        raise ValueError(
+            f"sweep refused: prefix_share {prefix_share} / prefix_len "
+            f"{prefix_len} leaves nothing shareable — a spill-capacity "
+            "sweep over a shareless stream prices a dead code path"
+        )
+    load = float(load)
+    if not (0.0 < load < 1.0):
+        raise ValueError(
+            f"sweep refused: offered load {load:.2f} must sit in "
+            "(0, 1) — at or beyond 1 the open-loop queue grows "
+            "without bound and no cache capacity can hold TTFT"
+        )
+    if int(replicas) < 2:
+        raise ValueError(
+            "sweep refused: a fleet cache needs >= 2 replicas — with "
+            "one there is no peer tier and DRAM only re-serves the "
+            "spiller itself"
+        )
+    # offered rate: load x fleet tick capacity under expected
+    # per-request work WITHOUT sharing (the pessimistic floor — cache
+    # hits only relieve it, so every candidate faces feasible load)
+    e_chunks = -(-int(prompt_len) // int(prompt_chunk))
+    e_ticks = e_chunks + -(-max(int(max_new) - 1, 0) // int(n_inner))
+    rate = load * int(replicas) * int(slots) / (
+        e_ticks * (float(tick_s) + float(chunk_s))
+    )
+    use_fast = _resolve_fast(fast)
+    if use_fast:
+        from .fastpath import poisson_arrival_batch, run_router_day_fast
+
+        batch = poisson_arrival_batch(
+            rate, n=requests, seed=seed, prompt_len=prompt_len,
+            max_new=max_new, prefix_share=prefix_share,
+            prefix_len=prefix_len, n_prefix_groups=n_prefix_groups,
+        )
+    chunks_per_hit = -(-int(prefix_len) // int(prompt_chunk))
+    entries: list[dict] = []
+    for g in cands:
+        clock = VirtualClock()
+        cache = SimFleetCache(
+            store_groups=g,
+            kv_bytes_per_token=kv_bytes_per_token,
+            planner=SpillFetchPlanner(
+                spill_gbs=spill_gbs, fetch_gbs=fetch_gbs,
+            ),
+        )
+        fleet = [
+            SimReplica(
+                clock, slots=slots, n_inner=n_inner,
+                prompt_chunk=prompt_chunk, chunk_s=chunk_s,
+                kv_bytes_per_token=kv_bytes_per_token,
+                tick_s=lognormal_ticks(
+                    float(tick_s), float(tick_sigma),
+                    seed=int(seed) * 1013 + i,
+                ),
+                cache=cache,
+            )
+            for i in range(int(replicas))
+        ]
+        router = RequestRouter(
+            fleet, policy="least_loaded", clock=clock,
+        )
+        if use_fast:
+            report = run_router_day_fast(router, batch)
+        else:
+            report = run_router_day(
+                router,
+                poisson_arrivals(
+                    rate, n=requests, seed=seed,
+                    prompt_len=prompt_len, max_new=max_new,
+                    prefix_share=prefix_share, prefix_len=prefix_len,
+                    n_prefix_groups=n_prefix_groups,
+                ),
+            )
+        hits = sum(r.n_fleet_hits for r in fleet)
+        st = cache.stats()
+        entries.append({
+            "store_groups": g,
+            "p50_ttft_s": report.p50_ttft(),
+            "p99_ttft_s": report.p99_ttft(),
+            "fleet_hits": hits,
+            "fetches": st["fetches"],
+            "fallbacks": st["fallbacks"],
+            "spills": st["spills"],
+            "evictions": st["evictions"],
+            "spill_bytes": st["spill_bytes"],
+            "fetch_bytes": st["fetch_bytes"],
+            "local_shared_admits": sum(
+                r.n_shared_admits for r in fleet
+            ),
+            "prefill_chip_s_saved": (
+                hits * chunks_per_hit * float(chunk_s)
+            ),
+            "completed": report.n - report.dropped,
+            "dropped": report.dropped,
+            "rate_req_s": rate,
+        })
+    best = min(entries, key=lambda e: e["p99_ttft_s"])
+    base = next(
+        (e for e in entries if e["store_groups"] == 0), None
+    )
+    return {
+        "entries": entries,
+        "best": best["store_groups"],
+        "best_entry": best,
+        "p99_ttft_vs_no_dram": (
+            base["p99_ttft_s"] / best["p99_ttft_s"]
+            if base is not None and best["p99_ttft_s"] > 0 else None
+        ),
+        "load": load,
         "requests": int(requests),
     }
 

@@ -55,6 +55,7 @@ __all__ = [
     "Arrival",
     "ReplicaPartition",
     "RetryPolicy",
+    "SimFleetCache",
     "SimPrompt",
     "SimRequest",
     "SimReplica",
@@ -671,6 +672,247 @@ class SimTicket:
         self.trace = None  # trace id riding inside the ticket
 
 
+class SimFleetCache:
+    """The sim twin of :class:`~..cache.FleetPrefixCache`: a
+    fleet-level prefix-group namespace with the same three tiers and
+    the same byte-priced movement model, on virtual time.
+
+    Replicas :meth:`register` and then report residency transitions:
+    0→1 holders of a prefix group publishes it as tier-``hbm`` here
+    (:meth:`publish_hbm`); the LAST holder leaving withdraws it and —
+    when no sibling still advertises the group — spills it into a
+    bounded host-DRAM FIFO of ``store_groups`` groups
+    (:meth:`residency_lost`, which returns the planner-priced spill
+    seconds the replica charges to its tick). An admission whose
+    prefix group is not locally resident asks :meth:`fetch`: DRAM
+    first, then a reachable peer's HBM — a hit skips the shared
+    prefill chunks at a priced transfer cost instead of for free,
+    which is exactly the live scheduler's fetch-instead-of-prefill
+    trade and what ``sweep_spill_capacity`` sweeps.
+
+    Failure model mirrors the live hub: :meth:`partition` makes a
+    replica unreachable (its HBM advertisements invisible, its own
+    fetches fail → fall back to prefill) until :meth:`heal`;
+    :meth:`drop_replica` (kill) purges its HBM entries while DRAM
+    spills SURVIVE. Everything is insertion-ordered dicts and pure
+    arithmetic — no OS clock, no unordered iteration — so a day
+    replays bit-identically (GC008), and every counter lives OUTSIDE
+    :meth:`WorkloadReport.digest`.
+
+    ``registry=`` (opt-in, GC004) publishes the same counter names as
+    the live plane: ``cache_spill_bytes_total``,
+    ``cache_fetch_bytes_total{src=}``, ``cache_directory_size``.
+    """
+
+    def __init__(self, *, store_groups: int = 64,
+                 kv_bytes_per_token: float = 4096.0,
+                 planner=None, registry=None):
+        if store_groups < 0:
+            raise ValueError(
+                f"store_groups must be >= 0 (0 disables the DRAM "
+                f"tier), got {store_groups}"
+            )
+        if kv_bytes_per_token < 0.0:
+            raise ValueError("kv_bytes_per_token must be >= 0")
+        # lazy import: cache/ is stdlib-only; sim/ keeps its closure
+        # explicit the way tune.py's models import does
+        if planner is None:
+            from ..cache import SpillFetchPlanner
+
+            planner = SpillFetchPlanner()
+        self.planner = planner
+        self.store_groups = int(store_groups)
+        self.kv_bytes_per_token = float(kv_bytes_per_token)
+        self._hbm: dict[str, set] = {}  # replica -> advertised groups
+        self._dram: dict = {}  # group -> nbytes, FIFO eviction order
+        self._unreachable: set[str] = set()
+        self._n_auto = 0
+        self.n_fetches = {"dram": 0, "peer": 0}
+        self.n_fallbacks = 0  # group known but unreachable -> prefill
+        self.n_spills = 0
+        self.n_evictions = 0
+        self.n_replica_drops = 0
+        self.spill_bytes = 0
+        self.fetch_bytes = 0
+        self._registry = registry
+        self._m_fetch: dict = {}
+        if registry is not None:
+            self._m_spill = registry.counter(
+                "cache_spill_bytes_total",
+                help="bytes of prefix pages absorbed by the host-DRAM "
+                "spill tier",
+            )
+            self._m_size = registry.gauge(
+                "cache_directory_size",
+                help="advertised prefix locations fleet-wide "
+                "(hbm + dram)",
+            )
+        else:
+            self._m_spill = None
+            self._m_size = None
+
+    # -- membership ------------------------------------------------------
+
+    def register(self, replica) -> str:
+        """A SimReplica joins; returns its fleet name (``"s<n>"``)."""
+        name = f"s{self._n_auto}"
+        self._n_auto += 1
+        self._hbm[name] = set()
+        return name
+
+    def drop_replica(self, name: str) -> None:
+        """Replica death: its HBM advertisements vanish with the
+        device memory; its DRAM spills survive (host-side state — the
+        whole point of the spill tier)."""
+        if self._hbm.pop(name, None) is not None:
+            self.n_replica_drops += 1
+        self._unreachable.discard(name)
+        self._set_size()
+
+    def partition(self, name: str) -> None:
+        self._unreachable.add(name)
+
+    def heal(self, name: str) -> None:
+        self._unreachable.discard(name)
+
+    # -- residency mirror ------------------------------------------------
+
+    def publish_hbm(self, name: str, group) -> None:
+        """First holder of ``group`` landed on ``name``: advertise its
+        HBM residency fleet-wide."""
+        self._hbm.setdefault(name, set()).add(group)
+        self._set_size()
+
+    def residency_lost(self, name: str, group, prefix_len: int) -> float:
+        """Last holder of ``group`` left ``name``: withdraw the HBM
+        advertisement and, when no sibling still holds the group and
+        the DRAM tier has room policy for it, spill it there. Returns
+        the priced spill seconds (0.0 when nothing moved) — the
+        replica charges them to its next busy tick, the sim's
+        device→host DMA."""
+        groups = self._hbm.get(name)
+        if groups is not None:
+            groups.discard(group)
+        self._set_size()
+        if self.store_groups == 0 or group in self._dram:
+            return 0.0
+        for held in self._hbm.values():
+            if group in held:  # a sibling still serves it from HBM
+                return 0.0
+        nbytes = int(prefix_len * self.kv_bytes_per_token)
+        if nbytes < 1:
+            return 0.0
+        while len(self._dram) >= self.store_groups:
+            oldest = next(iter(self._dram))
+            del self._dram[oldest]
+            self.n_evictions += 1
+        self._dram[group] = nbytes
+        self.n_spills += 1
+        self.spill_bytes += nbytes
+        if self._m_spill is not None:
+            self._m_spill.inc(nbytes)
+        self._set_size()
+        return self.planner.price(nbytes, "spill")
+
+    # -- lookup ----------------------------------------------------------
+
+    def fetch(self, group, prefix_len: int, *,
+              exclude: str | None = None):
+        """``("dram" | "peer", priced_seconds)`` for a reachable copy
+        of ``group``, or None (prefill the chunks). DRAM wins over
+        peer like the live hub; a partitioned asker (``exclude``) sees
+        nothing at all — it cannot reach the store host either."""
+        nbytes = int(prefix_len * self.kv_bytes_per_token)
+        if nbytes < 1:
+            return None
+        if exclude is not None and exclude in self._unreachable:
+            if self._known(group, exclude):
+                self.n_fallbacks += 1
+            return None
+        if group in self._dram:
+            return self._hit("dram", "fetch_dram", nbytes)
+        for name, held in self._hbm.items():
+            if name == exclude or name in self._unreachable:
+                continue
+            if group in held:
+                return self._hit("peer", "fetch_peer", nbytes)
+        if self._known(group, exclude):
+            self.n_fallbacks += 1
+        return None
+
+    def _hit(self, src: str, kind: str, nbytes: int):
+        self.n_fetches[src] += 1
+        self.fetch_bytes += nbytes
+        if self._registry is not None:
+            m = self._m_fetch.get(src)
+            if m is None:
+                m = self._registry.counter(
+                    "cache_fetch_bytes_total",
+                    help="bytes of prefix pages served by the fleet "
+                    "cache instead of re-prefill",
+                    src=src,
+                )
+                self._m_fetch[src] = m
+            m.inc(nbytes)
+        return (src, self.planner.price(nbytes, kind))
+
+    def _known(self, group, exclude: str | None = None) -> bool:
+        """Is ``group`` advertised anywhere OTHER than ``exclude``?
+        A miss on a group only the asker itself ever held is a cold
+        miss, not a fallback — fallbacks name copies that existed and
+        could not be reached."""
+        if group in self._dram:
+            return True
+        for name, held in self._hbm.items():
+            if name == exclude:
+                continue
+            if group in held:
+                return True
+        return False
+
+    def _set_size(self) -> None:
+        if self._m_size is not None:
+            self._m_size.set(
+                len(self._dram)
+                + sum(len(h) for h in self._hbm.values())
+            )
+
+    # -- bookkeeping -----------------------------------------------------
+
+    def check(self) -> None:
+        if len(self._dram) > self.store_groups:
+            raise AssertionError(
+                f"DRAM tier over capacity: {len(self._dram)} > "
+                f"{self.store_groups}"
+            )
+        for name in self._unreachable:
+            if name not in self._hbm:
+                raise AssertionError(
+                    f"unreachable set holds unknown replica {name!r}"
+                )
+
+    def stats(self) -> dict:
+        return {
+            "replicas": list(self._hbm),
+            "unreachable": sorted(self._unreachable),
+            "hbm_groups": sum(len(h) for h in self._hbm.values()),
+            "dram_groups": len(self._dram),
+            "fetches": dict(self.n_fetches),
+            "fallbacks": self.n_fallbacks,
+            "spills": self.n_spills,
+            "evictions": self.n_evictions,
+            "replica_drops": self.n_replica_drops,
+            "spill_bytes": self.spill_bytes,
+            "fetch_bytes": self.fetch_bytes,
+        }
+
+    def __repr__(self) -> str:
+        return (
+            f"SimFleetCache({len(self._hbm)} replicas, "
+            f"dram={len(self._dram)}/{self.store_groups})"
+        )
+
+
 class SimReplica:
     """A :class:`~..models.serving.ServingScheduler` timing model on
     virtual time — the router's replica protocol (submit / step /
@@ -722,7 +964,8 @@ class SimReplica:
                  chunk_s: float = 0.0,
                  kv_bytes_per_token: float = 4096.0,
                  page_tokens: int = 16, qos=None,
-                 max_queue: int | None = None, trace=None):
+                 max_queue: int | None = None, trace=None,
+                 cache: "SimFleetCache | None" = None):
         if slots < 1 or n_inner < 1 or prompt_chunk < 1:
             raise ValueError(
                 "slots, n_inner and prompt_chunk must be >= 1"
@@ -791,6 +1034,16 @@ class SimReplica:
         # intervals scheduled while busy) — the numerator of the QoS
         # plane's work-conservation floor; NOT in any digest
         self.busy_s = 0.0
+        # fleet prefix cache (opt-in): residency transitions mirror
+        # into the shared SimFleetCache; a fleet fetch skips shared
+        # prefill chunks at a priced cost accumulated here and charged
+        # to the next busy tick (like chunk_s, a tick stretch)
+        self.cache = cache
+        self.cache_name: str | None = None
+        self.n_fleet_hits = 0
+        self._xfer_s = 0.0
+        if cache is not None:
+            self.cache_name = cache.register(self)
         # causal tracing (round 22, opt-in per GC004): replica-side
         # events — DRR queue transitions, prefill chunks — stamped on
         # the VIRTUAL clock against trace ids the router minted
@@ -1006,9 +1259,12 @@ class SimReplica:
                     # here and decode continues from n_emitted on the
                     # next tick
                     if p.prefix is not None:
-                        self._resident[p.prefix] = (
-                            self._resident.get(p.prefix, 0) + 1
-                        )
+                        held = self._resident.get(p.prefix, 0)
+                        if held == 0 and self.cache is not None:
+                            self.cache.publish_hbm(
+                                self.cache_name, p.prefix
+                            )
+                        self._resident[p.prefix] = held + 1
                         req._holds_prefix = p.prefix
                     slots[s] = req
                     self._n_active += 1
@@ -1017,12 +1273,27 @@ class SimReplica:
                     continue
                 skip = 0
                 if p.prefix is not None:
-                    if self._resident.get(p.prefix, 0):
+                    held = self._resident.get(p.prefix, 0)
+                    if held:
                         skip = p.prefix_len
                         self.n_shared_admits += 1
-                    self._resident[p.prefix] = (
-                        self._resident.get(p.prefix, 0) + 1
-                    )
+                    elif self.cache is not None:
+                        # local miss: probe the fleet — a DRAM or peer
+                        # hit skips the shared chunks at a priced
+                        # transfer cost instead of re-prefilling them
+                        got = self.cache.fetch(
+                            p.prefix, p.prefix_len,
+                            exclude=self.cache_name,
+                        )
+                        if got is not None:
+                            skip = p.prefix_len
+                            self.n_fleet_hits += 1
+                            self._xfer_s += got[1]
+                    if held == 0 and self.cache is not None:
+                        self.cache.publish_hbm(
+                            self.cache_name, p.prefix
+                        )
+                    self._resident[p.prefix] = held + 1
                     req._holds_prefix = p.prefix
                 chunks = max(-(-(p.length - skip) // self.C), 1)
                 slots[s] = req
@@ -1073,6 +1344,12 @@ class SimReplica:
                 # scheduler's per-admitting-slot _extend cost, the
                 # contention disaggregation removes
                 dt += self.chunk_s * n_chunks
+            if self._xfer_s:
+                # fleet-cache page movement (fetches this tick, spills
+                # from the last retires): the modeled DMA/ring seconds
+                # stretch this tick the same way prefill work does
+                dt += self._xfer_s
+                self._xfer_s = 0.0
             self.next_tick_at = now + dt
             self.busy_s += dt
         else:
@@ -1100,6 +1377,13 @@ class SimReplica:
                 self._resident[g] = left
             else:
                 self._resident.pop(g, None)
+                if self.cache is not None:
+                    # last holder gone: the fleet withdraws the HBM
+                    # advertisement and may spill the group to DRAM —
+                    # the priced cost lands on the next busy tick
+                    self._xfer_s += self.cache.residency_lost(
+                        self.cache_name, g, req.prompt.prefix_len
+                    )
 
     # -- fault injection --------------------------------------------------
 
@@ -1115,10 +1399,19 @@ class SimReplica:
         self._prefill = [0] * self.S
         self._n_active = 0
         self._resident.clear()
+        self._xfer_s = 0.0
+        if self.cache is not None:
+            # device memory died with the process: HBM advertisements
+            # purge; DRAM spills survive for the fleet
+            self.cache.drop_replica(self.cache_name)
         self.next_tick_at = None
 
     def revive(self) -> None:
         self.alive = True
+        if self.cache is not None:
+            # a respawn is a NEW fleet identity (the live directory's
+            # generation bump): stale advertisements can never revive
+            self.cache_name = self.cache.register(self)
 
     def __repr__(self) -> str:
         return (

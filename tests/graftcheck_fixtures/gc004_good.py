@@ -198,3 +198,17 @@ def window_roll(now, series=None, slo=None):
         slo.maybe_roll(now)
     ok = slo is not None and slo.fast_burn_firing()
     return now if ok else None
+
+
+def cache_publish(digest, registry=None, flight=None):
+    """The round-25 fleet-cache shape, guarded: the directory-size
+    gauge, the spill/fetch byte counters with their src label, and
+    the spill flight instant all live inside is-not-None arms
+    (cache/directory.py + cache/store.py discipline — a dark fleet
+    cache spills and fetches with zero observability cost)."""
+    if registry is not None:
+        registry.gauge("cache_directory_size").set(digest)
+        registry.counter("cache_spill_bytes_total").inc(0)
+        registry.counter("cache_fetch_bytes_total", src="dram").inc(0)
+    ok = flight is not None and flight.event("page spilled")
+    return digest if ok else None

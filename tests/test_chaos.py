@@ -480,6 +480,7 @@ _SMALL = {
     "correlated_host_kill": {"n": 1200},
     "prefix_churn": {"steps": 800},
     "storm_with_host_kill": {"n": 1800},
+    "partition_mid_fetch": {"n": 1200},
 }
 
 

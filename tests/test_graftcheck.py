@@ -67,7 +67,8 @@ def _keys(findings):
                           ("GC004", 89), ("GC004", 90),
                           ("GC004", 98), ("GC004", 99),
                           ("GC004", 106),
-                          ("GC004", 113), ("GC004", 114)]),
+                          ("GC004", 113), ("GC004", 114),
+                          ("GC004", 122), ("GC004", 123)]),
         (
             "gc005_bad.py",
             [("GC005", 17), ("GC005", 18), ("GC005", 21),
@@ -183,7 +184,8 @@ def test_baseline_roundtrip(tmp_path):
                                 ("GC004", 89), ("GC004", 90),
                                 ("GC004", 98), ("GC004", 99),
                                 ("GC004", 106),
-                                ("GC004", 113), ("GC004", 114)]
+                                ("GC004", 113), ("GC004", 114),
+                                ("GC004", 122), ("GC004", 123)]
     assert res.baseline_size == 1
 
 
@@ -716,7 +718,7 @@ def test_cli_sarif_report(tmp_path):
         if any(s["kind"] == "external"
                for s in x.get("suppressions", []))
     ]
-    assert len(plain) == 23 and len(external) == 1
+    assert len(plain) == 25 and len(external) == 1
     loc = external[0]["locations"][0]["physicalLocation"]
     assert loc["region"]["startLine"] == 6
     assert loc["artifactLocation"]["uriBaseId"] == "SRCROOT"

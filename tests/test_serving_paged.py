@@ -252,7 +252,8 @@ def test_page_pool_metrics_exported():
     r2 = sched.submit(p, max_new=8)
     sched.run()
     assert r1.finished and r2.finished
-    assert (reg.counter("serving_prefix_share_hits_total").value
+    assert (reg.counter("serving_prefix_share_hits_total",
+                        tier="hbm").value
             == sched.pool.share_hits > 0)
     assert (reg.counter("serving_cow_copies_total").value
             == sched.pool.cow_copies)
